@@ -1,0 +1,60 @@
+"""Test harness setup: force a clean 8-virtual-device CPU JAX.
+
+Two things make this non-trivial in the build container:
+- the axon sitecustomize (PYTHONPATH=/root/.axon_site) registers a TPU PJRT
+  plugin in every python process; when its tunnel is unhealthy, *any* JAX
+  backend init hangs — even under JAX_PLATFORMS=cpu — so the axon backend
+  factory is deregistered outright before any backend initialises;
+- --xla_force_host_platform_device_count must be in XLA_FLAGS before the CPU
+  client is created (it is created lazily, so setting it at conftest import
+  time is early enough).
+
+This is the "fake backend" strategy of SURVEY.md §4: the reference only has
+live-cluster smoke tests; unit tests against an 8-virtual-device CPU mesh are
+one of the things this framework adds.
+"""
+
+import dataclasses
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:  # deregister the axon TPU tunnel backend (may hang when tunnel is down)
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    assert jax.default_backend() == "cpu"
+    assert jax.device_count() == 8, (
+        "tests expect 8 virtual CPU devices (xla_force_host_platform_device_count)")
+    yield
+
+
+@pytest.fixture(scope="session")
+def fp32_tiny_qwen3():
+    from tpuserve.models.config import get_model_config
+    return dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
+
+
+@pytest.fixture(scope="session")
+def fp32_tiny_llama():
+    from tpuserve.models.config import get_model_config
+    return dataclasses.replace(get_model_config("tiny-llama"), dtype="float32")
+
+
+@pytest.fixture(scope="session")
+def fp32_tiny_opt():
+    from tpuserve.models.config import get_model_config
+    return dataclasses.replace(get_model_config("tiny-opt"), dtype="float32")

@@ -1,0 +1,73 @@
+"""BlockManager: allocation, append, free, refcounting, prefix cache."""
+
+import pytest
+
+from tpuserve.runtime.block_manager import BlockManager
+
+
+def test_allocate_and_slots():
+    bm = BlockManager(num_blocks=8, block_size=4)
+    alloc = bm.allocate("a", list(range(10)))      # 10 tokens -> 3 blocks
+    assert len(alloc.blocks) == 3
+    assert bm.num_free_blocks == 5
+    assert bm.slot_for_token("a", 0) == alloc.blocks[0] * 4
+    assert bm.slot_for_token("a", 9) == alloc.blocks[2] * 4 + 1
+
+
+def test_append_grows_blocks():
+    bm = BlockManager(num_blocks=4, block_size=2)
+    bm.allocate("a", [1, 2])                       # fills one block exactly
+    assert bm.needs_new_block("a")
+    slot = bm.append_slot("a")
+    assert not bm.needs_new_block("a")
+    assert bm.num_free_blocks == 2
+    assert slot // 2 == bm.block_table("a")[1]
+
+
+def test_free_returns_blocks():
+    bm = BlockManager(num_blocks=4, block_size=2, enable_prefix_caching=False)
+    bm.allocate("a", [1, 2, 3])
+    bm.free("a")
+    assert bm.num_free_blocks == 4
+    bm.free("missing")                             # no-op
+
+
+def test_oom_raises():
+    bm = BlockManager(num_blocks=2, block_size=2)
+    bm.allocate("a", [1, 2, 3, 4])
+    with pytest.raises(MemoryError):
+        bm.allocate("b", [1])
+
+
+def test_prefix_cache_hit_and_refcount():
+    bm = BlockManager(num_blocks=8, block_size=2)
+    bm.allocate("a", [1, 2, 3, 4, 5])              # blocks for [1,2],[3,4],[5]
+    a_blocks = bm.block_table("a")
+    shared, cached = bm.lookup_prefix([1, 2, 3, 4, 9])
+    assert cached == 4 and shared == a_blocks[:2]
+    bm.allocate("b", [1, 2, 3, 4, 9], shared_blocks=shared)
+    # shared blocks counted once physically
+    assert bm.num_free_blocks == 8 - 3 - 1         # a used 3, b added only 1
+    # free "a": shared blocks survive (refcount), a's unique block returns
+    bm.free("a")
+    assert bm.num_free_blocks == 8 - 3
+    bm.free("b")
+    assert bm.num_free_blocks == 8
+
+
+def test_prefix_requires_whole_blocks_and_leaves_one_token():
+    bm = BlockManager(num_blocks=8, block_size=4)
+    bm.allocate("a", [1, 2, 3, 4, 5, 6, 7, 8])
+    # identical 8-token prompt: only the first block may be reused (the last
+    # token must be recomputed, so block 2 can't be fully cached)
+    shared, cached = bm.lookup_prefix([1, 2, 3, 4, 5, 6, 7, 8])
+    assert cached == 4 and len(shared) == 1
+    # different first block -> no hit
+    shared, cached = bm.lookup_prefix([9, 2, 3, 4, 5])
+    assert cached == 0 and shared == []
+
+
+def test_prefix_cache_disabled():
+    bm = BlockManager(num_blocks=8, block_size=2, enable_prefix_caching=False)
+    bm.allocate("a", [1, 2, 3, 4])
+    assert bm.lookup_prefix([1, 2, 3, 4]) == ([], 0)

@@ -1,0 +1,174 @@
+"""Engine end-to-end on CPU: continuous batching, stops, preemption,
+prefix caching, determinism."""
+
+import numpy as np
+import pytest
+
+from tpuserve.runtime import (
+    CacheConfig, Engine, EngineConfig, FinishReason, SamplingParams,
+    SchedulerConfig)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_prefill_tokens=256,
+                                  min_prefill_bucket=8, min_decode_bucket=2),
+    )
+    return Engine(cfg)
+
+
+def test_generate_batch(engine):
+    reqs = engine.generate(["Hello world", "The quick brown fox", "a"],
+                           SamplingParams(max_tokens=8, temperature=0.0))
+    assert len(reqs) == 3
+    for r in reqs:
+        assert len(r.output_token_ids) == 8
+        assert r.finish_reason == FinishReason.LENGTH
+        assert r.first_token_time is not None
+
+
+def test_greedy_deterministic_across_batsizes(engine):
+    a = engine.generate(["Hello world"], SamplingParams(max_tokens=6, temperature=0.0))[0]
+    b = engine.generate(["Hello world", "zzz"], SamplingParams(max_tokens=6, temperature=0.0))[0]
+    assert a.output_token_ids == b.output_token_ids
+
+
+def test_sampled_modes(engine):
+    reqs = engine.generate(
+        ["abc", "def"],
+        [SamplingParams(max_tokens=4, temperature=0.7),
+         SamplingParams(max_tokens=4, temperature=0.9, top_k=20, top_p=0.9)])
+    for r in reqs:
+        assert len(r.output_token_ids) == 4
+        assert all(0 <= t < 512 for t in r.output_token_ids)
+
+
+def test_eos_stops(engine):
+    # tiny-qwen3 eos_token_id = 1; force it by making every token eos
+    reqs = engine.generate(["q"], SamplingParams(max_tokens=50, temperature=0.0))
+    r = reqs[0]
+    # either hits eos naturally or max_tokens; both must terminate cleanly
+    assert r.finished or r.finish_reason is not None
+
+
+def test_ignore_eos_runs_to_length(engine):
+    r = engine.generate(["q"], SamplingParams(max_tokens=5, temperature=0.0,
+                                              ignore_eos=True))[0]
+    assert len(r.output_token_ids) == 5
+
+
+def test_empty_prompt_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.add_request(prompt_token_ids=[])
+
+
+def test_too_long_prompt_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.add_request(prompt_token_ids=list(range(10000)))
+
+
+def test_abort(engine):
+    rid = engine.add_request(prompt="hello", params=SamplingParams(max_tokens=4))
+    assert engine.abort_request(rid)
+    assert not engine.abort_request(rid)           # already gone
+    assert not engine.has_work()
+    engine.requests.pop(rid, None)
+
+
+def test_prefix_cache_reuses_blocks(engine):
+    prompt = list(range(10, 26))                    # 16 tokens = 4 full blocks
+    engine.generate([prompt], SamplingParams(max_tokens=2, temperature=0.0))
+    q_before = engine.block_manager.prefix_hits
+    engine.generate([prompt], SamplingParams(max_tokens=2, temperature=0.0))
+    assert engine.block_manager.prefix_hits > q_before
+
+
+def test_preemption_under_tiny_cache():
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=10, max_blocks_per_seq=8),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=64,
+                                  min_prefill_bucket=8, min_decode_bucket=2),
+        enable_prefix_caching=False,
+    )
+    eng = Engine(cfg)
+    reqs = eng.generate([[1, 2, 3, 4, 5, 6, 7]] * 3,
+                        SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True))
+    for r in reqs:
+        assert len(r.output_token_ids) == 12
+    # cache pressure should have forced at least one preemption
+    assert eng.stats.preemptions >= 1
+    assert eng.block_manager.num_seqs() == 0       # everything freed
+
+
+def test_stop_string():
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=32, max_blocks_per_seq=8),
+    )
+    eng = Engine(cfg)
+    # ByteTokenizer decodes ids 3..258 as bytes; force a stop after any text
+    r = eng.generate(["hi"], SamplingParams(max_tokens=30, temperature=0.0,
+                                            ignore_eos=True, stop=("",)))[0]
+    # empty stop string matches immediately after first token
+    assert len(r.output_token_ids) == 1
+    assert r.finish_reason == FinishReason.STOP
+
+
+def test_warmup_compiles(engine):
+    engine.warmup(prefill_buckets=[8], decode_buckets=[2])
+
+
+def test_generate_params_length_mismatch(engine):
+    with pytest.raises(ValueError):
+        engine.generate(["a", "b"], [SamplingParams(max_tokens=2)])
+
+
+def test_penalties_and_seed_and_logprobs(engine):
+    p = SamplingParams(max_tokens=6, temperature=0.8, seed=42,
+                       repetition_penalty=1.3, presence_penalty=0.2,
+                       logprobs=3, ignore_eos=True)
+    a = engine.generate(["seeded"], p)[0]
+    b = engine.generate(["seeded"], p)[0]
+    # per-request seed => reproducible regardless of batch composition
+    assert a.output_token_ids == b.output_token_ids
+    assert len(a.logprobs) == 6
+    assert all(len(e["top"]) == 3 for e in a.logprobs)
+    assert all(e["logprob"] <= 0.0 for e in a.logprobs)
+
+
+def test_prefill_batch_does_not_overcommit_blocks():
+    """Admission must reserve blocks per picked request (regression for
+    collective over-admission crashing allocate())."""
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=8, max_blocks_per_seq=8),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=128,
+                                  min_prefill_bucket=8, min_decode_bucket=2),
+        enable_prefix_caching=False,
+    )
+    eng = Engine(cfg)
+    # each needs 3+1 blocks; only 8 total -> must admit one at a time, not crash
+    outs = eng.generate([[1] * 12, [2] * 12], SamplingParams(max_tokens=2, temperature=0.0))
+    assert all(len(r.output_token_ids) == 2 for r in outs)
+
+
+def test_stop_string_truncated_from_output():
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=32, max_blocks_per_seq=8),
+    )
+    eng = Engine(cfg)
+    # Greedy from this prompt generates a deterministic id stream; find what
+    # text it produces, then stop on a substring of it.
+    free = eng.generate(["hi"], SamplingParams(max_tokens=10, temperature=0.0,
+                                               ignore_eos=True))[0]
+    if len(free.output_text) >= 2:
+        stop_s = free.output_text[1]
+        r = eng.generate(["hi"], SamplingParams(max_tokens=10, temperature=0.0,
+                                                ignore_eos=True, stop=(stop_s,)))[0]
+        assert stop_s not in r.output_text
+        assert r.finish_reason == FinishReason.STOP

@@ -1,0 +1,59 @@
+"""Pallas kernel correctness vs the pure-JAX reference (interpret mode on CPU
+— the fake-backend strategy of SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.ops import attention as ref_ops
+from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
+from tpuserve.ops.pallas_paged_attention import paged_decode_attention
+
+
+@pytest.mark.parametrize("B,T,Hq,Hkv,D,blk", [
+    (2, 64, 4, 2, 16, 32),
+    (1, 128, 8, 8, 64, 128),
+    (2, 48, 4, 4, 32, 32),     # T not a multiple of the block
+])
+def test_flash_prefill_matches_reference(B, T, Hq, Hkv, D, blk):
+    rng = np.random.default_rng(B * T)
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    lens = jnp.asarray(rng.integers(1, T + 1, (B,)), jnp.int32)
+    ref = ref_ops.prefill_attention(q, k, v, lens, D ** -0.5)
+    out = flash_prefill_attention(q, k, v, lens, D ** -0.5, blk_q=blk, blk_k=blk,
+                                  interpret=True)
+    for b in range(B):
+        L = int(lens[b])
+        np.testing.assert_allclose(np.asarray(out[b, :L]), np.asarray(ref[b, :L]),
+                                   atol=2e-5)
+
+
+@pytest.mark.parametrize("B,Hq,Hkv,D,page,nb,mp", [
+    (2, 4, 2, 16, 4, 16, 4),
+    (3, 8, 8, 64, 16, 32, 8),
+    (1, 16, 2, 128, 32, 64, 4),
+])
+def test_paged_decode_matches_reference(B, Hq, Hkv, D, page, nb, mp):
+    rng = np.random.default_rng(B + Hq)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(nb)[:B * mp].reshape(B, mp), jnp.int32)
+    sl = jnp.asarray(rng.integers(1, page * mp + 1, (B,)), jnp.int32)
+    ref = ref_ops.paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_single_token_sequence():
+    # seq_len == 1: only the freshly written token is attended to.
+    D = 16
+    q = jnp.ones((1, 2, D), jnp.float32)
+    kc = jnp.zeros((4, 4, 2, D), jnp.float32).at[2, 0].set(1.0)
+    vc = jnp.zeros((4, 4, 2, D), jnp.float32).at[2, 0].set(7.0)
+    bt = jnp.asarray([[2, 0]], jnp.int32)
+    sl = jnp.asarray([1], jnp.int32)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), 7.0, atol=1e-5)

@@ -1,0 +1,209 @@
+"""Model family tests: config registry/HF parsing, forward/prefill/decode
+parity, HF checkpoint name-mapping."""
+
+import dataclasses
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.models import transformer, weights
+from tpuserve.models.config import (
+    config_from_hf_json, get_model_config, list_model_configs)
+from tpuserve.ops.attention import PAD_SLOT
+
+
+def test_registry_has_tracked_configs():
+    # The five tracked configs from BASELINE.json.
+    for name in ("qwen3-0.6b", "qwen2-72b", "llama3-8b", "phi3-mini", "opt-1.3b"):
+        cfg = get_model_config(name)
+        assert cfg.num_layers > 0
+    assert "Qwen/Qwen3-0.6B" in list_model_configs()
+
+
+def test_qwen3_preset_shape_math():
+    cfg = get_model_config("qwen3-0.6b")
+    assert cfg.q_size == 2048 and cfg.kv_size == 1024
+    assert cfg.qk_norm and cfg.tie_word_embeddings
+    # ~0.6B params (embedding-heavy model)
+    assert 0.4e9 < cfg.num_params < 0.8e9
+
+
+def test_hf_config_parsing_llama_family():
+    hf = dict(model_type="qwen3", architectures=["Qwen3ForCausalLM"],
+              vocab_size=1000, hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, head_dim=16, rope_theta=1e6,
+              rms_norm_eps=1e-6, tie_word_embeddings=True,
+              max_position_embeddings=2048, eos_token_id=[7, 8])
+    cfg = config_from_hf_json("x", hf)
+    assert cfg.qk_norm and cfg.num_kv_heads == 2 and cfg.head_dim == 16
+    assert cfg.eos_token_id == 7
+
+
+def test_hf_config_parsing_opt():
+    hf = dict(model_type="opt", vocab_size=100, hidden_size=32, ffn_dim=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              max_position_embeddings=128, eos_token_id=2)
+    cfg = config_from_hf_json("opt", hf)
+    assert cfg.pos == "learned" and cfg.learned_pos_offset == 2
+    assert cfg.mlp_style == "mlp" and cfg.act == "relu" and cfg.norm == "layernorm"
+
+
+@pytest.mark.parametrize("fixture_name", ["fp32_tiny_qwen3", "fp32_tiny_llama", "fp32_tiny_opt"])
+def test_prefill_decode_matches_forward(fixture_name, request):
+    """Paged prefill + decode must reproduce the plain forward pass."""
+    cfg = request.getfixturevalue(fixture_name)
+    params = weights.init_params(cfg)
+    tokens = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], jnp.int32)
+    B, T, bs, nb = 2, 4, 4, 8
+    cache = [{"k": jnp.zeros((nb, bs, cfg.num_kv_heads, cfg.head_dim), jnp.float32),
+              "v": jnp.zeros((nb, bs, cfg.num_kv_heads, cfg.head_dim), jnp.float32)}
+             for _ in range(cfg.num_layers)]
+    prompt_lens = jnp.asarray([4, 2])
+    slots = np.full((B, T), PAD_SLOT, np.int32)
+    for b in range(B):
+        for t in range(int(prompt_lens[b])):
+            slots[b, t] = [0, 2][b] * bs + t
+    logits_p, cache = transformer.prefill(params, cfg, tokens, prompt_lens,
+                                          jnp.asarray(slots), cache)
+    full = transformer.forward(params, cfg, tokens, prompt_lens)
+    np.testing.assert_allclose(np.asarray(logits_p[0]), np.asarray(full[0, 3]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits_p[1]), np.asarray(full[1, 1]), atol=1e-4)
+
+    bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    logits_d, cache = transformer.decode_step(
+        params, cfg, jnp.asarray([7, 9], jnp.int32), jnp.asarray([4, 2], jnp.int32),
+        jnp.asarray([1 * bs, 2 * bs + 2], jnp.int32), bt, jnp.asarray([5, 3], jnp.int32),
+        cache)
+    full2 = transformer.forward(
+        params, cfg, jnp.asarray([[1, 2, 3, 4, 7, 0], [5, 6, 9, 0, 0, 0]], jnp.int32),
+        jnp.asarray([5, 3]))
+    np.testing.assert_allclose(np.asarray(logits_d[0]), np.asarray(full2[0, 4]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(logits_d[1]), np.asarray(full2[1, 2]), atol=1e-4)
+
+
+def _save_safetensors(path, tensors):
+    from safetensors.numpy import save_file
+    save_file(tensors, path)
+
+
+def test_hf_checkpoint_loading_llama_names(tmp_path, fp32_tiny_llama):
+    """Round-trip: write an HF-named checkpoint, load, compare vs direct params."""
+    cfg = fp32_tiny_llama
+    rng = np.random.default_rng(0)
+    raw = {"model.embed_tokens.weight": rng.standard_normal(
+        (cfg.vocab_size, cfg.hidden_size)).astype(np.float32),
+        "model.norm.weight": np.ones(cfg.hidden_size, np.float32),
+        "lm_head.weight": rng.standard_normal(
+            (cfg.vocab_size, cfg.hidden_size)).astype(np.float32)}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        raw[p + "input_layernorm.weight"] = np.ones(cfg.hidden_size, np.float32)
+        raw[p + "post_attention_layernorm.weight"] = np.ones(cfg.hidden_size, np.float32)
+        raw[p + "self_attn.q_proj.weight"] = rng.standard_normal(
+            (cfg.q_size, cfg.hidden_size)).astype(np.float32)
+        raw[p + "self_attn.k_proj.weight"] = rng.standard_normal(
+            (cfg.kv_size, cfg.hidden_size)).astype(np.float32)
+        raw[p + "self_attn.v_proj.weight"] = rng.standard_normal(
+            (cfg.kv_size, cfg.hidden_size)).astype(np.float32)
+        raw[p + "self_attn.o_proj.weight"] = rng.standard_normal(
+            (cfg.hidden_size, cfg.q_size)).astype(np.float32)
+        raw[p + "mlp.gate_proj.weight"] = rng.standard_normal(
+            (cfg.intermediate_size, cfg.hidden_size)).astype(np.float32)
+        raw[p + "mlp.up_proj.weight"] = rng.standard_normal(
+            (cfg.intermediate_size, cfg.hidden_size)).astype(np.float32)
+        raw[p + "mlp.down_proj.weight"] = rng.standard_normal(
+            (cfg.hidden_size, cfg.intermediate_size)).astype(np.float32)
+    _save_safetensors(str(tmp_path / "model.safetensors"), raw)
+    params = weights.load_hf_checkpoint(cfg, str(tmp_path))
+    # kernels are transposed HF weights
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["q_proj"]["kernel"]),
+        raw["model.layers.0.self_attn.q_proj.weight"].T)
+    np.testing.assert_array_equal(
+        np.asarray(params["lm_head"]["kernel"]),
+        raw["lm_head.weight"].T)
+    logits = transformer.forward(params, cfg, jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_hf_checkpoint_loading_phi3_fused(tmp_path):
+    """Phi-3 stores fused qkv_proj / gate_up_proj — loader must split them."""
+    from tpuserve.models.config import ModelConfig
+    cfg = ModelConfig(name="tiny-phi", vocab_size=64, hidden_size=32,
+                      intermediate_size=48, num_layers=1, num_heads=4,
+                      num_kv_heads=4, head_dim=8, tie_word_embeddings=False,
+                      dtype="float32")
+    rng = np.random.default_rng(1)
+    qkv = rng.standard_normal((cfg.q_size + 2 * cfg.kv_size, cfg.hidden_size)).astype(np.float32)
+    gu = rng.standard_normal((2 * cfg.intermediate_size, cfg.hidden_size)).astype(np.float32)
+    raw = {
+        "model.embed_tokens.weight": rng.standard_normal((64, 32)).astype(np.float32),
+        "model.norm.weight": np.ones(32, np.float32),
+        "lm_head.weight": rng.standard_normal((64, 32)).astype(np.float32),
+        "model.layers.0.input_layernorm.weight": np.ones(32, np.float32),
+        "model.layers.0.post_attention_layernorm.weight": np.ones(32, np.float32),
+        "model.layers.0.self_attn.qkv_proj.weight": qkv,
+        "model.layers.0.self_attn.o_proj.weight": rng.standard_normal(
+            (32, cfg.q_size)).astype(np.float32),
+        "model.layers.0.mlp.gate_up_proj.weight": gu,
+        "model.layers.0.mlp.down_proj.weight": rng.standard_normal(
+            (32, 48)).astype(np.float32),
+    }
+    _save_safetensors(str(tmp_path / "model.safetensors"), raw)
+    params = weights.load_hf_checkpoint(cfg, str(tmp_path))
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["q_proj"]["kernel"]), qkv[:cfg.q_size].T)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["k_proj"]["kernel"]),
+        qkv[cfg.q_size:cfg.q_size + cfg.kv_size].T)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["gate_proj"]["kernel"]),
+        gu[:cfg.intermediate_size].T)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"][0]["up_proj"]["kernel"]),
+        gu[cfg.intermediate_size:].T)
+
+
+def test_hf_checkpoint_loading_opt_names(tmp_path, fp32_tiny_opt):
+    cfg = fp32_tiny_opt
+    rng = np.random.default_rng(2)
+    h, q = cfg.hidden_size, cfg.q_size
+    raw = {
+        "model.decoder.embed_tokens.weight": rng.standard_normal(
+            (cfg.vocab_size, h)).astype(np.float32),
+        "model.decoder.embed_positions.weight": rng.standard_normal(
+            (cfg.max_position_embeddings + 2, h)).astype(np.float32),
+        "model.decoder.final_layer_norm.weight": np.ones(h, np.float32),
+        "model.decoder.final_layer_norm.bias": np.zeros(h, np.float32),
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.decoder.layers.{i}."
+        for nm in ("self_attn_layer_norm", "final_layer_norm"):
+            raw[p + nm + ".weight"] = np.ones(h, np.float32)
+            raw[p + nm + ".bias"] = np.zeros(h, np.float32)
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            raw[p + f"self_attn.{proj}.weight"] = rng.standard_normal((q, h) if proj != "out_proj" else (h, q)).astype(np.float32)
+            raw[p + f"self_attn.{proj}.bias"] = np.zeros(q if proj != "out_proj" else h, np.float32)
+        raw[p + "fc1.weight"] = rng.standard_normal((cfg.intermediate_size, h)).astype(np.float32)
+        raw[p + "fc1.bias"] = np.zeros(cfg.intermediate_size, np.float32)
+        raw[p + "fc2.weight"] = rng.standard_normal((h, cfg.intermediate_size)).astype(np.float32)
+        raw[p + "fc2.bias"] = np.zeros(h, np.float32)
+    _save_safetensors(str(tmp_path / "model.safetensors"), raw)
+    params = weights.load_hf_checkpoint(cfg, str(tmp_path))
+    assert "pos_embed" in params and "lm_head" not in params  # OPT ties embeddings
+    logits = transformer.forward(params, cfg, jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_get_model_config_from_checkpoint_dir(tmp_path):
+    cfg_json = dict(model_type="llama", vocab_size=128, hidden_size=32,
+                    intermediate_size=64, num_hidden_layers=1,
+                    num_attention_heads=4, num_key_value_heads=4,
+                    rms_norm_eps=1e-5, max_position_embeddings=256)
+    (tmp_path / "config.json").write_text(json.dumps(cfg_json))
+    cfg = get_model_config(str(tmp_path))
+    assert cfg.hidden_size == 32 and cfg.head_dim == 8

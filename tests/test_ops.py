@@ -1,0 +1,165 @@
+"""Unit tests for tpuserve.ops (rope, attention reference, sampling)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.ops import rope as rope_ops
+from tpuserve.ops import sampling as sampling_ops
+from tpuserve.ops.attention import (
+    PAD_SLOT, paged_decode_attention, prefill_attention, write_kv_cache)
+
+
+def test_rope_rotation_preserves_norm():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 3, 4, 16)), jnp.float32)
+    pos = jnp.arange(3)[None, :].repeat(2, axis=0)
+    cos, sin = rope_ops.rope_freqs(pos, 16, 10000.0)
+    y = rope_ops.apply_rope(x, cos, sin)
+    assert y.shape == x.shape
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(x), axis=-1),
+                               np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+
+
+def test_rope_position_zero_is_identity():
+    x = jnp.ones((1, 1, 2, 8), jnp.float32)
+    cos, sin = rope_ops.rope_freqs(jnp.zeros((1, 1), jnp.int32), 8, 10000.0)
+    y = rope_ops.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+
+def test_partial_rotary_passthrough():
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 2, 2, 16)), jnp.float32)
+    pos = jnp.arange(2)[None, :]
+    cos, sin = rope_ops.rope_freqs(pos, 16, 10000.0, rotary_dim=8)
+    y = rope_ops.apply_rope(x, cos, sin)
+    np.testing.assert_array_equal(np.asarray(y[..., 8:]), np.asarray(x[..., 8:]))
+
+
+def test_prefill_attention_causal_and_padding():
+    rng = np.random.default_rng(2)
+    B, T, H, D = 2, 8, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    lens = jnp.asarray([8, 3])
+    out = prefill_attention(q, k, v, lens, D ** -0.5)
+    # row 0 attends only to itself
+    expected0 = v[0, 0]
+    np.testing.assert_allclose(np.asarray(out[0, 0]), np.asarray(expected0), atol=1e-5)
+    # changing k/v beyond the prompt len must not affect valid outputs
+    k2 = k.at[1, 3:].set(99.0)
+    v2 = v.at[1, 3:].set(99.0)
+    out2 = prefill_attention(q, k2, v2, lens, D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out[1, :3]), np.asarray(out2[1, :3]), atol=1e-5)
+
+
+def test_paged_decode_matches_dense():
+    rng = np.random.default_rng(3)
+    B, Hq, Hkv, D, page, nb, mp = 2, 4, 2, 16, 4, 16, 4
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(nb)[:B * mp].reshape(B, mp), jnp.int32)
+    sl = jnp.asarray([7, 13], jnp.int32)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5)
+    for b in range(B):
+        S = mp * page
+        kk = np.asarray(kc)[np.asarray(bt)[b]].reshape(S, Hkv, D)
+        vv = np.asarray(vc)[np.asarray(bt)[b]].reshape(S, Hkv, D)
+        kk = np.repeat(kk, Hq // Hkv, axis=1)
+        vv = np.repeat(vv, Hq // Hkv, axis=1)
+        L = int(sl[b])
+        s = np.einsum("hd,khd->hk", np.asarray(q)[b], kk[:L]) * D ** -0.5
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("hk,khd->hd", p, vv[:L])
+        np.testing.assert_allclose(np.asarray(out[b]), o, atol=1e-5)
+
+
+def test_write_kv_cache_scatter_and_pad_drop():
+    cache = jnp.zeros((4, 2, 1, 3), jnp.float32)
+    new = jnp.ones((2, 1, 3), jnp.float32)
+    slots = jnp.asarray([5, PAD_SLOT], jnp.int32)     # slot 5 = block 2, offset 1
+    out = write_kv_cache(cache, new, slots)
+    assert float(out[2, 1, 0, 0]) == 1.0
+    assert float(jnp.abs(out).sum()) == 3.0           # pad write dropped
+
+
+def _keys(B, seed=0):
+    return jnp.asarray(np.asarray(jax.random.split(jax.random.PRNGKey(seed), B),
+                                  dtype=np.uint32))
+
+
+def test_sampling_greedy():
+    logits = jnp.asarray([[0.0, 5.0, 1.0], [2.0, 0.0, -1.0]], jnp.float32)
+    toks = sampling_ops.sample_tokens(
+        logits, _keys(2), jnp.zeros((2,)), jnp.zeros((2,), jnp.int32),
+        jnp.ones((2,)), mode="greedy")
+    assert list(np.asarray(toks)) == [1, 0]
+
+
+def test_sampling_topk_restricts_support():
+    logits = jnp.asarray(np.linspace(0, 5, 16)[None, :].repeat(64, 0), jnp.float32)
+    toks = sampling_ops.sample_tokens(
+        logits, _keys(64, 1), jnp.ones((64,)) * 1.0,
+        jnp.full((64,), 2, jnp.int32), jnp.ones((64,)), mode="full")
+    assert set(np.asarray(toks).tolist()) <= {14, 15}
+
+
+def test_sampling_topp_restricts_support():
+    # one dominant token (p ~ .97) => top_p=0.5 keeps only it
+    logits = jnp.zeros((32, 8), jnp.float32).at[:, 3].set(5.0)
+    toks = sampling_ops.sample_tokens(
+        logits, _keys(32, 2), jnp.ones((32,)),
+        jnp.zeros((32,), jnp.int32), jnp.full((32,), 0.5), mode="full")
+    assert set(np.asarray(toks).tolist()) == {3}
+
+
+def test_sampling_temperature_zero_is_greedy_in_all_modes():
+    logits = jnp.asarray([[0.0, 3.0, 1.0]], jnp.float32)
+    for mode in ("temperature", "full"):
+        toks = sampling_ops.sample_tokens(
+            logits, _keys(1, 3), jnp.zeros((1,)),
+            jnp.zeros((1,), jnp.int32), jnp.ones((1,)), mode=mode)
+        assert int(toks[0]) == 1
+
+
+def test_sampling_per_row_keys_deterministic():
+    """A row's sample depends only on its own key, not batch position."""
+    V = 32
+    rng = np.random.default_rng(4)
+    row = jnp.asarray(rng.standard_normal((1, V)), jnp.float32)
+    key_row = jnp.asarray([[123, 7]], jnp.uint32)
+    alone = sampling_ops.sample_tokens(
+        row, key_row, jnp.ones((1,)), jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,)), mode="temperature")
+    batched_logits = jnp.concatenate([jnp.asarray(rng.standard_normal((3, V)), jnp.float32), row])
+    keys = jnp.concatenate([_keys(3, 9), key_row])
+    batched = sampling_ops.sample_tokens(
+        batched_logits, keys, jnp.ones((4,)), jnp.zeros((4,), jnp.int32),
+        jnp.ones((4,)), mode="temperature")
+    assert int(alone[0]) == int(batched[3])
+
+
+def test_compute_logprobs():
+    logits = jnp.asarray([[0.0, 2.0, 1.0]], jnp.float32)
+    chosen_lp, top_ids, top_lps = sampling_ops.compute_logprobs(
+        logits, jnp.asarray([1], jnp.int32), top_n=2)
+    probs = np.exp(np.asarray(logits[0]) - np.log(np.exp(np.asarray(logits[0])).sum()))
+    np.testing.assert_allclose(float(chosen_lp[0]), np.log(probs[1]), rtol=1e-5)
+    assert list(np.asarray(top_ids[0])) == [1, 2]
+
+
+def test_logit_penalties():
+    logits = jnp.zeros((1, 6), jnp.float32)
+    out_tokens = jnp.asarray([[2, 2, 4]], jnp.int32)
+    mask = jnp.asarray([[True, True, True]])
+    out = sampling_ops.apply_logit_penalties(
+        logits, out_tokens, mask,
+        presence_penalty=jnp.asarray([0.5]),
+        frequency_penalty=jnp.asarray([0.25]),
+        repetition_penalty=jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               [0, 0, -(0.5 + 2 * 0.25), 0, -(0.5 + 0.25), 0],
+                               atol=1e-6)

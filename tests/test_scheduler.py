@@ -1,0 +1,88 @@
+"""Scheduler: buckets, admission, prefill batching, preemption."""
+
+from tpuserve.runtime.block_manager import BlockManager
+from tpuserve.runtime.request import Request, SamplingParams
+from tpuserve.runtime.scheduler import Scheduler, SchedulerConfig
+
+
+def mk_req(rid, n_tokens):
+    return Request(request_id=rid, prompt_token_ids=list(range(1, n_tokens + 1)),
+                   params=SamplingParams())
+
+
+def mk_sched(**kw):
+    cfg = SchedulerConfig(**{**dict(max_num_seqs=4, max_prefill_tokens=64,
+                                    max_prefill_seqs=4, min_prefill_bucket=8,
+                                    min_decode_bucket=2), **kw})
+    bm = BlockManager(num_blocks=32, block_size=4)
+    return Scheduler(cfg, bm, max_model_len=128), bm
+
+
+def test_prefill_before_decode():
+    s, bm = mk_sched()
+    s.add(mk_req("a", 5))
+    batch = s.schedule()
+    assert batch.kind == "prefill" and batch.padded_len == 8
+    bm.allocate("a", batch.requests[0].prompt_token_ids)
+    s.mark_running(batch.requests)
+    batch = s.schedule()
+    assert batch.kind == "decode" and batch.padded_batch == 2
+
+
+def test_prefill_token_budget_limits_batch():
+    s, _ = mk_sched(max_prefill_tokens=32)
+    for i in range(4):
+        s.add(mk_req(f"r{i}", 20))                 # bucket 32 each
+    batch = s.schedule()
+    assert batch.kind == "prefill" and len(batch.requests) == 1
+
+
+def test_prefill_shared_bucket():
+    s, _ = mk_sched(max_prefill_tokens=64)
+    s.add(mk_req("a", 5))
+    s.add(mk_req("b", 20))
+    batch = s.schedule()
+    # both admitted, padded to the larger bucket (32)
+    assert len(batch.requests) == 2 and batch.padded_len == 32
+
+
+def test_admission_respects_free_blocks():
+    s, bm = mk_sched()
+    bm.allocate("hog", list(range(119)))           # 30 of 32 blocks
+    s.add(mk_req("a", 24))                         # needs 6+1 blocks > 2 free
+    assert s.schedule() is None
+
+
+def test_max_num_seqs_cap():
+    s, bm = mk_sched(max_num_seqs=2)
+    for i in range(3):
+        s.add(mk_req(f"r{i}", 4))
+    batch = s.schedule()
+    assert len(batch.requests) == 2
+    for r in batch.requests:
+        bm.allocate(r.request_id, r.prompt_token_ids)
+    s.mark_running(batch.requests)
+    assert s.schedule().kind == "decode"           # third waits
+
+
+def test_preempt_last_moves_to_waiting_front():
+    s, bm = mk_sched()
+    for rid in ("a", "b"):
+        r = mk_req(rid, 4)
+        bm.allocate(rid, r.prompt_token_ids)
+        s.mark_running([r])
+    victim = s.preempt_last()
+    assert victim.request_id == "b"
+    assert s.waiting[0].request_id == "b"
+    assert s.num_running == 1
+
+
+def test_finish_frees_blocks():
+    s, bm = mk_sched()
+    r = mk_req("a", 8)
+    bm.allocate("a", r.prompt_token_ids)
+    s.mark_running([r])
+    free_before = bm.num_free_blocks
+    s.finish(r)
+    assert bm.num_free_blocks == free_before + 2
+    assert s.num_running == 0

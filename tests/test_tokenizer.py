@@ -1,0 +1,53 @@
+"""Tokenizer, incremental detokenization, chat templates."""
+
+from tpuserve.models.tokenizer import (
+    ByteTokenizer, IncrementalDetokenizer, default_chat_template, load_tokenizer)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    for text in ("hello", "héllo wörld", "日本語", ""):
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_byte_bos_eos():
+    tok = ByteTokenizer()
+    ids = tok.encode("a", add_bos=True)
+    assert ids[0] == tok.bos_id
+    assert tok.eos_id in tok.eos_token_ids
+    assert tok.decode([tok.bos_id, tok.eos_id]) == ""
+
+
+def test_out_of_range_ids_dropped():
+    tok = ByteTokenizer(vocab_size=512)
+    assert tok.decode([400, 500]) == ""
+
+
+def test_incremental_detok_streams_whole_runes():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    ids = tok.encode("héllo")            # 'é' is 2 bytes
+    chunks = [detok.add(i) for i in ids]
+    assert "".join(chunks) == "héllo"
+    # no partial runes ever emitted
+    assert all("�" not in c for c in chunks)
+
+
+def test_default_chat_template():
+    msgs = [{"role": "system", "content": "Be terse."},
+            {"role": "user", "content": "Who are you?"},
+            {"role": "assistant", "content": "A bot."},
+            {"role": "user", "content": "ok"}]
+    text = default_chat_template(msgs)
+    assert text.startswith("Be terse.")
+    assert "User: Who are you?" in text
+    assert "Assistant: A bot." in text
+    assert text.endswith("Assistant:")
+    text2 = default_chat_template(msgs, add_generation_prompt=False)
+    assert not text2.endswith("Assistant:")
+
+
+def test_load_tokenizer_falls_back_to_bytes(tmp_path):
+    tok = load_tokenizer(str(tmp_path), vocab_size=300)
+    assert isinstance(tok, ByteTokenizer)
+    assert tok.vocab_size == 300

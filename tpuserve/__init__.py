@@ -1,0 +1,24 @@
+"""tpuserve — a TPU-native LLM serving framework and cluster provisioner.
+
+A ground-up TPU-first rebuild of the capabilities of
+``lucky95270/aws-k8s-ansible-provisioner`` (see SURVEY.md).  The reference is an
+Ansible/Bash pipeline that provisions an AWS GPU instance, bootstraps
+Kubernetes, and deploys the llm-d/vLLM serving stack
+(reference: deploy-k8s-cluster.sh:1-117).  Here the serving engine itself is a
+first-class, in-repo JAX/XLA stack:
+
+- ``tpuserve.models``     — model definitions (Qwen3/Qwen2/Llama/Phi-3/OPT) and
+                            HF checkpoint loading.
+- ``tpuserve.ops``        — attention (Pallas TPU kernels + pure-JAX reference),
+                            RoPE, sampling.
+- ``tpuserve.runtime``    — paged KV cache, block manager, continuous-batching
+                            scheduler, the serving engine.
+- ``tpuserve.parallel``   — device mesh, tensor-parallel shardings,
+                            disaggregated prefill/decode, fine-tuning step.
+- ``tpuserve.server``     — OpenAI-compatible HTTP server, metrics, gateway.
+- ``tpuserve.provision``  — deploy/cleanup/test CLI mirroring the reference's
+                            deploy-k8s-cluster.sh UX, K8s manifests.
+- ``tpuserve.observability`` — Prometheus/OTEL stack + TPU metrics exporter.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+from tpuserve.models.config import ModelConfig, get_model_config, register_model_config, list_model_configs
+from tpuserve.models import transformer
+
+__all__ = [
+    "ModelConfig",
+    "get_model_config",
+    "register_model_config",
+    "list_model_configs",
+    "transformer",
+]

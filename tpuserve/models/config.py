@@ -1,0 +1,222 @@
+"""Model architecture configs and the model registry.
+
+The reference serves models by name only — the architecture lives inside the
+vLLM container it deploys (reference: llm-d-deploy.yaml:118 pins
+``Qwen/Qwen3-0.6B``; kubernetes-single-node.yaml:15 names Phi-3-mini;
+templates/opt-chat-template.yaml targets facebook/opt-1.3b).  Here the
+architectures are first-class: one ``ModelConfig`` covers the whole
+decoder-only family the framework serves (Qwen3/Qwen2/Llama/Phi-3/OPT), with
+per-family presets plus loading from a HuggingFace ``config.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_position_embeddings: int = 32768
+    # Architecture knobs spanning the supported families.
+    norm: str = "rmsnorm"            # "rmsnorm" | "layernorm"
+    norm_eps: float = 1e-6
+    act: str = "silu"                # "silu" | "gelu" | "relu"
+    mlp_style: str = "gated"         # "gated" (SwiGLU-style) | "mlp" (2-layer)
+    pos: str = "rope"                # "rope" | "learned"
+    rope_theta: float = 10000.0
+    partial_rotary_factor: float = 1.0
+    qk_norm: bool = False            # Qwen3 per-head RMSNorm on q/k
+    attention_bias: bool = False     # Qwen2-style bias on q/k/v projections
+    mlp_bias: bool = False
+    tie_word_embeddings: bool = True
+    learned_pos_offset: int = 0      # OPT stores positions shifted by 2
+    final_layernorm: bool = True
+    bos_token_id: Optional[int] = None
+    eos_token_id: Optional[int] = None
+    dtype: str = "bfloat16"
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings counted once if tied)."""
+        h, i, l, v = self.hidden_size, self.intermediate_size, self.num_layers, self.vocab_size
+        attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
+        mlp = (3 if self.mlp_style == "gated" else 2) * h * i
+        embed = v * h * (1 if self.tie_word_embeddings else 2)
+        return l * (attn + mlp) + embed
+
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register_model_config(cfg: ModelConfig, *aliases: str) -> ModelConfig:
+    for key in (cfg.name, *aliases):
+        _REGISTRY[key.lower()] = cfg
+    return cfg
+
+
+def list_model_configs() -> list[str]:
+    return sorted({c.name for c in _REGISTRY.values()})
+
+
+def get_model_config(name_or_path: str) -> ModelConfig:
+    """Resolve a model by registry name, or by a local HF checkpoint dir."""
+    key = name_or_path.lower()
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    cfg_path = os.path.join(name_or_path, "config.json")
+    if os.path.isfile(cfg_path):
+        return config_from_hf_json(name_or_path, json.load(open(cfg_path)))
+    raise KeyError(
+        f"Unknown model {name_or_path!r}; known: {list_model_configs()} "
+        "or pass a local checkpoint directory containing config.json"
+    )
+
+
+def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
+    """Map a HuggingFace config.json onto ModelConfig for supported families."""
+    arch = (hf.get("architectures") or [""])[0].lower()
+    mt = hf.get("model_type", "").lower()
+    family = mt or arch
+    common = dict(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf.get("num_hidden_layers", hf.get("num_layers")),
+        num_heads=hf.get("num_attention_heads"),
+        max_position_embeddings=hf.get("max_position_embeddings", 32768),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        bos_token_id=hf.get("bos_token_id"),
+        eos_token_id=_first(hf.get("eos_token_id")),
+    )
+    if "opt" in family:
+        common["tie_word_embeddings"] = hf.get("tie_word_embeddings", True)
+        return ModelConfig(
+            intermediate_size=hf["ffn_dim"],
+            num_kv_heads=hf["num_attention_heads"],
+            head_dim=hf["hidden_size"] // hf["num_attention_heads"],
+            norm="layernorm",
+            norm_eps=1e-5,
+            act="relu",
+            mlp_style="mlp",
+            pos="learned",
+            learned_pos_offset=2,
+            attention_bias=True,
+            mlp_bias=True,
+            **common,
+        )
+    # Llama / Qwen2 / Qwen3 / Phi-3 all share the rotary+gated-MLP skeleton.
+    nh = hf["num_attention_heads"]
+    return ModelConfig(
+        intermediate_size=hf["intermediate_size"],
+        num_kv_heads=hf.get("num_key_value_heads", nh),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // nh,
+        norm="rmsnorm",
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        act=hf.get("hidden_act", "silu"),
+        mlp_style="gated",
+        pos="rope",
+        rope_theta=hf.get("rope_theta", 10000.0),
+        partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
+        qk_norm="qwen3" in family,
+        attention_bias="qwen2" in family or hf.get("attention_bias", False),
+        **common,
+    )
+
+
+def _first(x):
+    if isinstance(x, (list, tuple)):
+        return x[0] if x else None
+    return x
+
+
+# --- Presets for the tracked configs (BASELINE.json "configs") -------------
+
+register_model_config(ModelConfig(
+    name="Qwen/Qwen3-0.6B",
+    vocab_size=151936, hidden_size=1024, intermediate_size=3072,
+    num_layers=28, num_heads=16, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=40960, rope_theta=1e6, norm_eps=1e-6,
+    qk_norm=True, tie_word_embeddings=True,
+    bos_token_id=151643, eos_token_id=151645,
+), "qwen3-0.6b")
+
+register_model_config(ModelConfig(
+    name="Qwen/Qwen2-72B-Instruct",
+    vocab_size=152064, hidden_size=8192, intermediate_size=29568,
+    num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=32768, rope_theta=1e6, norm_eps=1e-6,
+    attention_bias=True, tie_word_embeddings=False,
+    bos_token_id=151643, eos_token_id=151645,
+), "qwen2-72b")
+
+register_model_config(ModelConfig(
+    name="meta-llama/Meta-Llama-3-8B-Instruct",
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=8192, rope_theta=500000.0, norm_eps=1e-5,
+    tie_word_embeddings=False,
+    bos_token_id=128000, eos_token_id=128009,
+), "llama3-8b")
+
+register_model_config(ModelConfig(
+    name="microsoft/Phi-3-mini-4k-instruct",
+    vocab_size=32064, hidden_size=3072, intermediate_size=8192,
+    num_layers=32, num_heads=32, num_kv_heads=32, head_dim=96,
+    max_position_embeddings=4096, rope_theta=10000.0, norm_eps=1e-5,
+    tie_word_embeddings=False,
+    bos_token_id=1, eos_token_id=32000,
+), "phi3-mini")
+
+register_model_config(ModelConfig(
+    name="facebook/opt-1.3b",
+    vocab_size=50272, hidden_size=2048, intermediate_size=8192,
+    num_layers=24, num_heads=32, num_kv_heads=32, head_dim=64,
+    max_position_embeddings=2048, norm="layernorm", norm_eps=1e-5,
+    act="relu", mlp_style="mlp", pos="learned", learned_pos_offset=2,
+    attention_bias=True, mlp_bias=True, tie_word_embeddings=True,
+    bos_token_id=2, eos_token_id=2,
+), "opt-1.3b")
+
+# Tiny configs for tests / CPU smoke (one per architectural family).
+register_model_config(ModelConfig(
+    name="tiny-qwen3",
+    vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    max_position_embeddings=512, rope_theta=1e6,
+    qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
+))
+
+register_model_config(ModelConfig(
+    name="tiny-llama",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+    max_position_embeddings=512, tie_word_embeddings=False, eos_token_id=1,
+))
+
+register_model_config(ModelConfig(
+    name="tiny-opt",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+    max_position_embeddings=512, norm="layernorm", norm_eps=1e-5,
+    act="relu", mlp_style="mlp", pos="learned", learned_pos_offset=2,
+    attention_bias=True, mlp_bias=True, tie_word_embeddings=True, eos_token_id=1,
+))

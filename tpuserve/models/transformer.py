@@ -1,0 +1,211 @@
+"""Functional decoder-only transformer covering the Qwen3/Qwen2/Llama/Phi-3/OPT
+family, built for XLA: static shapes, paged KV cache, bf16 matmuls with fp32
+softmax/norm accumulation.
+
+Params are a plain pytree (dict of layer lists), so the same code path works
+under ``jit``, ``pjit`` with NamedShardings, and ``jax.grad`` (fine-tuning).
+The reference delegates the model entirely to the vLLM container image
+(reference: llm-d-deploy.yaml:176-193); here it is framework code.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpuserve.models.config import ModelConfig
+from tpuserve.ops import attention as attn_ops
+from tpuserve.ops import rope as rope_ops
+
+Params = Any  # nested dict/list pytree of jnp arrays
+
+
+# --------------------------------------------------------------------------
+# Normalisation
+# --------------------------------------------------------------------------
+
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)).astype(dtype)
+
+
+def layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def _norm(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
+    if cfg.norm == "rmsnorm":
+        return rmsnorm(x, p["scale"], cfg.norm_eps)
+    return layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+
+def _linear(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+def _act(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name in ("gelu", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x)
+    if name == "relu":
+        return jax.nn.relu(x)
+    raise ValueError(f"unknown activation {name}")
+
+
+def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
+    if cfg.mlp_style == "gated":
+        gate = _act(_linear(x, p["gate_proj"]), cfg.act)
+        return _linear(gate * _linear(x, p["up_proj"]), p["down_proj"])
+    return _linear(_act(_linear(x, p["fc1"]), cfg.act), p["fc2"])
+
+
+# --------------------------------------------------------------------------
+# Attention projections (shared by prefill and decode)
+# --------------------------------------------------------------------------
+
+def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray):
+    """h: (..., H) -> q (..., Hq, D), k/v (..., Hkv, D), with qk-norm and RoPE."""
+    q = _linear(h, lp["q_proj"]).reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
+    k = _linear(h, lp["k_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    v = _linear(h, lp["v_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rmsnorm(q, lp["q_norm"]["scale"], cfg.norm_eps)
+        k = rmsnorm(k, lp["k_norm"]["scale"], cfg.norm_eps)
+    if cfg.pos == "rope":
+        rotary_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+        cos, sin = rope_ops.rope_freqs(positions, cfg.head_dim, cfg.rope_theta, rotary_dim)
+        q = rope_ops.apply_rope(q, cos, sin)
+        k = rope_ops.apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+           positions: jnp.ndarray) -> jnp.ndarray:
+    h = params["embed"]["weight"][tokens]
+    if cfg.pos == "learned":
+        h = h + params["pos_embed"]["weight"][positions + cfg.learned_pos_offset]
+    return h
+
+
+def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    if cfg.final_layernorm:
+        h = _norm(h, params["final_norm"], cfg)
+    if cfg.tie_word_embeddings:
+        logits = h @ params["embed"]["weight"].T
+    else:
+        logits = _linear(h, params["lm_head"])
+    return logits.astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# Prefill: process full (padded) prompts, write KV cache, return last logits
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            prompt_lens: jnp.ndarray, slot_ids: jnp.ndarray,
+            kv_cache: list, *, attn_impl: str = "reference"):
+    """Run full prompts through the model.
+
+    tokens: (B, T) right-padded prompts; prompt_lens: (B,); slot_ids: (B, T)
+    flat cache slots per token (PAD_SLOT for padding); kv_cache: per-layer
+    list of {"k","v"} paged caches.  Returns (last_logits (B, V), kv_cache).
+    """
+    B, T = tokens.shape
+    positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = _embed(params, cfg, tokens, positions)
+    scale = cfg.head_dim ** -0.5
+    new_cache = []
+    for li, lp in enumerate(params["layers"]):
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions)
+        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
+        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
+        new_cache.append({"k": ck, "v": cv})
+        if attn_impl == "pallas":
+            from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
+            out = flash_prefill_attention(q, k, v, prompt_lens, scale)
+        else:
+            out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale)
+        out = out.reshape(B, T, cfg.q_size)
+        h = h + _linear(out, lp["o_proj"])
+        hn = _norm(h, lp["mlp_norm"], cfg)
+        h = h + _mlp(hn, lp, cfg)
+    last_idx = jnp.maximum(prompt_lens - 1, 0)
+    h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # (B, H)
+    return _unembed(params, cfg, h_last), new_cache
+
+
+# --------------------------------------------------------------------------
+# Decode: one token per sequence against the paged cache
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                positions: jnp.ndarray, slot_ids: jnp.ndarray,
+                block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                kv_cache: list, *, attn_impl: str = "reference"):
+    """One decode step for a batch of sequences.
+
+    tokens/positions/slot_ids/seq_lens: (B,); block_tables: (B, max_blocks).
+    seq_lens includes the token being decoded (its K/V is written first).
+    Returns (logits (B, V), kv_cache).
+    """
+    B = tokens.shape[0]
+    h = _embed(params, cfg, tokens, positions)                 # (B, H)
+    scale = cfg.head_dim ** -0.5
+    new_cache = []
+    for li, lp in enumerate(params["layers"]):
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions)                 # (B, Hq/Hkv, D)
+        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
+        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
+        new_cache.append({"k": ck, "v": cv})
+        if attn_impl == "pallas":
+            from tpuserve.ops.pallas_paged_attention import paged_decode_attention as impl
+            out = impl(q, ck, cv, block_tables, seq_lens, scale)
+        else:
+            out = attn_ops.paged_decode_attention(q, ck, cv, block_tables, seq_lens, scale)
+        out = out.reshape(B, cfg.q_size)
+        h = h + _linear(out, lp["o_proj"])
+        hn = _norm(h, lp["mlp_norm"], cfg)
+        h = h + _mlp(hn, lp, cfg)
+    return _unembed(params, cfg, h), new_cache
+
+
+# --------------------------------------------------------------------------
+# Plain forward (no cache) — for fine-tuning / the graft entry point
+# --------------------------------------------------------------------------
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            seq_lens: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Causal LM forward over (B, T) tokens -> (B, T, V) float32 logits."""
+    B, T = tokens.shape
+    if seq_lens is None:
+        seq_lens = jnp.full((B,), T, jnp.int32)
+    positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = _embed(params, cfg, tokens, positions)
+    scale = cfg.head_dim ** -0.5
+    for lp in params["layers"]:
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions)
+        out = attn_ops.prefill_attention(q, k, v, seq_lens, scale)
+        h = h + _linear(out.reshape(B, T, cfg.q_size), lp["o_proj"])
+        hn = _norm(h, lp["mlp_norm"], cfg)
+        h = h + _mlp(hn, lp, cfg)
+    return _unembed(params, cfg, h)

@@ -1,0 +1,241 @@
+"""Parameter initialisation and HuggingFace checkpoint loading.
+
+The reference downloads weights by delegating ``--download-model
+Qwen/Qwen3-0.6B`` to the llm-d installer and stores them on PVCs
+(reference: llm-d-deploy.yaml:176-215, kubernetes-single-node.yaml:375-401).
+Here loading is in-framework: safetensors -> JAX pytree matching
+``tpuserve.models.transformer`` param layout, with the HF->tpuserve name
+mapping per model family (including Phi-3's fused qkv/gate_up and OPT's
+decoder naming).  ``init_params`` provides random weights for tests/benches
+in air-gapped environments.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.models.config import ModelConfig
+
+Params = Any
+
+
+def param_dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype)
+
+
+# --------------------------------------------------------------------------
+# Random initialisation (tests, CPU smoke, air-gapped benches)
+# --------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
+    """Random-normal initialised params in the transformer's pytree layout."""
+    rng = np.random.default_rng(seed)
+    dtype = param_dtype(cfg)
+
+    def dense(n_in, n_out, bias):
+        p = {"kernel": jnp.asarray(
+            rng.standard_normal((n_in, n_out), dtype=np.float32) / np.sqrt(n_in),
+            dtype=dtype)}
+        if bias:
+            p["bias"] = jnp.zeros((n_out,), dtype)
+        return p
+
+    def norm(n):
+        p = {"scale": jnp.ones((n,), dtype)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((n,), dtype)
+        return p
+
+    h, d = cfg.hidden_size, cfg.head_dim
+    layers = []
+    for _ in range(cfg.num_layers):
+        lp = {
+            "attn_norm": norm(h),
+            "q_proj": dense(h, cfg.q_size, cfg.attention_bias),
+            "k_proj": dense(h, cfg.kv_size, cfg.attention_bias),
+            "v_proj": dense(h, cfg.kv_size, cfg.attention_bias),
+            "o_proj": dense(cfg.q_size, h, cfg.attention_bias and cfg.pos == "learned"),
+            "mlp_norm": norm(h),
+        }
+        if cfg.qk_norm:
+            lp["q_norm"] = {"scale": jnp.ones((d,), dtype)}
+            lp["k_norm"] = {"scale": jnp.ones((d,), dtype)}
+        if cfg.mlp_style == "gated":
+            lp["gate_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
+            lp["up_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
+            lp["down_proj"] = dense(cfg.intermediate_size, h, cfg.mlp_bias)
+        else:
+            lp["fc1"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
+            lp["fc2"] = dense(cfg.intermediate_size, h, cfg.mlp_bias)
+        layers.append(lp)
+
+    params = {
+        "embed": {"weight": jnp.asarray(
+            rng.standard_normal((cfg.vocab_size, h), dtype=np.float32) * 0.02, dtype=dtype)},
+        "layers": layers,
+        "final_norm": norm(h),
+    }
+    if cfg.pos == "learned":
+        params["pos_embed"] = {"weight": jnp.asarray(
+            rng.standard_normal((cfg.max_position_embeddings + cfg.learned_pos_offset, h),
+                                dtype=np.float32) * 0.02, dtype=dtype)}
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(h, cfg.vocab_size, False)
+    return params
+
+
+# --------------------------------------------------------------------------
+# HF checkpoint loading
+# --------------------------------------------------------------------------
+
+def _read_safetensors(ckpt_dir: str) -> dict[str, jnp.ndarray]:
+    """Load all tensors from single-file or index-sharded safetensors."""
+    from safetensors import safe_open
+    files = sorted(glob.glob(os.path.join(ckpt_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files in {ckpt_dir}")
+    tensors: dict[str, jnp.ndarray] = {}
+    for path in files:
+        with safe_open(path, framework="flax") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+    return tensors
+
+
+def _t(w: jnp.ndarray, dtype) -> jnp.ndarray:
+    """HF stores Linear as (out, in); transformer uses (in, out)."""
+    return jnp.asarray(w, dtype=dtype).T
+
+
+def load_hf_checkpoint(cfg: ModelConfig, ckpt_dir: str) -> Params:
+    """Convert an HF checkpoint directory into the transformer param pytree."""
+    raw = _read_safetensors(ckpt_dir)
+    dtype = param_dtype(cfg)
+    if cfg.pos == "learned":
+        return _load_opt(cfg, raw, dtype)
+    return _load_llama_family(cfg, raw, dtype)
+
+
+def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
+    def get(name):
+        return raw[name]
+
+    def dense(name, bias_name=None):
+        p = {"kernel": _t(get(name), dtype)}
+        if bias_name and bias_name in raw:
+            p["bias"] = jnp.asarray(raw[bias_name], dtype=dtype)
+        return p
+
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        lp = {
+            "attn_norm": {"scale": jnp.asarray(get(pre + "input_layernorm.weight"), dtype=dtype)},
+            "mlp_norm": {"scale": jnp.asarray(get(pre + "post_attention_layernorm.weight"), dtype=dtype)},
+            "o_proj": dense(pre + "self_attn.o_proj.weight"),
+        }
+        if pre + "self_attn.qkv_proj.weight" in raw:            # Phi-3 fused qkv
+            qkv = jnp.asarray(raw[pre + "self_attn.qkv_proj.weight"], dtype=dtype)
+            q, k, v = jnp.split(qkv, [cfg.q_size, cfg.q_size + cfg.kv_size], axis=0)
+            lp["q_proj"], lp["k_proj"], lp["v_proj"] = ({"kernel": q.T}, {"kernel": k.T}, {"kernel": v.T})
+        else:
+            for proj in ("q", "k", "v"):
+                lp[f"{proj}_proj"] = dense(pre + f"self_attn.{proj}_proj.weight",
+                                           pre + f"self_attn.{proj}_proj.bias")
+        if cfg.qk_norm:
+            lp["q_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.q_norm.weight"), dtype=dtype)}
+            lp["k_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.k_norm.weight"), dtype=dtype)}
+        if pre + "mlp.gate_up_proj.weight" in raw:              # Phi-3 fused mlp
+            gu = jnp.asarray(raw[pre + "mlp.gate_up_proj.weight"], dtype=dtype)
+            g, u = jnp.split(gu, 2, axis=0)
+            lp["gate_proj"], lp["up_proj"] = {"kernel": g.T}, {"kernel": u.T}
+        else:
+            lp["gate_proj"] = dense(pre + "mlp.gate_proj.weight")
+            lp["up_proj"] = dense(pre + "mlp.up_proj.weight")
+        lp["down_proj"] = dense(pre + "mlp.down_proj.weight")
+        layers.append(lp)
+
+    params = {
+        "embed": {"weight": jnp.asarray(get("model.embed_tokens.weight"), dtype=dtype)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.asarray(get("model.norm.weight"), dtype=dtype)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _t(get("lm_head.weight"), dtype)}
+    return params
+
+
+def _load_opt(cfg: ModelConfig, raw: dict, dtype) -> Params:
+    # OPT checkpoints may or may not carry the "model." prefix.
+    def get(name):
+        for cand in (name, "model." + name):
+            if cand in raw:
+                return raw[cand]
+        raise KeyError(name)
+
+    def dense(name):
+        p = {"kernel": _t(get(name + ".weight"), dtype)}
+        try:
+            p["bias"] = jnp.asarray(get(name + ".bias"), dtype=dtype)
+        except KeyError:
+            pass
+        return p
+
+    def norm(name):
+        return {"scale": jnp.asarray(get(name + ".weight"), dtype=dtype),
+                "bias": jnp.asarray(get(name + ".bias"), dtype=dtype)}
+
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"decoder.layers.{i}."
+        layers.append({
+            "attn_norm": norm(pre + "self_attn_layer_norm"),
+            "q_proj": dense(pre + "self_attn.q_proj"),
+            "k_proj": dense(pre + "self_attn.k_proj"),
+            "v_proj": dense(pre + "self_attn.v_proj"),
+            "o_proj": dense(pre + "self_attn.out_proj"),
+            "mlp_norm": norm(pre + "final_layer_norm"),
+            "fc1": dense(pre + "fc1"),
+            "fc2": dense(pre + "fc2"),
+        })
+    return {
+        "embed": {"weight": jnp.asarray(get("decoder.embed_tokens.weight"), dtype=dtype)},
+        "pos_embed": {"weight": jnp.asarray(get("decoder.embed_positions.weight"), dtype=dtype)},
+        "layers": layers,
+        "final_norm": norm("decoder.final_layer_norm"),
+    }
+
+
+def load_or_init(cfg: ModelConfig, ckpt_dir: str | None, seed: int = 0) -> Params:
+    """Load from a checkpoint dir when given/present, else random-init."""
+    if ckpt_dir and glob.glob(os.path.join(ckpt_dir, "*.safetensors")):
+        return load_hf_checkpoint(cfg, ckpt_dir)
+    return init_params(cfg, seed)
+
+
+# --------------------------------------------------------------------------
+# Orbax save/restore (weight persistence analog of the reference's PVC cache)
+# --------------------------------------------------------------------------
+
+def save_orbax(params: Params, path: str) -> None:
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_orbax(cfg: ModelConfig, path: str) -> Params:
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    target = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        init_params(cfg),
+    )
+    return ckptr.restore(os.path.abspath(path), target)

@@ -1,0 +1,3 @@
+from tpuserve.ops import attention, rope, sampling
+
+__all__ = ["attention", "rope", "sampling"]

@@ -1,0 +1,92 @@
+"""Pure-JAX reference attention (prefill + paged decode).
+
+These are the semantics the Pallas kernels (tpuserve.ops.pallas_*) must match;
+they also serve as the CPU path.  The reference repo delegates all of this to
+the vLLM container it deploys (reference: kubernetes-single-node.yaml:14,
+llm-d-deploy.yaml:140-193) — here paged attention is an in-repo op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+# Sentinel slot id for padding tokens in write_kv_cache: far out of range for
+# any realistic cache, so scatter mode="drop" discards the write.
+PAD_SLOT = 2**30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(..., Hkv, D) -> (..., Hkv*n_rep, D) grouped-query expansion."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      prompt_lens: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """Causal self-attention over the prompt being prefetched.
+
+    q: (B, T, Hq, D); k, v: (B, T, Hkv, D); prompt_lens: (B,) valid lengths.
+    Returns (B, T, Hq, D) in q.dtype.  Softmax in float32.
+    """
+    B, T, Hq, D = q.shape
+    n_rep = Hq // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(T)
+    causal = pos[None, :] <= pos[:, None]                      # (Tq, Tk)
+    valid = pos[None, :] < prompt_lens[:, None]                # (B, Tk)
+    mask = causal[None, None, :, :] & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                           v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                           seq_lens: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """Single-token decode attention against a paged KV cache.
+
+    q: (B, Hq, D); k_cache/v_cache: (num_blocks, block_size, Hkv, D);
+    block_tables: (B, max_blocks) int32 physical block ids;
+    seq_lens: (B,) total tokens in cache per sequence (including current).
+    Returns (B, Hq, D).
+    """
+    B, Hq, D = q.shape
+    _, block_size, Hkv, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * block_size
+    # Gather pages: (B, max_blocks, block_size, Hkv, D) -> (B, S, Hkv, D)
+    k = k_cache[block_tables].reshape(B, S, Hkv, D)
+    v = v_cache[block_tables].reshape(B, S, Hkv, D)
+    n_rep = Hq // Hkv
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scores = jnp.einsum("bhd,bkhd->bhk", q, k, preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(S)[None, :] < seq_lens[:, None]         # (B, S)
+    scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def write_kv_cache(cache: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
+    """Scatter new K or V vectors into the paged cache.
+
+    cache: (num_blocks, block_size, Hkv, D); new: (N, Hkv, D) or (B, T, Hkv, D);
+    slots: flat slot ids (block*block_size + offset), same leading shape as
+    ``new`` minus the trailing (Hkv, D).  Padding tokens must use
+    ``PAD_SLOT`` (out of range, so the scatter drops them — negative indices
+    would wrap in JAX and corrupt the cache).
+    """
+    num_blocks, block_size, Hkv, D = cache.shape
+    flat = cache.reshape(num_blocks * block_size, Hkv, D)
+    new = new.reshape(-1, Hkv, D).astype(cache.dtype)
+    slots = slots.reshape(-1)
+    flat = flat.at[slots].set(new, mode="drop")
+    return flat.reshape(num_blocks, block_size, Hkv, D)

@@ -1,0 +1,130 @@
+"""Pallas TPU paged attention for single-token decode.
+
+One grid program per sequence; the sequence's KV pages are DMA'd from HBM
+into a double-buffered VMEM scratch using the block table (scalar-prefetched
+so page addresses are known before the kernel body runs), with an online
+softmax accumulated across pages.  This is the TPU-native replacement for the
+CUDA paged-attention kernels inside the vLLM image the reference deploys
+(reference: kubernetes-single-node.yaml:14; SURVEY.md §2.2, §7 "hard parts" —
+see also PAPERS.md "Ragged Paged Attention").
+
+Semantics match ``tpuserve.ops.attention.paged_decode_attention``; verified
+against it in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         k_scr, v_scr, sems, *, scale, page_size, max_pages,
+                         num_kv_heads, group, head_dim):
+    b = pl.program_id(0)
+    seq_len = sl_ref[b]
+    num_pages = pl.cdiv(seq_len, page_size)
+
+    def start_copy(i, slot):
+        page = bt_ref[b, i]
+        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sems.at[0, slot]).start()
+        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sems.at[1, slot]).start()
+
+    def wait_copy(i, slot):
+        page = bt_ref[b, i]
+        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sems.at[0, slot]).wait()
+        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sems.at[1, slot]).wait()
+
+    start_copy(0, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale                  # (Hq, D)
+    q_r = q.reshape(num_kv_heads, group, head_dim)
+
+    m0 = jnp.full((num_kv_heads, group, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < num_pages)
+        def _prefetch():
+            start_copy(i + 1, 1 - slot)
+
+        wait_copy(i, slot)
+        k = k_scr[slot].astype(jnp.float32)                    # (page, Hkv, D)
+        v = v_scr[slot].astype(jnp.float32)
+        k_t = jnp.swapaxes(k, 0, 1)                            # (Hkv, page, D)
+        v_t = jnp.swapaxes(v, 0, 1)
+        # (Hkv, group, D) x (Hkv, page, D) -> (Hkv, group, page)
+        s = jax.lax.dot_general(q_r, k_t, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, group, page_size), 2)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=2, keepdims=True)
+        # (Hkv, group, page) x (Hkv, page, D) -> (Hkv, group, D)
+        pv = jax.lax.dot_general(p, v_t, (((2,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        acc_new = acc_prev * correction + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).reshape(num_kv_heads * group, head_dim)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                           v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                           seq_lens: jnp.ndarray, scale: float,
+                           interpret: bool | None = None) -> jnp.ndarray:
+    """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
+    block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D)."""
+    B, Hq, D = q.shape
+    num_blocks, page_size, Hkv, _ = k_cache.shape
+    max_pages = block_tables.shape[1]
+    group = Hq // Hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages, num_kv_heads=Hkv, group=group, head_dim=D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),      # k_cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),      # v_cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hkv, D), k_cache.dtype),
+            pltpu.VMEM((2, page_size, Hkv, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(block_tables, seq_lens, q, k_cache, v_cache)

@@ -1,0 +1,40 @@
+"""Rotary position embeddings (GPT-NeoX split-half convention, as used by
+Llama/Qwen/Phi-3 checkpoints)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(positions: jnp.ndarray, head_dim: int, theta: float,
+               rotary_dim: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for ``positions``.
+
+    positions: int array (...,) — returns cos/sin of shape (..., rotary_dim//2),
+    computed in float32.
+    """
+    rotary_dim = rotary_dim or head_dim
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: (..., num_heads, head_dim); cos/sin: (..., rotary_dim//2) broadcast over
+    the heads axis. The first ``rotary_dim`` features are rotated as two halves
+    (NeoX style); any remainder passes through (partial rotary, e.g. Phi).
+    """
+    rotary_half = cos.shape[-1]
+    dtype = x.dtype
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    x1 = x[..., :rotary_half].astype(jnp.float32)
+    x2 = x[..., rotary_half:2 * rotary_half].astype(jnp.float32)
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x2 * cos + x1 * sin
+    out = jnp.concatenate([rot1, rot2], axis=-1).astype(dtype)
+    if 2 * rotary_half < x.shape[-1]:
+        out = jnp.concatenate([out, x[..., 2 * rotary_half:]], axis=-1)
+    return out

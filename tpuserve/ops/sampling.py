@@ -1,0 +1,103 @@
+"""Token sampling — greedy, temperature, top-k, top-p, penalties — as
+jit-friendly ops.
+
+Per-request sampling parameters arrive as batched arrays so one compiled
+function serves a heterogeneous continuous batch.  Each batch row gets its own
+PRNG key (B, 2) uint32, so a request's sampled stream is deterministic given
+its seed regardless of which batch it lands in.  The full top-k/top-p path
+sorts the vocabulary; the engine picks the cheap path (``mode="greedy"`` /
+``mode="temperature"``) when no request in the batch needs truncation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _row_gumbel(keys: jnp.ndarray, shape: tuple[int, int]) -> jnp.ndarray:
+    """Per-row Gumbel noise: keys (B, 2) uint32 -> (B, V) float32."""
+    u = jax.vmap(lambda k: jax.random.uniform(
+        k, shape[1:], jnp.float32, minval=1e-7, maxval=1.0))(keys)
+    return -jnp.log(-jnp.log(u))
+
+
+def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarray,
+                  top_k: jnp.ndarray, top_p: jnp.ndarray, *, mode: str = "full") -> jnp.ndarray:
+    """Sample next tokens.
+
+    logits: (B, V); keys: (B, 2) uint32 per-row PRNG keys;
+    temperature/top_k/top_p: (B,) per-request params.
+    ``temperature <= 0`` means greedy regardless of mode.  ``top_k <= 0``
+    disables top-k; ``top_p >= 1`` disables top-p.  ``mode`` is static:
+      - "greedy": pure argmax (params/keys ignored).
+      - "temperature": no top-k/top-p truncation.
+      - "full": sort-based top-k + top-p truncation.
+    Returns (B,) int32.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if mode == "greedy":
+        return greedy_tok
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    gumbel = _row_gumbel(keys, (B, V))
+
+    if mode == "temperature":
+        sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+    # Full path: sort descending once, apply both truncations in sorted order.
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    rank = jnp.arange(V)[None, :]
+    k = jnp.where(top_k <= 0, V, top_k)[:, None]
+    keep_k = rank < k
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumsum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative prob *before* them is < top_p (always keeps
+    # the most-likely token).
+    keep_p = (cumsum - probs) < top_p[:, None]
+    masked = jnp.where(keep_k & keep_p, sorted_logits, NEG_INF)
+    choice = jnp.argmax(masked + gumbel, axis=-1)            # index into sorted
+    sampled = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
+                          output_mask: jnp.ndarray,
+                          presence_penalty: jnp.ndarray,
+                          frequency_penalty: jnp.ndarray,
+                          repetition_penalty: jnp.ndarray) -> jnp.ndarray:
+    """OpenAI-style presence/frequency and HF-style repetition penalties.
+
+    logits: (B, V); output_tokens: (B, T) previously generated token ids with
+    ``output_mask`` (B, T) marking valid entries; penalties: (B,).
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    counts = jnp.zeros((B, V), jnp.float32)
+    ids = jnp.where(output_mask, output_tokens, V)           # V = dropped
+    counts = counts.at[jnp.arange(B)[:, None], ids].add(1.0, mode="drop")
+    seen = counts > 0
+    logits = logits - presence_penalty[:, None] * seen
+    logits = logits - frequency_penalty[:, None] * counts
+    rep = repetition_penalty[:, None]
+    rep_logits = jnp.where(logits > 0, logits / rep, logits * rep)
+    return jnp.where(seen, rep_logits, logits)
+
+
+def compute_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray, top_n: int):
+    """Log-probabilities for the chosen tokens plus the top-N alternatives.
+
+    logits: (B, V); chosen: (B,) int32.  Returns (chosen_lp (B,),
+    top_ids (B, top_n), top_lps (B, top_n)).
+    """
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen_lp = jnp.take_along_axis(lp, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(lp, top_n)
+    return chosen_lp, top_ids.astype(jnp.int32), top_lps

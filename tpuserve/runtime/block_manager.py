@@ -1,0 +1,200 @@
+"""Paged KV-cache block manager with hash-based prefix caching.
+
+Python implementation of the block-table bookkeeping that vLLM does inside
+the container the reference deploys (the reference delegates the whole
+engine: kubernetes-single-node.yaml:14, llm-d-deploy.yaml:140-193).
+The interface is deliberately ctypes-friendly; ``tpuserve.native`` provides a
+C++ drop-in replacement for the hot bookkeeping when built.
+
+Design: physical blocks of ``block_size`` token slots; per-sequence block
+tables map logical block index -> physical block id.  Full prompt blocks are
+content-hashed (chained, so a hash identifies the whole prefix) for
+copy-free prefix reuse.  Freed hashed blocks move to an LRU "cached" pool:
+still holding their KV contents, reusable by a later request with the same
+prefix, evicted only when fresh blocks run out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import OrderedDict
+from typing import Optional
+
+from tpuserve.utils import cdiv
+
+
+@dataclasses.dataclass
+class SeqAlloc:
+    blocks: list[int]
+    num_tokens: int                  # tokens written so far
+
+
+class BlockManager:
+    """Allocates physical cache blocks to sequences; optional prefix cache."""
+
+    def __init__(self, num_blocks: int, block_size: int, enable_prefix_caching: bool = True):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+        # freed-but-hashed blocks, LRU order (oldest first), KV still valid
+        self._cached: OrderedDict[int, None] = OrderedDict()
+        self._seqs: dict[str, SeqAlloc] = {}
+        self._refcount: dict[int, int] = {}
+        self._prefix: dict[int, int] = {}       # chain-hash -> physical block
+        self._block_hash: dict[int, int] = {}   # physical block -> chain-hash
+        self.prefix_hits = 0
+        self.prefix_queries = 0
+
+    # ---- capacity -------------------------------------------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        """Blocks available for allocation (fresh + evictable cached)."""
+        return len(self._free) + len(self._cached)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return cdiv(num_tokens, self.block_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.blocks_needed(num_tokens) <= self.num_free_blocks
+
+    def _pop_free_block(self) -> int:
+        if self._free:
+            return self._free.pop()
+        # evict the LRU cached block: its prefix entry dies with it
+        block, _ = self._cached.popitem(last=False)
+        self._drop_hash(block)
+        return block
+
+    def _drop_hash(self, block: int) -> None:
+        h = self._block_hash.pop(block, None)
+        if h is not None and self._prefix.get(h) == block:
+            del self._prefix[h]
+
+    # ---- prefix cache ---------------------------------------------------
+
+    @staticmethod
+    def _chain_hash(prev_hash: int, tokens: tuple[int, ...]) -> int:
+        return hash((prev_hash, tokens))
+
+    def lookup_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
+        """Longest cached prefix: returns (physical blocks, num cached tokens).
+
+        Only whole blocks are reusable, and at least one token must remain
+        un-cached so prefill has something to compute.
+        """
+        if not self.enable_prefix_caching:
+            return [], 0
+        self.prefix_queries += 1
+        blocks: list[int] = []
+        h = 0
+        max_full = (len(token_ids) - 1) // self.block_size
+        for i in range(max_full):
+            chunk = tuple(token_ids[i * self.block_size:(i + 1) * self.block_size])
+            h = self._chain_hash(h, chunk)
+            phys = self._prefix.get(h)
+            if phys is None:
+                break
+            blocks.append(phys)
+        if blocks:
+            self.prefix_hits += 1
+        return blocks, len(blocks) * self.block_size
+
+    def _register_prefix_blocks(self, seq_id: str, token_ids: list[int]) -> None:
+        """Hash and publish this sequence's fully-written prompt blocks."""
+        if not self.enable_prefix_caching:
+            return
+        alloc = self._seqs[seq_id]
+        h = 0
+        for i in range(len(token_ids) // self.block_size):
+            chunk = tuple(token_ids[i * self.block_size:(i + 1) * self.block_size])
+            h = self._chain_hash(h, chunk)
+            phys = alloc.blocks[i]
+            if h not in self._prefix and phys not in self._block_hash:
+                self._prefix[h] = phys
+                self._block_hash[phys] = h
+
+    # ---- allocation -----------------------------------------------------
+
+    def allocate(self, seq_id: str, prompt_token_ids: list[int],
+                 shared_blocks: Optional[list[int]] = None) -> SeqAlloc:
+        """Allocate blocks for a prompt; ``shared_blocks`` are prefix-cache
+        hits (revived / ref-counted, never copied).
+
+        Note: sharing currently dedups KV *memory* across identical prefixes;
+        the prefill still recomputes and rewrites identical KV into shared
+        blocks (compute skip lands with chunked prefill)."""
+        assert seq_id not in self._seqs, f"{seq_id} already allocated"
+        shared_blocks = shared_blocks or []
+        need = self.blocks_needed(len(prompt_token_ids)) - len(shared_blocks)
+        # shared blocks sitting in the cached pool don't count as consumable
+        free_after_revive = (self.num_free_blocks
+                             - sum(1 for b in shared_blocks if b in self._cached))
+        if need > free_after_revive:
+            raise MemoryError(f"out of KV blocks (need {need}, free {free_after_revive})")
+        for b in shared_blocks:
+            if b in self._cached:           # revive: refcount was 0
+                del self._cached[b]
+                self._refcount[b] = 1
+            else:
+                self._refcount[b] = self._refcount.get(b, 0) + 1
+        fresh = [self._pop_free_block() for _ in range(max(need, 0))]
+        for b in fresh:
+            self._refcount[b] = 1
+        alloc = SeqAlloc(blocks=shared_blocks + fresh,
+                         num_tokens=len(prompt_token_ids))
+        self._seqs[seq_id] = alloc
+        self._register_prefix_blocks(seq_id, prompt_token_ids)
+        return alloc
+
+    def needs_new_block(self, seq_id: str) -> bool:
+        """True when the next append_slot will have to grab a fresh block."""
+        alloc = self._seqs[seq_id]
+        return (alloc.num_tokens % self.block_size == 0
+                and alloc.num_tokens // self.block_size == len(alloc.blocks))
+
+    def can_append(self, seq_id: str) -> bool:
+        return not self.needs_new_block(seq_id) or self.num_free_blocks >= 1
+
+    def append_slot(self, seq_id: str) -> int:
+        """Reserve the next token slot; returns the flat slot id
+        (block * block_size + offset).  Grows the block table as needed."""
+        alloc = self._seqs[seq_id]
+        offset = alloc.num_tokens % self.block_size
+        if self.needs_new_block(seq_id):
+            if self.num_free_blocks == 0:
+                raise MemoryError("out of KV blocks on append")
+            b = self._pop_free_block()
+            self._refcount[b] = 1
+            alloc.blocks.append(b)
+        block = alloc.blocks[alloc.num_tokens // self.block_size]
+        alloc.num_tokens += 1
+        return block * self.block_size + offset
+
+    def slot_for_token(self, seq_id: str, token_idx: int) -> int:
+        alloc = self._seqs[seq_id]
+        return (alloc.blocks[token_idx // self.block_size] * self.block_size
+                + token_idx % self.block_size)
+
+    def block_table(self, seq_id: str) -> list[int]:
+        return list(self._seqs[seq_id].blocks)
+
+    def free(self, seq_id: str) -> None:
+        alloc = self._seqs.pop(seq_id, None)
+        if alloc is None:
+            return
+        for b in alloc.blocks:
+            rc = self._refcount.get(b, 1) - 1
+            if rc > 0:
+                self._refcount[b] = rc
+                continue
+            self._refcount.pop(b, None)
+            if b in self._block_hash:       # keep KV around for prefix reuse
+                self._cached[b] = None
+                self._cached.move_to_end(b)
+            else:
+                self._free.append(b)
+
+    def num_seqs(self) -> int:
+        return len(self._seqs)

@@ -1,0 +1,431 @@
+"""The serving engine: continuous batching over a paged KV cache.
+
+This is the component the reference outsources to the vLLM container image
+(reference: kubernetes-single-node.yaml:14, llm-d-deploy.yaml:176-193 — the
+"hot path" of SURVEY.md §3.2).  Rebuilt TPU-first:
+
+- prefill and decode are two jitted functions with bucketed static shapes
+  (powers of two) so XLA compiles a small executable set once;
+- the KV cache is paged device memory, donated through every step (in-place
+  scatter updates, no copies);
+- attention runs as Pallas TPU kernels on TPU and as the pure-JAX reference
+  implementation on CPU;
+- sampling happens on-device; only the sampled (B,) token vector crosses to
+  host per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.models import transformer
+from tpuserve.models.config import ModelConfig, get_model_config
+from tpuserve.models.tokenizer import IncrementalDetokenizer, load_tokenizer
+from tpuserve.models.weights import load_or_init
+from tpuserve.ops import sampling as sampling_ops
+from tpuserve.ops.attention import PAD_SLOT
+from tpuserve.runtime.block_manager import BlockManager
+from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
+from tpuserve.runtime.request import (
+    FinishReason, Request, RequestOutput, RequestState, SamplingParams, check_stop)
+from tpuserve.runtime.scheduler import ScheduledBatch, Scheduler, SchedulerConfig
+from tpuserve.utils import next_power_of_2
+
+logger = logging.getLogger("tpuserve.engine")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "Qwen/Qwen3-0.6B"
+    checkpoint_dir: Optional[str] = None      # HF safetensors dir; None = random init
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    attn_impl: str = "auto"                   # "auto" | "reference" | "pallas"
+    enable_prefix_caching: bool = True
+    seed: int = 0
+
+    def resolve_attn_impl(self) -> str:
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        return "pallas" if jax.default_backend() == "tpu" else "reference"
+
+
+@dataclasses.dataclass
+class EngineStats:
+    num_prefill_steps: int = 0
+    num_decode_steps: int = 0
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    preemptions: int = 0
+    requests_finished: int = 0
+    ttft_sum: float = 0.0
+    ttft_count: int = 0
+    # recent per-token latencies (decode step wall time / batch)
+    last_step_time: float = 0.0
+
+
+class Engine:
+    """Single-replica serving engine (one model, one device/mesh)."""
+
+    def __init__(self, config: EngineConfig, *, params=None,
+                 model_cfg: ModelConfig | None = None, mesh=None):
+        self.config = config
+        self.model_cfg = model_cfg or get_model_config(config.model)
+        self.cache_cfg = config.cache
+        self.attn_impl = config.resolve_attn_impl()
+        self.mesh = mesh
+        self.tokenizer = load_tokenizer(config.checkpoint_dir or config.model,
+                                        vocab_size=self.model_cfg.vocab_size)
+        if params is None:
+            params = load_or_init(self.model_cfg, config.checkpoint_dir, config.seed)
+        self.params = params
+        self.kv_cache = create_kv_cache(self.model_cfg, self.cache_cfg)
+        self.block_manager = BlockManager(
+            self.cache_cfg.num_blocks, self.cache_cfg.block_size,
+            enable_prefix_caching=config.enable_prefix_caching)
+        self.scheduler = Scheduler(config.scheduler, self.block_manager,
+                                   max_model_len=self.cache_cfg.max_model_len)
+        self.stats = EngineStats()
+        self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
+        self._detok: dict[str, IncrementalDetokenizer] = {}
+        self._req_counter = itertools.count()
+        self._rng_key = jax.random.PRNGKey(config.seed)
+        self._eos_ids = set(self.tokenizer.eos_token_ids)
+        if self.model_cfg.eos_token_id is not None:
+            self._eos_ids.add(self.model_cfg.eos_token_id)
+        # Effective sequence limit: cache capacity AND the model's position
+        # range (learned position tables silently clamp out-of-range gathers).
+        self.max_seq_len = min(self.cache_cfg.max_model_len,
+                               self.model_cfg.max_position_embeddings)
+
+    # ------------------------------------------------------------------
+    # Request intake
+    # ------------------------------------------------------------------
+
+    def add_request(self, prompt: str | None = None,
+                    prompt_token_ids: Optional[Sequence[int]] = None,
+                    params: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> str:
+        params = params or SamplingParams()
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.tokenizer.encode(prompt)
+        prompt_token_ids = list(prompt_token_ids)
+        if not prompt_token_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_token_ids) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} exceeds max sequence "
+                f"length {self.max_seq_len} (min of cache capacity "
+                f"{self.cache_cfg.max_model_len} and model position range "
+                f"{self.model_cfg.max_position_embeddings})")
+        request_id = request_id or f"req-{next(self._req_counter)}"
+        req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
+                      params=params, prompt=prompt)
+        self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
+        self.requests[request_id] = req
+        self.scheduler.add(req)
+        self.stats.prompt_tokens += len(prompt_token_ids)
+        return request_id
+
+    def abort_request(self, request_id: str) -> bool:
+        req = self.scheduler.abort(request_id)
+        if req is None:
+            return False
+        req.state = RequestState.FINISHED
+        req.finish_reason = FinishReason.ABORT
+        self.block_manager.free(request_id)
+        self._detok.pop(request_id, None)
+        return True
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # ------------------------------------------------------------------
+    # Step
+    # ------------------------------------------------------------------
+
+    def step(self) -> list[RequestOutput]:
+        """Run one engine iteration (one prefill batch or one decode step)."""
+        batch = self.scheduler.schedule()
+        if batch is None:
+            return []
+        t0 = time.monotonic()
+        if batch.kind == "prefill":
+            outputs = self._run_prefill(batch)
+        else:
+            outputs = self._run_decode(batch)
+        self.stats.last_step_time = time.monotonic() - t0
+        return outputs
+
+    def _next_key(self) -> jax.Array:
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    # ---- prefill ------------------------------------------------------
+
+    def _run_prefill(self, batch: ScheduledBatch) -> list[RequestOutput]:
+        reqs = batch.requests
+        L = batch.padded_len
+        B = next_power_of_2(len(reqs))
+        tokens = np.zeros((B, L), np.int32)
+        slot_ids = np.full((B, L), PAD_SLOT, np.int32)
+        prompt_lens = np.ones((B,), np.int32)
+        for i, req in enumerate(reqs):
+            ids = self._prefill_tokens(req)
+            shared, _cached = self.block_manager.lookup_prefix(ids)
+            self.block_manager.allocate(req.request_id, ids, shared_blocks=shared)
+            tokens[i, :len(ids)] = ids
+            prompt_lens[i] = len(ids)
+            for t in range(len(ids)):
+                slot_ids[i, t] = self.block_manager.slot_for_token(req.request_id, t)
+        logits, self.kv_cache = transformer.prefill(
+            self.params, self.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(prompt_lens), jnp.asarray(slot_ids), self.kv_cache,
+            attn_impl=self.attn_impl)
+        self.scheduler.mark_running(reqs)
+        self.stats.num_prefill_steps += 1
+        new_tokens = self._sample(logits, reqs, B)
+        now = time.monotonic()
+        for req in reqs:
+            if req.first_token_time is None:      # not a re-prefill after preemption
+                req.first_token_time = now
+                self.stats.ttft_sum += now - req.arrival_time
+                self.stats.ttft_count += 1
+        return self._append_and_emit(reqs, new_tokens)
+
+    def _prefill_tokens(self, req: Request) -> list[int]:
+        """Tokens to prefill — prompt plus, after a preemption, everything
+        generated so far (the cache was dropped and must be rebuilt)."""
+        return req.prompt_token_ids + req.output_token_ids
+
+    # ---- decode -------------------------------------------------------
+
+    def _run_decode(self, batch: ScheduledBatch) -> list[RequestOutput]:
+        reqs = batch.requests
+        # Reserve capacity up front (preempting if needed), THEN append —
+        # append_slot mutates per-seq state, so it must not fail mid-batch.
+        while (sum(self.block_manager.needs_new_block(r.request_id) for r in reqs)
+               > self.block_manager.num_free_blocks):
+            victim = self.scheduler.preempt_last()
+            self.stats.preemptions += 1
+            if victim is None:
+                raise MemoryError("KV cache exhausted with a single sequence")
+            reqs = [r for r in reqs if r is not victim]
+            if not reqs:
+                return []
+        slots = [self.block_manager.append_slot(r.request_id) for r in reqs]
+        B = self.scheduler.decode_bucket(len(reqs))
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        slot_arr = np.full((B,), PAD_SLOT, np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq), np.int32)
+        for i, req in enumerate(reqs):
+            tokens[i] = req.output_token_ids[-1]
+            positions[i] = req.num_tokens - 1
+            slot_arr[i] = slots[i]
+            seq_lens[i] = req.num_tokens
+            bt = self.block_manager.block_table(req.request_id)
+            block_tables[i, :len(bt)] = bt
+        logits, self.kv_cache = transformer.decode_step(
+            self.params, self.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(slot_arr),
+            jnp.asarray(block_tables), jnp.asarray(seq_lens), self.kv_cache,
+            attn_impl=self.attn_impl)
+        self.stats.num_decode_steps += 1
+        new_tokens = self._sample(logits, reqs, B)
+        return self._append_and_emit(reqs, new_tokens)
+
+    # ---- sampling -----------------------------------------------------
+
+    MAX_LOGPROBS = 20
+
+    def _sample(self, logits: jnp.ndarray, reqs: list[Request], B: int) -> np.ndarray:
+        n = len(reqs)
+        if any(r.params.needs_penalties for r in reqs):
+            logits = self._apply_penalties(logits, reqs, B)
+        if all(r.params.greedy for r in reqs):
+            mode = "greedy"
+        elif not any(r.params.needs_truncation for r in reqs):
+            mode = "temperature"
+        else:
+            mode = "full"
+        if mode == "greedy":
+            toks = sampling_ops.sample_tokens(
+                logits, jnp.zeros((B, 2), jnp.uint32), jnp.zeros((B,)),
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,)), mode=mode)
+        else:
+            temperature = np.zeros((B,), np.float32)
+            top_k = np.zeros((B,), np.int32)
+            top_p = np.ones((B,), np.float32)
+            keys = np.zeros((B, 2), np.uint32)
+            for i, r in enumerate(reqs):
+                temperature[i] = r.params.temperature
+                top_k[i] = r.params.top_k
+                top_p[i] = r.params.top_p
+                # Per-row key: deterministic for seeded requests no matter
+                # which batches the request lands in.
+                salt = (r.params.seed if r.params.seed is not None
+                        else self.config.seed ^ (hash(r.request_id) & 0x7FFFFFFF))
+                keys[i] = (np.uint32(salt & 0xFFFFFFFF),
+                           np.uint32(len(r.output_token_ids)))
+            toks = sampling_ops.sample_tokens(
+                logits, jnp.asarray(keys), jnp.asarray(temperature),
+                jnp.asarray(top_k), jnp.asarray(top_p), mode=mode)
+        if any(r.params.logprobs is not None for r in reqs):
+            self._record_logprobs(logits, toks, reqs)
+        return np.asarray(jax.device_get(toks))[:n]
+
+    def _apply_penalties(self, logits: jnp.ndarray, reqs: list[Request], B: int) -> jnp.ndarray:
+        from tpuserve.utils import next_power_of_2 as np2
+        T = max(np2(max(len(r.output_token_ids) for r in reqs)), 8)
+        out_tokens = np.zeros((B, T), np.int32)
+        mask = np.zeros((B, T), bool)
+        presence = np.zeros((B,), np.float32)
+        frequency = np.zeros((B,), np.float32)
+        repetition = np.ones((B,), np.float32)
+        for i, r in enumerate(reqs):
+            ids = r.output_token_ids[-T:]
+            out_tokens[i, :len(ids)] = ids
+            mask[i, :len(ids)] = True
+            presence[i] = r.params.presence_penalty
+            frequency[i] = r.params.frequency_penalty
+            repetition[i] = r.params.repetition_penalty
+        return sampling_ops.apply_logit_penalties(
+            logits, jnp.asarray(out_tokens), jnp.asarray(mask),
+            jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition))
+
+    def _record_logprobs(self, logits: jnp.ndarray, toks: jnp.ndarray,
+                         reqs: list[Request]) -> None:
+        top_n = min(max(r.params.logprobs or 0 for r in reqs) or 1, self.MAX_LOGPROBS)
+        chosen_lp, top_ids, top_lps = sampling_ops.compute_logprobs(logits, toks, top_n)
+        chosen_lp = np.asarray(chosen_lp)
+        top_ids = np.asarray(top_ids)
+        top_lps = np.asarray(top_lps)
+        for i, r in enumerate(reqs):
+            if r.params.logprobs is None:
+                continue
+            k = min(r.params.logprobs, top_n)
+            r.logprobs.append({
+                "token_id": int(toks[i]),
+                "logprob": float(chosen_lp[i]),
+                "top": [(int(t), float(l)) for t, l in
+                        zip(top_ids[i, :k], top_lps[i, :k])],
+            })
+
+    # ---- bookkeeping --------------------------------------------------
+
+    def _append_and_emit(self, reqs: list[Request], new_tokens: np.ndarray) -> list[RequestOutput]:
+        outputs = []
+        for req, tok in zip(reqs, new_tokens):
+            tok = int(tok)
+            req.output_token_ids.append(tok)
+            self.stats.generated_tokens += 1
+            delta = self._detok[req.request_id].add(tok)
+            reason = None
+            if req.params.stop:
+                delta, stopped = self._match_stop(req, delta)   # mutates output_text on stop
+                if stopped:
+                    reason = FinishReason.STOP
+            else:
+                req.output_text += delta
+            if reason is None:
+                reason = check_stop(req, self._eos_ids, self.max_seq_len)
+            finished = reason is not None
+            if finished:
+                req.finish_reason = reason
+                req.finish_time = time.monotonic()
+                self.scheduler.finish(req)
+                self.stats.requests_finished += 1
+                self._detok.pop(req.request_id, None)
+            outputs.append(RequestOutput(
+                request_id=req.request_id, new_token_ids=[tok], new_text=delta,
+                finished=finished, finish_reason=reason,
+                num_prompt_tokens=req.num_prompt_tokens,
+                num_output_tokens=len(req.output_token_ids)))
+        return outputs
+
+    def _match_stop(self, req: Request, delta: str) -> tuple[str, bool]:
+        """Bounded stop-string search over the tail.  Appends ``delta`` to
+        ``req.output_text``; on a match, truncates so the stop string is
+        neither stored nor streamed (OpenAI semantics — the reference smoke
+        tests hit an OpenAI-compatible API, llm-d-test.yaml:61-78).
+        Returns (emitted_delta, stopped)."""
+        max_stop = max(len(s) for s in req.params.stop)
+        prev_len = len(req.output_text)
+        # A match must overlap the new delta, so only the tail can matter.
+        window_start = max(0, prev_len - max(max_stop - 1, 0))
+        text = req.output_text + delta
+        tail = text[window_start:]
+        best = None
+        for s in req.params.stop:
+            pos = tail.find(s)
+            if pos != -1 and (best is None or pos < best[0]):
+                best = (pos, s)
+        if best is None:
+            req.output_text = text
+            return delta, False
+        cut_abs = window_start + best[0]
+        req.output_text = text[:cut_abs]
+        return text[prev_len:cut_abs] if cut_abs > prev_len else "", True
+
+    def generate(self, prompts: Sequence[str] | Sequence[Sequence[int]],
+                 params: SamplingParams | Sequence[SamplingParams] | None = None,
+                 ) -> list[Request]:
+        if params is None:
+            params = SamplingParams()
+        if isinstance(params, SamplingParams):
+            params = [params] * len(prompts)
+        if len(params) != len(prompts):
+            raise ValueError(f"got {len(prompts)} prompts but {len(params)} "
+                             "sampling params")
+        rids = []
+        for prompt, p in zip(prompts, params):
+            if isinstance(prompt, str):
+                rids.append(self.add_request(prompt=prompt, params=p))
+            else:
+                rids.append(self.add_request(prompt_token_ids=prompt, params=p))
+        while self.has_work():
+            self.step()
+        return [self.requests.pop(rid) for rid in rids]
+
+    # ------------------------------------------------------------------
+    # Warmup: pre-compile the bucketed executables (TTFT depends on this —
+    # SURVEY.md §7 "TTFT ≤150 ms requires compile-cache warmup at startup")
+    # ------------------------------------------------------------------
+
+    def warmup(self, prefill_buckets: Sequence[int] = (), decode_buckets: Sequence[int] = ()) -> None:
+        prefill_buckets = list(prefill_buckets) or [
+            self.config.scheduler.min_prefill_bucket]
+        decode_buckets = list(decode_buckets) or [
+            self.config.scheduler.min_decode_bucket]
+        for L in prefill_buckets:
+            tokens = jnp.zeros((1, L), jnp.int32)
+            lens = jnp.ones((1,), jnp.int32)
+            slots = jnp.full((1, L), PAD_SLOT, jnp.int32)
+            logits, self.kv_cache = transformer.prefill(
+                self.params, self.model_cfg, tokens, lens, slots, self.kv_cache,
+                attn_impl=self.attn_impl)
+            logits.block_until_ready()
+        for B in decode_buckets:
+            tokens = jnp.zeros((B,), jnp.int32)
+            positions = jnp.zeros((B,), jnp.int32)
+            slots = jnp.full((B,), PAD_SLOT, jnp.int32)
+            bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
+            seq_lens = jnp.ones((B,), jnp.int32)
+            logits, self.kv_cache = transformer.decode_step(
+                self.params, self.model_cfg, tokens, positions, slots, bt,
+                seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+            logits.block_until_ready()
+        logger.info("warmup complete: prefill buckets %s, decode buckets %s",
+                    prefill_buckets, decode_buckets)

@@ -1,0 +1,60 @@
+"""Paged KV cache device arrays + sizing.
+
+Layout (per layer): K and V each ``(num_blocks, block_size, num_kv_heads,
+head_dim)`` so a physical block is contiguous in HBM — the Pallas decode
+kernel DMAs whole blocks, and the kv-head axis is shardable over the 'tp'
+mesh axis.  The capacity math plays the role of the reference's PVC sizing
+(reference: kubernetes-single-node.yaml:375-401 provisions fixed 100Gi PVCs;
+here capacity is derived from the HBM budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpuserve.models.config import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    block_size: int = 32
+    num_blocks: int = 1024
+    max_blocks_per_seq: int = 64
+    dtype: str = "bfloat16"
+
+    @property
+    def max_model_len(self) -> int:
+        return self.block_size * self.max_blocks_per_seq
+
+
+def bytes_per_block(model_cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
+    itemsize = jnp.dtype(cache_cfg.dtype).itemsize
+    return (2 * model_cfg.num_layers * cache_cfg.block_size
+            * model_cfg.num_kv_heads * model_cfg.head_dim * itemsize)
+
+
+def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
+                          hbm_bytes: int, utilization: float = 0.9) -> int:
+    """How many KV blocks fit in ``hbm_bytes`` after weights, at the given
+    utilization fraction."""
+    weight_bytes = model_cfg.num_params * jnp.dtype(model_cfg.dtype).itemsize
+    budget = int(hbm_bytes * utilization) - weight_bytes
+    return max(budget // bytes_per_block(model_cfg, cache_cfg), 16)
+
+
+def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
+                    sharding=None) -> list[dict]:
+    """Zero-initialised per-layer [{"k","v"}] paged cache."""
+    shape = (cache_cfg.num_blocks, cache_cfg.block_size,
+             model_cfg.num_kv_heads, model_cfg.head_dim)
+    dtype = jnp.dtype(cache_cfg.dtype)
+
+    def zeros():
+        if sharding is not None:
+            return jax.device_put(jnp.zeros(shape, dtype), sharding)
+        return jnp.zeros(shape, dtype)
+
+    return [{"k": zeros(), "v": zeros()} for _ in range(model_cfg.num_layers)]

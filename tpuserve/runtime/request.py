@@ -1,0 +1,115 @@
+"""Request lifecycle types for the serving engine.
+
+The reference's request surface is the OpenAI-compatible API it smoke-tests
+through the llm-d gateway (reference: llm-d-test.yaml:61-78 POSTs
+``{"model": ..., "prompt": ..., "max_tokens": ...}``); these types carry that
+request through tokenize -> schedule -> prefill -> decode -> detokenize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional, Sequence
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+
+
+class FinishReason(enum.Enum):
+    STOP = "stop"            # hit EOS or a stop string
+    LENGTH = "length"        # hit max_tokens / max_model_len
+    ABORT = "abort"
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_k: int = 0                      # <=0 disables
+    top_p: float = 1.0                  # >=1 disables
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    stop: tuple[str, ...] = ()
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+    logprobs: Optional[int] = None      # top-N logprobs per generated token
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    @property
+    def needs_truncation(self) -> bool:
+        return self.top_k > 0 or self.top_p < 1.0
+
+    @property
+    def needs_penalties(self) -> bool:
+        return (self.presence_penalty != 0.0 or self.frequency_penalty != 0.0
+                or self.repetition_penalty != 1.0)
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_token_ids: list[int]
+    params: SamplingParams
+    prompt: Optional[str] = None
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+    state: RequestState = RequestState.WAITING
+    output_token_ids: list[int] = dataclasses.field(default_factory=list)
+    output_text: str = ""
+    finish_reason: Optional[FinishReason] = None
+    first_token_time: Optional[float] = None     # TTFT measurement
+    finish_time: Optional[float] = None
+    # logprob of each generated token + top alternatives (when requested)
+    logprobs: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_token_ids)
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    @property
+    def finished(self) -> bool:
+        return self.state == RequestState.FINISHED
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """Incremental output emitted by Engine.step() for one request."""
+    request_id: str
+    new_token_ids: list[int]
+    new_text: str
+    finished: bool
+    finish_reason: Optional[FinishReason] = None
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+
+
+def check_stop(req: Request, eos_token_ids: Sequence[int], max_model_len: int) -> Optional[FinishReason]:
+    """Decide whether a request just finished after its latest token.
+
+    Stop-*string* matching is handled by the engine during detokenization
+    (it must truncate the emitted text); this checks eos/length only.
+    """
+    if not req.output_token_ids:
+        return None
+    last = req.output_token_ids[-1]
+    if not req.params.ignore_eos and last in eos_token_ids:
+        return FinishReason.STOP
+    if len(req.output_token_ids) >= req.params.max_tokens:
+        return FinishReason.LENGTH
+    if req.num_tokens >= max_model_len:
+        return FinishReason.LENGTH
+    return None

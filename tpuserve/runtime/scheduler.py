@@ -1,0 +1,146 @@
+"""Continuous-batching scheduler.
+
+Each engine step is either a PREFILL batch (admit waiting requests, bounded
+by a token budget) or a DECODE step over everything running — the classic
+continuous-batching loop that, in the reference, lives inside the deployed
+vLLM container (reference: SURVEY.md §2.2; the repo itself has no scheduler).
+Prefill lengths and decode batch sizes are bucketed to powers of two so XLA
+compiles a small, reusable set of executables (static shapes — see
+SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+from tpuserve.runtime.block_manager import BlockManager
+from tpuserve.runtime.request import Request, RequestState
+from tpuserve.utils import next_power_of_2
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_num_seqs: int = 64              # decode batch capacity
+    max_prefill_tokens: int = 8192      # per-step prefill token budget
+    max_prefill_seqs: int = 8
+    min_prefill_bucket: int = 32        # smallest padded prompt length
+    min_decode_bucket: int = 4          # smallest padded decode batch
+
+
+@dataclasses.dataclass
+class ScheduledBatch:
+    kind: str                            # "prefill" | "decode"
+    requests: list[Request]
+    # prefill only: padded token length all prompts in the batch share
+    padded_len: int = 0
+    # decode only: padded batch size
+    padded_batch: int = 0
+
+
+class Scheduler:
+    def __init__(self, cfg: SchedulerConfig, block_manager: BlockManager,
+                 max_model_len: int):
+        self.cfg = cfg
+        self.block_manager = block_manager
+        self.max_model_len = max_model_len
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+
+    # ---- intake ---------------------------------------------------------
+
+    def add(self, req: Request) -> None:
+        self.waiting.append(req)
+
+    def abort(self, request_id: str) -> Optional[Request]:
+        for q in (self.waiting, self.running):
+            for r in q:
+                if r.request_id == request_id:
+                    q.remove(r)
+                    return r
+        return None
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ---- policy ---------------------------------------------------------
+
+    def prefill_bucket(self, n: int) -> int:
+        return max(next_power_of_2(n), self.cfg.min_prefill_bucket)
+
+    def decode_bucket(self, n: int) -> int:
+        return min(max(next_power_of_2(n), self.cfg.min_decode_bucket),
+                   next_power_of_2(self.cfg.max_num_seqs))
+
+    def schedule(self) -> Optional[ScheduledBatch]:
+        """Pick the next batch.  Prefill-priority: admit waiting work first
+        (keeps TTFT low and the decode batch full), then decode."""
+        batch = self._schedule_prefill()
+        if batch is not None:
+            return batch
+        if self.running:
+            return ScheduledBatch(
+                kind="decode", requests=list(self.running),
+                padded_batch=self.decode_bucket(len(self.running)))
+        return None
+
+    def _schedule_prefill(self) -> Optional[ScheduledBatch]:
+        if not self.waiting or len(self.running) >= self.cfg.max_num_seqs:
+            return None
+        picked: list[Request] = []
+        bucket = 0
+        reserved = 0   # blocks spoken for by requests already picked this batch
+        while (self.waiting and len(picked) < self.cfg.max_prefill_seqs
+               and len(self.running) + len(picked) < self.cfg.max_num_seqs):
+            req = self.waiting[0]
+            # All prompts in one prefill batch share a padded length bucket.
+            # num_tokens (not num_prompt_tokens): a preempted request
+            # re-prefills its prompt plus everything generated so far.
+            cand = max(bucket, self.prefill_bucket(req.num_tokens))
+            if cand * (len(picked) + 1) > self.cfg.max_prefill_tokens and picked:
+                break
+            # +1 block headroom so the first decode append can't OOM.
+            need = self.block_manager.blocks_needed(req.num_tokens) + 1
+            if reserved + need > self.block_manager.num_free_blocks:
+                break
+            self.waiting.popleft()
+            picked.append(req)
+            reserved += need
+            bucket = cand
+        if not picked:
+            return None
+        return ScheduledBatch(kind="prefill", requests=picked, padded_len=bucket)
+
+    # ---- state transitions (driven by the engine) -----------------------
+
+    def mark_running(self, reqs: list[Request]) -> None:
+        for r in reqs:
+            r.state = RequestState.RUNNING
+            self.running.append(r)
+
+    def finish(self, req: Request) -> None:
+        req.state = RequestState.FINISHED
+        if req in self.running:
+            self.running.remove(req)
+        self.block_manager.free(req.request_id)
+
+    def preempt_last(self) -> Optional[Request]:
+        """Evict the most recent running request back to waiting (frees its
+        blocks; it will re-prefill later).  Called on decode OOM."""
+        if not self.running:
+            return None
+        req = self.running.pop()
+        self.block_manager.free(req.request_id)
+        # Re-prefill will recompute the full context (prompt + generated).
+        req.state = RequestState.PREEMPTED
+        self.waiting.appendleft(req)
+        return req

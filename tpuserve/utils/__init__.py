@@ -1,0 +1,3 @@
+from tpuserve.utils.misc import cdiv, round_up, pad_to, next_power_of_2
+
+__all__ = ["cdiv", "round_up", "pad_to", "next_power_of_2"]

@@ -1,0 +1,26 @@
+"""Small shared helpers used across the framework."""
+
+from __future__ import annotations
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division."""
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Round ``x`` up to the nearest multiple of ``multiple``."""
+    return cdiv(x, multiple) * multiple
+
+
+def next_power_of_2(x: int) -> int:
+    """Smallest power of two >= x (>=1)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def pad_to(seq, length, pad_value=0):
+    """Pad a python list to ``length`` with ``pad_value`` (truncates if longer)."""
+    seq = list(seq)[:length]
+    return seq + [pad_value] * (length - len(seq))
