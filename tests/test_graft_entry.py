@@ -1,0 +1,13 @@
+"""Driver contract: dryrun_multichip must shard + execute on the CPU mesh."""
+
+import subprocess
+import sys
+
+
+def test_dryrun_multichip_8(capsys):
+    sys.path.insert(0, "/root/repo")
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.pop(0)

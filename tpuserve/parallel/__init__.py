@@ -1,0 +1,9 @@
+from tpuserve.parallel.mesh import MeshConfig, make_mesh
+from tpuserve.parallel.sharding import (
+    batch_sharding, cache_shardings, param_shardings, replicated, shard_params)
+
+__all__ = [
+    "MeshConfig", "make_mesh",
+    "batch_sharding", "cache_shardings", "param_shardings", "replicated",
+    "shard_params",
+]

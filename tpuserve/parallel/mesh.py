@@ -1,0 +1,63 @@
+"""Device mesh construction — the TPU-native replacement for the NCCL/MPI
+communication backend the reference delegates to vLLM's container
+(reference: SURVEY.md §5 "Distributed communication backend" — nothing in the
+repo itself; vLLM's internal NCCL is replaced wholesale by XLA collectives
+over ICI/DCN).
+
+Axes:
+- ``dp``: data parallel (batch split; gradient psum when fine-tuning).
+- ``tp``: tensor parallel (attention heads / MLP columns over ICI).
+
+Multi-host: ``jax.distributed.initialize()`` + the same mesh over all
+processes' devices — XLA routes collectives over ICI within a slice and DCN
+across slices; no per-backend code here, which is the point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a (dp, tp) mesh.  Default: all local devices on the tp axis
+    (serving wants TP over ICI; DP is usually the K8s replica count, matching
+    the reference's llm-d topology where the gateway load-balances replicas).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if cfg is None:
+        cfg = MeshConfig(dp=1, tp=len(devices))
+    if cfg.num_devices > len(devices):
+        raise ValueError(f"mesh {cfg} needs {cfg.num_devices} devices, "
+                         f"have {len(devices)}")
+    grid = np.asarray(devices[:cfg.num_devices]).reshape(cfg.dp, cfg.tp)
+    return Mesh(grid, (AXIS_DP, AXIS_TP))
+
+
+def multihost_initialize(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Join a multi-host mesh (GKE TPU slice pods).  Safe no-op when already
+    initialised or running single-process."""
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (RuntimeError, ValueError):
+        pass

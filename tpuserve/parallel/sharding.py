@@ -1,0 +1,90 @@
+"""Megatron-style tensor-parallel NamedShardings for the transformer pytree.
+
+The reference never implements TP itself — it relies on vLLM's NCCL tensor
+parallelism inside the deployed container (SURVEY.md §2.3).  Here TP is
+GSPMD: annotate the params once, jit the same model code, and XLA inserts the
+all-reduces over ICI.
+
+Layout (axis names from tpuserve.parallel.mesh):
+- q/k/v projections: columns (head dim) sharded over ``tp``; o_proj rows.
+- gate/up: columns over ``tp``; down: rows.  Each transformer block then
+  needs exactly one psum after attention and one after the MLP.
+- embedding + lm_head: vocab-sharded over ``tp`` (logits all-gather at the
+  sampler).
+- KV cache: kv-heads axis over ``tp`` — decode attention is fully local.
+- norms and biases of row-sharded layers: replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuserve.models.config import ModelConfig
+from tpuserve.parallel.mesh import AXIS_TP
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _spec_for(path: str, cfg: ModelConfig) -> P:
+    """PartitionSpec for one param, keyed on its pytree path string."""
+    # column-parallel kernels: (in, out) with out sharded
+    if any(k in path for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
+                               "up_proj", "fc1")):
+        if path.endswith("kernel"):
+            return P(None, AXIS_TP)
+        if path.endswith("bias"):
+            return P(AXIS_TP)
+    # row-parallel kernels: (in, out) with in sharded; bias replicated
+    if any(k in path for k in ("o_proj", "down_proj", "fc2")):
+        if path.endswith("kernel"):
+            return P(AXIS_TP, None)
+        return P()
+    # vocab-parallel embeddings
+    if path.startswith("embed.") or path.startswith("lm_head."):
+        if path.endswith("weight"):
+            return P(AXIS_TP, None)         # embed.weight: (V, H)
+        if path.endswith("kernel"):
+            return P(None, AXIS_TP)         # lm_head.kernel: (H, V)
+    # position tables, norms, qk-norm scales: replicated
+    return P()
+
+
+def _tree_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = []
+    for keypath, leaf in flat:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+        paths.append((".".join(p for p in parts if not p.isdigit()), leaf))
+    return paths, treedef
+
+
+def param_shardings(params, cfg: ModelConfig, mesh: Mesh):
+    """NamedSharding pytree matching ``params`` structure."""
+    flat, treedef = _tree_paths(params)
+    shardings = [NamedSharding(mesh, _spec_for(path, cfg)) for path, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def cache_shardings(cfg: ModelConfig, mesh: Mesh, num_layers: int | None = None):
+    """Per-layer [{"k","v"}] shardings: kv-head axis over tp."""
+    s = NamedSharding(mesh, P(None, None, AXIS_TP, None))
+    return [{"k": s, "v": s} for _ in range(num_layers or cfg.num_layers)]
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the batch axis over dp; everything else replicated."""
+    from tpuserve.parallel.mesh import AXIS_DP
+    return NamedSharding(mesh, P(AXIS_DP, *([None] * (ndim - 1))))
+
+
+def shard_params(params, cfg: ModelConfig, mesh: Mesh):
+    """Place a params pytree onto the mesh with TP shardings."""
+    return jax.device_put(params, param_shardings(params, cfg, mesh))

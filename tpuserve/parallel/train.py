@@ -1,0 +1,70 @@
+"""Fine-tuning step (causal LM loss + optax) over the (dp, tp) mesh.
+
+The reference has no training path (SURVEY.md §5 "Checkpoint/resume: no
+training, so none") — this is a framework extension so served models can be
+tuned in place: same transformer code, same param pytree/shardings as
+serving; dp shards the batch (XLA psums the grads), tp shards the matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpuserve.models import transformer
+from tpuserve.models.config import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-5
+    weight_decay: float = 0.0
+    grad_clip: float = 1.0
+    remat: bool = True     # rematerialise layer activations (HBM for FLOPs)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
+    )
+
+
+def causal_lm_loss(params, model_cfg: ModelConfig, tokens: jnp.ndarray,
+                   loss_mask: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy.  tokens: (B, T) int32; loss_mask: (B, T)
+    True where the *target* token (position t, predicted from t-1) counts."""
+    fwd = transformer.forward
+    logits = fwd(params, model_cfg, tokens)                  # (B, T, V) f32
+    targets = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+@partial(jax.jit, static_argnames=("model_cfg", "train_cfg", "optimizer"),
+         donate_argnames=("params", "opt_state"))
+def train_step(params, opt_state, model_cfg: ModelConfig,
+               train_cfg: TrainConfig, optimizer, tokens, loss_mask):
+    """One SGD step.  With params TP-sharded and tokens dp-sharded, GSPMD
+    emits the grad psum over dp and the activation collectives over tp."""
+    loss_fn = causal_lm_loss
+    if train_cfg.remat:
+        loss_fn = jax.checkpoint(causal_lm_loss, static_argnums=(1,))
+    loss, grads = jax.value_and_grad(loss_fn)(params, model_cfg, tokens, loss_mask)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def init_train_state(params, train_cfg: TrainConfig):
+    opt = make_optimizer(train_cfg)
+    # jitted init propagates the params' NamedShardings into the optimizer
+    # moments (scalars come out replicated) — required for sharded training.
+    return opt, jax.jit(opt.init)(params)

@@ -87,7 +87,22 @@ class Engine:
         if params is None:
             params = load_or_init(self.model_cfg, config.checkpoint_dir, config.seed)
         self.params = params
-        self.kv_cache = create_kv_cache(self.model_cfg, self.cache_cfg)
+        if mesh is not None:
+            # Tensor-parallel placement: GSPMD inserts the ICI collectives.
+            from tpuserve.parallel.mesh import AXIS_TP
+            from tpuserve.parallel.sharding import cache_shardings, shard_params
+            self.params = shard_params(self.params, self.model_cfg, mesh)
+            self.kv_cache = create_kv_cache(
+                self.model_cfg, self.cache_cfg,
+                shardings=cache_shardings(self.model_cfg, mesh))
+            if mesh.shape.get(AXIS_TP, 1) > 1 and self.attn_impl == "pallas":
+                # The Pallas kernels don't carry SPMD partitioning rules yet;
+                # under TP the einsum reference path partitions cleanly.
+                logger.warning("attn_impl=pallas is not TP-partitionable yet; "
+                               "falling back to reference under tp>1")
+                self.attn_impl = "reference"
+        else:
+            self.kv_cache = create_kv_cache(self.model_cfg, self.cache_cfg)
         self.block_manager = BlockManager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
@@ -101,10 +116,15 @@ class Engine:
         self._eos_ids = set(self.tokenizer.eos_token_ids)
         if self.model_cfg.eos_token_id is not None:
             self._eos_ids.add(self.model_cfg.eos_token_id)
-        # Effective sequence limit: cache capacity AND the model's position
-        # range (learned position tables silently clamp out-of-range gathers).
-        self.max_seq_len = min(self.cache_cfg.max_model_len,
-                               self.model_cfg.max_position_embeddings)
+        # Effective sequence limit: per-seq cache capacity, the model's
+        # position range (learned position tables silently clamp out-of-range
+        # gathers), and total cache size minus one block of headroom — a
+        # sequence that can never be allocated must be rejected at intake,
+        # not spin forever in the waiting queue.
+        self.max_seq_len = min(
+            self.cache_cfg.max_model_len,
+            self.model_cfg.max_position_embeddings,
+            (self.cache_cfg.num_blocks - 1) * self.cache_cfg.block_size)
 
     # ------------------------------------------------------------------
     # Request intake

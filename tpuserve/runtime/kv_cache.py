@@ -46,15 +46,29 @@ def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
 
 
 def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
-                    sharding=None) -> list[dict]:
-    """Zero-initialised per-layer [{"k","v"}] paged cache."""
+                    shardings=None) -> list[dict]:
+    """Zero-initialised per-layer [{"k","v"}] paged cache.
+
+    ``shardings``: a single NamedSharding, or a per-layer [{"k","v"}] pytree
+    (as from ``tpuserve.parallel.cache_shardings``).  Each buffer is created
+    directly in its sharded layout — never materialised on one device first.
+    """
     shape = (cache_cfg.num_blocks, cache_cfg.block_size,
              model_cfg.num_kv_heads, model_cfg.head_dim)
     dtype = jnp.dtype(cache_cfg.dtype)
 
-    def zeros():
-        if sharding is not None:
-            return jax.device_put(jnp.zeros(shape, dtype), sharding)
+    def zeros(sh):
+        if sh is not None:
+            return jnp.zeros(shape, dtype, device=sh)
         return jnp.zeros(shape, dtype)
 
-    return [{"k": zeros(), "v": zeros()} for _ in range(model_cfg.num_layers)]
+    cache = []
+    for li in range(model_cfg.num_layers):
+        if shardings is None:
+            k_sh = v_sh = None
+        elif isinstance(shardings, list):
+            k_sh, v_sh = shardings[li]["k"], shardings[li]["v"]
+        else:
+            k_sh = v_sh = shardings
+        cache.append({"k": zeros(k_sh), "v": zeros(v_sh)})
+    return cache
