@@ -1,0 +1,169 @@
+"""HTTP server tests: the same API surface the reference smoke-tests through
+the gateway (llm-d-test.yaml: GET /v1/models, POST /v1/completions), plus
+chat, streaming, metrics, and probes."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=8, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload, raw=False, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        data = r.read()
+        return r.status, data if raw else json.loads(data)
+
+
+def test_models_endpoint(server):
+    status, body = _get(server + "/v1/models")
+    assert status == 200
+    assert body["object"] == "list"
+    assert body["data"][0]["id"] == "tiny-qwen3"
+
+
+def test_health_ready(server):
+    assert _get(server + "/healthz")[0] == 200
+    assert _get(server + "/readyz")[0] == 200
+
+
+def test_completions(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "Who are you?", "max_tokens": 6,
+        "temperature": 0, "ignore_eos": True})
+    assert status == 200
+    assert body["object"] == "text_completion"
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "length"
+    assert body["usage"]["completion_tokens"] == 6
+    assert body["model"] == "tiny-qwen3"
+
+
+def test_completions_token_ids_prompt(server):
+    status, body = _post(server + "/v1/completions", {
+        "prompt": [5, 6, 7], "max_tokens": 3, "temperature": 0,
+        "ignore_eos": True})
+    assert status == 200
+    assert body["usage"]["prompt_tokens"] == 3
+
+
+def test_chat_completions(server):
+    status, body = _post(server + "/v1/chat/completions", {
+        "messages": [{"role": "system", "content": "Be nice."},
+                     {"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True})
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["role"] == "assistant"
+    assert body["choices"][0]["finish_reason"] == "length"
+
+
+def test_streaming(server):
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"prompt": "stream", "max_tokens": 4, "stream": True,
+                         "temperature": 0, "ignore_eos": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert "text/event-stream" in r.headers["Content-Type"]
+        raw = r.read().decode()
+    events = [ln[len("data: "):] for ln in raw.splitlines()
+              if ln.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert len(chunks) == 4
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+
+
+def test_logprobs_in_response(server):
+    status, body = _post(server + "/v1/completions", {
+        "prompt": "lp", "max_tokens": 3, "temperature": 0, "logprobs": 2,
+        "ignore_eos": True})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(len(t) == 2 for t in lp["top_logprobs"])
+
+
+def test_metrics_exposition(server):
+    with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    # the families the reference's verification queries check
+    # (otel-observability-setup.yaml:758-761)
+    assert "vllm_request_total" in text
+    assert "vllm_active_requests" in text
+    assert "vllm_request_duration_seconds" in text
+    assert "vllm_time_to_first_token_seconds" in text
+    assert "vllm_kv_cache_usage_perc" in text
+
+
+def test_bad_requests(server):
+    for payload, frag in [
+        ({}, "prompt"),
+        ({"prompt": ""}, "prompt"),
+        ({"prompt": ["a", "b"]}, "one request per prompt"),
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", payload)
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read())
+        assert frag in body["error"]["message"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/chat/completions", {"messages": []})
+    assert ei.value.code == 400
+
+
+def test_unknown_route(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server + "/v2/whatever")
+    assert ei.value.code == 404
+
+
+def test_oversize_prompt_rejected_cleanly(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions",
+              {"prompt": "x" * 5000, "max_tokens": 2})
+    assert ei.value.code == 400
+    assert "exceeds max sequence length" in json.loads(
+        ei.value.read())["error"]["message"]
+
+
+def test_malformed_sampling_fields(server):
+    """Regression: junk sampling fields must 400, not drop the connection;
+    nulls fall back to defaults (OpenAI clients send explicit nulls)."""
+    for payload in [
+        {"prompt": "x", "max_tokens": "lots"},
+        {"prompt": "x", "temperature": "hot"},
+        {"prompt": "x", "stop": [1, 2]},
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", payload)
+        assert ei.value.code == 400
+    status, _body = _post(server + "/v1/completions", {
+        "prompt": "x", "temperature": None, "max_tokens": 2,
+        "top_p": None, "ignore_eos": True})
+    assert status == 200

@@ -95,7 +95,6 @@ class DisaggregatedEngine:
         self.decode = Engine(decode_config)
         self.decode_device = decode_device
         self.stats = DisaggStats()
-        self._pending: dict[str, SamplingParams] = {}
         # Prefilled requests whose KV still lives in the prefill cache,
         # waiting for decode-pool capacity (admission-controlled migration).
         self._ready: list[Request] = []
@@ -108,7 +107,9 @@ class DisaggregatedEngine:
         rid = self.prefill.add_request(prompt=prompt,
                                        prompt_token_ids=prompt_token_ids,
                                        params=params, request_id=request_id)
-        self._pending[rid] = params
+        # Mirror the record decode-side immediately: every request is claimed
+        # from (and popped off) decode.requests regardless of where it ends.
+        self.decode.requests[rid] = self.prefill.requests[rid]
         return rid
 
     def _decode_has_capacity(self, req: Request) -> bool:
@@ -144,19 +145,25 @@ class DisaggregatedEngine:
         dst.scheduler.running.append(req)
         self.prefill.block_manager.free(rid)
         self.prefill.requests.pop(rid, None)
-        self._pending.pop(rid, None)
+
+    def _try_migrations(self) -> bool:
+        """Migrate every parked request the decode pool can admit."""
+        migrated = False
+        still_ready = []
+        for req in self._ready:
+            if self._decode_has_capacity(req):
+                self._migrate(req)
+                migrated = True
+            else:
+                still_ready.append(req)
+        self._ready = still_ready
+        return migrated
 
     def step(self) -> list[RequestOutput]:
         """One iteration: drain ready migrations under decode admission
         control, run prefill intake, then the decode batch."""
         outputs: list[RequestOutput] = []
-        still_ready = []
-        for req in self._ready:
-            if self._decode_has_capacity(req):
-                self._migrate(req)
-            else:
-                still_ready.append(req)
-        self._ready = still_ready
+        self._try_migrations()
 
         if self.prefill.scheduler.num_waiting:
             outputs.extend(self.prefill.step())
@@ -164,35 +171,55 @@ class DisaggregatedEngine:
             # the prefill scheduler so it never decodes them.
             for req in list(self.prefill.scheduler.running):
                 self.prefill.scheduler.running.remove(req)
-                if self._decode_has_capacity(req):
-                    self._migrate(req)
-                else:
-                    self._ready.append(req)
+                self._ready.append(req)
+            self._try_migrations()
             # Requests that finished during prefill (e.g. max_tokens=1) never
             # migrate; hand their records to the decode side for claiming.
             for out in outputs:
                 if out.finished and out.request_id in self.prefill.requests:
                     self.decode.requests[out.request_id] = \
                         self.prefill.requests.pop(out.request_id)
-                    self._pending.pop(out.request_id, None)
         if self.decode.scheduler.has_work():
             outputs.extend(self.decode.step())
-        if (not outputs and self._ready and len(self._ready) == len(still_ready)
-                and not self.prefill.scheduler.has_work()
-                and not self.decode.scheduler.has_work()):
-            # No migration, no prefill, no decode: the decode pool can never
-            # admit what's parked.  Surface it instead of spinning forever.
-            req = self._ready[0]
-            raise MemoryError(
-                f"decode pool cannot admit request {req.request_id} "
-                f"({req.num_prompt_tokens} prompt tokens): needs "
-                f"{self.decode.block_manager.blocks_needed(req.num_prompt_tokens) + 1}"
-                f" blocks, pool has {self.decode.cache_cfg.num_blocks} total")
+        if self._ready and not self.decode.scheduler.has_work():
+            # Decode went idle this step; its free block count is now at its
+            # maximum, so one more migration attempt is decisive: if nothing
+            # moves, the parked request can never be admitted.
+            if not self._try_migrations():
+                req = self._ready[0]
+                raise MemoryError(
+                    f"decode pool cannot admit request {req.request_id} "
+                    f"({req.num_prompt_tokens} prompt tokens): needs "
+                    f"{self.decode.block_manager.blocks_needed(req.num_prompt_tokens) + 1}"
+                    f" blocks / 1 seq slot, pool has "
+                    f"{self.decode.cache_cfg.num_blocks} blocks total")
         return outputs
 
     def has_work(self) -> bool:
         return (bool(self._ready) or self.prefill.has_work()
                 or self.decode.has_work())
+
+    @property
+    def requests(self) -> dict:
+        """Request records, mirrored into the decode engine's dict from
+        intake (so callers can look up / pop from one real dict)."""
+        return self.decode.requests
+
+    def abort_request(self, request_id: str) -> bool:
+        aborted = False
+        for req in list(self._ready):
+            if req.request_id == request_id:
+                self._ready.remove(req)
+                self.prefill.block_manager.free(request_id)
+                self.prefill._detok.pop(request_id, None)
+                aborted = True
+        if not aborted:
+            aborted = (self.prefill.abort_request(request_id)
+                       or self.decode.abort_request(request_id))
+        if aborted:
+            self.prefill.requests.pop(request_id, None)
+            self.decode.requests.pop(request_id, None)
+        return aborted
 
     def generate(self, prompts, params=None) -> list[Request]:
         if params is None:

@@ -1,0 +1,5 @@
+from tpuserve.server.metrics import ServerMetrics
+from tpuserve.server.runner import AsyncEngineRunner
+from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+__all__ = ["ServerMetrics", "AsyncEngineRunner", "OpenAIServer", "ServerConfig"]

@@ -1,0 +1,4 @@
+from tpuserve.server.openai_api import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,301 @@
+"""Inference gateway: routes OpenAI-API traffic across engine replicas.
+
+The reference deploys the llm-d inference gateway (Gateway API + Envoy) and
+discovers its address three ways in the smoke tests
+(reference: llm-d-test.yaml:14-26); the gateway's job there is to spread
+requests across model-serving pods and steer prefill/decode traffic.  This
+is the in-repo equivalent: a threaded HTTP proxy with
+
+- health-checked backend pools (``/healthz`` probing, auto-eject/readmit),
+- least-outstanding-requests load balancing,
+- KV-aware session affinity: requests whose prompt shares a prefix hash
+  prefer the replica that served it before (prefix-cache hits stay local),
+- pass-through streaming (SSE chunks relayed as they arrive).
+
+DP replicas = multiple backends here + K8s replica count, matching the
+reference's llm-d topology (SURVEY.md §2.3 "DP: implicit via K8s replicas +
+gateway LB").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import threading
+import time
+import urllib.request
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger("tpuserve.gateway")
+
+
+@dataclasses.dataclass
+class Backend:
+    url: str                       # http://host:port
+    healthy: bool = True
+    outstanding: int = 0
+    last_checked: float = 0.0
+    consecutive_failures: int = 0
+
+
+@dataclasses.dataclass
+class GatewayConfig:
+    host: str = "0.0.0.0"
+    port: int = 8080
+    health_interval_s: float = 5.0
+    health_timeout_s: float = 2.0
+    affinity_prefix_chars: int = 256     # prompt prefix hashed for affinity
+    affinity_cache_size: int = 4096
+    upstream_timeout_s: float = 600.0
+
+
+class Gateway:
+    def __init__(self, backend_urls: list[str], config: GatewayConfig | None = None):
+        if not backend_urls:
+            raise ValueError("gateway needs at least one backend")
+        self.config = config or GatewayConfig()
+        self.backends = [Backend(url=u.rstrip("/")) for u in backend_urls]
+        self._lock = threading.Lock()
+        self._affinity: OrderedDict[str, str] = OrderedDict()  # prefix hash -> url
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ---- backend selection ---------------------------------------------
+
+    def _prefix_key(self, body: bytes) -> Optional[str]:
+        try:
+            payload = json.loads(body)
+            prompt = payload.get("prompt")
+            if isinstance(prompt, list):
+                prompt = "".join(map(str, prompt[:64]))
+            if not prompt and isinstance(payload.get("messages"), list):
+                prompt = json.dumps(payload["messages"])[:512]
+            if not isinstance(prompt, str) or not prompt:
+                return None
+            return hashlib.sha256(
+                prompt[: self.config.affinity_prefix_chars].encode()).hexdigest()
+        except Exception:
+            return None
+
+    def pick_backend(self, body: bytes | None = None) -> Backend:
+        with self._lock:
+            healthy = [b for b in self.backends if b.healthy]
+            pool = healthy or self.backends
+            key = self._prefix_key(body) if body else None
+            if key is not None:
+                url = self._affinity.get(key)
+                if url is not None:
+                    self._affinity.move_to_end(key)
+                    for b in pool:
+                        if b.url == url:
+                            b.outstanding += 1
+                            return b
+            chosen = min(pool, key=lambda b: b.outstanding)
+            if key is not None:
+                self._affinity[key] = chosen.url
+                while len(self._affinity) > self.config.affinity_cache_size:
+                    self._affinity.popitem(last=False)
+            chosen.outstanding += 1
+            return chosen
+
+    def release(self, backend: Backend, ok: bool) -> None:
+        with self._lock:
+            backend.outstanding = max(backend.outstanding - 1, 0)
+            if ok:
+                backend.consecutive_failures = 0
+            else:
+                backend.consecutive_failures += 1
+                if backend.consecutive_failures >= 2:
+                    backend.healthy = False
+
+    # ---- health checking ------------------------------------------------
+
+    def _health_loop(self):
+        while not self._stop.wait(self.config.health_interval_s):
+            for b in self.backends:
+                try:
+                    with urllib.request.urlopen(
+                            b.url + "/healthz",
+                            timeout=self.config.health_timeout_s) as resp:
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                with self._lock:
+                    if ok:
+                        b.healthy = True
+                        b.consecutive_failures = 0
+                    else:
+                        b.healthy = False
+                    b.last_checked = time.monotonic()
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> int:
+        gw = self
+
+        class Handler(_GatewayHandler):
+            ctx = gw
+
+        self._httpd = ThreadingHTTPServer((self.config.host, self.config.port),
+                                          Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="tpuserve-gateway").start()
+        self._health_thread = threading.Thread(target=self._health_loop,
+                                               daemon=True,
+                                               name="tpuserve-gateway-health")
+        self._health_thread.start()
+        port = self._httpd.server_address[1]
+        logger.info("gateway on :%d -> %s", port,
+                    [b.url for b in self.backends])
+        return port
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"backends": [dataclasses.asdict(b) for b in self.backends],
+                    "affinity_entries": len(self._affinity)}
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    ctx: Gateway
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _relay(self, method: str):
+        ctx = self.ctx
+        if self.path == "/gateway/status":
+            data = json.dumps(ctx.status()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else None
+        backend = ctx.pick_backend(body if method == "POST" else None)
+        backend_ok = True      # only upstream failures count against it
+        headers_sent = False
+        try:
+            try:
+                req = urllib.request.Request(
+                    backend.url + self.path, data=body, method=method,
+                    headers={"Content-Type": self.headers.get(
+                        "Content-Type", "application/json")})
+                resp_ctx = urllib.request.urlopen(
+                    req, timeout=ctx.config.upstream_timeout_s)
+            except urllib.error.HTTPError as e:
+                # an HTTP error *response* from the backend: relay it;
+                # 5xx counts against the backend's health
+                backend_ok = e.code < 500
+                data = e.read()
+                self.send_response(e.code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                headers_sent = True
+                self.wfile.write(data)
+                return
+            except Exception as e:
+                backend_ok = False
+                logger.warning("upstream %s failed: %s", backend.url, e)
+                data = json.dumps({"error": {
+                    "message": f"upstream {backend.url} unreachable",
+                    "type": "bad_gateway"}}).encode()
+                self.send_response(502)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                headers_sent = True
+                self.wfile.write(data)
+                return
+            with resp_ctx as resp:
+                self.send_response(resp.status)
+                ctype = resp.headers.get("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
+                if "event-stream" in ctype:
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    headers_sent = True
+                    while True:
+                        try:
+                            chunk = resp.read1(65536)
+                        except Exception:
+                            backend_ok = False      # upstream died mid-stream
+                            break
+                        if not chunk:
+                            break
+                        self.wfile.write(hex(len(chunk))[2:].encode()
+                                         + b"\r\n" + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                else:
+                    data = resp.read()
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    headers_sent = True
+                    self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass                      # client went away — backend is fine
+        except Exception:
+            logger.exception("gateway relay failed")
+            if not headers_sent:
+                try:
+                    data = b'{"error":{"message":"gateway error"}}'
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except Exception:
+                    pass
+        finally:
+            ctx.release(backend, backend_ok)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            data = b'{"status":"ok"}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self._relay("GET")
+
+    def do_POST(self):
+        self._relay("POST")
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser("tpuserve.gateway")
+    ap.add_argument("--backend", action="append", required=True,
+                    help="backend URL (repeatable)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    gw = Gateway(args.backend, GatewayConfig(host=args.host, port=args.port))
+    port = gw.start()
+    print(f"gateway listening on :{port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        gw.shutdown()
+
+
+if __name__ == "__main__":
+    main()

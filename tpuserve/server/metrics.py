@@ -1,0 +1,84 @@
+"""Prometheus serving metrics with vLLM-compatible metric families.
+
+The reference's observability stack scrapes vLLM pods by the
+``prometheus.io/scrape`` annotation and queries ``vllm_request_total``,
+``vllm_active_requests``, ``vllm_request_duration_seconds`` and friends
+(reference: otel-observability-setup.yaml:337-391 scrape job,
+:728,:758-761 verification queries).  Emitting the same families means the
+ported scrape config and Grafana cookbook carry over unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
+                               generate_latest)
+
+_TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5,
+                 5.0, 10.0)
+_ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+_DURATION_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class ServerMetrics:
+    """Per-server metric registry (isolated so tests can run many servers)."""
+
+    def __init__(self, model_name: str):
+        self.registry = CollectorRegistry()
+        self.model_name = model_name
+        label = {"model_name": model_name}
+
+        def counter(name, doc):
+            return Counter(name, doc, ["model_name"],
+                           registry=self.registry).labels(**label)
+
+        def gauge(name, doc):
+            return Gauge(name, doc, ["model_name"],
+                         registry=self.registry).labels(**label)
+
+        def histogram(name, doc, buckets):
+            return Histogram(name, doc, ["model_name"], buckets=buckets,
+                             registry=self.registry).labels(**label)
+
+        # The families the reference's verification queries look for:
+        self.request_total = counter(
+            "vllm_request_total", "Total requests received")
+        self.active_requests = gauge(
+            "vllm_active_requests", "Requests currently running or queued")
+        self.request_duration = histogram(
+            "vllm_request_duration_seconds", "End-to-end request latency",
+            _DURATION_BUCKETS)
+        # Standard vLLM serving families:
+        self.request_success = Counter(
+            "vllm_request_success", "Finished requests by reason",
+            ["model_name", "finished_reason"], registry=self.registry)
+        self.prompt_tokens = counter(
+            "vllm_prompt_tokens", "Prefill tokens processed")
+        self.generation_tokens = counter(
+            "vllm_generation_tokens", "Tokens generated")
+        self.ttft = histogram(
+            "vllm_time_to_first_token_seconds", "Time to first token",
+            _TTFT_BUCKETS)
+        self.itl = histogram(
+            "vllm_time_per_output_token_seconds", "Inter-token latency",
+            _ITL_BUCKETS)
+        self.kv_usage = gauge(
+            "vllm_kv_cache_usage_perc", "Fraction of KV blocks in use")
+        self.preemptions = counter(
+            "vllm_num_preemptions", "Sequences preempted and re-prefilled")
+        self.running = gauge(
+            "vllm_num_requests_running", "Requests in the decode batch")
+        self.waiting = gauge(
+            "vllm_num_requests_waiting", "Requests queued for prefill")
+
+    def observe_finish(self, reason: str, duration_s: float) -> None:
+        self.request_success.labels(model_name=self.model_name,
+                                    finished_reason=reason).inc()
+        self.request_duration.observe(duration_s)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+_START_TIME = time.time()

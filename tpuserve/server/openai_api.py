@@ -1,0 +1,415 @@
+"""OpenAI-compatible HTTP server (stdlib only — no FastAPI in the image).
+
+Serves the same API surface the reference smoke-tests through the llm-d
+gateway: ``GET /v1/models`` and ``POST /v1/completions``
+(reference: llm-d-test.yaml:32-78), plus ``/v1/chat/completions`` with SSE
+streaming, ``/metrics`` in Prometheus format on the scrape-annotated port
+(otel-observability-setup.yaml:337-391 expects port 8000 + the
+``prometheus.io/scrape`` annotation), and ``/healthz`` / ``/readyz`` probes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpuserve.models.tokenizer import default_chat_template
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.server.metrics import ServerMetrics
+from tpuserve.server.runner import AsyncEngineRunner
+
+logger = logging.getLogger("tpuserve.server")
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8000
+    served_model_name: Optional[str] = None     # defaults to engine model
+    max_tokens_cap: int = 4096
+    request_timeout_s: float = 600.0
+
+
+def _num(body: dict, key: str, default, cast):
+    """Fetch a numeric field; null falls back to the default; junk -> 400."""
+    val = body.get(key)
+    if val is None:
+        return default
+    try:
+        return cast(val)
+    except (TypeError, ValueError):
+        raise ValueError(f"'{key}' must be a number, got {val!r}") from None
+
+
+def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
+    stop = body.get("stop") or ()
+    if isinstance(stop, str):
+        stop = (stop,)
+    if not isinstance(stop, (list, tuple)) or not all(
+            isinstance(s, str) for s in stop):
+        raise ValueError("'stop' must be a string or list of strings")
+    n_logprobs = body.get("logprobs")
+    if isinstance(n_logprobs, bool):            # chat API sends a bool
+        n_logprobs = _num(body, "top_logprobs", 5, int) if n_logprobs else None
+    elif n_logprobs is not None:
+        n_logprobs = _num(body, "logprobs", None, int)
+    seed = body.get("seed")
+    if seed is not None:
+        seed = _num(body, "seed", None, int)
+    return SamplingParams(
+        max_tokens=min(_num(body, "max_tokens", 16, int), cap),
+        temperature=_num(body, "temperature", 1.0, float),
+        top_k=_num(body, "top_k", 0, int),
+        top_p=_num(body, "top_p", 1.0, float),
+        presence_penalty=_num(body, "presence_penalty", 0.0, float),
+        frequency_penalty=_num(body, "frequency_penalty", 0.0, float),
+        repetition_penalty=_num(body, "repetition_penalty", 1.0, float),
+        stop=tuple(stop),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        seed=seed,
+        logprobs=n_logprobs,
+    )
+
+
+class OpenAIServer:
+    """HTTP front end over an AsyncEngineRunner."""
+
+    def __init__(self, engine, config: ServerConfig | None = None,
+                 metrics: ServerMetrics | None = None):
+        self.config = config or ServerConfig()
+        model_name = self.config.served_model_name
+        if model_name is None:
+            cfg_owner = engine if hasattr(engine, "config") else \
+                getattr(engine, "prefill", None)
+            model_name = getattr(getattr(cfg_owner, "config", None), "model", "model")
+        self.model_name = model_name
+        self.metrics = metrics or ServerMetrics(model_name)
+        self.runner = AsyncEngineRunner(engine, self.metrics)
+        self.engine = engine
+        self.ready = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self, warmup: bool = False) -> int:
+        """Start engine loop + HTTP listener; returns the bound port."""
+        self.runner.start()
+        if warmup and hasattr(self.engine, "warmup"):
+            self.engine.warmup()
+        server = self
+
+        class Handler(_Handler):
+            ctx = server
+
+        self._httpd = ThreadingHTTPServer((self.config.host, self.config.port),
+                                          Handler)
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tpuserve-http")
+        self._serve_thread.start()
+        self.ready.set()
+        port = self._httpd.server_address[1]
+        logger.info("serving %s on %s:%d", self.model_name,
+                    self.config.host, port)
+        return port
+
+    def shutdown(self) -> None:
+        self.ready.clear()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.runner.shutdown()
+
+    # ---- request handling (called from handler threads) ----------------
+
+    def handle_completion(self, body: dict, chat: bool):
+        if chat:
+            messages = body.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ValueError("'messages' must be a non-empty list")
+            tok = getattr(self.engine, "tokenizer", None) or \
+                self.engine.prefill.tokenizer
+            if hasattr(tok, "apply_chat_template"):
+                prompt = tok.apply_chat_template(messages)
+            else:
+                prompt = default_chat_template(messages)
+        else:
+            prompt = body.get("prompt")
+            if isinstance(prompt, list):
+                if prompt and isinstance(prompt[0], int):
+                    return prompt, _sampling_from_request(
+                        body, self.config.max_tokens_cap)
+                if len(prompt) != 1:
+                    raise ValueError("batched prompt lists are not supported; "
+                                     "send one request per prompt")
+                prompt = prompt[0]
+            if not isinstance(prompt, str) or not prompt:
+                raise ValueError("'prompt' must be a non-empty string")
+        return prompt, _sampling_from_request(body, self.config.max_tokens_cap)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    ctx: OpenAIServer
+    protocol_version = "HTTP/1.1"
+
+    # quieter logs
+    def log_message(self, fmt, *args):
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    # ---- helpers -------------------------------------------------------
+
+    def _json(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, code: int, message: str, etype: str = "invalid_request_error") -> None:
+        self._json(code, {"error": {"message": message, "type": etype}})
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ValueError("missing request body")
+        if length > 10 * 1024 * 1024:
+            raise ValueError("request body too large")
+        raw = self.rfile.read(length)
+        body = json.loads(raw)
+        if not isinstance(body, dict):
+            raise ValueError("request body must be a JSON object")
+        return body
+
+    # ---- routes --------------------------------------------------------
+
+    def do_GET(self):
+        ctx = self.ctx
+        if self.path == "/v1/models":
+            self._json(200, {"object": "list", "data": [{
+                "id": ctx.model_name, "object": "model",
+                "created": int(time.time()), "owned_by": "tpuserve"}]})
+        elif self.path == "/metrics":
+            data = ctx.metrics.render()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif self.path == "/healthz":
+            self._json(200, {"status": "ok"})
+        elif self.path == "/readyz":
+            if ctx.ready.is_set():
+                self._json(200, {"status": "ready"})
+            else:
+                self._error(503, "not ready", "server_error")
+        else:
+            self._error(404, f"no route {self.path}")
+
+    def do_POST(self):
+        chat = self.path == "/v1/chat/completions"
+        if self.path not in ("/v1/completions", "/v1/chat/completions"):
+            self._error(404, f"no route {self.path}")
+            return
+        try:
+            body = self._read_body()
+            prompt, params = self.ctx.handle_completion(body, chat)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, str(e))
+            return
+        stream = bool(body.get("stream", False))
+        kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
+                  else {"prompt": prompt})
+        try:
+            if stream:
+                # _stream_response owns its error handling: once SSE headers
+                # are out, a second status line would corrupt the stream.
+                self._stream_response(body, params, chat, kwargs)
+            else:
+                self._full_response(body, params, chat, kwargs)
+        except BrokenPipeError:
+            pass
+        except Exception as e:               # engine-side failure, pre-headers
+            logger.exception("request failed")
+            if not stream:
+                try:
+                    self._error(500, str(e), "server_error")
+                except Exception:
+                    pass
+
+    # ---- response shapes ------------------------------------------------
+
+    def _full_response(self, body, params, chat, kwargs):
+        ctx = self.ctx
+        t0 = time.monotonic()
+        rid, q = ctx.runner.submit(params=params, **kwargs)
+        text_parts, token_ids, logprob_entries = [], [], []
+        finish_reason = "stop"
+        deadline = t0 + ctx.config.request_timeout_s
+        import queue as _queue
+        while True:
+            try:
+                item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+            except _queue.Empty:
+                # Abandoning without aborting would leave the engine
+                # generating to max_tokens and leak the record.
+                ctx.runner.abort(rid)
+                ctx.engine.requests.pop(rid, None)
+                self._error(504, "request timed out", "server_error")
+                return
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                ctx.engine.requests.pop(rid, None)
+                self._error(400, str(item))
+                return
+            text_parts.append(item.new_text)
+            token_ids.extend(item.new_token_ids)
+            if item.finish_reason is not None:
+                finish_reason = item.finish_reason.value
+        req = ctx.engine.requests.pop(rid, None)
+        text = "".join(text_parts)
+        if req is not None and params.logprobs is not None:
+            logprob_entries = req.logprobs
+        oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        usage = {
+            "prompt_tokens": req.num_prompt_tokens if req else None,
+            "completion_tokens": len(token_ids),
+            "total_tokens": (req.num_prompt_tokens if req else 0) + len(token_ids),
+        }
+        if chat:
+            choice = {"index": 0, "message": {"role": "assistant", "content": text},
+                      "finish_reason": finish_reason}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
+            if logprob_entries:
+                choice["logprobs"] = {
+                    "token_logprobs": [e["logprob"] for e in logprob_entries],
+                    "tokens": [e["token_id"] for e in logprob_entries],
+                    "top_logprobs": [dict(e["top"]) for e in logprob_entries],
+                }
+            obj = "text_completion"
+        self._json(200, {"id": oid, "object": obj, "created": int(time.time()),
+                         "model": ctx.model_name, "choices": [choice],
+                         "usage": usage})
+
+    def _stream_response(self, body, params, chat, kwargs):
+        ctx = self.ctx
+        rid, q = ctx.runner.submit(params=params, **kwargs)
+        oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(payload: dict):
+            data = b"data: " + json.dumps(payload).encode() + b"\n\n"
+            self.wfile.write(hex(len(data))[2:].encode() + b"\r\n" + data + b"\r\n")
+            self.wfile.flush()
+
+        deadline = time.monotonic() + ctx.config.request_timeout_s
+        import queue as _queue
+        try:
+            if chat:
+                send_chunk({"id": oid, "object": "chat.completion.chunk",
+                            "model": ctx.model_name,
+                            "choices": [{"index": 0,
+                                         "delta": {"role": "assistant"},
+                                         "finish_reason": None}]})
+            while True:
+                try:
+                    item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+                except _queue.Empty:
+                    ctx.runner.abort(rid)
+                    send_chunk({"error": {"message": "request timed out"}})
+                    break
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    send_chunk({"error": {"message": str(item)}})
+                    break
+                finish = item.finish_reason.value if item.finish_reason else None
+                if chat:
+                    delta = {"content": item.new_text} if item.new_text else {}
+                    choice = {"index": 0, "delta": delta, "finish_reason": finish}
+                    obj = "chat.completion.chunk"
+                else:
+                    choice = {"index": 0, "text": item.new_text,
+                              "finish_reason": finish}
+                    obj = "text_completion"
+                send_chunk({"id": oid, "object": obj, "created": int(time.time()),
+                            "model": ctx.model_name, "choices": [choice]})
+            done = b"data: [DONE]\n\n"
+            self.wfile.write(hex(len(done))[2:].encode() + b"\r\n" + done + b"\r\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            ctx.runner.abort(rid)       # client went away mid-stream
+        except Exception:
+            logger.exception("streaming failed")
+            ctx.runner.abort(rid)
+        finally:
+            ctx.engine.requests.pop(rid, None)
+
+
+def main(argv=None):
+    import argparse
+
+    from tpuserve.runtime.engine import Engine, EngineConfig
+    from tpuserve.runtime.kv_cache import CacheConfig
+    from tpuserve.runtime.scheduler import SchedulerConfig
+
+    ap = argparse.ArgumentParser("tpuserve.server")
+    ap.add_argument("--model", default="Qwen/Qwen3-0.6B")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--block-size", type=int, default=32)
+    ap.add_argument("--num-blocks", type=int, default=2048)
+    ap.add_argument("--max-blocks-per-seq", type=int, default=64)
+    ap.add_argument("--max-num-seqs", type=int, default=64)
+    ap.add_argument("--attn-impl", default="auto")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor parallel degree (0 = no mesh)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode pools in-process")
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    ecfg = EngineConfig(
+        model=args.model, checkpoint_dir=args.checkpoint_dir,
+        cache=CacheConfig(block_size=args.block_size,
+                          num_blocks=args.num_blocks,
+                          max_blocks_per_seq=args.max_blocks_per_seq),
+        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
+        attn_impl=args.attn_impl)
+    mesh = None
+    if args.tp > 1:
+        from tpuserve.parallel import MeshConfig, make_mesh
+        mesh = make_mesh(MeshConfig(dp=1, tp=args.tp))
+    if args.disagg:
+        from tpuserve.parallel.disagg import DisaggregatedEngine
+        engine = DisaggregatedEngine(ecfg, ecfg)
+    else:
+        engine = Engine(ecfg, mesh=mesh)
+    server = OpenAIServer(engine, ServerConfig(host=args.host, port=args.port))
+    port = server.start(warmup=not args.no_warmup)
+    print(f"tpuserve listening on {args.host}:{port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

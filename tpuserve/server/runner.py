@@ -1,0 +1,241 @@
+"""Background engine loop with a thread-safe request interface.
+
+The Engine itself is single-threaded (all device work happens on the loop
+thread); HTTP handler threads talk to it through an intake queue and
+per-request output queues.  This is the process-level analog of vLLM's
+AsyncLLMEngine inside the container the reference deploys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Optional, Sequence, Union
+
+from tpuserve.runtime.engine import Engine
+from tpuserve.runtime.request import RequestOutput, SamplingParams
+
+logger = logging.getLogger("tpuserve.server")
+
+
+@dataclasses.dataclass
+class _Submit:
+    prompt: Optional[str]
+    prompt_token_ids: Optional[list[int]]
+    params: SamplingParams
+    out_queue: "queue.Queue[RequestOutput | Exception | None]"
+    rid_event: threading.Event
+    request_id: Optional[str] = None
+    assigned_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Abort:
+    request_id: str
+
+
+class AsyncEngineRunner:
+    """Runs engine.step() on a dedicated thread; routes outputs to callers.
+
+    Works with any engine exposing add_request/step/has_work/abort_request —
+    both Engine and DisaggregatedEngine.
+    """
+
+    def __init__(self, engine, metrics=None):
+        self.engine = engine
+        self.metrics = metrics
+        self._intake: "queue.Queue[_Submit | _Abort]" = queue.Queue()
+        self._out_queues: dict[str, queue.Queue] = {}
+        self._req_started: dict[str, float] = {}
+        self._last_token_time: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpuserve-engine-loop")
+        self._started = False
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._started:
+            self._thread.join(timeout=30)
+
+    # ---- client API (any thread) ---------------------------------------
+
+    def submit(self, prompt: Optional[str] = None,
+               prompt_token_ids: Optional[Sequence[int]] = None,
+               params: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None,
+               ) -> tuple[str, "queue.Queue[RequestOutput | Exception | None]"]:
+        """Enqueue a request; returns (request_id, output queue).  The queue
+        yields RequestOutput items, then None when finished; an Exception
+        item signals a rejected request."""
+        sub = _Submit(prompt=prompt,
+                      prompt_token_ids=list(prompt_token_ids) if prompt_token_ids else None,
+                      params=params or SamplingParams(),
+                      out_queue=queue.Queue(), rid_event=threading.Event(),
+                      request_id=request_id)
+        self._intake.put(sub)
+        self._wake.set()
+        sub.rid_event.wait(timeout=60)
+        if sub.assigned_id is None:
+            raise TimeoutError("engine loop did not accept the request")
+        return sub.assigned_id, sub.out_queue
+
+    def abort(self, request_id: str) -> None:
+        self._intake.put(_Abort(request_id))
+        self._wake.set()
+
+    def generate_sync(self, prompt=None, prompt_token_ids=None, params=None,
+                      timeout: float = 600.0):
+        """Blocking convenience: returns (list[RequestOutput], request_id)."""
+        rid, q = self.submit(prompt=prompt, prompt_token_ids=prompt_token_ids,
+                             params=params)
+        outs = []
+        deadline = time.monotonic() + timeout
+        while True:
+            item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+            if item is None:
+                getattr(self.engine, "requests", {}).pop(rid, None)
+                return outs, rid
+            if isinstance(item, Exception):
+                getattr(self.engine, "requests", {}).pop(rid, None)
+                raise item
+            outs.append(item)
+
+    # ---- engine loop ----------------------------------------------------
+
+    def _drain_intake(self) -> None:
+        while True:
+            try:
+                msg = self._intake.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(msg, _Abort):
+                if self.engine.abort_request(msg.request_id):
+                    q = self._out_queues.pop(msg.request_id, None)
+                    getattr(self.engine, "requests", {}).pop(msg.request_id, None)
+                    if q is not None:
+                        q.put(None)
+                continue
+            try:
+                rid = self.engine.add_request(
+                    prompt=msg.prompt, prompt_token_ids=msg.prompt_token_ids,
+                    params=msg.params, request_id=msg.request_id)
+            except Exception as e:           # invalid request: report, don't die
+                msg.assigned_id = msg.request_id or "rejected"
+                msg.rid_event.set()
+                msg.out_queue.put(e)
+                msg.out_queue.put(None)
+                continue
+            msg.assigned_id = rid
+            self._out_queues[rid] = msg.out_queue
+            self._req_started[rid] = time.monotonic()
+            self._last_token_time[rid] = self._req_started[rid]
+            if self.metrics:
+                self.metrics.request_total.inc()
+                req = getattr(self.engine, "requests", {}).get(rid)
+                if req is not None:
+                    self.metrics.prompt_tokens.inc(req.num_prompt_tokens)
+            msg.rid_event.set()
+
+    def _route_outputs(self, outputs: list[RequestOutput]) -> None:
+        now = time.monotonic()
+        for out in outputs:
+            q = self._out_queues.get(out.request_id)
+            if self.metrics:
+                self.metrics.generation_tokens.inc(len(out.new_token_ids))
+                last = self._last_token_time.get(out.request_id)
+                if last is not None:
+                    if out.num_output_tokens == 1:
+                        self.metrics.ttft.observe(now - self._req_started.get(
+                            out.request_id, now))
+                    else:
+                        self.metrics.itl.observe(now - last)
+                self._last_token_time[out.request_id] = now
+            if q is not None:
+                q.put(out)
+            if out.finished:
+                if self.metrics:
+                    started = self._req_started.pop(out.request_id, now)
+                    reason = out.finish_reason.value if out.finish_reason else "stop"
+                    self.metrics.observe_finish(reason, now - started)
+                self._last_token_time.pop(out.request_id, None)
+                # NOTE: the request record stays in engine.requests — the
+                # caller that submitted claims (pops) it for usage/logprobs.
+                if q is not None:
+                    self._out_queues.pop(out.request_id, None)
+                    q.put(None)
+
+    def _update_gauges(self) -> None:
+        if not self.metrics:
+            return
+        eng = self.engine
+        scheds = []
+        if hasattr(eng, "scheduler"):
+            scheds = [eng.scheduler]
+        elif hasattr(eng, "prefill"):
+            scheds = [eng.prefill.scheduler, eng.decode.scheduler]
+        running = sum(s.num_running for s in scheds)
+        waiting = sum(s.num_waiting for s in scheds)
+        self.metrics.running.set(running)
+        self.metrics.waiting.set(waiting)
+        self.metrics.active_requests.set(running + waiting)
+        bms = []
+        if hasattr(eng, "block_manager"):
+            bms = [eng.block_manager]
+        elif hasattr(eng, "decode"):
+            bms = [eng.prefill.block_manager, eng.decode.block_manager]
+        if bms:
+            total = sum(bm.num_blocks for bm in bms)
+            free = sum(bm.num_free_blocks for bm in bms)
+            self.metrics.kv_usage.set((total - free) / max(total, 1))
+        stats = getattr(eng, "stats", None)
+        if stats is not None and hasattr(stats, "preemptions"):
+            # counter semantics: advance to the engine's cumulative count
+            current = self.metrics.preemptions._value.get()
+            if stats.preemptions > current:
+                self.metrics.preemptions.inc(stats.preemptions - current)
+
+    def _loop(self) -> None:
+        logger.info("engine loop started")
+        while not self._stop.is_set():
+            self._drain_intake()
+            if not self.engine.has_work():
+                self._update_gauges()
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed")
+                # Fail all in-flight requests AND drain them from the engine:
+                # leaving them scheduled would re-raise every iteration in a
+                # tight loop.
+                for rid, q in list(self._out_queues.items()):
+                    try:
+                        self.engine.abort_request(rid)
+                    except Exception:
+                        pass
+                    getattr(self.engine, "requests", {}).pop(rid, None)
+                    q.put(RuntimeError("engine failure"))
+                    q.put(None)
+                self._out_queues.clear()
+                self._req_started.clear()
+                self._last_token_time.clear()
+                time.sleep(0.1)
+                continue
+            self._route_outputs(outputs)
+            self._update_gauges()
+        logger.info("engine loop stopped")
