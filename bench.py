@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Headline benchmark: continuous-batching decode throughput on one chip.
+
+Runs the full serving engine path (scheduler -> paged KV cache -> jitted
+bucketed prefill/decode -> on-device sampling; Pallas attention kernels on
+TPU) on the flagship model Qwen3-0.6B — the reference's default served model
+(reference: llm-d-deploy.yaml:118, llm-d-test.yaml:7) — and prints ONE JSON
+line.  The baseline is the driver-defined north-star target of 2,000
+tok/s/chip on v5e (BASELINE.md); the reference itself publishes no numbers
+(SURVEY.md §6).
+
+Usage: python bench.py [--batch N] [--prompt-len N] [--gen-len N] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+TARGET_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north-star target
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="qwen3-0.6b")
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--prompt-len", type=int, default=None)
+    ap.add_argument("--gen-len", type=int, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-model CPU smoke run (does not update baselines)")
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from tpuserve.runtime.engine import Engine, EngineConfig
+    from tpuserve.runtime.kv_cache import CacheConfig
+    from tpuserve.runtime.request import SamplingParams
+    from tpuserve.runtime.scheduler import SchedulerConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.smoke:
+        model, batch, prompt_len, gen_len = "tiny-qwen3", 8, 16, 16
+    elif not on_tpu:
+        # Real model, CPU-sized workload (the BASELINE "CPU smoke" config).
+        model = args.model
+        batch = args.batch or 8
+        prompt_len = args.prompt_len or 16
+        gen_len = args.gen_len or 16
+    else:
+        model = args.model
+        batch = args.batch or 64
+        prompt_len = args.prompt_len or 128
+        gen_len = args.gen_len or 128
+
+    max_len = prompt_len + gen_len
+    block_size = 32
+    blocks_per_seq = -(-max_len // block_size) + 1
+    cache = CacheConfig(block_size=block_size,
+                        num_blocks=batch * blocks_per_seq + 2 * batch,
+                        max_blocks_per_seq=blocks_per_seq)
+    sched = SchedulerConfig(max_num_seqs=batch)
+    # tiny-model head dims don't meet Pallas TPU tiling minima (8, 128)
+    attn_impl = "reference" if args.smoke else "auto"
+    engine = Engine(EngineConfig(
+        model=model, cache=cache, scheduler=sched, attn_impl=attn_impl,
+        enable_prefix_caching=False))
+
+    rng = np.random.default_rng(0)
+    vocab = engine.model_cfg.vocab_size
+    prompts = [rng.integers(1, vocab - 1, size=prompt_len).tolist()
+               for _ in range(batch)]
+    params = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                            ignore_eos=True)
+
+    # Warm the compile cache so the measurement sees steady-state executables
+    # (SURVEY.md §7: TTFT budget requires AOT warmup, cold XLA compile would
+    # dominate otherwise).
+    engine.warmup(
+        prefill_buckets=[engine.scheduler.prefill_bucket(prompt_len)],
+        decode_buckets=[engine.scheduler.decode_bucket(batch)])
+
+    for p in prompts:
+        engine.add_request(prompt_token_ids=p, params=params)
+
+    t_start = time.perf_counter()
+    prefill_time = decode_time = 0.0
+    while engine.has_work():
+        d0 = engine.stats.num_decode_steps
+        t0 = time.perf_counter()
+        engine.step()
+        dt = time.perf_counter() - t0
+        if engine.stats.num_decode_steps > d0:
+            decode_time += dt
+        else:
+            prefill_time += dt
+    total_time = time.perf_counter() - t_start
+
+    gen_tokens = engine.stats.generated_tokens
+    n_chips = max(jax.local_device_count(), 1) if on_tpu else 1
+    decode_tok_s = gen_tokens / decode_time / n_chips if decode_time else 0.0
+    ttft_ms = (1000.0 * engine.stats.ttft_sum / engine.stats.ttft_count
+               if engine.stats.ttft_count else 0.0)
+
+    print(json.dumps({
+        "metric": "decode_throughput",
+        "value": round(decode_tok_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(decode_tok_s / TARGET_TOK_S_PER_CHIP, 3),
+        "model": engine.model_cfg.name,
+        "backend": jax.default_backend(),
+        "attn_impl": engine.attn_impl,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+        "ttft_ms": round(ttft_ms, 1),
+        "e2e_tok_s": round(gen_tokens / total_time / n_chips, 1),
+        "prefill_s": round(prefill_time, 3),
+        "decode_s": round(decode_time, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,10 +2,13 @@
 
 Blockwise online-softmax attention: grid (batch, q_heads, q_blocks, k_blocks)
 with fp32 running max / sum / accumulator in VMEM scratch persisted across the
-k dimension (the innermost, "arbitrary" grid axis).  Matches
-``tpuserve.ops.attention.prefill_attention`` semantics; tested against it in
-interpret mode on CPU (the reference repo has no kernels to compare — it
-delegates attention to vLLM's CUDA kernels, SURVEY.md §2.2).
+k dimension (the innermost, "arbitrary" grid axis).  Inputs are laid out
+(B, H, T, D) inside the kernel so each block's trailing two dims are
+(block_len, head_dim) — the shape Mosaic can tile onto the 8x128 VPU lanes
+and the MXU.  Matches ``tpuserve.ops.attention.prefill_attention`` semantics;
+tested against it in interpret mode on CPU and compiled on real TPU (the
+reference repo has no kernels to compare — it delegates attention to vLLM's
+CUDA kernels, SURVEY.md §2.2).
 """
 
 from __future__ import annotations
@@ -21,7 +24,7 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, blk_q, blk_k, seq_len):
+                  m_scr, l_scr, acc_scr, *, scale, blk_q, blk_k):
     b = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -41,9 +44,9 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     # the last query row of the q block, and inside the valid prompt.
     @pl.when((k_start <= q_start + blk_q - 1) & (k_start < prompt_len))
     def _compute():
-        q = q_ref[0, :, 0, :].astype(jnp.float32)          # (blk_q, D)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)          # (blk_k, D)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)          # (blk_q, D)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)          # (blk_k, D)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         # Zero v rows past the prompt: out-of-bounds block tails are
         # unspecified memory (possibly NaN), and 0 * NaN would poison the
         # accumulator even though their probabilities are exactly 0.
@@ -73,7 +76,7 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         # Fully-masked rows (padding) have l == 0; emit zeros there.
         l = l_scr[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, :, 0, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, 0, :, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k", "interpret"))
@@ -83,7 +86,9 @@ def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             interpret: bool | None = None) -> jnp.ndarray:
     """q: (B, T, Hq, D); k/v: (B, T, Hkv, D); prompt_lens: (B,). -> (B, T, Hq, D).
 
-    T is padded (bucketed) by the engine; rows past prompt_lens produce zeros.
+    T is padded (bucketed) by the engine; query rows past prompt_lens still
+    attend to the valid keys (same as the reference impl) — the engine only
+    reads the row at prompt_len - 1, so their values are never consumed.
     """
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -94,17 +99,23 @@ def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         interpret = jax.default_backend() != "tpu"
     grid = (B, Hq, pl.cdiv(T, blk_q), pl.cdiv(T, blk_k))
 
+    # (B, T, H, D) -> (B, H, T, D): trailing block dims become (blk, D),
+    # which Mosaic can tile; XLA fuses the transposes into neighbours.
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
     kernel = functools.partial(_flash_kernel, scale=scale, blk_q=blk_q,
-                               blk_k=blk_k, seq_len=T)
+                               blk_k=blk_k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, blk_q, 1, D), lambda b, h, qi, ki, lens: (b, qi, h, 0)),
-            pl.BlockSpec((1, blk_k, 1, D), lambda b, h, qi, ki, lens: (b, ki, h // group, 0)),
-            pl.BlockSpec((1, blk_k, 1, D), lambda b, h, qi, ki, lens: (b, ki, h // group, 0)),
+            pl.BlockSpec((1, 1, blk_q, D), lambda b, h, qi, ki, lens: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, qi, ki, lens: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, blk_k, D), lambda b, h, qi, ki, lens: (b, h // group, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, 1, D), lambda b, h, qi, ki, lens: (b, qi, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, blk_q, D), lambda b, h, qi, ki, lens: (b, h, qi, 0)),
         scratch_shapes=[
             pltpu.VMEM((blk_q, 1), jnp.float32),
             pltpu.VMEM((blk_q, 1), jnp.float32),
@@ -114,10 +125,10 @@ def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(prompt_lens, q, k, v)
-    return out
+    )(prompt_lens, qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
