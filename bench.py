@@ -77,9 +77,15 @@ def main(argv=None):
     # Warm the compile cache so the measurement sees steady-state executables
     # (SURVEY.md §7: TTFT budget requires AOT warmup, cold XLA compile would
     # dominate otherwise).
-    engine.warmup(
-        prefill_buckets=[engine.scheduler.prefill_bucket(prompt_len)],
-        decode_buckets=[engine.scheduler.decode_bucket(batch)])
+    # Warm every shape the run will actually hit: prefill batches are padded
+    # to powers of two up to max_prefill_seqs; with uniform prompts and
+    # ignore_eos the decode batch only ever runs at one bucket.
+    from tpuserve.utils import next_power_of_2
+    L = engine.scheduler.prefill_bucket(prompt_len)
+    max_pb = min(next_power_of_2(sched.max_prefill_seqs), batch)
+    pb = {max_pb, next_power_of_2(batch % sched.max_prefill_seqs or max_pb)}
+    engine.warmup(prefill_buckets=[(B, L) for B in sorted(pb)],
+                  decode_buckets=[engine.scheduler.decode_bucket(batch)])
 
     for p in prompts:
         engine.add_request(prompt_token_ids=p, params=params)
@@ -98,8 +104,11 @@ def main(argv=None):
     total_time = time.perf_counter() - t_start
 
     gen_tokens = engine.stats.generated_tokens
-    n_chips = max(jax.local_device_count(), 1) if on_tpu else 1
-    decode_tok_s = gen_tokens / decode_time / n_chips if decode_time else 0.0
+    # Each request's first token is sampled during its prefill step; only the
+    # rest were produced in decode-timed steps.  The engine runs on a single
+    # chip (no mesh), so the per-chip divisor is 1.
+    decode_tokens = gen_tokens - batch
+    decode_tok_s = decode_tokens / decode_time if decode_time else 0.0
     ttft_ms = (1000.0 * engine.stats.ttft_sum / engine.stats.ttft_count
                if engine.stats.ttft_count else 0.0)
 
@@ -115,7 +124,7 @@ def main(argv=None):
         "prompt_len": prompt_len,
         "gen_len": gen_len,
         "ttft_ms": round(ttft_ms, 1),
-        "e2e_tok_s": round(gen_tokens / total_time / n_chips, 1),
+        "e2e_tok_s": round(gen_tokens / total_time, 1),
         "prefill_s": round(prefill_time, 3),
         "decode_s": round(decode_time, 3),
     }))

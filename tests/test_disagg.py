@@ -81,12 +81,18 @@ def test_disagg_admission_control_many_requests():
     assert all(len(r.output_token_ids) == 4 for r in out)
 
 
-def test_disagg_decode_pool_too_small_raises():
+def test_disagg_decode_pool_too_small_rejected_at_intake():
+    # A prompt the decode pool can never admit must be rejected at
+    # add_request — surfacing it later as a step() failure would take down
+    # every other in-flight request.
     tiny_decode = EngineConfig(
         model="tiny-qwen3",
         cache=CacheConfig(block_size=4, num_blocks=2, max_blocks_per_seq=8),
         enable_prefix_caching=False)
     disagg = DisaggregatedEngine(_cfg(), tiny_decode)
-    with pytest.raises(MemoryError):
-        disagg.generate([[1, 2, 3, 4, 5, 6, 7, 8]],
-                        SamplingParams(max_tokens=4, ignore_eos=True))
+    with pytest.raises(ValueError, match="decode pool capacity"):
+        disagg.add_request(prompt_token_ids=[1, 2, 3, 4, 5, 6, 7, 8],
+                           params=SamplingParams(max_tokens=4, ignore_eos=True))
+    # nothing leaked into either pool
+    assert not disagg.has_work()
+    assert disagg.prefill.block_manager.num_seqs() == 0

@@ -90,9 +90,9 @@ class DisaggregatedEngine:
     """
 
     def __init__(self, prefill_config: EngineConfig, decode_config: EngineConfig,
-                 decode_device=None):
-        self.prefill = Engine(prefill_config)
-        self.decode = Engine(decode_config)
+                 decode_device=None, mesh=None):
+        self.prefill = Engine(prefill_config, mesh=mesh)
+        self.decode = Engine(decode_config, mesh=mesh)
         self.decode_device = decode_device
         self.stats = DisaggStats()
         # Prefilled requests whose KV still lives in the prefill cache,
@@ -104,6 +104,22 @@ class DisaggregatedEngine:
                     params: Optional[SamplingParams] = None,
                     request_id: Optional[str] = None) -> str:
         params = params or SamplingParams()
+        # Validate against BOTH pools at intake: a prompt the decode pool can
+        # never admit must be rejected here, not discovered as a MemoryError
+        # in step() after it has already prefilled (which would fail every
+        # other in-flight request via the runner's engine-failure path).
+        if prompt_token_ids is None:
+            if prompt is None:
+                raise ValueError("need prompt or prompt_token_ids")
+            prompt_token_ids = self.prefill.tokenizer.encode(prompt)
+            prompt = None
+        n = len(prompt_token_ids)
+        # max_tokens == 1 finishes during prefill and never migrates, so only
+        # requests that will actually decode are held to the decode pool cap.
+        if params.max_tokens > 1 and n >= self.decode.max_seq_len:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the decode pool capacity "
+                f"({self.decode.max_seq_len} tokens)")
         rid = self.prefill.add_request(prompt=prompt,
                                        prompt_token_ids=prompt_token_ids,
                                        params=params, request_id=request_id)

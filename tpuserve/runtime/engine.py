@@ -221,7 +221,7 @@ class Engine:
                 req.first_token_time = now
                 self.stats.ttft_sum += now - req.arrival_time
                 self.stats.ttft_count += 1
-        return self._append_and_emit(reqs, new_tokens)
+        return self._append_and_emit(reqs, new_tokens, from_prefill=True)
 
     def _prefill_tokens(self, req: Request) -> list[int]:
         """Tokens to prefill — prompt plus, after a preemption, everything
@@ -345,7 +345,8 @@ class Engine:
 
     # ---- bookkeeping --------------------------------------------------
 
-    def _append_and_emit(self, reqs: list[Request], new_tokens: np.ndarray) -> list[RequestOutput]:
+    def _append_and_emit(self, reqs: list[Request], new_tokens: np.ndarray,
+                         from_prefill: bool = False) -> list[RequestOutput]:
         outputs = []
         for req, tok in zip(reqs, new_tokens):
             tok = int(tok)
@@ -372,7 +373,8 @@ class Engine:
                 request_id=req.request_id, new_token_ids=[tok], new_text=delta,
                 finished=finished, finish_reason=reason,
                 num_prompt_tokens=req.num_prompt_tokens,
-                num_output_tokens=len(req.output_token_ids)))
+                num_output_tokens=len(req.output_token_ids),
+                from_prefill=from_prefill))
         return outputs
 
     def _match_stop(self, req: Request, delta: str) -> tuple[str, bool]:
@@ -424,15 +426,21 @@ class Engine:
     # SURVEY.md §7 "TTFT ≤150 ms requires compile-cache warmup at startup")
     # ------------------------------------------------------------------
 
-    def warmup(self, prefill_buckets: Sequence[int] = (), decode_buckets: Sequence[int] = ()) -> None:
+    def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] = (),
+               decode_buckets: Sequence[int] = ()) -> None:
+        """Pre-compile executables.  ``prefill_buckets`` entries are either a
+        padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
+        — _run_prefill pads the batch to a power of two, so warming only
+        batch 1 leaves the multi-sequence prefill shapes cold."""
         prefill_buckets = list(prefill_buckets) or [
             self.config.scheduler.min_prefill_bucket]
         decode_buckets = list(decode_buckets) or [
             self.config.scheduler.min_decode_bucket]
-        for L in prefill_buckets:
-            tokens = jnp.zeros((1, L), jnp.int32)
-            lens = jnp.ones((1,), jnp.int32)
-            slots = jnp.full((1, L), PAD_SLOT, jnp.int32)
+        for bucket in prefill_buckets:
+            B, L = bucket if isinstance(bucket, tuple) else (1, bucket)
+            tokens = jnp.zeros((B, L), jnp.int32)
+            lens = jnp.ones((B,), jnp.int32)
+            slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
             logits, self.kv_cache = transformer.prefill(
                 self.params, self.model_cfg, tokens, lens, slots, self.kv_cache,
                 attn_impl=self.attn_impl)

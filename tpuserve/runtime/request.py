@@ -95,6 +95,10 @@ class RequestOutput:
     finish_reason: Optional[FinishReason] = None
     num_prompt_tokens: int = 0
     num_output_tokens: int = 0
+    # True when this emission came from a prefill step.  With
+    # num_output_tokens > 1 it marks a re-prefill after preemption, whose
+    # wall-clock gap is queue+recompute time, not inter-token latency.
+    from_prefill: bool = False
 
 
 def check_stop(req: Request, eos_token_ids: Sequence[int], max_model_len: int) -> Optional[FinishReason]:

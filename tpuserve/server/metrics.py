@@ -10,8 +10,6 @@ ported scrape config and Grafana cookbook carry over unchanged.
 
 from __future__ import annotations
 
-import time
-
 from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
                                generate_latest)
 
@@ -79,6 +77,3 @@ class ServerMetrics:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
-
-
-_START_TIME = time.time()

@@ -181,6 +181,9 @@ class _Handler(BaseHTTPRequestHandler):
         if length <= 0:
             raise ValueError("missing request body")
         if length > 10 * 1024 * 1024:
+            # The body is left unread; keeping the connection alive would make
+            # the handler parse those bytes as the next request line.
+            self.close_connection = True
             raise ValueError("request body too large")
         raw = self.rfile.read(length)
         body = json.loads(raw)
@@ -268,7 +271,10 @@ class _Handler(BaseHTTPRequestHandler):
                 break
             if isinstance(item, Exception):
                 ctx.engine.requests.pop(rid, None)
-                self._error(400, str(item))
+                if isinstance(item, ValueError):   # rejected at intake
+                    self._error(400, str(item))
+                else:                              # engine-side fault
+                    self._error(500, str(item), "server_error")
                 return
             text_parts.append(item.new_text)
             token_ids.extend(item.new_token_ids)
@@ -399,7 +405,7 @@ def main(argv=None):
         mesh = make_mesh(MeshConfig(dp=1, tp=args.tp))
     if args.disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
-        engine = DisaggregatedEngine(ecfg, ecfg)
+        engine = DisaggregatedEngine(ecfg, ecfg, mesh=mesh)
     else:
         engine = Engine(ecfg, mesh=mesh)
     server = OpenAIServer(engine, ServerConfig(host=args.host, port=args.port))

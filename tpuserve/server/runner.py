@@ -125,6 +125,8 @@ class AsyncEngineRunner:
                 if self.engine.abort_request(msg.request_id):
                     q = self._out_queues.pop(msg.request_id, None)
                     getattr(self.engine, "requests", {}).pop(msg.request_id, None)
+                    self._req_started.pop(msg.request_id, None)
+                    self._last_token_time.pop(msg.request_id, None)
                     if q is not None:
                         q.put(None)
                 continue
@@ -160,7 +162,10 @@ class AsyncEngineRunner:
                     if out.num_output_tokens == 1:
                         self.metrics.ttft.observe(now - self._req_started.get(
                             out.request_id, now))
-                    else:
+                    elif not out.from_prefill:
+                        # A from_prefill emission with output tokens > 1 is a
+                        # re-prefill after preemption: its gap is queue +
+                        # recompute time and would blow out the ITL histogram.
                         self.metrics.itl.observe(now - last)
                 self._last_token_time[out.request_id] = now
             if q is not None:
