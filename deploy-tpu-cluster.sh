@@ -1,0 +1,45 @@
+#!/bin/bash
+# TPU serving cluster deployment — one command from nothing to a serving API.
+# Shell-compatible entry point mirroring the reference's CLI UX
+# (reference: deploy-k8s-cluster.sh:1-117): `deploy` and `cleanup`
+# subcommands, no arguments to deploy, non-zero exit on first failure.
+# All logic lives in the unit-tested Python package (tpuserve.provision).
+
+set -e
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+cd "$SCRIPT_DIR"
+
+usage() {
+    echo "Usage: $0 {deploy|cleanup|test}"
+    echo ""
+    echo "  deploy   Provision a GKE TPU cluster, bootstrap it, deploy the"
+    echo "           tpuserve engine + gateway, smoke-test the API, and set"
+    echo "           up OTEL/Prometheus observability."
+    echo "  cleanup  Tear down every cluster recorded by tpu-inventory-*.ini"
+    echo "           and delete the generated files."
+    echo "  test     Re-run the API smoke tests against the latest cluster."
+    echo ""
+    echo "Config: set TPUSERVE_* env vars or pass a YAML file via"
+    echo "        TPUSERVE_CONFIG (see tpuserve/provision/config.py)."
+    exit 1
+}
+
+case "${1:-}" in
+    deploy)
+        # deploy takes no further arguments (deploy-k8s-cluster.sh:96-99)
+        [ $# -eq 1 ] || usage
+        exec python -m tpuserve.provision ${TPUSERVE_CONFIG:+--config "$TPUSERVE_CONFIG"} deploy
+        ;;
+    cleanup)
+        [ $# -eq 1 ] || usage
+        exec python -m tpuserve.provision ${TPUSERVE_CONFIG:+--config "$TPUSERVE_CONFIG"} cleanup
+        ;;
+    test)
+        [ $# -eq 1 ] || usage
+        exec python -m tpuserve.provision ${TPUSERVE_CONFIG:+--config "$TPUSERVE_CONFIG"} test
+        ;;
+    *)
+        usage
+        ;;
+esac

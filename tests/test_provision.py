@@ -1,0 +1,554 @@
+"""Provisioner tests: inventory contract, config, infra, manifests, layers.
+
+The reference has no unit tests at all (SURVEY.md §4 — e2e smoke only);
+these tests use a fake command runner as the "fake backend" so the whole
+pipeline is exercised without cloud credentials.
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+
+from tpuserve.provision import manifests, observability
+from tpuserve.provision import cluster as cluster_layer
+from tpuserve.provision import infra, serving, smoke
+from tpuserve.provision.config import DeployConfig, load_config
+from tpuserve.provision.inventory import (ClusterRecord, details_path,
+                                          extract_cluster_id,
+                                          find_inventories, generated_files,
+                                          latest_inventory, parse_details,
+                                          read_inventory, write_details,
+                                          write_inventory)
+from tpuserve.provision.runner import (CommandResult, CommandRunner,
+                                       DryRunRunner)
+
+
+class FakeRunner(CommandRunner):
+    """Canned-response runner: first matching (predicate, result) wins."""
+
+    def __init__(self, responses=()):
+        self.responses = list(responses)
+        self.commands = []
+        self.slept = 0.0
+
+    def run(self, argv, *, check=True, timeout=600.0, input_text=None):
+        argv = tuple(argv)
+        self.commands.append((argv, input_text))
+        for match, result in self.responses:
+            joined = " ".join(argv)
+            if (match(joined) if callable(match) else match in joined):
+                res = CommandResult(argv, *result) if isinstance(result, tuple) \
+                    else CommandResult(argv, 0, result, "")
+                if check and not res.ok:
+                    from tpuserve.provision.runner import CommandError
+                    raise CommandError(res)
+                return res
+        return CommandResult(argv, 0, "", "")
+
+    def sleep(self, seconds):
+        self.slept += seconds
+
+    def argvs(self):
+        return [" ".join(a) for a, _ in self.commands]
+
+
+# --- inventory contract ---------------------------------------------------
+
+def _rec(cid="tpu-serve-abc123"):
+    return ClusterRecord(cluster_id=cid, cluster_name="tpu-serve",
+                         project="proj", region="us-central1",
+                         zone="us-central1-a", tpu_type="v5litepod-4",
+                         endpoint="1.2.3.4")
+
+
+def test_inventory_roundtrip(tmp_path):
+    rec = _rec()
+    path = write_inventory(rec, str(tmp_path))
+    assert os.path.basename(path) == "tpu-inventory-tpu-serve-abc123.ini"
+    got = read_inventory(path)
+    assert got.cluster_id == rec.cluster_id
+    assert got.project == "proj"
+    assert got.zone == "us-central1-a"
+    assert got.tpu_type == "v5litepod-4"
+    assert got.kubeconfig_file == "kubeconfig-tpu-serve-abc123"
+
+
+def test_latest_inventory_is_newest_by_mtime(tmp_path):
+    # ls -rt | tail -1 semantics (deploy-k8s-cluster.sh:23)
+    a = write_inventory(_rec("old-1"), str(tmp_path))
+    b = write_inventory(_rec("new-2"), str(tmp_path))
+    os.utime(a, (1000, 1000))
+    os.utime(b, (2000, 2000))
+    assert latest_inventory(str(tmp_path)) == b
+    assert [os.path.basename(p) for p in find_inventories(str(tmp_path))] == [
+        "tpu-inventory-old-1.ini", "tpu-inventory-new-2.ini"]
+
+
+def test_extract_cluster_id_content_and_filename_fallback(tmp_path):
+    # content strategy (cleanup-instance.yaml:24-38)
+    p = tmp_path / "tpu-inventory-namedfile.ini"
+    p.write_text("[tpu_cluster]\nhost cluster_id=from-content x=y\n")
+    assert extract_cluster_id(str(p)) == "from-content"
+    # filename fallback (cleanup-instance.yaml:40-49)
+    q = tmp_path / "tpu-inventory-from-filename.ini"
+    q.write_text("[tpu_cluster]\njunk-without-id\n")
+    assert extract_cluster_id(str(q)) == "from-filename"
+
+
+def test_details_file_roundtrip(tmp_path):
+    rec = _rec()
+    write_details(rec, str(tmp_path), extra={"Model": "Qwen/Qwen3-0.6B"})
+    got = parse_details(details_path(rec.cluster_id, str(tmp_path)))
+    assert got["Cluster ID"] == rec.cluster_id
+    assert got["Model"] == "Qwen/Qwen3-0.6B"
+    assert got["TPU Type"] == "v5litepod-4"
+
+
+# --- config ---------------------------------------------------------------
+
+def test_config_yaml_env_and_override(tmp_path, monkeypatch):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("model: facebook/opt-1.3b\nreplicas: 2\nprovider: local\n")
+    monkeypatch.setenv("TPUSERVE_TENSOR_PARALLEL", "8")
+    monkeypatch.setenv("TPUSERVE_DISAGGREGATED", "true")
+    cfg = load_config(str(f), namespace="custom-ns")
+    assert cfg.model == "facebook/opt-1.3b"
+    assert cfg.replicas == 2
+    assert cfg.tensor_parallel == 8
+    assert cfg.disaggregated is True
+    assert cfg.namespace == "custom-ns"
+
+
+def test_config_rejects_unknown_keys_and_bad_values(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("no_such_key: 1\n")
+    with pytest.raises(ValueError):
+        load_config(str(f))
+    with pytest.raises(ValueError):
+        load_config(None, provider="nope")
+    # project requirement is enforced at provision time, not load time, so
+    # `test`/`cleanup` work without it
+    cfg = load_config(None, provider="gke", project="")
+    with pytest.raises(ValueError, match="project"):
+        infra.provision(cfg, FakeRunner(), "/tmp/nonexistent-ok")
+
+
+def test_chips_per_node():
+    assert DeployConfig(provider="local", tpu_type="v5litepod-8").chips_per_node == 8
+    assert DeployConfig(provider="local", tpu_type="weird").chips_per_node == 4
+
+
+# --- infra: provision + cleanup -------------------------------------------
+
+KUBECONFIG_YAML = "apiVersion: v1\nkind: Config\nclusters: []\n"
+TPU_NODES_OUT = "gke-tpu-node-1 4\n"
+
+
+def gke_fake():
+    return FakeRunner([
+        ("clusters describe", (1, "", "not found")),   # no existing cluster
+        ("node-pools describe", (1, "", "not found")),
+        ("config view", KUBECONFIG_YAML),
+        ("kubectl wait --for=condition=Ready nodes", (0, "ok", "")),
+        ("get nodes -o jsonpath", TPU_NODES_OUT),
+    ])
+
+
+def test_provision_gke_sequences_and_writes_contract(tmp_path):
+    cfg = load_config(None, provider="gke", project="proj")
+    runner = gke_fake()
+    rec = infra.provision(cfg, runner, str(tmp_path))
+    argvs = runner.argvs()
+    assert any("container clusters create tpu-serve" in a for a in argvs)
+    assert any("node-pools create tpu-pool" in a and
+               "--tpu-topology 2x2" in a and
+               "--machine-type ct5lp-hightpu-4t" in a for a in argvs)
+    assert any("get-credentials" in a for a in argvs)
+    # inventory + details + kubeconfig written
+    inv = latest_inventory(str(tmp_path))
+    assert inv and extract_cluster_id(inv) == rec.cluster_id
+    assert rec.cluster_id.startswith("tpu-serve-")
+    assert os.path.exists(tmp_path / f"kubeconfig-{rec.cluster_id}")
+    assert os.path.exists(details_path(rec.cluster_id, str(tmp_path)))
+
+
+def test_provision_gke_adopts_existing_cluster(tmp_path):
+    cfg = load_config(None, provider="gke", project="proj")
+    runner = FakeRunner([
+        ("clusters describe tpu-serve --project", (0, "34.1.2.3\n", "")),
+        ("node-pools describe", (0, "exists", "")),
+        ("config view", KUBECONFIG_YAML),
+        ("kubectl wait --for=condition=Ready nodes", (0, "ok", "")),
+        ("get nodes -o jsonpath", TPU_NODES_OUT),
+    ])
+    rec = infra.provision(cfg, runner, str(tmp_path))
+    assert rec.endpoint == "34.1.2.3"
+    assert not any("clusters create" in a for a in runner.argvs())
+    assert not any("node-pools create" in a for a in runner.argvs())
+
+
+def test_provision_gke_fails_without_tpu_resource(tmp_path):
+    cfg = load_config(None, provider="gke", project="proj")
+    runner = FakeRunner([
+        ("clusters describe", (1, "", "nope")),
+        ("config view", KUBECONFIG_YAML),
+        ("kubectl wait --for=condition=Ready nodes", (0, "ok", "")),
+        ("get nodes -o jsonpath", "node-1 \n"),   # no google.com/tpu
+    ])
+    with pytest.raises(RuntimeError, match="google.com/tpu|device plugin"):
+        infra.provision(cfg, runner, str(tmp_path))
+
+
+def test_provision_local_adopts_kubeconfig(tmp_path):
+    cfg = load_config(None, provider="local")
+    runner = FakeRunner([
+        ("config view", KUBECONFIG_YAML),
+        ("current-context", "kind-kind\n"),
+        ("kubectl wait --for=condition=Ready nodes", (0, "ok", "")),
+        ("get nodes -o jsonpath", "node-1 \n"),   # soft: no TPU on local
+    ])
+    rec = infra.provision(cfg, runner, str(tmp_path))
+    assert rec.endpoint == "kind-kind"
+    assert not any(a.startswith("gcloud") for a in runner.argvs())
+
+
+def test_cleanup_terminates_and_removes_files(tmp_path):
+    rec = _rec()
+    write_inventory(rec, str(tmp_path))
+    write_details(rec, str(tmp_path))
+    (tmp_path / rec.kubeconfig_file).write_text("kc")
+    runner = FakeRunner([
+        ("clusters describe", (0, "RUNNING\n", "")),
+    ])
+    removed = infra.cleanup(runner, str(tmp_path))
+    assert removed == [rec.cluster_id]
+    assert any("clusters delete tpu-serve" in a and "--quiet" in a
+               for a in runner.argvs())
+    assert generated_files(rec.cluster_id, str(tmp_path)) == []
+
+
+def test_cleanup_skips_cloud_when_cluster_gone(tmp_path):
+    rec = _rec()
+    write_inventory(rec, str(tmp_path))
+    runner = FakeRunner([("clusters describe", (1, "", "NOT_FOUND"))])
+    removed = infra.cleanup(runner, str(tmp_path))
+    assert removed == [rec.cluster_id]
+    assert not any("clusters delete" in a for a in runner.argvs())
+
+
+def test_cleanup_noop_without_inventories(tmp_path):
+    runner = FakeRunner()
+    assert infra.cleanup(runner, str(tmp_path)) == []
+    assert runner.commands == []
+
+
+# --- manifests ------------------------------------------------------------
+
+def _cfg(**kw):
+    kw.setdefault("provider", "gke")
+    kw.setdefault("project", "proj")
+    return load_config(None, **kw)
+
+
+def test_serving_manifests_colocated():
+    cfg = _cfg()
+    objs = manifests.serving_manifests(cfg)
+    text = manifests.render(*objs)
+    parsed = list(yaml.safe_load_all(text))
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in parsed]
+    assert ("Namespace", cfg.namespace) in kinds
+    assert ("Job", "model-download") in kinds
+    assert ("Deployment", "tpuserve-engine") in kinds
+    assert ("Deployment", "tpuserve-gateway") in kinds
+    assert ("Service", "tpuserve-gateway") in kinds
+    # serving applies only the PVC it mounts (llm-d-deploy.yaml:207 analog);
+    # model-storage-1/2 belong to the cluster layer
+    pvcs = [n for k, n in kinds if k == "PersistentVolumeClaim"]
+    assert pvcs == ["model-pvc"]
+    # chat-template ConfigMaps (templates/*.yaml analog)
+    cms = [n for k, n in kinds if k == "ConfigMap"]
+    assert "phi-chat-template" in cms and "opt-chat-template" in cms
+
+
+def test_engine_deployment_tpu_resources_and_probes():
+    cfg = _cfg(tensor_parallel=4)
+    dep = manifests.engine_deployment(cfg)
+    pod = dep["spec"]["template"]
+    c = pod["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert pod["metadata"]["annotations"]["prometheus.io/scrape"] == "true"
+    assert pod["metadata"]["annotations"]["prometheus.io/port"] == "8000"
+    assert c["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert c["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert "--tp" in c["command"] and "4" in c["command"]
+
+
+def test_serving_manifests_disaggregated():
+    cfg = _cfg(disaggregated=True)
+    objs = manifests.serving_manifests(cfg)
+    deps = {o["metadata"]["name"]: o for o in objs if o["kind"] == "Deployment"}
+    assert "tpuserve-engine" not in deps
+    c = deps["tpuserve-disagg"]["spec"]["template"]["spec"]["containers"][0]
+    assert "--disagg" in c["command"]       # in-process pools, KV over ICI
+    gw = deps["tpuserve-gateway"]["spec"]["template"]["spec"]["containers"][0]
+    assert any("tpuserve-disagg" in a for a in gw["command"])
+
+
+def test_local_provider_omits_tpu_bits():
+    cfg = _cfg(provider="local", project="")
+    dep = manifests.engine_deployment(cfg)
+    pod = dep["spec"]["template"]
+    assert "nodeSelector" not in pod["spec"]
+    c = pod["spec"]["containers"][0]
+    assert c["resources"] == {}
+    assert {"name": "JAX_PLATFORMS", "value": "cpu"} in c["env"]
+
+
+def test_chat_templates_render():
+    # The bundled templates must actually work for both families
+    # (templates/phi-chat-template.yaml / opt-chat-template.yaml parity).
+    import jinja2
+    msgs = [{"role": "system", "content": "Be brief."},
+            {"role": "user", "content": "Hi"},
+            {"role": "assistant", "content": "Hello"},
+            {"role": "user", "content": "Who are you?"}]
+    phi = jinja2.Template(manifests.PHI_CHAT_TEMPLATE).render(
+        messages=msgs, add_generation_prompt=True)
+    assert "<|system|>" in phi and phi.rstrip().endswith("<|assistant|>")
+    opt = jinja2.Template(manifests.OPT_CHAT_TEMPLATE).render(
+        messages=msgs, add_generation_prompt=True)
+    assert "Be brief." in opt and "Human: Hi" in opt
+    assert opt.rstrip().endswith("Assistant:")
+
+
+# --- cluster + serving layers ---------------------------------------------
+
+def test_bootstrap_installs_prometheus_when_absent(tmp_path):
+    cfg = _cfg()
+    runner = FakeRunner([
+        ("helm --kubeconfig kc status prometheus", (1, "", "not found")),
+        ("get crd servicemonitors", (0, "ok", "")),
+    ])
+    kube = infra.KubeCtl(runner, "kc")
+    cluster_layer.bootstrap(cfg, kube)
+    argvs = runner.argvs()
+    assert any("helm" in a and "install prometheus" in a and
+               f"retention={cfg.prometheus_retention}" in a for a in argvs)
+    applied = "\n".join(t or "" for _, t in runner.commands)
+    assert "ServiceMonitor" in applied
+    assert f"interval: {cfg.tpu_metrics_interval_s}s" in applied
+    # cluster layer owns the general storage PVCs
+    assert "model-storage-1" in applied and "model-storage-2" in applied
+
+
+def test_bootstrap_skips_prometheus_when_installed():
+    cfg = _cfg()
+    runner = FakeRunner([
+        ("status prometheus", (0, "deployed", "")),
+        ("get crd servicemonitors", (0, "ok", "")),
+    ])
+    cluster_layer.bootstrap(cfg, infra.KubeCtl(runner, "kc"))
+    assert not any("install prometheus" in a for a in runner.argvs())
+
+
+def test_serving_deploy_waits_and_secret(tmp_path, monkeypatch):
+    token_file = tmp_path / "token"
+    token_file.write_text("hf_secret_token\n")
+    monkeypatch.delenv("HF_TOKEN", raising=False)
+    cfg = _cfg(hf_token_file=str(token_file))
+    runner = FakeRunner([
+        ("wait --for=condition=complete job/model-download", (0, "ok", "")),
+        ("wait --for=condition=Ready pods", (0, "ok", "")),
+    ])
+    serving.deploy(cfg, infra.KubeCtl(runner, "kc"))
+    applied = "\n".join(t or "" for _, t in runner.commands)
+    assert "hf_secret_token" in applied        # secret applied
+    assert "model-download" in applied
+    argvs = runner.argvs()
+    assert any("job/model-download" in a for a in argvs)
+    assert any("wait --for=condition=Ready pods" in a and
+               f"--timeout={cfg.pods_ready_timeout_s}s" in a for a in argvs)
+
+
+def test_serving_redeploy_deletes_immutable_job(tmp_path, monkeypatch):
+    monkeypatch.delenv("HF_TOKEN", raising=False)
+    cfg = _cfg(hf_token_file=str(tmp_path / "missing"))
+    runner = FakeRunner([
+        ("wait --for=condition=complete job/model-download", (0, "ok", "")),
+        ("wait --for=condition=Ready pods", (0, "ok", "")),
+    ])
+    serving.deploy(cfg, infra.KubeCtl(runner, "kc"))
+    argvs = runner.argvs()
+    delete_idx = next(i for i, a in enumerate(argvs)
+                      if "delete job model-download" in a)
+    apply_idx = next(i for i, (a, t) in enumerate(runner.commands)
+                     if "apply" in " ".join(a) and "model-download" in (t or ""))
+    assert delete_idx < apply_idx
+
+
+def test_discover_gateway_fallbacks():
+    cfg = _cfg()
+    # LB ingress present
+    r1 = FakeRunner([("loadBalancer", "34.9.9.9")])
+    assert serving.discover_gateway(cfg, infra.KubeCtl(r1, "kc")) == "34.9.9.9"
+    # clusterIP fallback (llm-d-test.yaml:24-26)
+    r2 = FakeRunner([("loadBalancer", ""), ("clusterIP", "10.0.0.5")])
+    assert serving.discover_gateway(cfg, infra.KubeCtl(r2, "kc")) == "10.0.0.5"
+    # DNS-name fallback
+    r3 = FakeRunner()
+    assert serving.discover_gateway(cfg, infra.KubeCtl(r3, "kc")) == \
+        f"tpuserve-gateway.{cfg.namespace}.svc.cluster.local"
+
+
+# --- smoke tests ----------------------------------------------------------
+
+def smoke_fake(models_body, completion_body):
+    def logs_for(joined):
+        return "logs" in joined
+    return FakeRunner([
+        ("clusterIP", "10.0.0.5"),
+        (lambda j: "logs curl-gw-models" in j, (0, models_body, "")),
+        (lambda j: "logs curl-gw-completion" in j, (0, completion_body, "")),
+        ("wait pod/", (0, "ok", "")),
+    ])
+
+
+def test_smoke_tests_pass_and_cleanup_pods():
+    cfg = _cfg()
+    models = json.dumps({"data": [{"id": cfg.model}]})
+    completion = json.dumps({"choices": [{"text": "I am tpuserve."}]})
+    runner = smoke_fake(models, completion)
+    out = smoke.run_smoke_tests(cfg, infra.KubeCtl(runner, "kc"))
+    assert cfg.model in out["models"]
+    argvs = runner.argvs()
+    assert any("run curl-gw-models" in a and "curlimages/curl" in a
+               for a in argvs)
+    assert any(smoke.SMOKE_PROMPT in (t or "") or smoke.SMOKE_PROMPT in a
+               for a, t in [(" ".join(c), t) for c, t in runner.commands])
+    # pods deleted after each test (llm-d-test.yaml:43,73)
+    assert sum("delete pod curl-gw-" in a for a in argvs) >= 2
+
+
+def test_smoke_tests_fail_on_wrong_model():
+    cfg = _cfg()
+    runner = smoke_fake(json.dumps({"data": [{"id": "other-model"}]}), "{}")
+    with pytest.raises(smoke.SmokeTestFailure, match="not in /v1/models"):
+        smoke.run_smoke_tests(cfg, infra.KubeCtl(runner, "kc"))
+
+
+def test_smoke_retry_then_fail():
+    cfg = _cfg()
+    runner = FakeRunner([
+        ("clusterIP", "10.0.0.5"),
+        ("wait pod/", (1, "", "timed out")),
+    ])
+    with pytest.raises(smoke.SmokeTestFailure, match="3 attempts"):
+        smoke.run_smoke_tests(cfg, infra.KubeCtl(runner, "kc"))
+    assert runner.slept == pytest.approx(10.0)   # 2 retries x 5s
+
+
+# --- observability --------------------------------------------------------
+
+def test_collector_config_structure():
+    cfg = _cfg()
+    conf = observability.collector_config(cfg)
+    jobs = {j["job_name"]
+            for j in conf["receivers"]["prometheus"]["config"]["scrape_configs"]}
+    # vllm job kept verbatim; DCGM jobs replaced by TPU exporter jobs
+    assert {"vllm-metrics", "tpu-metrics-exporter", "tpu-metrics-exporter-pods",
+            "kubernetes-nodes", "kubernetes-cadvisor"} <= jobs
+    mp = conf["service"]["pipelines"]["metrics"]
+    assert "prometheusremotewrite" in mp["exporters"]
+    assert mp["processors"][0] == "memory_limiter"
+    assert conf["service"]["pipelines"]["traces"]["exporters"] == ["debug"]
+    # remote-write endpoint targets the dedicated prometheus
+    assert cfg.otel_namespace in \
+        conf["exporters"]["prometheusremotewrite"]["endpoint"]
+
+
+def test_observability_setup_applies_everything():
+    cfg = _cfg()
+    runner = FakeRunner([
+        ("wait --for=condition=Ready pods", (0, "ok", "")),
+    ])
+    observability.setup(cfg, infra.KubeCtl(runner, "kc"))
+    applied = "\n".join(t or "" for _, t in runner.commands)
+    assert "otel-prometheus" in applied
+    assert "--web.enable-remote-write-receiver" in applied
+    assert "tpu-metrics-exporter" in applied
+    assert "otel-collector" in applied
+    assert "ClusterRoleBinding" in applied
+    assert f"name: {cfg.otel_namespace}" in applied
+
+
+def test_observability_verify_with_fetch():
+    cfg = _cfg()
+    def fetch(path):
+        if "label" in path:
+            return '{"status":"success","data":["tpu-serve"]}'
+        if "vllm_request_total" in path:
+            return '{"status":"success","data":{"result":[{"value":[0,"1"]}]}}'
+        return '{"status":"success","data":{"result":[]}}'
+    res = observability.verify(cfg, infra.KubeCtl(FakeRunner(), "kc"),
+                               fetch=fetch)
+    assert res["cluster label present"] is True
+    assert res["engine request metric"] is True
+    assert res["TPU duty cycle metric"] is False   # soft failure, not raise
+
+
+# --- TPU metrics exporter -------------------------------------------------
+
+def test_tpu_metrics_exporter_collects():
+    from prometheus_client import CollectorRegistry, generate_latest
+    from tpuserve.server.tpu_metrics import TpuMetricsExporter
+    reg = CollectorRegistry()
+    exp = TpuMetricsExporter(interval_s=0.1, registry=reg)
+    exp.record_busy(0.01)
+    exp.collect_once()
+    text = generate_latest(reg).decode()
+    assert "tpu_device_count" in text
+    assert "tpu_hbm_used_bytes" in text
+    assert "tpu_duty_cycle_percent" in text
+
+
+def test_tpu_metrics_exporter_manifests():
+    cfg = _cfg()
+    objs = observability.tpu_metrics_exporter_manifests(cfg)
+    ds, svc = objs
+    assert ds["kind"] == "DaemonSet"
+    # service port named `metrics` so service-SD matches by name
+    assert svc["spec"]["ports"][0]["name"] == "metrics"
+    assert ds["spec"]["template"]["spec"]["containers"][0]["command"][:3] == \
+        ["python", "-m", "tpuserve.server.tpu_metrics"]
+
+
+# --- CLI ------------------------------------------------------------------
+
+def test_cli_dry_run_deploy_full_pipeline(tmp_path, monkeypatch):
+    from tpuserve.provision import cli
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TPUSERVE_PROVIDER", "local")
+    rc = cli.main(["--workdir", str(tmp_path), "--dry-run", "deploy"])
+    assert rc == 0
+    assert latest_inventory(str(tmp_path)) is not None
+
+
+def test_cli_requires_subcommand(capsys):
+    from tpuserve.provision import cli
+    assert cli.main([]) == 1
+
+
+def test_cli_cleanup_no_inventories(tmp_path, capsys):
+    from tpuserve.provision import cli
+    rc = cli.main(["--workdir", str(tmp_path), "--dry-run", "cleanup"])
+    assert rc == 0
+    assert "nothing to clean up" in capsys.readouterr().out
+
+
+def test_cli_test_without_deploy_errors(tmp_path):
+    from tpuserve.provision import cli
+    rc = cli.main(["--workdir", str(tmp_path), "--dry-run", "test"])
+    assert rc != 0

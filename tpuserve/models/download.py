@@ -1,0 +1,57 @@
+"""HF checkpoint downloader — the ``--download-model`` analog.
+
+The reference hands model download to the llm-d installer
+(``--download-model Qwen/Qwen3-0.6B`` with HF_TOKEN env,
+llm-d-deploy.yaml:176-193) which fetches weights onto the model PVC.  Here
+the download Job (tpuserve/provision/manifests.py::model_download_job) runs
+this module inside the cluster; it is also usable locally.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+logger = logging.getLogger("tpuserve.download")
+
+_WEIGHT_PATTERNS = ["*.safetensors", "*.json", "*.txt", "tokenizer*",
+                    "*.model", "*.jinja"]
+
+
+def download_model(model: str, out_dir: str,
+                   token: str | None = None) -> str:
+    """Snapshot the HF repo into ``<out_dir>/<model>``; idempotent (existing
+    complete snapshots are reused — the reference gets this from the
+    hub cache on the PVC)."""
+    target = os.path.join(out_dir, model)
+    cfg = os.path.join(target, "config.json")
+    if os.path.isfile(cfg) and any(
+            f.endswith(".safetensors") for f in os.listdir(target)):
+        logger.info("checkpoint already present at %s", target)
+        return target
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:
+        raise RuntimeError(
+            "huggingface_hub is required to download models; "
+            "pre-populate the checkpoint dir instead") from e
+    os.makedirs(target, exist_ok=True)
+    snapshot_download(repo_id=model, local_dir=target,
+                      allow_patterns=_WEIGHT_PATTERNS,
+                      token=token or os.environ.get("HF_TOKEN") or None)
+    logger.info("downloaded %s -> %s", model, target)
+    return target
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Download HF model weights")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--out", default="/models")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    download_model(args.model, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Provisioner: one-command infra → cluster → serving → test → observability.
+
+TPU-native rebuild of the reference's Bash+Ansible pipeline
+(reference: deploy-k8s-cluster.sh:1-117 orchestrating launch-instance.yaml,
+kubernetes-single-node.yaml, llm-d-deploy.yaml, llm-d-test.yaml,
+otel-observability-setup.yaml, cleanup-instance.yaml).  Instead of EC2 GPU
+instances + kubeadm + the NVIDIA GPU Operator it provisions GKE TPU v5e node
+pools with the GKE TPU device plugin, and instead of deploying vLLM
+containers it deploys this repo's own JAX/XLA serving engine.
+"""
+
+from tpuserve.provision.config import DeployConfig, load_config
+from tpuserve.provision.runner import (CommandError, CommandResult,
+                                       CommandRunner, DryRunRunner)
+
+__all__ = [
+    "DeployConfig", "load_config",
+    "CommandRunner", "DryRunRunner", "CommandResult", "CommandError",
+]

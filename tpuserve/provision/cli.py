@@ -1,0 +1,145 @@
+"""CLI orchestrator: ``deploy`` / ``cleanup`` / ``test`` subcommands.
+
+Port of deploy-k8s-cluster.sh:1-117.  ``deploy`` sequences the layers with
+hard ordering — infra → cluster bootstrap → serving → smoke tests →
+observability (deploy-k8s-cluster.sh:19-44; note tests run *before* the
+observability play, :40-44) — any layer failure aborts the pipeline
+(``set -e`` analog, :3), and a summary parsed from the details file is
+printed at the end (:47-74).  ``cleanup`` bails politely when no inventory
+files exist (:81-84).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from tpuserve.provision import cluster as cluster_layer
+from tpuserve.provision import infra, observability, serving, smoke
+from tpuserve.provision.config import DeployConfig, load_config
+from tpuserve.provision.inventory import (details_path, latest_inventory,
+                                          parse_details, read_inventory)
+from tpuserve.provision.runner import CommandRunner, DryRunRunner
+
+logger = logging.getLogger("tpuserve.provision")
+
+
+def _kube_for_latest(workdir: str, runner: CommandRunner) -> tuple:
+    inv = latest_inventory(workdir)   # ls -rt … | tail -1 (deploy-k8s-cluster.sh:23)
+    if inv is None:
+        raise RuntimeError("No tpu-inventory-*.ini found. Run deploy first.")
+    rec = read_inventory(inv)
+    import os
+    kubeconfig = os.path.join(workdir, rec.kubeconfig_file)
+    if not os.path.exists(kubeconfig):
+        kubeconfig = None
+    return rec, infra.KubeCtl(runner, kubeconfig)
+
+
+def deploy(cfg: DeployConfig, runner: CommandRunner,
+           workdir: str = ".") -> None:
+    print("==> [1/5] Provisioning infrastructure "
+          f"(provider={cfg.provider}, tpu={cfg.tpu_type})")
+    rec = infra.provision(cfg, runner, workdir)
+    import os
+    kube = infra.KubeCtl(runner, os.path.join(workdir, rec.kubeconfig_file))
+
+    print("==> [2/5] Bootstrapping cluster (storage, metrics stack)")
+    cluster_layer.bootstrap(cfg, kube)
+
+    print(f"==> [3/5] Deploying serving stack (model={cfg.model}, "
+          f"tp={cfg.tensor_parallel}, disagg={cfg.disaggregated})")
+    serving.deploy(cfg, kube)
+
+    print("==> [4/5] Running API smoke tests")
+    smoke.run_smoke_tests(cfg, kube)
+
+    print("==> [5/5] Setting up observability (OTEL → Prometheus)")
+    observability.setup(cfg, kube)
+    observability.verify(cfg, kube)
+
+    _print_summary(rec.cluster_id, cfg, workdir)
+
+
+def _print_summary(cluster_id: str, cfg: DeployConfig,
+                   workdir: str) -> None:
+    """Final summary parsed back from the details file, like
+    deploy-k8s-cluster.sh:50-74 parses instance-*-details.txt."""
+    try:
+        details = parse_details(details_path(cluster_id, workdir))
+    except OSError:
+        details = {}
+    print("\n" + "=" * 60)
+    print("Deployment complete!")
+    for k, v in details.items():
+        print(f"  {k}: {v}")
+    print(f"\n  Gateway:   kubectl -n {cfg.namespace} get svc tpuserve-gateway")
+    print(f"  API check: curl http://<gateway>/v1/models")
+    print(f"  Grafana:   kubectl -n {cfg.monitoring_namespace} "
+          f"port-forward svc/prometheus-grafana 3000:80  (admin/"
+          f"{cfg.grafana_admin_password})")
+    print(f"  Cleanup:   ./deploy-tpu-cluster.sh cleanup")
+    print("=" * 60)
+
+
+def run_tests(cfg: DeployConfig, runner: CommandRunner,
+              workdir: str = ".") -> None:
+    _, kube = _kube_for_latest(workdir, runner)
+    smoke.run_smoke_tests(cfg, kube)
+    print("Smoke tests passed.")
+
+
+def cleanup(runner: CommandRunner, workdir: str = ".") -> None:
+    from tpuserve.provision.inventory import find_inventories
+    if not find_inventories(workdir):
+        print("No tpu-inventory-*.ini files found — nothing to clean up.")
+        return   # deploy-k8s-cluster.sh:81-84
+    removed = infra.cleanup(runner, workdir)
+    print(f"Cleaned up {len(removed)} cluster(s): {', '.join(removed) or '-'}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpu-provisioner",
+        description="Deploy a TPU LLM-serving cluster end to end")
+    ap.add_argument("--config", default=None,
+                    help="YAML config file (see DeployConfig)")
+    ap.add_argument("--workdir", default=".",
+                    help="where inventory/details files live")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print commands without executing")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    sub = ap.add_subparsers(dest="command")
+    sub.add_parser("deploy", help="provision + bootstrap + serve + test + observe")
+    sub.add_parser("cleanup", help="tear down all recorded clusters")
+    sub.add_parser("test", help="re-run API smoke tests")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    if args.command is None:
+        # usage text with both subcommands, deploy-k8s-cluster.sh:106-115
+        ap.print_help()
+        return 1
+
+    runner = DryRunRunner() if args.dry_run else CommandRunner()
+    try:
+        if args.command == "deploy":
+            deploy(load_config(args.config), runner, args.workdir)
+        elif args.command == "cleanup":
+            # cleanup is inventory-file driven, config-free (SURVEY.md §3.3)
+            cleanup(runner, args.workdir)
+        elif args.command == "test":
+            run_tests(load_config(args.config), runner, args.workdir)
+    except Exception as e:
+        # set -e: first failure aborts with a non-zero exit (deploy-k8s-cluster.sh:3)
+        logger.error("%s failed: %s", args.command, e)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""One shared deploy config for the whole pipeline.
+
+The reference scatters its configuration across per-playbook ``vars:`` blocks
+with duplicated values — the served model name appears in both
+llm-d-deploy.yaml:118 and llm-d-test.yaml:7, namespaces in three files
+(SURVEY.md §5 flags this as a flaw to fix).  Here every layer reads the same
+``DeployConfig``, loadable from a YAML file with env-var overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DeployConfig:
+    # --- infra (launch-instance.yaml:6-13 analog: instance/AMI/region) ----
+    provider: str = "gke"                  # "gke" | "local" (existing kubeconfig / kind)
+    project: str = ""                      # GCP project (like AWS account implied by creds)
+    region: str = "us-central1"            # reference: us-east-2 (launch-instance.yaml:7)
+    zone: str = "us-central1-a"            # reference: us-east-2b availability zone
+    cluster_name: str = "tpu-serve"
+    tpu_type: str = "v5litepod-4"          # reference: g6.4xlarge 1xL4 (launch-instance.yaml:8)
+    tpu_topology: str = "2x2"
+    num_nodes: int = 1                     # single-node by design, like the reference
+    disk_size_gb: int = 500                # reference: 500GB gp3 (launch-instance.yaml:12)
+    machine_type: str = "ct5lp-hightpu-4t"
+    gke_version: Optional[str] = None      # reference pins K8s 1.33 (kubernetes-single-node.yaml:7)
+
+    # --- serving (llm-d-deploy.yaml:113-119 analog) -----------------------
+    namespace: str = "tpu-serve"           # reference: llm-d
+    model: str = "Qwen/Qwen3-0.6B"         # reference: llm-d-deploy.yaml:118
+    replicas: int = 1                      # DP via replica count + gateway LB
+    tensor_parallel: int = 4               # chips per replica, sharded over ICI
+    disaggregated: bool = False            # prefill/decode pool split (llm-d topology)
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
+    storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
+    model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
+    image: str = "tpuserve:latest"         # engine container image
+    hf_token_file: str = "~/.cache/huggingface/token"  # reference: llm-d-deploy.yaml:117
+    chat_template: Optional[str] = None    # name of a bundled template (phi/opt)
+    engine_port: int = 8000                # vLLM-compatible metrics port (otel-observability-setup.yaml:379)
+    gateway_port: int = 8080
+
+    # --- observability (otel-observability-setup.yaml:7-12 analog) --------
+    monitoring_namespace: str = "monitoring"
+    observability_namespace: str = "observability"
+    otel_namespace: str = "otel-monitoring"
+    tpu_metrics_interval_s: int = 5        # reference: DCGM 5s (kubernetes-single-node.yaml:487)
+    otel_scrape_interval_s: int = 15       # reference: otel-observability-setup.yaml:190
+    prometheus_retention: str = "15d"      # reference: kubernetes-single-node.yaml:428
+    otel_prometheus_retention: str = "30d" # reference: otel-observability-setup.yaml:236
+    otel_prometheus_retention_size: str = "10GB"
+    grafana_admin_password: str = "admin"  # reference: kubernetes-single-node.yaml:427
+
+    # --- timeouts (reference envelope, SURVEY.md §6) ----------------------
+    install_timeout_s: int = 1800          # llm-d-deploy.yaml:192
+    pods_ready_timeout_s: int = 1800       # llm-d-deploy.yaml:232
+    node_ready_timeout_s: int = 300        # SSH-up analog (launch-instance.yaml:69)
+
+    def validate(self) -> None:
+        if self.provider not in ("gke", "local"):
+            raise ValueError(f"unknown provider {self.provider!r}")
+        if self.tensor_parallel < 1 or self.replicas < 1:
+            raise ValueError("replicas and tensor_parallel must be >= 1")
+        # NOTE: the GCP-project requirement is enforced at provision time
+        # (infra._provision_gke), not here — subcommands like `test` read
+        # cluster identity from the inventory file and need no project.
+
+    @property
+    def chips_per_node(self) -> int:
+        # v5litepod-N exposes N chips on the node; topology 2x2 -> 4.
+        try:
+            return int(self.tpu_type.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return 4
+
+
+_ENV_PREFIX = "TPUSERVE_"
+
+
+def load_config(path: Optional[str] = None, **overrides) -> DeployConfig:
+    """Load config from YAML (if given), then env vars, then overrides.
+
+    Env override example: TPUSERVE_MODEL=facebook/opt-1.3b.  The reference
+    supports only HF_TOKEN via env (llm-d-deploy.yaml:187-189); everything
+    else required editing playbooks (README.md:80-104).
+    """
+    data: dict = {}
+    if path:
+        import yaml
+        with open(os.path.expanduser(path)) as f:
+            data.update(yaml.safe_load(f) or {})
+    fields = {f.name: f for f in dataclasses.fields(DeployConfig)}
+    for name, field in fields.items():
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            data[name] = _coerce(env, field.type)
+    data.update({k: v for k, v in overrides.items() if v is not None})
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+    cfg = DeployConfig(**data)
+    cfg.validate()
+    return cfg
+
+
+def _coerce(value: str, typ) -> object:
+    t = str(typ)
+    if "int" in t:
+        return int(value)
+    if "bool" in t:
+        return value.lower() in ("1", "true", "yes", "on")
+    return value

@@ -1,0 +1,313 @@
+"""Kubernetes manifest builders for the serving + storage + test layers.
+
+The reference embeds raw YAML manifests inside playbook strings
+(PVCs kubernetes-single-node.yaml:375-401, model PVC llm-d-deploy.yaml:
+195-215, chat-template ConfigMaps templates/*.yaml, test pods
+llm-d-test.yaml:32-78).  Here they are built as Python dicts from the one
+shared DeployConfig and rendered with yaml — no duplicated literals.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import yaml
+
+from tpuserve.provision.config import DeployConfig
+
+TPU_RESOURCE = "google.com/tpu"
+
+
+def render(*objs: dict) -> str:
+    return yaml.safe_dump_all([o for o in objs if o], sort_keys=False)
+
+
+def namespace(name: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name}}
+
+
+# --- storage (kubernetes-single-node.yaml:360-401 analog) -----------------
+
+def _pvc(cfg: DeployConfig, name: str, size: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": name, "namespace": cfg.namespace},
+        "spec": {
+            "accessModes": ["ReadWriteOnce"],
+            "storageClassName": cfg.storage_class,
+            "resources": {"requests": {"storage": size}},
+        },
+    }
+
+
+def storage_pvcs(cfg: DeployConfig) -> list[dict]:
+    """General model-storage PVCs created at the cluster layer
+    (kubernetes-single-node.yaml:385-400)."""
+    return [_pvc(cfg, "model-storage-1", cfg.model_pvc_size),
+            _pvc(cfg, "model-storage-2", cfg.model_pvc_size)]
+
+
+def model_pvc(cfg: DeployConfig) -> dict:
+    """The PVC the serving workloads actually mount — the reference adds it
+    as a deploy-layer workaround (llm-d-deploy.yaml:195-215)."""
+    return _pvc(cfg, "model-pvc", cfg.model_pvc_size)
+
+
+def hf_token_secret(cfg: DeployConfig, token: str) -> dict:
+    """HF token as a Secret — the reference slurps ~/.cache/huggingface/token
+    on the control host and passes it via env (llm-d-deploy.yaml:117-132,
+    187-189)."""
+    return {
+        "apiVersion": "v1", "kind": "Secret",
+        "metadata": {"name": "hf-token", "namespace": cfg.namespace},
+        "type": "Opaque",
+        "stringData": {"token": token},
+    }
+
+
+# --- chat templates (templates/phi-chat-template.yaml:1-25,
+#     templates/opt-chat-template.yaml:1-25 analog) ------------------------
+
+PHI_CHAT_TEMPLATE = """\
+{% for message in messages %}{% if message['role'] == 'system' %}<|system|>
+{{ message['content'] }}<|end|>
+{% elif message['role'] == 'user' %}<|user|>
+{{ message['content'] }}<|end|>
+{% elif message['role'] == 'assistant' %}<|assistant|>
+{{ message['content'] }}<|end|>
+{% endif %}{% endfor %}{% if add_generation_prompt %}<|assistant|>
+{% endif %}"""
+
+OPT_CHAT_TEMPLATE = """\
+{% if messages and messages[0]['role'] == 'system' %}{{ messages[0]['content'] }}
+
+{% set messages = messages[1:] %}{% endif %}{% for message in messages %}\
+{% if message['role'] == 'user' %}Human: {{ message['content'] }}
+{% elif message['role'] == 'assistant' %}Assistant: {{ message['content'] }}
+{% endif %}{% endfor %}{% if add_generation_prompt %}Assistant:{% endif %}"""
+
+CHAT_TEMPLATES = {"phi": PHI_CHAT_TEMPLATE, "opt": OPT_CHAT_TEMPLATE}
+
+
+def chat_template_configmap(cfg: DeployConfig, name: str) -> dict:
+    """ConfigMap `<name>-chat-template` holding template.jinja, for models
+    that ship without one — same mechanism as the reference's manual
+    kubectl-apply assets (templates/*.yaml; SURVEY.md §2.1 item 8)."""
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": f"{name}-chat-template",
+                     "namespace": cfg.namespace},
+        "data": {"template.jinja": CHAT_TEMPLATES[name]},
+    }
+
+
+# --- serving workloads (llm-d-deploy.yaml:140-193 replacement: the engine
+#     is in-repo, not a cloned installer) ----------------------------------
+
+def model_download_job(cfg: DeployConfig) -> dict:
+    """Weight-fetch Job (`--download-model` analog, llm-d-deploy.yaml:184):
+    downloads the HF checkpoint onto model-pvc before the engine starts."""
+    return {
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {"name": "model-download", "namespace": cfg.namespace},
+        "spec": {
+            "backoffLimit": 3,
+            "template": {
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [{
+                        "name": "download",
+                        "image": cfg.image,
+                        "command": ["python", "-m", "tpuserve.models.download",
+                                    "--model", cfg.model,
+                                    "--out", "/models"],
+                        "env": [{"name": "HF_TOKEN", "valueFrom": {
+                            "secretKeyRef": {"name": "hf-token",
+                                             "key": "token",
+                                             "optional": True}}}],
+                        "volumeMounts": [{"name": "models",
+                                          "mountPath": "/models"}],
+                    }],
+                    "volumes": [{"name": "models", "persistentVolumeClaim": {
+                        "claimName": "model-pvc"}}],
+                },
+            },
+        },
+    }
+
+
+def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
+                      extra_args: Optional[list[str]] = None) -> dict:
+    args = ["python", "-m", "tpuserve.server",
+            "--model", cfg.model,
+            "--checkpoint-dir", f"/models/{cfg.model}",
+            "--port", str(cfg.engine_port),
+            "--tp", str(cfg.tensor_parallel)]
+    args += extra_args or []
+    tpu_req = {TPU_RESOURCE: str(cfg.tensor_parallel)} \
+        if cfg.provider == "gke" else {}
+    env = [{"name": "HF_TOKEN", "valueFrom": {"secretKeyRef": {
+        "name": "hf-token", "key": "token", "optional": True}}}]
+    if cfg.provider != "gke":
+        env.append({"name": "JAX_PLATFORMS", "value": "cpu"})
+    if cfg.chat_template:
+        args += ["--chat-template", "/chat-template/template.jinja"]
+    container = {
+        "name": role or "engine",
+        "image": cfg.image,
+        "command": args,
+        "ports": [{"containerPort": cfg.engine_port, "name": "http"}],
+        "env": env,
+        "resources": {"limits": dict(tpu_req)} if tpu_req else {},
+        # Probes — the reference has none in-repo (delegated to llm-d
+        # charts, SURVEY.md §5 failure-detection note); here they are
+        # first-class.
+        "readinessProbe": {"httpGet": {"path": "/readyz", "port": "http"},
+                           "initialDelaySeconds": 10, "periodSeconds": 5},
+        "livenessProbe": {"httpGet": {"path": "/healthz", "port": "http"},
+                          "initialDelaySeconds": 60, "periodSeconds": 10},
+        "volumeMounts": [{"name": "models", "mountPath": "/models"}],
+    }
+    if cfg.chat_template:
+        container["volumeMounts"].append(
+            {"name": "chat-template", "mountPath": "/chat-template"})
+    return container
+
+
+def engine_deployment(cfg: DeployConfig, *, role: Optional[str] = None,
+                      replicas: Optional[int] = None,
+                      extra_args: Optional[list[str]] = None) -> dict:
+    """Engine Deployment.  Pods carry the prometheus.io/scrape annotations
+    the OTEL collector's pod-SD job gates on
+    (otel-observability-setup.yaml:337-391)."""
+    name = f"tpuserve-{role}" if role else "tpuserve-engine"
+    labels = {"app": "tpuserve", "component": role or "engine"}
+    volumes = [{"name": "models",
+                "persistentVolumeClaim": {"claimName": "model-pvc"}}]
+    if cfg.chat_template:
+        volumes.append({"name": "chat-template", "configMap": {
+            "name": f"{cfg.chat_template}-chat-template"}})
+    spec = {
+        "replicas": replicas if replicas is not None else cfg.replicas,
+        "selector": {"matchLabels": labels},
+        "template": {
+            "metadata": {
+                "labels": labels,
+                "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": str(cfg.engine_port),
+                    "prometheus.io/path": "/metrics",
+                },
+            },
+            "spec": {
+                "containers": [_engine_container(cfg, role=role,
+                                                 extra_args=extra_args)],
+                "volumes": volumes,
+            },
+        },
+    }
+    if cfg.provider == "gke":
+        spec["template"]["spec"]["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": _accelerator(cfg),
+            "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
+        }
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": cfg.namespace,
+                         "labels": labels},
+            "spec": spec}
+
+
+def _accelerator(cfg: DeployConfig) -> str:
+    return {"v5litepod": "tpu-v5-lite-podslice",
+            "v5p": "tpu-v5p-slice",
+            "v4": "tpu-v4-podslice"}.get(
+        cfg.tpu_type.rsplit("-", 1)[0], "tpu-v5-lite-podslice")
+
+
+def engine_service(cfg: DeployConfig, *, role: Optional[str] = None) -> dict:
+    name = f"tpuserve-{role}" if role else "tpuserve-engine"
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "namespace": cfg.namespace,
+                     "labels": {"app": "tpuserve"}},
+        "spec": {
+            "selector": {"app": "tpuserve", "component": role or "engine"},
+            "ports": [{"name": "http", "port": cfg.engine_port,
+                       "targetPort": cfg.engine_port}],
+        },
+    }
+
+
+def gateway_deployment(cfg: DeployConfig, backends: list[str]) -> dict:
+    """Gateway Deployment — replaces the llm-d inference gateway the
+    reference discovers at llm-d-test.yaml:14-26."""
+    labels = {"app": "tpuserve", "component": "gateway"}
+    args = ["python", "-m", "tpuserve.server.gateway",
+            "--port", str(cfg.gateway_port)]
+    for b in backends:
+        args += ["--backend", b]
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "tpuserve-gateway", "namespace": cfg.namespace,
+                     "labels": labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels, "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": str(cfg.gateway_port),
+                    "prometheus.io/path": "/metrics"}},
+                "spec": {"containers": [{
+                    "name": "gateway",
+                    "image": cfg.image,
+                    "command": args,
+                    "ports": [{"containerPort": cfg.gateway_port,
+                               "name": "http"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/healthz", "port": "http"},
+                        "initialDelaySeconds": 2, "periodSeconds": 5},
+                }]},
+            },
+        },
+    }
+
+
+def gateway_service(cfg: DeployConfig) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "tpuserve-gateway", "namespace": cfg.namespace,
+                     "labels": {"app": "tpuserve"}},
+        "spec": {
+            "type": "LoadBalancer" if cfg.provider == "gke" else "ClusterIP",
+            "selector": {"app": "tpuserve", "component": "gateway"},
+            "ports": [{"name": "http", "port": 80,
+                       "targetPort": cfg.gateway_port}],
+        },
+    }
+
+
+def serving_manifests(cfg: DeployConfig) -> list[dict]:
+    """Everything the serving layer applies, in order."""
+    objs: list[dict] = [namespace(cfg.namespace), model_pvc(cfg)]
+    for name in CHAT_TEMPLATES:
+        objs.append(chat_template_configmap(cfg, name))
+    objs.append(model_download_job(cfg))
+    if cfg.disaggregated:
+        # Disaggregated prefill/decode (llm-d's headline topology, SURVEY.md
+        # §2.2; BASELINE 'Llama-3-8B disaggregated' config).  TPU-idiomatic
+        # form: each pod runs BOTH pools in-process with KV handoff over ICI
+        # within its slice (tpuserve/parallel/disagg.py) — not separate
+        # network-connected pods, because ICI beats any pod-to-pod path.
+        objs.append(engine_deployment(cfg, role="disagg",
+                                      extra_args=["--disagg"]))
+        objs.append(engine_service(cfg, role="disagg"))
+        backends = [f"http://tpuserve-disagg.{cfg.namespace}.svc.cluster.local:{cfg.engine_port}"]
+    else:
+        objs.append(engine_deployment(cfg))
+        objs.append(engine_service(cfg))
+        backends = [f"http://tpuserve-engine.{cfg.namespace}.svc.cluster.local:{cfg.engine_port}"]
+    objs.append(gateway_deployment(cfg, backends))
+    objs.append(gateway_service(cfg))
+    return objs

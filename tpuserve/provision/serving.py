@@ -1,0 +1,113 @@
+"""Serving layer: deploy the in-repo engine + gateway onto the cluster.
+
+Replaces the reference's llm-d-deploy.yaml:109-257, which clones the
+upstream llm-d-deployer and runs its installer against vLLM images — here
+the engine is this repo's own JAX/XLA stack, so "deploy" is: HF token
+secret → manifests (PVCs, download Job, engine/gateway Deployments) →
+wait for the download Job → wait for pods Ready, with the reference's
+timeout envelope (install ≤1800s, pods-ready ≤1800s).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from tpuserve.provision import manifests
+from tpuserve.provision.config import DeployConfig
+from tpuserve.provision.infra import KubeCtl
+
+logger = logging.getLogger("tpuserve.provision")
+
+
+def read_hf_token(cfg: DeployConfig) -> Optional[str]:
+    """Slurp the HF token from the local cache file, env fallback
+    (llm-d-deploy.yaml:117-132 slurps ~/.cache/huggingface/token;
+    HF_TOKEN env at :187-189)."""
+    env = os.environ.get("HF_TOKEN")
+    if env:
+        return env.strip()
+    path = os.path.expanduser(cfg.hf_token_file)
+    if os.path.isfile(path):
+        return open(path).read().strip()
+    return None
+
+
+def deploy(cfg: DeployConfig, kube: KubeCtl) -> None:
+    token = read_hf_token(cfg)
+    if token:
+        kube.apply_manifest(manifests.render(
+            manifests.namespace(cfg.namespace),
+            manifests.hf_token_secret(cfg, token)))
+    else:
+        # Public models need no token; reference fails hard here
+        # (llm-d-deploy.yaml:126-132) — we degrade gracefully since the
+        # secretKeyRef is optional.
+        logger.warning("no HF token found (%s / $HF_TOKEN); gated models "
+                       "will fail to download", cfg.hf_token_file)
+
+    # Job pod templates are immutable — delete any previous download Job so
+    # redeploying with a different model/image applies cleanly.
+    kube.kubectl("delete", "job", "model-download", "-n", cfg.namespace,
+                 "--ignore-not-found", check=False)
+    objs = manifests.serving_manifests(cfg)
+    kube.apply_manifest(manifests.render(*objs))
+
+    _wait_download_job(cfg, kube)
+    _wait_pods_ready(cfg, kube)
+    _print_services(cfg, kube)
+
+
+def _wait_download_job(cfg: DeployConfig, kube: KubeCtl) -> None:
+    """Async poll on the weight download, 30s cadence within the install
+    timeout (llm-d-deploy.yaml:176-193: async 1800, poll 30)."""
+    retries = max(cfg.install_timeout_s // 30, 1)
+    res = kube.runner.retry(
+        kube._base("kubectl") + ["wait", "--for=condition=complete",
+                                 "job/model-download", "-n", cfg.namespace,
+                                 "--timeout=30s"],
+        retries=retries, delay=0.0, timeout=60.0)
+    if res is None or not res.ok:
+        raise RuntimeError(
+            f"model download did not complete within {cfg.install_timeout_s}s: "
+            f"{(res.stderr if res else '')[:500]}")
+
+
+def _wait_pods_ready(cfg: DeployConfig, kube: KubeCtl) -> None:
+    """kubectl wait pods --all Ready ≤1800s (llm-d-deploy.yaml:227-239)."""
+    res = kube.kubectl(
+        "wait", "--for=condition=Ready", "pods",
+        "-l", "app=tpuserve", "-n", cfg.namespace,
+        f"--timeout={cfg.pods_ready_timeout_s}s",
+        check=False, timeout=cfg.pods_ready_timeout_s + 60)
+    if not res.ok:
+        raise RuntimeError(f"serving pods not Ready: {res.stderr[:500]}")
+
+
+def _print_services(cfg: DeployConfig, kube: KubeCtl) -> None:
+    """Service summary print (llm-d-deploy.yaml:246-257 json_query analog)."""
+    res = kube.kubectl(
+        "get", "svc", "-n", cfg.namespace, "-o",
+        "jsonpath={range .items[*]}{.metadata.name} {.spec.type} "
+        "{.spec.clusterIP} {.spec.ports[0].port}{\"\\n\"}{end}",
+        check=False)
+    if res.ok:
+        logger.info("services in %s:\n%s", cfg.namespace, res.stdout.strip())
+
+
+def discover_gateway(cfg: DeployConfig, kube: KubeCtl) -> str:
+    """Gateway address discovery with the reference's three fallbacks
+    (llm-d-test.yaml:14-26): LoadBalancer ingress → Service clusterIP →
+    cluster-DNS name."""
+    res = kube.kubectl(
+        "get", "svc", "tpuserve-gateway", "-n", cfg.namespace, "-o",
+        "jsonpath={.status.loadBalancer.ingress[0].ip}", check=False)
+    if res.ok and res.stdout.strip():
+        return res.stdout.strip()
+    res = kube.kubectl(
+        "get", "svc", "tpuserve-gateway", "-n", cfg.namespace, "-o",
+        "jsonpath={.spec.clusterIP}", check=False)
+    if res.ok and res.stdout.strip() not in ("", "None"):
+        return res.stdout.strip()
+    return f"tpuserve-gateway.{cfg.namespace}.svc.cluster.local"

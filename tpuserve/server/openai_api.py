@@ -34,6 +34,12 @@ class ServerConfig:
     served_model_name: Optional[str] = None     # defaults to engine model
     max_tokens_cap: int = 4096
     request_timeout_s: float = 600.0
+    # Jinja chat-template text overriding the tokenizer's (the reference
+    # mounts these from ConfigMaps for template-less models, templates/*.yaml)
+    chat_template: Optional[str] = None
+    # Export tpu_* device metrics alongside vllm_* on /metrics — the engine
+    # owns the chips, so it is the authoritative DCGM-analog source.
+    tpu_metrics: bool = True
 
 
 def _num(body: dict, key: str, default, cast):
@@ -95,12 +101,23 @@ class OpenAIServer:
         self.ready = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
+        self.tpu_exporter = None
+        if self.config.tpu_metrics:
+            try:
+                from tpuserve.server.tpu_metrics import TpuMetricsExporter
+                self.tpu_exporter = TpuMetricsExporter(
+                    registry=self.metrics.registry)
+                self.runner.on_step_time = self.tpu_exporter.record_busy
+            except Exception:
+                logger.exception("TPU metrics exporter unavailable")
 
     # ---- lifecycle -----------------------------------------------------
 
     def start(self, warmup: bool = False) -> int:
         """Start engine loop + HTTP listener; returns the bound port."""
         self.runner.start()
+        if self.tpu_exporter is not None:
+            self.tpu_exporter.start()
         if warmup and hasattr(self.engine, "warmup"):
             self.engine.warmup()
         server = self
@@ -122,6 +139,8 @@ class OpenAIServer:
 
     def shutdown(self) -> None:
         self.ready.clear()
+        if self.tpu_exporter is not None:
+            self.tpu_exporter.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -136,7 +155,11 @@ class OpenAIServer:
                 raise ValueError("'messages' must be a non-empty list")
             tok = getattr(self.engine, "tokenizer", None) or \
                 self.engine.prefill.tokenizer
-            if hasattr(tok, "apply_chat_template"):
+            if self.config.chat_template:
+                import jinja2
+                prompt = jinja2.Template(self.config.chat_template).render(
+                    messages=messages, add_generation_prompt=True)
+            elif hasattr(tok, "apply_chat_template"):
                 prompt = tok.apply_chat_template(messages)
             else:
                 prompt = default_chat_template(messages)
@@ -387,7 +410,11 @@ def main(argv=None):
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor parallel degree (0 = no mesh)")
     ap.add_argument("--disagg", action="store_true",
-                    help="disaggregated prefill/decode pools in-process")
+                    help="disaggregated prefill/decode pools in-process "
+                         "(KV handoff over ICI within the slice)")
+    ap.add_argument("--chat-template", default=None,
+                    help="path to a Jinja chat template overriding the "
+                         "tokenizer's (ConfigMap-mounted in K8s)")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -408,7 +435,11 @@ def main(argv=None):
         engine = DisaggregatedEngine(ecfg, ecfg, mesh=mesh)
     else:
         engine = Engine(ecfg, mesh=mesh)
-    server = OpenAIServer(engine, ServerConfig(host=args.host, port=args.port))
+    chat_template = None
+    if args.chat_template:
+        chat_template = open(args.chat_template).read()
+    server = OpenAIServer(engine, ServerConfig(host=args.host, port=args.port,
+                                               chat_template=chat_template))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)
     try:

@@ -47,6 +47,9 @@ class AsyncEngineRunner:
     def __init__(self, engine, metrics=None):
         self.engine = engine
         self.metrics = metrics
+        # Optional hook fed with the wall-clock seconds of each engine.step()
+        # — the TPU duty-cycle source for tpu_metrics.TpuMetricsExporter.
+        self.on_step_time = None
         self._intake: "queue.Queue[_Submit | _Abort]" = queue.Queue()
         self._out_queues: dict[str, queue.Queue] = {}
         self._req_started: dict[str, float] = {}
@@ -221,8 +224,11 @@ class AsyncEngineRunner:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            step_start = time.monotonic()
             try:
                 outputs = self.engine.step()
+                if self.on_step_time is not None:
+                    self.on_step_time(time.monotonic() - step_start)
             except Exception:
                 logger.exception("engine step failed")
                 # Fail all in-flight requests AND drain them from the engine:

@@ -1,0 +1,111 @@
+"""TPU metrics exporter — the DCGM-exporter analog.
+
+The reference scrapes NVIDIA DCGM metrics (DCGM_FI_DEV_GPU_UTIL etc.) via a
+ServiceMonitor at 5s cadence (reference: kubernetes-single-node.yaml:447-504)
+and OTEL jobs (otel-observability-setup.yaml:393-468).  This exporter
+publishes the TPU equivalents from the PJRT/libtpu runtime as Prometheus
+gauges on :9400 — HBM usage from device memory stats, device duty cycle
+derived from a periodic probe, plus device inventory — for the
+``tpu-metrics-exporter`` scrape jobs in
+tpuserve/provision/observability.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+
+logger = logging.getLogger("tpuserve.tpu_metrics")
+
+
+class TpuMetricsExporter:
+    def __init__(self, interval_s: float = 5.0, registry=None):
+        from prometheus_client import REGISTRY, Gauge
+        self.registry = registry or REGISTRY
+        self.interval_s = interval_s
+        labels = ["device", "kind"]
+
+        def gauge(name, doc):
+            return Gauge(name, doc, labels, registry=self.registry)
+
+        self.hbm_used = gauge("tpu_hbm_used_bytes",
+                              "HBM bytes in use (DCGM_FI_DEV_FB_USED analog)")
+        self.hbm_total = gauge("tpu_hbm_total_bytes",
+                               "HBM capacity (DCGM_FI_DEV_FB_TOTAL analog)")
+        self.duty_cycle = gauge("tpu_duty_cycle_percent",
+                                "TensorCore duty cycle (DCGM_FI_DEV_GPU_UTIL analog)")
+        from prometheus_client import Gauge as _G
+        self.device_count = _G("tpu_device_count", "Visible TPU devices",
+                               registry=self.registry)
+        self._stop = threading.Event()
+        self._probe_busy_s = 0.0
+        self._window_start = time.monotonic()
+
+    # --- collection -------------------------------------------------------
+
+    def collect_once(self) -> None:
+        import jax
+        devices = jax.local_devices()
+        self.device_count.set(len(devices))
+        now = time.monotonic()
+        window = max(now - self._window_start, 1e-6)
+        duty = min(100.0 * self._probe_busy_s / window, 100.0)
+        self._probe_busy_s = 0.0
+        self._window_start = now
+        for d in devices:
+            name = f"{d.platform}:{d.id}"
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # CPU backend has no memory_stats
+                pass
+            self.hbm_used.labels(device=name, kind=d.device_kind).set(
+                stats.get("bytes_in_use", 0))
+            self.hbm_total.labels(device=name, kind=d.device_kind).set(
+                stats.get("bytes_limit", 0))
+            self.duty_cycle.labels(device=name, kind=d.device_kind).set(duty)
+
+    def record_busy(self, seconds: float) -> None:
+        """Engines embedding the exporter report device-busy time here; the
+        standalone daemonset reports only memory + inventory (duty stays 0,
+        matching DCGM semantics when no process shares its counters)."""
+        self._probe_busy_s += seconds
+
+    # --- daemon -----------------------------------------------------------
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.collect_once()
+            except Exception:
+                logger.exception("TPU metrics collection failed")
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run_forever, daemon=True,
+                             name="tpu-metrics")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="TPU metrics exporter")
+    ap.add_argument("--port", type=int, default=9400)
+    ap.add_argument("--interval", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    from prometheus_client import start_http_server
+    exporter = TpuMetricsExporter(interval_s=args.interval)
+    start_http_server(args.port)
+    logger.info("TPU metrics exporter on :%d (interval %.1fs)",
+                args.port, args.interval)
+    exporter.run_forever()
+
+
+if __name__ == "__main__":
+    main()
