@@ -1,0 +1,76 @@
+// Native paged KV-cache block manager — C++ core with a C ABI for ctypes.
+//
+// Drop-in replacement for the bookkeeping in
+// tpuserve/runtime/block_manager.py (same semantics: free-list allocation,
+// refcounted prefix sharing, chained block hashing, LRU eviction of freed
+// hashed blocks).  The reference delegates this logic to vLLM's C++/Python
+// block manager inside the deployed container (reference:
+// kubernetes-single-node.yaml:14, llm-d-deploy.yaml:140-193); here it is a
+// first-class native component on the scheduler hot path, where Python dict
+// and list churn shows up at high request rates.
+//
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).  Loaded via ctypes in
+// tpuserve/native/__init__.py; the pure-Python BlockManager remains the
+// fallback when the shared library is absent.
+
+#include "block_manager.hh"
+
+using tpuserve::BlockManager;
+
+
+extern "C" {
+
+void* bm_create(int32_t num_blocks, int32_t block_size, int enable_prefix) {
+  return new BlockManager(num_blocks, block_size, enable_prefix != 0);
+}
+void bm_destroy(void* h) { delete static_cast<BlockManager*>(h); }
+
+int32_t bm_num_free_blocks(void* h) {
+  return static_cast<BlockManager*>(h)->num_free_blocks();
+}
+int32_t bm_num_seqs(void* h) {
+  return static_cast<BlockManager*>(h)->num_seqs();
+}
+int64_t bm_blocks_needed(void* h, int64_t n) {
+  return static_cast<BlockManager*>(h)->blocks_needed(n);
+}
+int bm_can_allocate(void* h, int64_t n) {
+  return static_cast<BlockManager*>(h)->can_allocate(n);
+}
+int64_t bm_prefix_hits(void* h) {
+  return static_cast<BlockManager*>(h)->prefix_hits();
+}
+int64_t bm_prefix_queries(void* h) {
+  return static_cast<BlockManager*>(h)->prefix_queries();
+}
+int64_t bm_lookup_prefix(void* h, const int32_t* tokens, int64_t n,
+                         int32_t* out, int64_t max_out) {
+  return static_cast<BlockManager*>(h)->lookup_prefix(tokens, n, out, max_out);
+}
+int64_t bm_allocate(void* h, const char* seq_id, const int32_t* tokens,
+                    int64_t n, const int32_t* shared, int64_t nshared,
+                    int32_t* out, int64_t max_out) {
+  return static_cast<BlockManager*>(h)->allocate(seq_id, tokens, n, shared,
+                                                 nshared, out, max_out);
+}
+int bm_needs_new_block(void* h, const char* seq_id) {
+  return static_cast<BlockManager*>(h)->needs_new_block(seq_id);
+}
+int bm_can_append(void* h, const char* seq_id) {
+  return static_cast<BlockManager*>(h)->can_append(seq_id);
+}
+int64_t bm_append_slot(void* h, const char* seq_id) {
+  return static_cast<BlockManager*>(h)->append_slot(seq_id);
+}
+int64_t bm_slot_for_token(void* h, const char* seq_id, int64_t idx) {
+  return static_cast<BlockManager*>(h)->slot_for_token(seq_id, idx);
+}
+int64_t bm_block_table(void* h, const char* seq_id, int32_t* out,
+                       int64_t max_out) {
+  return static_cast<BlockManager*>(h)->block_table(seq_id, out, max_out);
+}
+void bm_free_seq(void* h, const char* seq_id) {
+  static_cast<BlockManager*>(h)->free_seq(seq_id);
+}
+
+}  // extern "C"

@@ -1,0 +1,238 @@
+// Native paged KV-cache block manager core (see block_manager.cc for the
+// C ABI and block_manager_ext.cc for the CPython extension binding).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace tpuserve {
+
+// FNV-1a over the token chunk, chained through the previous hash.  Only
+// internal consistency matters (lookup vs. register); this never has to
+// match Python's hash().
+inline uint64_t chain_hash(uint64_t prev, const int32_t* tokens, int64_t n) {
+  uint64_t h = 1469598103934665603ull ^ prev;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = static_cast<uint64_t>(static_cast<uint32_t>(tokens[i]));
+    h ^= v;
+    h *= 1099511628211ull;
+  }
+  // never return 0 so "no hash" can be the empty sentinel
+  return h ? h : 1;
+}
+
+struct SeqAlloc {
+  std::vector<int32_t> blocks;
+  int64_t num_tokens = 0;
+};
+
+class BlockManager {
+ public:
+  BlockManager(int32_t num_blocks, int32_t block_size, bool enable_prefix)
+      : num_blocks_(num_blocks),
+        block_size_(block_size),
+        enable_prefix_(enable_prefix) {
+    free_.reserve(num_blocks);
+    for (int32_t b = num_blocks - 1; b >= 0; --b) free_.push_back(b);
+  }
+
+  int32_t num_free_blocks() const {
+    return static_cast<int32_t>(free_.size() + cached_lru_.size());
+  }
+  int32_t num_seqs() const { return static_cast<int32_t>(seqs_.size()); }
+  int64_t blocks_needed(int64_t num_tokens) const {
+    return (num_tokens + block_size_ - 1) / block_size_;
+  }
+  bool can_allocate(int64_t num_tokens) const {
+    return blocks_needed(num_tokens) <= num_free_blocks();
+  }
+  int64_t prefix_hits() const { return prefix_hits_; }
+  int64_t prefix_queries() const { return prefix_queries_; }
+
+  // Longest cached whole-block prefix; at least one token stays uncached.
+  int64_t lookup_prefix(const int32_t* tokens, int64_t n, int32_t* out,
+                        int64_t max_out) {
+    if (!enable_prefix_) return 0;
+    ++prefix_queries_;
+    int64_t max_full = (n - 1) / block_size_;
+    uint64_t h = 0;
+    int64_t got = 0;
+    for (int64_t i = 0; i < max_full && got < max_out; ++i) {
+      h = chain_hash(h, tokens + i * block_size_, block_size_);
+      auto it = prefix_.find(h);
+      if (it == prefix_.end()) break;
+      out[got++] = it->second;
+    }
+    if (got > 0) ++prefix_hits_;
+    return got;
+  }
+
+  // Returns block count, or -1 OOM, -2 seq exists.
+  int64_t allocate(const std::string& seq_id, const int32_t* tokens,
+                   int64_t n, const int32_t* shared, int64_t nshared,
+                   int32_t* out, int64_t max_out) {
+    if (seqs_.count(seq_id)) return -2;
+    int64_t need = blocks_needed(n) - nshared;
+    int64_t revivable = 0;
+    for (int64_t i = 0; i < nshared; ++i)
+      if (cached_pos_.count(shared[i])) ++revivable;
+    if (need > num_free_blocks() - revivable) return -1;
+    SeqAlloc alloc;
+    alloc.blocks.reserve(blocks_needed(n));
+    for (int64_t i = 0; i < nshared; ++i) {
+      int32_t b = shared[i];
+      auto it = cached_pos_.find(b);
+      if (it != cached_pos_.end()) {  // revive: refcount was 0
+        cached_lru_.erase(it->second);
+        cached_pos_.erase(it);
+        refcount_[b] = 1;
+      } else {
+        ++refcount_[b];
+      }
+      alloc.blocks.push_back(b);
+    }
+    for (int64_t i = 0; i < (need > 0 ? need : 0); ++i) {
+      int32_t b = pop_free_block();
+      refcount_[b] = 1;
+      alloc.blocks.push_back(b);
+    }
+    alloc.num_tokens = n;
+    register_prefix_blocks(alloc, tokens, n);
+    int64_t total = static_cast<int64_t>(alloc.blocks.size());
+    for (int64_t i = 0; i < total && i < max_out; ++i) out[i] = alloc.blocks[i];
+    seqs_.emplace(seq_id, std::move(alloc));
+    return total;
+  }
+
+  int needs_new_block(const std::string& seq_id) const {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -1;
+    const SeqAlloc& a = it->second;
+    return a.num_tokens % block_size_ == 0 &&
+           a.num_tokens / block_size_ ==
+               static_cast<int64_t>(a.blocks.size());
+  }
+
+  int can_append(const std::string& seq_id) const {
+    int nb = needs_new_block(seq_id);
+    if (nb < 0) return -1;
+    return !nb || num_free_blocks() >= 1;
+  }
+
+  // Flat slot id, or -1 OOM, -2 unknown seq.
+  int64_t append_slot(const std::string& seq_id) {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    SeqAlloc& a = it->second;
+    int64_t offset = a.num_tokens % block_size_;
+    if (a.num_tokens % block_size_ == 0 &&
+        a.num_tokens / block_size_ == static_cast<int64_t>(a.blocks.size())) {
+      if (num_free_blocks() == 0) return -1;
+      int32_t b = pop_free_block();
+      refcount_[b] = 1;
+      a.blocks.push_back(b);
+    }
+    int32_t block = a.blocks[a.num_tokens / block_size_];
+    ++a.num_tokens;
+    return static_cast<int64_t>(block) * block_size_ + offset;
+  }
+
+  int64_t slot_for_token(const std::string& seq_id, int64_t idx) const {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    const SeqAlloc& a = it->second;
+    if (idx / block_size_ >= static_cast<int64_t>(a.blocks.size())) return -3;
+    return static_cast<int64_t>(a.blocks[idx / block_size_]) * block_size_ +
+           idx % block_size_;
+  }
+
+  int64_t block_table(const std::string& seq_id, int32_t* out,
+                      int64_t max_out) const {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    int64_t n = static_cast<int64_t>(it->second.blocks.size());
+    for (int64_t i = 0; i < n && i < max_out; ++i)
+      out[i] = it->second.blocks[i];
+    return n;
+  }
+
+  void free_seq(const std::string& seq_id) {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return;
+    for (int32_t b : it->second.blocks) {
+      auto rc = refcount_.find(b);
+      int32_t count = (rc == refcount_.end() ? 1 : rc->second) - 1;
+      if (count > 0) {
+        refcount_[b] = count;
+        continue;
+      }
+      if (rc != refcount_.end()) refcount_.erase(rc);
+      if (block_hash_.count(b)) {  // keep KV for prefix reuse, LRU order
+        auto pos = cached_pos_.find(b);
+        if (pos != cached_pos_.end()) cached_lru_.erase(pos->second);
+        cached_lru_.push_back(b);
+        cached_pos_[b] = std::prev(cached_lru_.end());
+      } else {
+        free_.push_back(b);
+      }
+    }
+    seqs_.erase(it);
+  }
+
+ private:
+  int32_t pop_free_block() {
+    if (!free_.empty()) {
+      int32_t b = free_.back();
+      free_.pop_back();
+      return b;
+    }
+    // evict the LRU cached block; its prefix entry dies with it
+    int32_t b = cached_lru_.front();
+    cached_lru_.pop_front();
+    cached_pos_.erase(b);
+    drop_hash(b);
+    return b;
+  }
+
+  void drop_hash(int32_t block) {
+    auto it = block_hash_.find(block);
+    if (it == block_hash_.end()) return;
+    auto p = prefix_.find(it->second);
+    if (p != prefix_.end() && p->second == block) prefix_.erase(p);
+    block_hash_.erase(it);
+  }
+
+  void register_prefix_blocks(const SeqAlloc& alloc, const int32_t* tokens,
+                              int64_t n) {
+    if (!enable_prefix_) return;
+    uint64_t h = 0;
+    int64_t full = n / block_size_;
+    for (int64_t i = 0; i < full; ++i) {
+      h = chain_hash(h, tokens + i * block_size_, block_size_);
+      int32_t phys = alloc.blocks[i];
+      if (!prefix_.count(h) && !block_hash_.count(phys)) {
+        prefix_[h] = phys;
+        block_hash_[phys] = h;
+      }
+    }
+  }
+
+  int32_t num_blocks_;
+  int32_t block_size_;
+  bool enable_prefix_;
+  std::vector<int32_t> free_;
+  std::list<int32_t> cached_lru_;  // oldest first
+  std::unordered_map<int32_t, std::list<int32_t>::iterator> cached_pos_;
+  std::unordered_map<std::string, SeqAlloc> seqs_;
+  std::unordered_map<int32_t, int32_t> refcount_;
+  std::unordered_map<uint64_t, int32_t> prefix_;
+  std::unordered_map<int32_t, uint64_t> block_hash_;
+  int64_t prefix_hits_ = 0;
+  int64_t prefix_queries_ = 0;
+};
+
+}  // namespace tpuserve

@@ -1,0 +1,190 @@
+"""Differential tests: C++ NativeBlockManager vs pure-Python BlockManager.
+
+The native module (native/block_manager.cc via ctypes) must be
+operation-for-operation equivalent to tpuserve/runtime/block_manager.py —
+these tests drive both with identical randomized workloads and compare
+every observable.
+"""
+
+import random
+
+import pytest
+
+from tpuserve.runtime.block_manager import BlockManager, create_block_manager
+
+native = pytest.importorskip("tpuserve.native")
+if not native.native_available():
+    pytest.skip("native library not buildable here", allow_module_level=True)
+
+from tpuserve.native import NativeBlockManager
+
+
+def make_pair(num_blocks=64, block_size=4, prefix=True):
+    return (BlockManager(num_blocks, block_size, enable_prefix_caching=prefix),
+            NativeBlockManager(num_blocks, block_size,
+                               enable_prefix_caching=prefix))
+
+
+def test_basic_allocate_append_free_parity():
+    py, cc = make_pair()
+    tokens = list(range(10))
+    a_py = py.allocate("s1", tokens)
+    a_cc = cc.allocate("s1", tokens)
+    assert a_py.blocks == a_cc.blocks
+    assert py.num_free_blocks == cc.num_free_blocks
+    for _ in range(9):
+        assert py.append_slot("s1") == cc.append_slot("s1")
+        assert py.block_table("s1") == cc.block_table("s1")
+    assert py.slot_for_token("s1", 7) == cc.slot_for_token("s1", 7)
+    py.free("s1"); cc.free("s1")
+    assert py.num_free_blocks == cc.num_free_blocks
+    assert py.num_seqs() == cc.num_seqs() == 0
+
+
+def test_oom_and_duplicate_errors():
+    py, cc = make_pair(num_blocks=2, block_size=4, prefix=False)
+    py.allocate("a", list(range(8)))
+    cc.allocate("a", list(range(8)))
+    for bm in (py, cc):
+        with pytest.raises(MemoryError):
+            bm.allocate("b", list(range(4)))
+        with pytest.raises(AssertionError):
+            bm.allocate("a", [1, 2])
+        with pytest.raises(MemoryError):
+            bm.append_slot("a")   # table full at block boundary, 0 free
+
+
+def test_unknown_seq_raises():
+    _, cc = make_pair()
+    with pytest.raises(KeyError):
+        cc.append_slot("ghost")
+    with pytest.raises(KeyError):
+        cc.block_table("ghost")
+    with pytest.raises(KeyError):
+        cc.needs_new_block("ghost")
+    cc.free("ghost")   # no-op like the Python impl
+
+
+def test_prefix_reuse_and_revive_parity():
+    py, cc = make_pair(num_blocks=16, block_size=4)
+    prompt = list(range(12))            # 3 full blocks
+    for bm in (py, cc):
+        bm.allocate("s1", prompt)
+        bm.free("s1")                   # blocks parked in the cached pool
+    sh_py, n_py = py.lookup_prefix(prompt + [99])
+    sh_cc, n_cc = cc.lookup_prefix(prompt + [99])
+    assert n_py == n_cc == 12
+    assert sh_py == sh_cc
+    a_py = py.allocate("s2", prompt + [99], shared_blocks=sh_py)
+    a_cc = cc.allocate("s2", prompt + [99], shared_blocks=sh_cc)
+    assert a_py.blocks == a_cc.blocks
+    assert a_py.blocks[:3] == sh_py     # shared prefix kept in place
+    assert py.num_free_blocks == cc.num_free_blocks
+    # a second concurrent user of the same prefix refcounts, not copies
+    for bm, sh in ((py, sh_py), (cc, sh_cc)):
+        bm.allocate("s3", prompt + [7], shared_blocks=sh)
+        bm.free("s2")
+        bm.free("s3")
+    assert py.num_free_blocks == cc.num_free_blocks == 16
+
+
+def test_shared_blocks_exceeding_blocks_needed():
+    # a cached prefix longer than the new prompt's block need: result is
+    # shared + fresh and must not over-read the output buffer
+    py, cc = make_pair(num_blocks=16, block_size=2)
+    for bm in (py, cc):
+        bm.allocate("warm", [1, 2, 3, 4, 5, 6])   # 3 hashed blocks
+        bm.free("warm")
+    sh_py, _ = py.lookup_prefix([1, 2, 3, 4, 5, 6, 7])
+    sh_cc, _ = cc.lookup_prefix([1, 2, 3, 4, 5, 6, 7])
+    assert sh_py == sh_cc and len(sh_py) == 3
+    a_py = py.allocate("s", [1, 2, 3], shared_blocks=sh_py)
+    a_cc = cc.allocate("s", [1, 2, 3], shared_blocks=sh_cc)
+    assert a_py.blocks == a_cc.blocks
+    assert py.num_free_blocks == cc.num_free_blocks
+
+
+def test_lru_eviction_parity():
+    py, cc = make_pair(num_blocks=4, block_size=2)
+    for bm in (py, cc):
+        bm.allocate("old", [1, 2, 3, 4])     # hashes 2 blocks
+        bm.free("old")
+        # exhausts the free list, forcing eviction of the LRU cached blocks
+        bm.allocate("new", [9, 9, 9, 9, 9, 9, 9])
+    assert py.num_free_blocks == cc.num_free_blocks
+    # evicted prefixes are gone from the cache in both
+    assert py.lookup_prefix([1, 2, 3, 4, 5])[1] == \
+        cc.lookup_prefix([1, 2, 3, 4, 5])[1]
+
+
+def test_randomized_differential():
+    rng = random.Random(0)
+    py, cc = make_pair(num_blocks=48, block_size=4)
+    live: list[str] = []
+    next_id = 0
+    for step in range(800):
+        op = rng.random()
+        if op < 0.35:
+            tokens = [rng.randrange(16) for _ in range(rng.randrange(1, 20))]
+            sid = f"s{next_id}"; next_id += 1
+            sh_py, _ = py.lookup_prefix(tokens)
+            sh_cc, _ = cc.lookup_prefix(tokens)
+            assert sh_py == sh_cc, f"step {step}"
+            err_py = err_cc = None
+            try:
+                a_py = py.allocate(sid, tokens, shared_blocks=sh_py)
+            except MemoryError as e:
+                err_py = e
+            try:
+                a_cc = cc.allocate(sid, tokens, shared_blocks=sh_cc)
+            except MemoryError as e:
+                err_cc = e
+            assert (err_py is None) == (err_cc is None), f"step {step}"
+            if err_py is None:
+                assert a_py.blocks == a_cc.blocks, f"step {step}"
+                live.append(sid)
+        elif op < 0.75 and live:
+            sid = rng.choice(live)
+            assert py.can_append(sid) == cc.can_append(sid)
+            err_py = err_cc = None
+            try:
+                s_py = py.append_slot(sid)
+            except MemoryError as e:
+                err_py = e
+            try:
+                s_cc = cc.append_slot(sid)
+            except MemoryError as e:
+                err_cc = e
+            assert (err_py is None) == (err_cc is None), f"step {step}"
+            if err_py is None:
+                assert s_py == s_cc, f"step {step}"
+        elif live:
+            sid = live.pop(rng.randrange(len(live)))
+            py.free(sid); cc.free(sid)
+        assert py.num_free_blocks == cc.num_free_blocks, f"step {step}"
+        assert py.num_seqs() == cc.num_seqs(), f"step {step}"
+    for sid in live:
+        assert py.block_table(sid) == cc.block_table(sid)
+
+
+def test_factory_selects_native():
+    bm = create_block_manager(8, 4, impl="native")
+    assert isinstance(bm, NativeBlockManager)
+    bm = create_block_manager(8, 4, impl="python")
+    assert isinstance(bm, BlockManager)
+    bm = create_block_manager(8, 4, impl="auto")
+    assert isinstance(bm, NativeBlockManager)
+
+
+def test_engine_uses_native(monkeypatch):
+    from tpuserve.runtime.engine import Engine, EngineConfig
+    from tpuserve.runtime.kv_cache import CacheConfig
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=32, max_blocks_per_seq=8)))
+    assert isinstance(eng.block_manager, NativeBlockManager)
+    # and it actually serves
+    from tpuserve.runtime.request import SamplingParams
+    outs = eng.generate(["hello"], SamplingParams(max_tokens=4,
+                                                  temperature=0.0))
+    assert outs and outs[0].output_token_ids

@@ -238,6 +238,35 @@ def test_cleanup_skips_cloud_when_cluster_gone(tmp_path):
     assert not any("clusters delete" in a for a in runner.argvs())
 
 
+def test_cleanup_keeps_files_when_cloud_unverifiable(tmp_path):
+    # auth/network failure is NOT "already gone": a billing cluster must
+    # never lose its only recorded state
+    rec = _rec()
+    write_inventory(rec, str(tmp_path))
+    runner = FakeRunner([
+        ("clusters describe", (1, "", "ERROR: token expired")),
+    ])
+    removed = infra.cleanup(runner, str(tmp_path))
+    assert removed == []
+    assert generated_files(rec.cluster_id, str(tmp_path)) != []
+
+
+def test_download_job_failure_fails_fast(tmp_path, monkeypatch):
+    monkeypatch.delenv("HF_TOKEN", raising=False)
+    cfg = _cfg(hf_token_file=str(tmp_path / "missing"))
+    runner = FakeRunner([
+        ("wait --for=condition=complete", (1, "", "timed out")),
+        ('jsonpath={.status.conditions[?(@.type=="Failed")].status}',
+         (0, "True", "")),
+        ("logs job/model-download", (0, "401 unauthorized", "")),
+    ])
+    with pytest.raises(RuntimeError, match="401 unauthorized"):
+        serving.deploy(cfg, infra.KubeCtl(runner, "kc"))
+    # failed fast: one wait attempt, not install_timeout_s/30 of them
+    waits = sum("wait --for=condition=complete" in a for a in runner.argvs())
+    assert waits == 1
+
+
 def test_cleanup_noop_without_inventories(tmp_path):
     runner = FakeRunner()
     assert infra.cleanup(runner, str(tmp_path)) == []
@@ -514,6 +543,22 @@ def test_tpu_metrics_exporter_collects():
     assert "tpu_duty_cycle_percent" in text
 
 
+def test_tpu_metrics_standalone_never_inits_jax(monkeypatch):
+    # the DaemonSet mode must not touch libtpu (single-owner per host —
+    # the engine owns the chips); it reads /dev chardevs only
+    import sys
+    from prometheus_client import CollectorRegistry
+    from tpuserve.server.tpu_metrics import TpuMetricsExporter
+    reg = CollectorRegistry()
+    exp = TpuMetricsExporter(interval_s=0.1, registry=reg, standalone=True)
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        monkeypatch.setattr(jax_mod, "local_devices",
+                            lambda: (_ for _ in ()).throw(
+                                AssertionError("standalone touched jax")))
+    exp.collect_once()   # must not raise / touch jax
+
+
 def test_tpu_metrics_exporter_manifests():
     cfg = _cfg()
     objs = observability.tpu_metrics_exporter_manifests(cfg)
@@ -533,7 +578,8 @@ def test_cli_dry_run_deploy_full_pipeline(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUSERVE_PROVIDER", "local")
     rc = cli.main(["--workdir", str(tmp_path), "--dry-run", "deploy"])
     assert rc == 0
-    assert latest_inventory(str(tmp_path)) is not None
+    # dry-run must leave NO phantom cluster state for test/cleanup to target
+    assert latest_inventory(str(tmp_path)) is None
 
 
 def test_cli_requires_subcommand(capsys):

@@ -1,0 +1,153 @@
+"""Bindings for the native (C++) runtime components.
+
+``NativeBlockManager`` is an API-compatible drop-in for
+``tpuserve.runtime.block_manager.BlockManager`` backed by
+native/block_manager.hh.  The primary binding is a CPython extension
+(_tpuserve_native, built from native/block_manager_ext.cc) — ctypes adds
+microseconds per call, which swamps these micro-operations, so it is kept
+only as a C ABI for non-Python hosts.  The extension is built on demand
+with g++ (no pybind11 in the environment — plain C API); when the
+toolchain is unavailable everything falls back to pure Python.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+logger = logging.getLogger("tpuserve.native")
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)),
+                           "native")
+_EXT_SRC = os.path.join(_NATIVE_DIR, "block_manager_ext.cc")
+_HDR = os.path.join(_NATIVE_DIR, "block_manager.hh")
+_lock = threading.Lock()
+_ext = None
+_ext_tried = False
+
+
+def _ext_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_PKG_DIR, f"_tpuserve_native{suffix}")
+
+
+def _build() -> bool:
+    out = _ext_path()
+    if not (os.path.isfile(_EXT_SRC) and os.path.isfile(_HDR)):
+        return os.path.isfile(out)
+    src_mtime = max(os.path.getmtime(_EXT_SRC), os.path.getmtime(_HDR))
+    if os.path.isfile(out) and os.path.getmtime(out) >= src_mtime:
+        return True
+    include = sysconfig.get_paths()["include"]
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+             f"-I{include}", "-o", out, _EXT_SRC],
+            check=True, capture_output=True, timeout=180)
+        logger.info("built %s", out)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        logger.warning("native build failed (%s%s); using pure Python",
+                       e, stderr.decode(errors="replace")[:500])
+        return False
+
+
+def _load():
+    global _ext, _ext_tried
+    with _lock:
+        if _ext_tried:
+            return _ext
+        _ext_tried = True
+        if not _build():
+            return None
+        if _PKG_DIR not in sys.path:
+            sys.path.insert(0, _PKG_DIR)
+        try:
+            _ext = importlib.import_module("_tpuserve_native")
+        except ImportError as e:
+            logger.warning("cannot import _tpuserve_native: %s", e)
+            _ext = None
+        return _ext
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeBlockManager:
+    """Drop-in for runtime.block_manager.BlockManager (see that module for
+    the semantics; native/block_manager.hh mirrors them)."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True):
+        ext = _load()
+        if ext is None:
+            raise RuntimeError("native extension unavailable")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self._core = ext.BlockManagerCore(
+            num_blocks, block_size,
+            enable_prefix_caching=enable_prefix_caching)
+
+    # ---- capacity -------------------------------------------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._core.num_free_blocks()
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return self._core.blocks_needed(num_tokens)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self._core.can_allocate(num_tokens)
+
+    @property
+    def prefix_hits(self) -> int:
+        return self._core.prefix_hits()
+
+    @property
+    def prefix_queries(self) -> int:
+        return self._core.prefix_queries()
+
+    # ---- prefix cache ---------------------------------------------------
+
+    def lookup_prefix(self, token_ids) -> tuple[list[int], int]:
+        blocks = self._core.lookup_prefix(list(token_ids))
+        return blocks, len(blocks) * self.block_size
+
+    # ---- allocation -----------------------------------------------------
+
+    def allocate(self, seq_id: str, prompt_token_ids, shared_blocks=None):
+        blocks = self._core.allocate(seq_id, list(prompt_token_ids),
+                                     list(shared_blocks or []))
+        from tpuserve.runtime.block_manager import SeqAlloc
+        return SeqAlloc(blocks=blocks, num_tokens=len(prompt_token_ids))
+
+    def needs_new_block(self, seq_id: str) -> bool:
+        return self._core.needs_new_block(seq_id)
+
+    def can_append(self, seq_id: str) -> bool:
+        return self._core.can_append(seq_id)
+
+    def append_slot(self, seq_id: str) -> int:
+        return self._core.append_slot(seq_id)
+
+    def slot_for_token(self, seq_id: str, token_idx: int) -> int:
+        return self._core.slot_for_token(seq_id, token_idx)
+
+    def block_table(self, seq_id: str) -> list[int]:
+        return self._core.block_table(seq_id)
+
+    def free(self, seq_id: str) -> None:
+        self._core.free(seq_id)
+
+    def num_seqs(self) -> int:
+        return self._core.num_seqs()

@@ -35,8 +35,6 @@ class DeployConfig:
     replicas: int = 1                      # DP via replica count + gateway LB
     tensor_parallel: int = 4               # chips per replica, sharded over ICI
     disaggregated: bool = False            # prefill/decode pool split (llm-d topology)
-    prefill_replicas: int = 1
-    decode_replicas: int = 1
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)

@@ -95,11 +95,14 @@ def provision(cfg: DeployConfig, runner: CommandRunner, workdir: str = ".",
         raise RuntimeError("nodes did not become Ready within the timeout")
     _preflight_tpu(cfg, kube)
 
-    write_inventory(rec, workdir)
-    write_details(rec, workdir, extra={
-        "Model": cfg.model, "Namespace": cfg.namespace,
-        "Tensor Parallel": str(cfg.tensor_parallel),
-    })
+    if not runner.dry_run:
+        # No on-disk state for clusters that were never created — a phantom
+        # inventory would become a `test`/`cleanup` target.
+        write_inventory(rec, workdir)
+        write_details(rec, workdir, extra={
+            "Model": cfg.model, "Namespace": cfg.namespace,
+            "Tensor Parallel": str(cfg.tensor_parallel),
+        })
     logger.info("provisioned cluster %s (%s)", rec.cluster_id, cfg.provider)
     return rec
 
@@ -235,9 +238,17 @@ def cleanup(runner: CommandRunner, workdir: str = ".") -> list[str]:
                     logger.warning("cluster delete failed for %s; files kept",
                                    cluster_id)
                     continue
-            else:
+            elif info.ok or "not_found" in info.stderr.lower().replace(" ", "_"):
                 logger.info("cluster %s not found in cloud (already gone)",
                             rec.cluster_name)
+            else:
+                # Auth/network failure is NOT "already gone" — deleting the
+                # inventory here would orphan a billing cluster with no
+                # recorded state left to clean it up.
+                logger.warning("cannot verify cluster %s (%s); files kept — "
+                               "fix gcloud auth and re-run cleanup",
+                               rec.cluster_name, info.stderr.strip()[:200])
+                continue
         for path in generated_files(cluster_id, workdir):
             os.remove(path)
             logger.info("removed %s", path)

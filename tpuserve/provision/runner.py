@@ -81,7 +81,7 @@ class CommandRunner:
             if (until(last) if until else last.ok):
                 return last
             if attempt < retries - 1:
-                time.sleep(delay)
+                self.sleep(delay)
         return last
 
     def sleep(self, seconds: float) -> None:  # seam for tests

@@ -61,17 +61,28 @@ def deploy(cfg: DeployConfig, kube: KubeCtl) -> None:
 
 def _wait_download_job(cfg: DeployConfig, kube: KubeCtl) -> None:
     """Async poll on the weight download, 30s cadence within the install
-    timeout (llm-d-deploy.yaml:176-193: async 1800, poll 30)."""
+    timeout (llm-d-deploy.yaml:176-193: async 1800, poll 30).  Fails fast
+    with the job logs when the Job hits its backoff limit — no point
+    burning the remaining timeout on a condition that can never come."""
     retries = max(cfg.install_timeout_s // 30, 1)
-    res = kube.runner.retry(
-        kube._base("kubectl") + ["wait", "--for=condition=complete",
-                                 "job/model-download", "-n", cfg.namespace,
-                                 "--timeout=30s"],
-        retries=retries, delay=0.0, timeout=60.0)
-    if res is None or not res.ok:
-        raise RuntimeError(
-            f"model download did not complete within {cfg.install_timeout_s}s: "
-            f"{(res.stderr if res else '')[:500]}")
+    for _ in range(retries):
+        res = kube.kubectl("wait", "--for=condition=complete",
+                           "job/model-download", "-n", cfg.namespace,
+                           "--timeout=30s", check=False, timeout=60.0)
+        if res.ok:
+            return
+        failed = kube.kubectl(
+            "get", "job", "model-download", "-n", cfg.namespace, "-o",
+            "jsonpath={.status.conditions[?(@.type==\"Failed\")].status}",
+            check=False)
+        if failed.ok and failed.stdout.strip() == "True":
+            logs = kube.kubectl("logs", "job/model-download",
+                                "-n", cfg.namespace, "--tail", "30",
+                                check=False)
+            raise RuntimeError(
+                f"model download Job failed:\n{logs.stdout[-2000:]}")
+    raise RuntimeError(
+        f"model download did not complete within {cfg.install_timeout_s}s")
 
 
 def _wait_pods_ready(cfg: DeployConfig, kube: KubeCtl) -> None:

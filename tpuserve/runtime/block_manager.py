@@ -198,3 +198,30 @@ class BlockManager:
 
     def num_seqs(self) -> int:
         return len(self._seqs)
+
+
+def create_block_manager(num_blocks: int, block_size: int,
+                         enable_prefix_caching: bool = True,
+                         impl: str = "auto"):
+    """Factory selecting the C++ block manager (tpuserve.native) when the
+    shared library is available, else this module's pure-Python one.
+
+    impl: "auto" | "native" | "python".  TPUSERVE_BLOCK_MANAGER overrides.
+    """
+    import os
+    impl = os.environ.get("TPUSERVE_BLOCK_MANAGER", impl)
+    if impl in ("auto", "native"):
+        try:
+            from tpuserve.native import NativeBlockManager, native_available
+            if native_available():
+                return NativeBlockManager(
+                    num_blocks, block_size,
+                    enable_prefix_caching=enable_prefix_caching)
+            if impl == "native":
+                raise RuntimeError("native block manager requested but "
+                                   "library unavailable")
+        except RuntimeError:
+            if impl == "native":
+                raise
+    return BlockManager(num_blocks, block_size,
+                        enable_prefix_caching=enable_prefix_caching)

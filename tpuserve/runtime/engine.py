@@ -32,7 +32,7 @@ from tpuserve.models.tokenizer import IncrementalDetokenizer, load_tokenizer
 from tpuserve.models.weights import load_or_init
 from tpuserve.ops import sampling as sampling_ops
 from tpuserve.ops.attention import PAD_SLOT
-from tpuserve.runtime.block_manager import BlockManager
+from tpuserve.runtime.block_manager import BlockManager, create_block_manager
 from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
 from tpuserve.runtime.request import (
     FinishReason, Request, RequestOutput, RequestState, SamplingParams, check_stop)
@@ -103,7 +103,7 @@ class Engine:
                 self.attn_impl = "reference"
         else:
             self.kv_cache = create_kv_cache(self.model_cfg, self.cache_cfg)
-        self.block_manager = BlockManager(
+        self.block_manager = create_block_manager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
         self.scheduler = Scheduler(config.scheduler, self.block_manager,

@@ -101,6 +101,10 @@ class OpenAIServer:
         self.ready = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
+        self._chat_template = None
+        if self.config.chat_template:
+            import jinja2
+            self._chat_template = jinja2.Template(self.config.chat_template)
         self.tpu_exporter = None
         if self.config.tpu_metrics:
             try:
@@ -155,9 +159,8 @@ class OpenAIServer:
                 raise ValueError("'messages' must be a non-empty list")
             tok = getattr(self.engine, "tokenizer", None) or \
                 self.engine.prefill.tokenizer
-            if self.config.chat_template:
-                import jinja2
-                prompt = jinja2.Template(self.config.chat_template).render(
+            if self._chat_template is not None:
+                prompt = self._chat_template.render(
                     messages=messages, add_generation_prompt=True)
             elif hasattr(tok, "apply_chat_template"):
                 prompt = tok.apply_chat_template(messages)

@@ -21,10 +21,24 @@ logger = logging.getLogger("tpuserve.tpu_metrics")
 
 
 class TpuMetricsExporter:
-    def __init__(self, interval_s: float = 5.0, registry=None):
+    """Two modes:
+
+    - embedded (standalone=False): runs inside the engine process that owns
+      the chips; reads PJRT memory stats + step-time duty cycle.  The
+      authoritative source, like vLLM's in-process GPU metrics.
+    - standalone (standalone=True): node-level DaemonSet.  libtpu is
+      single-owner per host, so initializing jax here would either steal the
+      chips from the engine or fail — instead it reports device inventory
+      from the /dev/accel* / /dev/vfio chardevs without touching the runtime
+      (HBM/duty metrics stay with the embedded exporter).
+    """
+
+    def __init__(self, interval_s: float = 5.0, registry=None,
+                 standalone: bool = False):
         from prometheus_client import REGISTRY, Gauge
         self.registry = registry or REGISTRY
         self.interval_s = interval_s
+        self.standalone = standalone
         labels = ["device", "kind"]
 
         def gauge(name, doc):
@@ -46,6 +60,9 @@ class TpuMetricsExporter:
     # --- collection -------------------------------------------------------
 
     def collect_once(self) -> None:
+        if self.standalone:
+            self._collect_node_level()
+            return
         import jax
         devices = jax.local_devices()
         self.device_count.set(len(devices))
@@ -66,6 +83,20 @@ class TpuMetricsExporter:
             self.hbm_total.labels(device=name, kind=d.device_kind).set(
                 stats.get("bytes_limit", 0))
             self.duty_cycle.labels(device=name, kind=d.device_kind).set(duty)
+
+    def _collect_node_level(self) -> None:
+        """Count TPU chardevs without initializing libtpu (which would
+        contend with the engine for chip ownership)."""
+        import glob
+        devs = sorted(set(glob.glob("/dev/accel*") +
+                          glob.glob("/dev/vfio/[0-9]*")))
+        self.device_count.set(len(devs))
+        for path in devs:
+            name = path.rsplit("/", 1)[-1]
+            # inventory-only: HBM/duty metrics come from the embedded
+            # exporter inside the engine that owns the runtime
+            self.hbm_used.labels(device=name, kind="tpu-node").set(0)
+            self.hbm_total.labels(device=name, kind="tpu-node").set(0)
 
     def record_busy(self, seconds: float) -> None:
         """Engines embedding the exporter report device-busy time here; the
@@ -100,7 +131,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     from prometheus_client import start_http_server
-    exporter = TpuMetricsExporter(interval_s=args.interval)
+    exporter = TpuMetricsExporter(interval_s=args.interval, standalone=True)
     start_http_server(args.port)
     logger.info("TPU metrics exporter on :%d (interval %.1fs)",
                 args.port, args.interval)
