@@ -1,0 +1,93 @@
+"""Ring / Ulysses context-parallel attention vs the dense reference, on the
+8-virtual-device CPU mesh (the multi-chip "fake backend", SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.ops.attention import prefill_attention
+from tpuserve.parallel.ring_attention import (
+    AXIS_SP, make_sp_mesh, ring_prefill_attention, ulysses_prefill_attention)
+
+
+def _random_qkv(rng, B, T, Hq, Hkv, D, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+@pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 2)])
+def test_ring_matches_reference(sp, Hq, Hkv):
+    rng = np.random.default_rng(0)
+    B, T, D = 2, 32, 16
+    scale = D ** -0.5
+    q, k, v = _random_qkv(rng, B, T, Hq, Hkv, D)
+    prompt_lens = jnp.asarray([T, T - 5], jnp.int32)
+    mesh = make_sp_mesh(sp)
+    got = ring_prefill_attention(q, k, v, prompt_lens, scale, mesh)
+    want = prefill_attention(q, k, v, prompt_lens, scale)
+    # only positions < prompt_len are meaningful
+    for b in range(B):
+        L = int(prompt_lens[b])
+        np.testing.assert_allclose(got[b, :L], want[b, :L],
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ulysses_matches_reference(sp):
+    rng = np.random.default_rng(1)
+    B, T, Hq, Hkv, D = 2, 32, 8, 2, 16
+    scale = D ** -0.5
+    q, k, v = _random_qkv(rng, B, T, Hq, Hkv, D)
+    prompt_lens = jnp.asarray([T, T - 7], jnp.int32)
+    mesh = make_sp_mesh(sp)
+    got = ulysses_prefill_attention(q, k, v, prompt_lens, scale, mesh)
+    want = prefill_attention(q, k, v, prompt_lens, scale)
+    for b in range(B):
+        L = int(prompt_lens[b])
+        np.testing.assert_allclose(got[b, :L], want[b, :L],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ring_bf16_dtype_preserved():
+    rng = np.random.default_rng(2)
+    B, T, Hq, Hkv, D = 1, 16, 4, 4, 8
+    q, k, v = _random_qkv(rng, B, T, Hq, Hkv, D, jnp.bfloat16)
+    mesh = make_sp_mesh(4)
+    out = ring_prefill_attention(q, k, v, jnp.asarray([T], jnp.int32),
+                                 D ** -0.5, mesh)
+    assert out.dtype == jnp.bfloat16
+    want = prefill_attention(q, k, v, jnp.asarray([T], jnp.int32), D ** -0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_ring_jit_under_sharding():
+    """ring attention composes with jit + sharded inputs (the serving path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rng = np.random.default_rng(3)
+    B, T, Hq, Hkv, D = 2, 64, 4, 4, 8
+    scale = D ** -0.5
+    q, k, v = _random_qkv(rng, B, T, Hq, Hkv, D)
+    mesh = make_sp_mesh(8)
+    sh = NamedSharding(mesh, P(None, AXIS_SP, None, None))
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    lens = jnp.asarray([T, T], jnp.int32)
+
+    fn = jax.jit(lambda q, k, v, lens: ring_prefill_attention(
+        q, k, v, lens, scale, mesh))
+    got = fn(q, k, v, lens)
+    want = prefill_attention(q, k, v, lens, scale)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_rejects_indivisible_seq():
+    mesh = make_sp_mesh(8)
+    q = jnp.zeros((1, 12, 4, 8))
+    with pytest.raises(ValueError):
+        ring_prefill_attention(q, q[:, :, :4], q[:, :, :4],
+                               jnp.asarray([12], jnp.int32), 1.0, mesh)

@@ -1,9 +1,12 @@
 from tpuserve.parallel.mesh import MeshConfig, make_mesh
+from tpuserve.parallel.ring_attention import (
+    make_sp_mesh, ring_prefill_attention, ulysses_prefill_attention)
 from tpuserve.parallel.sharding import (
     batch_sharding, cache_shardings, param_shardings, replicated, shard_params)
 
 __all__ = [
     "MeshConfig", "make_mesh",
+    "make_sp_mesh", "ring_prefill_attention", "ulysses_prefill_attention",
     "batch_sharding", "cache_shardings", "param_shardings", "replicated",
     "shard_params",
 ]

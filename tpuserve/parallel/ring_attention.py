@@ -1,0 +1,155 @@
+"""Sequence/context parallelism: ring attention and Ulysses (all-to-all).
+
+Long-context prefill splits the sequence axis across a ``Mesh`` axis.  Two
+strategies, both matching :func:`tpuserve.ops.attention.prefill_attention`
+semantics (causal + prompt-length masking, fp32 softmax):
+
+- **Ring attention**: each device keeps its Q shard and streams K/V shards
+  around the ICI ring with ``lax.ppermute``, folding each visiting block
+  into a flash-style online softmax.  Memory per device is O(T/n); the
+  compute/communication overlap is XLA's job (the ppermute for step s+1 is
+  independent of step s's einsums, so latency hiding falls out of the DAG).
+- **Ulysses**: ``lax.all_to_all`` re-shards from sequence-split to
+  head-split, runs dense local attention over the full sequence, and
+  re-shards back.  Cheaper at moderate T (two all-to-alls instead of n-1
+  permute steps) but caps the axis size at the head count.
+
+The reference repo has no long-context story at all — max context is
+whatever the deployed vLLM container allows (SURVEY.md §5 "Long-context";
+e.g. Phi-3-mini-4k, kubernetes-single-node.yaml:15).  Here it is a
+first-class framework component, exercised multi-device in the CPU-mesh
+tests and in ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuserve.ops.attention import NEG_INF, repeat_kv
+
+AXIS_SP = "sp"
+
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def make_sp_mesh(sp: int | None = None, devices=None) -> Mesh:
+    """1-D ('sp',) mesh over the ICI ring for context parallelism."""
+    devices = list(devices if devices is not None else jax.devices())
+    sp = sp or len(devices)
+    if sp > len(devices):
+        raise ValueError(f"sp={sp} exceeds {len(devices)} devices")
+    return Mesh(np.asarray(devices[:sp]), (AXIS_SP,))
+
+
+# --------------------------------------------------------------------------
+# Ring attention
+# --------------------------------------------------------------------------
+
+def _ring_shard(q, k, v, prompt_lens, *, scale: float, axis: str,
+                axis_size: int):
+    """Per-device ring body.  q/k/v: (B, Tl, H, D) local sequence shards."""
+    idx = lax.axis_index(axis)
+    B, Tl, Hq, D = q.shape
+    n_rep = Hq // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    q32 = q.astype(jnp.float32)
+
+    q_pos = idx * Tl + jnp.arange(Tl)                       # global positions
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(s, carry):
+        o, m, l, k, v = carry
+        src = (idx - s) % axis_size          # chunk currently held
+        k_pos = src * Tl + jnp.arange(Tl)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k.astype(jnp.float32),
+                            preferred_element_type=jnp.float32) * scale
+        causal = k_pos[None, :] <= q_pos[:, None]                  # (Tq, Tk)
+        valid = k_pos[None, :] < prompt_lens[:, None]              # (B, Tk)
+        mask = causal[None, None, :, :] & valid[:, None, None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # p is masked explicitly: when a whole row is NEG_INF, exp(0)=1 would
+        # otherwise pollute l with phantom mass.
+        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)                                 # (B,H,Tq)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        return o, m_new, l, k, v
+
+    o0 = jnp.zeros((B, Hq, Tl, D), jnp.float32)
+    m0 = jnp.full((B, Hq, Tl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hq, Tl), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, step, (o0, m0, l0, k, v))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)               # (B,Tl,H,D)
+
+
+def ring_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           prompt_lens: jnp.ndarray, scale: float,
+                           mesh: Mesh, axis: str = AXIS_SP) -> jnp.ndarray:
+    """Causal prefill attention with the sequence axis sharded over ``axis``.
+
+    q: (B, T, Hq, D); k/v: (B, T, Hkv, D); T must divide by the axis size.
+    Matches :func:`tpuserve.ops.attention.prefill_attention` numerics.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={n}")
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        partial(_ring_shard, scale=scale, axis=axis, axis_size=n),
+        mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v, prompt_lens)
+
+
+# --------------------------------------------------------------------------
+# Ulysses (all-to-all) attention
+# --------------------------------------------------------------------------
+
+def _ulysses_shard(q, k, v, prompt_lens, *, scale: float, axis: str,
+                   axis_size: int):
+    from tpuserve.ops.attention import prefill_attention
+    n_rep = q.shape[2] // k.shape[2]
+    k = repeat_kv(k, n_rep)          # GQA: expand so the head axis splits
+    v = repeat_kv(v, n_rep)
+    # (B, Tl, H, D) -> (B, T, H/n, D): scatter heads, gather sequence.
+    q = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = prefill_attention(q, k, v, prompt_lens, scale)
+    # back to (B, Tl, H, D)
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                              prompt_lens: jnp.ndarray, scale: float,
+                              mesh: Mesh, axis: str = AXIS_SP) -> jnp.ndarray:
+    """All-to-all sequence parallelism (Ulysses-style).
+
+    Requires Hq % axis_size == 0 and T % axis_size == 0.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[2] % n:
+        raise ValueError(f"{q.shape[2]} query heads not divisible by {axis}={n}")
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        partial(_ulysses_shard, scale=scale, axis=axis, axis_size=n),
+        mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v, prompt_lens)
