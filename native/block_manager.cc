@@ -9,9 +9,10 @@
 // first-class native component on the scheduler hot path, where Python dict
 // and list churn shows up at high request rates.
 //
-// Build: see native/Makefile (g++ -O2 -shared -fPIC).  Loaded via ctypes in
-// tpuserve/native/__init__.py; the pure-Python BlockManager remains the
-// fallback when the shared library is absent.
+// Build: see native/Makefile (g++ -O2 -shared -fPIC).  The primary Python
+// binding is the CPython extension (block_manager_ext.cc); this C ABI is for
+// non-Python hosts and is exercised via ctypes in
+// tests/test_native.py::test_c_abi_via_ctypes to keep it in sync.
 
 #include "block_manager.hh"
 

@@ -145,7 +145,8 @@ class BlockManager {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
     const SeqAlloc& a = it->second;
-    if (idx / block_size_ >= static_cast<int64_t>(a.blocks.size())) return -3;
+    if (idx < 0 || idx / block_size_ >= static_cast<int64_t>(a.blocks.size()))
+      return -3;
     return static_cast<int64_t>(a.blocks[idx / block_size_]) * block_size_ +
            idx % block_size_;
   }

@@ -1,12 +1,16 @@
 """Differential tests: C++ NativeBlockManager vs pure-Python BlockManager.
 
-The native module (native/block_manager.cc via ctypes) must be
-operation-for-operation equivalent to tpuserve/runtime/block_manager.py —
-these tests drive both with identical randomized workloads and compare
-every observable.
+The native module (the _tpuserve_native CPython extension built from
+native/block_manager_ext.cc) must be operation-for-operation equivalent to
+tpuserve/runtime/block_manager.py — these tests drive both with identical
+randomized workloads and compare every observable.  The C ABI
+(native/block_manager.cc, for non-Python hosts) is exercised separately via
+ctypes in test_c_abi_via_ctypes.
 """
 
+import os
 import random
+import subprocess
 
 import pytest
 
@@ -188,3 +192,65 @@ def test_engine_uses_native(monkeypatch):
     outs = eng.generate(["hello"], SamplingParams(max_tokens=4,
                                                   temperature=0.0))
     assert outs and outs[0].output_token_ids
+
+def test_slot_for_token_negative_index_raises():
+    py, cc = make_pair()
+    py.allocate("s", list(range(10)))
+    cc.allocate("s", list(range(10)))
+    for bm in (py, cc):
+        with pytest.raises(IndexError):
+            bm.slot_for_token("s", -1)
+        with pytest.raises(IndexError):
+            bm.slot_for_token("s", -8)
+
+
+def test_c_abi_via_ctypes(tmp_path):
+    """Build libtpuserve_native.so (the non-Python-host C ABI) and drive it
+    through ctypes, comparing against the pure-Python BlockManager."""
+    import ctypes
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "native", "block_manager.cc")
+    so = str(tmp_path / "libtpuserve_native.so")
+    subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                    "-o", so, src], check=True, capture_output=True,
+                   timeout=180)
+    lib = ctypes.CDLL(so)
+    lib.bm_create.restype = ctypes.c_void_p
+    lib.bm_create.argtypes = [ctypes.c_int32, ctypes.c_int32, ctypes.c_int]
+    lib.bm_destroy.argtypes = [ctypes.c_void_p]
+    lib.bm_num_free_blocks.restype = ctypes.c_int32
+    lib.bm_num_free_blocks.argtypes = [ctypes.c_void_p]
+    lib.bm_allocate.restype = ctypes.c_int64
+    lib.bm_allocate.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    lib.bm_append_slot.restype = ctypes.c_int64
+    lib.bm_append_slot.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bm_slot_for_token.restype = ctypes.c_int64
+    lib.bm_slot_for_token.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64]
+    lib.bm_free_seq.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+    h = lib.bm_create(16, 4, 1)
+    assert h
+    py = BlockManager(16, 4, enable_prefix_caching=True)
+
+    tokens = list(range(10))
+    arr = (ctypes.c_int32 * len(tokens))(*tokens)
+    out = (ctypes.c_int32 * 16)()
+    n = lib.bm_allocate(h, b"s1", arr, len(tokens), None, 0, out, 16)
+    a_py = py.allocate("s1", tokens)
+    assert n == len(a_py.blocks)
+    assert list(out[:n]) == a_py.blocks
+    assert lib.bm_num_free_blocks(h) == py.num_free_blocks
+
+    for _ in range(6):
+        assert lib.bm_append_slot(h, b"s1") == py.append_slot("s1")
+    assert lib.bm_slot_for_token(h, b"s1", 7) == py.slot_for_token("s1", 7)
+    assert lib.bm_slot_for_token(h, b"s1", -1) == -3  # error code, no UB
+    lib.bm_free_seq(h, b"s1")
+    py.free("s1")
+    assert lib.bm_num_free_blocks(h) == py.num_free_blocks
+    lib.bm_destroy(h)

@@ -232,7 +232,9 @@ def test_cleanup_terminates_and_removes_files(tmp_path):
 def test_cleanup_skips_cloud_when_cluster_gone(tmp_path):
     rec = _rec()
     write_inventory(rec, str(tmp_path))
-    runner = FakeRunner([("clusters describe", (1, "", "NOT_FOUND"))])
+    runner = FakeRunner([("clusters describe", (
+        1, "", "ERROR: ResponseError: code=404, message=Not found: "
+               "projects/proj/zones/us-central1-a/clusters/tpu-serve."))])
     removed = infra.cleanup(runner, str(tmp_path))
     assert removed == [rec.cluster_id]
     assert not any("clusters delete" in a for a in runner.argvs())
@@ -248,6 +250,21 @@ def test_cleanup_keeps_files_when_cloud_unverifiable(tmp_path):
     ])
     removed = infra.cleanup(runner, str(tmp_path))
     assert removed == []
+
+
+def test_cleanup_keeps_files_when_project_not_found(tmp_path):
+    # "Not found" about the *project or zone* (misconfig, revoked access)
+    # must not be read as "cluster already deleted"
+    rec = _rec()
+    write_inventory(rec, str(tmp_path))
+    runner = FakeRunner([
+        ("clusters describe", (
+            1, "", "ERROR: ResponseError: code=404, "
+                   "message=Not found: projects/proj.")),
+    ])
+    removed = infra.cleanup(runner, str(tmp_path))
+    assert removed == []
+    assert generated_files(rec.cluster_id, str(tmp_path)) != []
     assert generated_files(rec.cluster_id, str(tmp_path)) != []
 
 

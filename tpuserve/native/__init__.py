@@ -45,17 +45,26 @@ def _build() -> bool:
     if os.path.isfile(out) and os.path.getmtime(out) >= src_mtime:
         return True
     include = sysconfig.get_paths()["include"]
+    # Compile to a private temp path and os.replace() it into place: the
+    # publish is atomic, so a concurrent process (pytest-xdist worker,
+    # sibling replica on a shared volume) never dlopens a half-written .so.
+    tmp = f"{out}.tmp{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
-             f"-I{include}", "-o", out, _EXT_SRC],
+             f"-I{include}", "-o", tmp, _EXT_SRC],
             check=True, capture_output=True, timeout=180)
+        os.replace(tmp, out)
         logger.info("built %s", out)
         return True
     except (OSError, subprocess.SubprocessError) as e:
         stderr = getattr(e, "stderr", b"") or b""
         logger.warning("native build failed (%s%s); using pure Python",
                        e, stderr.decode(errors="replace")[:500])
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -205,6 +205,24 @@ def _preflight_tpu(cfg: DeployConfig, kube: KubeCtl) -> None:
                     TPU_RESOURCE)
 
 
+def _cluster_gone(stderr: str, cluster_name: str) -> bool:
+    """True only when gcloud's error says the *cluster* resource is missing.
+
+    A bare "not found" can also mean a missing project or zone (revoked
+    access, typo'd config); treating that as "already gone" would delete
+    the inventory and orphan a billing cluster, so the 404 must name the
+    cluster itself (gcloud 404s carry the resource path, e.g.
+    ``message=Not found: projects/p/zones/z/clusters/<name>``).
+    """
+    err = stderr.lower()
+    name = cluster_name.lower()
+    if "404" not in err and "not_found" not in err.replace(" ", "_"):
+        return False
+    return (f"clusters/{name}" in err
+            or f'cluster "{name}"' in err
+            or f"cluster {name}" in err)
+
+
 def cleanup(runner: CommandRunner, workdir: str = ".") -> list[str]:
     """Tear down every cluster recorded by an inventory file and delete the
     generated files (cleanup-instance.yaml:1-154 analog).  Never touches the
@@ -238,7 +256,7 @@ def cleanup(runner: CommandRunner, workdir: str = ".") -> list[str]:
                     logger.warning("cluster delete failed for %s; files kept",
                                    cluster_id)
                     continue
-            elif info.ok or "not_found" in info.stderr.lower().replace(" ", "_"):
+            elif info.ok or _cluster_gone(info.stderr, rec.cluster_name):
                 logger.info("cluster %s not found in cloud (already gone)",
                             rec.cluster_name)
             else:

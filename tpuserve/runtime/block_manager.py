@@ -174,6 +174,8 @@ class BlockManager:
 
     def slot_for_token(self, seq_id: str, token_idx: int) -> int:
         alloc = self._seqs[seq_id]
+        if token_idx < 0:
+            raise IndexError("token index out of range")
         return (alloc.blocks[token_idx // self.block_size] * self.block_size
                 + token_idx % self.block_size)
 
