@@ -11,6 +11,8 @@ sorts the vocabulary; the engine picks the cheap path (``mode="greedy"`` /
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -24,6 +26,7 @@ def _row_gumbel(keys: jnp.ndarray, shape: tuple[int, int]) -> jnp.ndarray:
     return -jnp.log(-jnp.log(u))
 
 
+@partial(jax.jit, static_argnames=("mode",))
 def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarray,
                   top_k: jnp.ndarray, top_p: jnp.ndarray, *, mode: str = "full") -> jnp.ndarray:
     """Sample next tokens.
@@ -68,6 +71,7 @@ def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarr
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)
 
 
+@jax.jit
 def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
                           output_mask: jnp.ndarray,
                           presence_penalty: jnp.ndarray,
@@ -91,6 +95,7 @@ def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
     return jnp.where(seen, rep_logits, logits)
 
 
+@partial(jax.jit, static_argnames=("top_n",))
 def compute_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray, top_n: int):
     """Log-probabilities for the chosen tokens plus the top-N alternatives.
 

@@ -111,6 +111,7 @@ class Engine:
         self.stats = EngineStats()
         self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
         self._detok: dict[str, IncrementalDetokenizer] = {}
+        self._greedy_cache: dict[int, tuple] = {}
         self._req_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._eos_ids = set(self.tokenizer.eos_token_ids)
@@ -282,8 +283,7 @@ class Engine:
             mode = "full"
         if mode == "greedy":
             toks = sampling_ops.sample_tokens(
-                logits, jnp.zeros((B, 2), jnp.uint32), jnp.zeros((B,)),
-                jnp.zeros((B,), jnp.int32), jnp.ones((B,)), mode=mode)
+                logits, *self._greedy_dummies(B), mode=mode)
         else:
             temperature = np.zeros((B,), np.float32)
             top_k = np.zeros((B,), np.int32)
@@ -305,6 +305,17 @@ class Engine:
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
         return np.asarray(jax.device_get(toks))[:n]
+
+    def _greedy_dummies(self, B: int):
+        """Per-bucket constant sampling inputs, created once.  Building these
+        eagerly every step costs ~4 dispatches/step — tens of ms on a
+        tunneled backend — for arrays whose values never change."""
+        d = self._greedy_cache.get(B)
+        if d is None:
+            d = (jnp.zeros((B, 2), jnp.uint32), jnp.zeros((B,)),
+                 jnp.zeros((B,), jnp.int32), jnp.ones((B,)))
+            self._greedy_cache[B] = d
+        return d
 
     def _apply_penalties(self, logits: jnp.ndarray, reqs: list[Request], B: int) -> jnp.ndarray:
         from tpuserve.utils import next_power_of_2 as np2
@@ -427,7 +438,9 @@ class Engine:
     # ------------------------------------------------------------------
 
     def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] = (),
-               decode_buckets: Sequence[int] = ()) -> None:
+               decode_buckets: Sequence[int] = (),
+               sample_modes: Sequence[str] = ("greedy", "temperature", "full"),
+               ) -> None:
         """Pre-compile executables.  ``prefill_buckets`` entries are either a
         padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
         — _run_prefill pads the batch to a power of two, so warming only
@@ -436,24 +449,45 @@ class Engine:
             self.config.scheduler.min_prefill_bucket]
         decode_buckets = list(decode_buckets) or [
             self.config.scheduler.min_decode_bucket]
-        for bucket in prefill_buckets:
-            B, L = bucket if isinstance(bucket, tuple) else (1, bucket)
-            tokens = jnp.zeros((B, L), jnp.int32)
-            lens = jnp.ones((B,), jnp.int32)
-            slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
-            logits, self.kv_cache = transformer.prefill(
-                self.params, self.model_cfg, tokens, lens, slots, self.kv_cache,
-                attn_impl=self.attn_impl)
-            logits.block_until_ready()
-        for B in decode_buckets:
-            tokens = jnp.zeros((B,), jnp.int32)
-            positions = jnp.zeros((B,), jnp.int32)
-            slots = jnp.full((B,), PAD_SLOT, jnp.int32)
-            bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
-            seq_lens = jnp.ones((B,), jnp.int32)
-            logits, self.kv_cache = transformer.decode_step(
-                self.params, self.model_cfg, tokens, positions, slots, bt,
-                seq_lens, self.kv_cache, attn_impl=self.attn_impl)
-            logits.block_until_ready()
+        # Two rounds: round 1 compiles each executable against the cache
+        # layouts it happens to see; the kv_cache arrays that come OUT may
+        # carry different XLA-chosen layouts, and a jitted call whose input
+        # layouts changed recompiles (observed as a 47 s stall on the first
+        # real prefill despite a warmed identical shape).  Round 2 runs every
+        # bucket again with the settled layouts, so the steady-state
+        # executables all exist before the first request arrives.
+        for _round in range(2):
+            for bucket in prefill_buckets:
+                B, L = bucket if isinstance(bucket, tuple) else (1, bucket)
+                tokens = jnp.zeros((B, L), jnp.int32)
+                lens = jnp.ones((B,), jnp.int32)
+                slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
+                logits, self.kv_cache = transformer.prefill(
+                    self.params, self.model_cfg, tokens, lens, slots,
+                    self.kv_cache, attn_impl=self.attn_impl)
+                self._warm_sampling(logits, sample_modes)
+            for B in decode_buckets:
+                tokens = jnp.zeros((B,), jnp.int32)
+                positions = jnp.zeros((B,), jnp.int32)
+                slots = jnp.full((B,), PAD_SLOT, jnp.int32)
+                bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
+                seq_lens = jnp.ones((B,), jnp.int32)
+                logits, self.kv_cache = transformer.decode_step(
+                    self.params, self.model_cfg, tokens, positions, slots, bt,
+                    seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+                self._warm_sampling(logits, sample_modes)
+        logits.block_until_ready()
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",
                     prefill_buckets, decode_buckets)
+
+    def _warm_sampling(self, logits: jnp.ndarray,
+                       modes: Sequence[str]) -> None:
+        """Compile the samplers for this logits shape so no request ever
+        stalls the serving loop on a sampler compile.  'full' sorts the
+        vocab — by far the slowest compile — so latency-sensitive callers
+        that only ever sample greedily can pass a reduced mode list."""
+        B = logits.shape[0]
+        keys, temp, top_k, top_p = self._greedy_dummies(B)
+        for mode in modes:
+            sampling_ops.sample_tokens(logits, keys, temp, top_k, top_p,
+                                       mode=mode)
