@@ -1,0 +1,79 @@
+"""Deploy presets (the BASELINE tracked configs) and multi-host manifests."""
+
+import pytest
+
+from tpuserve.provision import manifests
+from tpuserve.provision.config import PRESETS, DeployConfig, load_config
+
+
+def test_all_presets_load_and_validate():
+    for name in PRESETS:
+        cfg = load_config(preset=name)
+        cfg.validate()
+
+
+def test_unknown_preset_rejected():
+    with pytest.raises(ValueError, match="unknown preset"):
+        load_config(preset="nope")
+
+
+def test_explicit_value_wins_over_preset(monkeypatch):
+    monkeypatch.setenv("TPUSERVE_MODEL", "my/override")
+    cfg = load_config(preset="llama3-8b-disagg-v5e8")
+    assert cfg.model == "my/override"
+    assert cfg.disaggregated            # preset fields not overridden survive
+
+
+def test_disagg_preset_shape():
+    cfg = load_config(preset="llama3-8b-disagg-v5e8")
+    assert cfg.disaggregated and cfg.tensor_parallel == 4
+    assert cfg.tpu_topology == "2x4"
+    objs = manifests.serving_manifests(cfg)
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in objs]
+    assert ("Deployment", "tpuserve-disagg") in kinds
+
+
+def test_multihost_preset_generates_statefulsets():
+    cfg = load_config(preset="qwen2-72b-tp8-v5e16")
+    assert cfg.tensor_parallel > cfg.chips_per_node
+    objs = manifests.serving_manifests(cfg)
+    ssets = [o for o in objs if o["kind"] == "StatefulSet"]
+    heads = [o for o in objs if o["kind"] == "Service"
+             and o["spec"].get("clusterIP") == "None"]
+    assert len(ssets) == cfg.replicas == 2
+    assert len(heads) == 2
+    for s in ssets:
+        # one pod per slice host: tp=8 over 4-chip hosts -> 2 pods
+        assert s["spec"]["replicas"] == 2
+        assert s["spec"]["podManagementPolicy"] == "Parallel"
+        c = s["spec"]["template"]["spec"]["containers"][0]
+        assert "--multihost" in c["command"]
+        # per-pod TPU request is one host's chips
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        # followers can't answer HTTP probes
+        assert "readinessProbe" not in c and "livenessProbe" not in c
+    gw = next(o for o in objs if o["metadata"]["name"] == "tpuserve-gateway"
+              and o["kind"] == "Deployment")
+    args = gw["spec"]["template"]["spec"]["containers"][0]["command"]
+    backends = [args[i + 1] for i, a in enumerate(args) if a == "--backend"]
+    assert len(backends) == 2
+    assert all("-0.tpuserve-mh-" in b for b in backends)   # pod-0 DNS
+
+
+def test_multihost_protocol_degenerates_single_process():
+    """Single-process: coordinator wrap is a no-op and follower returns."""
+    from tpuserve.parallel import multihost
+    from tpuserve.runtime.engine import Engine, EngineConfig
+    from tpuserve.runtime.kv_cache import CacheConfig
+    from tpuserve.runtime.request import SamplingParams
+
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16)))
+    assert multihost.is_coordinator()
+    coord = multihost.MultihostCoordinator(eng)
+    outs = eng.generate(["hello"], SamplingParams(max_tokens=4,
+                                                  temperature=0.0))
+    assert outs and outs[0].output_token_ids
+    coord.stop_followers()          # no-op single-process
+    multihost.follower_loop(eng)    # returns immediately

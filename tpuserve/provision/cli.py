@@ -105,6 +105,10 @@ def main(argv=None):
         description="Deploy a TPU LLM-serving cluster end to end")
     ap.add_argument("--config", default=None,
                     help="YAML config file (see DeployConfig)")
+    ap.add_argument("--preset", default=None,
+                    help="named deploy preset for a tracked BASELINE config "
+                         "(e.g. llama3-8b-disagg-v5e8, qwen2-72b-tp8-v5e16); "
+                         "explicit YAML/env/flag values win over the preset")
     ap.add_argument("--workdir", default=".",
                     help="where inventory/details files live")
     ap.add_argument("--dry-run", action="store_true",
@@ -128,12 +132,14 @@ def main(argv=None):
     runner = DryRunRunner() if args.dry_run else CommandRunner()
     try:
         if args.command == "deploy":
-            deploy(load_config(args.config), runner, args.workdir)
+            deploy(load_config(args.config, preset=args.preset), runner,
+                   args.workdir)
         elif args.command == "cleanup":
             # cleanup is inventory-file driven, config-free (SURVEY.md §3.3)
             cleanup(runner, args.workdir)
         elif args.command == "test":
-            run_tests(load_config(args.config), runner, args.workdir)
+            run_tests(load_config(args.config, preset=args.preset), runner,
+                      args.workdir)
     except Exception as e:
         # set -e: first failure aborts with a non-zero exit (deploy-k8s-cluster.sh:3)
         logger.error("%s failed: %s", args.command, e)

@@ -81,8 +81,9 @@ class DeployConfig:
 _ENV_PREFIX = "TPUSERVE_"
 
 
-def load_config(path: Optional[str] = None, **overrides) -> DeployConfig:
-    """Load config from YAML (if given), then env vars, then overrides.
+def load_config(path: Optional[str] = None, preset: Optional[str] = None,
+                **overrides) -> DeployConfig:
+    """Load config from preset (if given), then YAML, env vars, overrides.
 
     Env override example: TPUSERVE_MODEL=facebook/opt-1.3b.  The reference
     supports only HF_TOKEN via env (llm-d-deploy.yaml:187-189); everything
@@ -99,6 +100,8 @@ def load_config(path: Optional[str] = None, **overrides) -> DeployConfig:
         if env is not None:
             data[name] = _coerce(env, field.type)
     data.update({k: v for k, v in overrides.items() if v is not None})
+    if preset:
+        data = apply_preset(data, preset)
     unknown = set(data) - set(fields)
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -114,3 +117,69 @@ def _coerce(value: str, typ) -> object:
     if "bool" in t:
         return value.lower() in ("1", "true", "yes", "on")
     return value
+
+
+# --------------------------------------------------------------------------
+# Deploy presets — the BASELINE.json "configs" as one-flag deployments
+# --------------------------------------------------------------------------
+
+#: Named presets for the tracked BASELINE configs (BASELINE.md "Tracked
+#: configs"); each is a dict of DeployConfig overrides applied on top of the
+#: YAML/env/CLI layers.  The reference needed playbook edits to change any
+#: of this (README.md:80-104).
+PRESETS: dict[str, dict] = {
+    # default single-host serve target (llm-d-deploy.yaml:118)
+    "qwen3-0.6b-v5e4": {
+        "model": "Qwen/Qwen3-0.6B",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t", "tensor_parallel": 4,
+    },
+    # alternate models (kubernetes-single-node.yaml:15, templates/*.yaml)
+    "phi3-mini-v5e4": {
+        "model": "microsoft/Phi-3-mini-4k-instruct",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t", "tensor_parallel": 4,
+        "chat_template": "phi",
+    },
+    "opt-1.3b-v5e4": {
+        "model": "facebook/opt-1.3b",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t", "tensor_parallel": 4,
+        "chat_template": "opt",
+    },
+    # disaggregated prefill/decode pools on a v5e-8 (BASELINE "Llama-3-8B
+    # disaggregated prefill/decode on v5e-8"): 4 chips prefill + 4 decode,
+    # KV handoff over ICI within the slice
+    "llama3-8b-disagg-v5e8": {
+        "model": "meta-llama/Meta-Llama-3-8B-Instruct",
+        "tpu_type": "v5litepod-8", "tpu_topology": "2x4",
+        "machine_type": "ct5lp-hightpu-8t", "tensor_parallel": 4,
+        "disaggregated": True,
+    },
+    # multi-host TP=8 at v5e-16 total capacity (BASELINE "Qwen2-72B TP=8
+    # multi-host v5e-16"): two 2x4 slices (2 hosts x 4 chips each), each a
+    # tp=8 replica — jax.distributed joins each slice and GSPMD routes the
+    # collectives over ICI; the gateway load-balances the two replicas
+    "qwen2-72b-tp8-v5e16": {
+        "model": "Qwen/Qwen2-72B-Instruct",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x4",
+        "machine_type": "ct5lp-hightpu-4t", "num_nodes": 4,
+        "tensor_parallel": 8, "replicas": 2,
+        "storage_size": "200Gi", "model_pvc_size": "300Gi",
+    },
+    # harness-friendly CPU smoke path (BASELINE "CPU smoke" config)
+    "cpu-smoke": {
+        "provider": "local", "model": "tiny-qwen3",
+        "tensor_parallel": 1, "replicas": 1,
+    },
+}
+
+
+def apply_preset(data: dict, preset: str) -> dict:
+    """Overlay a named preset under explicit YAML/env/override values."""
+    if preset not in PRESETS:
+        raise ValueError(
+            f"unknown preset {preset!r}; available: {sorted(PRESETS)}")
+    merged = dict(PRESETS[preset])
+    merged.update(data)
+    return merged

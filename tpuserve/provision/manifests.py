@@ -239,6 +239,79 @@ def engine_service(cfg: DeployConfig, *, role: Optional[str] = None) -> dict:
     }
 
 
+def multihost_headless_service(cfg: DeployConfig, replica_idx: int) -> dict:
+    """Headless Service giving each slice pod a stable DNS name (the
+    jax.distributed coordinator address is pod ordinal 0)."""
+    name = f"tpuserve-mh-{replica_idx}"
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": name, "namespace": cfg.namespace,
+                     "labels": {"app": "tpuserve"}},
+        "spec": {
+            "clusterIP": "None",
+            # followers never pass an HTTP readiness probe; DNS must still
+            # resolve so the slice can rendezvous
+            "publishNotReadyAddresses": True,
+            "selector": {"app": "tpuserve", "component": name},
+            "ports": [{"name": "http", "port": cfg.engine_port}],
+        },
+    }
+
+
+def multihost_engine_statefulset(cfg: DeployConfig, replica_idx: int) -> dict:
+    """One serving replica spanning several TPU hosts (BASELINE config
+    "Qwen2-72B TP=8 multi-host v5e-16").
+
+    A StatefulSet with one pod per slice host: GKE injects TPU_WORKER_ID /
+    TPU_WORKER_HOSTNAMES for pods consuming a multi-host slice, and
+    ``--multihost`` makes the engine join via jax.distributed — process 0
+    serves HTTP and broadcasts each step; the rest run the lockstep
+    follower loop (tpuserve/parallel/multihost.py).
+    """
+    name = f"tpuserve-mh-{replica_idx}"
+    hosts = -(-cfg.tensor_parallel // cfg.chips_per_node)
+    labels = {"app": "tpuserve", "component": name}
+    container = _engine_container(
+        cfg, role="engine", extra_args=["--multihost"])
+    # per-pod TPU request is one HOST's chips, not the whole slice
+    if cfg.provider == "gke":
+        container["resources"] = {"limits": {TPU_RESOURCE:
+                                             str(cfg.chips_per_node)}}
+    # only ordinal 0 answers HTTP; followers would fail HTTP probes forever
+    container.pop("readinessProbe", None)
+    container.pop("livenessProbe", None)
+    volumes = [{"name": "models",
+                "persistentVolumeClaim": {"claimName": "model-pvc"}}]
+    if cfg.chat_template:
+        volumes.append({"name": "chat-template", "configMap": {
+            "name": f"{cfg.chat_template}-chat-template"}})
+    pod_spec = {"containers": [container], "volumes": volumes,
+                "subdomain": name}
+    if cfg.provider == "gke":
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": _accelerator(cfg),
+            "cloud.google.com/gke-tpu-topology": cfg.tpu_topology,
+        }
+    return {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": cfg.namespace,
+                     "labels": labels},
+        "spec": {
+            "serviceName": name,
+            "replicas": hosts,
+            "podManagementPolicy": "Parallel",   # all hosts must rendezvous
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels, "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": str(cfg.engine_port),
+                    "prometheus.io/path": "/metrics"}},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
 def gateway_deployment(cfg: DeployConfig, backends: list[str]) -> dict:
     """Gateway Deployment — replaces the llm-d inference gateway the
     reference discovers at llm-d-test.yaml:14-26."""
@@ -294,6 +367,19 @@ def serving_manifests(cfg: DeployConfig) -> list[dict]:
     for name in CHAT_TEMPLATES:
         objs.append(chat_template_configmap(cfg, name))
     objs.append(model_download_job(cfg))
+    if cfg.tensor_parallel > cfg.chips_per_node:
+        # TP spans hosts: one StatefulSet (slice) per replica, gateway
+        # routes to each slice's coordinator pod (ordinal 0).
+        backends = []
+        for r in range(cfg.replicas):
+            objs.append(multihost_headless_service(cfg, r))
+            objs.append(multihost_engine_statefulset(cfg, r))
+            backends.append(
+                f"http://tpuserve-mh-{r}-0.tpuserve-mh-{r}."
+                f"{cfg.namespace}.svc.cluster.local:{cfg.engine_port}")
+        objs.append(gateway_deployment(cfg, backends))
+        objs.append(gateway_service(cfg))
+        return objs
     if cfg.disaggregated:
         # Disaggregated prefill/decode (llm-d's headline topology, SURVEY.md
         # §2.2; BASELINE 'Llama-3-8B disaggregated' config).  TPU-idiomatic

@@ -192,6 +192,19 @@ class Engine:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
+    # ---- execution hooks (multi-host coordinators wrap these to broadcast
+    # each step to follower processes before running it — parallel/multihost)
+
+    def _exec_prefill(self, tokens, prompt_lens, slot_ids):
+        return transformer.prefill(
+            self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
+            self.kv_cache, attn_impl=self.attn_impl)
+
+    def _exec_decode(self, tokens, positions, slot_ids, block_tables, seq_lens):
+        return transformer.decode_step(
+            self.params, self.model_cfg, tokens, positions, slot_ids,
+            block_tables, seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+
     # ---- prefill ------------------------------------------------------
 
     def _run_prefill(self, batch: ScheduledBatch) -> list[RequestOutput]:
@@ -209,10 +222,9 @@ class Engine:
             prompt_lens[i] = len(ids)
             for t in range(len(ids)):
                 slot_ids[i, t] = self.block_manager.slot_for_token(req.request_id, t)
-        logits, self.kv_cache = transformer.prefill(
-            self.params, self.model_cfg, jnp.asarray(tokens),
-            jnp.asarray(prompt_lens), jnp.asarray(slot_ids), self.kv_cache,
-            attn_impl=self.attn_impl)
+        logits, self.kv_cache = self._exec_prefill(
+            jnp.asarray(tokens), jnp.asarray(prompt_lens),
+            jnp.asarray(slot_ids))
         self.scheduler.mark_running(reqs)
         self.stats.num_prefill_steps += 1
         new_tokens = self._sample(logits, reqs, B)
@@ -258,11 +270,10 @@ class Engine:
             seq_lens[i] = req.num_tokens
             bt = self.block_manager.block_table(req.request_id)
             block_tables[i, :len(bt)] = bt
-        logits, self.kv_cache = transformer.decode_step(
-            self.params, self.model_cfg, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(slot_arr),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens), self.kv_cache,
-            attn_impl=self.attn_impl)
+        logits, self.kv_cache = self._exec_decode(
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(slot_arr), jnp.asarray(block_tables),
+            jnp.asarray(seq_lens))
         self.stats.num_decode_steps += 1
         new_tokens = self._sample(logits, reqs, B)
         return self._append_and_emit(reqs, new_tokens)

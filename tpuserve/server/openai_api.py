@@ -418,10 +418,17 @@ def main(argv=None):
     ap.add_argument("--chat-template", default=None,
                     help="path to a Jinja chat template overriding the "
                          "tokenizer's (ConfigMap-mounted in K8s)")
+    ap.add_argument("--multihost", action="store_true",
+                    help="join a multi-host TPU slice via jax.distributed "
+                         "(GKE injects TPU_WORKER_* env); process 0 serves, "
+                         "others follow in lockstep")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if args.multihost:
+        from tpuserve.parallel.mesh import multihost_initialize
+        multihost_initialize()
     ecfg = EngineConfig(
         model=args.model, checkpoint_dir=args.checkpoint_dir,
         cache=CacheConfig(block_size=args.block_size,
@@ -438,6 +445,16 @@ def main(argv=None):
         engine = DisaggregatedEngine(ecfg, ecfg, mesh=mesh)
     else:
         engine = Engine(ecfg, mesh=mesh)
+    if args.multihost:
+        import jax
+
+        from tpuserve.parallel import multihost
+        if not multihost.is_coordinator():
+            # Followers never serve HTTP: mirror the coordinator's steps
+            # until it broadcasts OP_STOP, then exit.
+            multihost.follower_loop(engine)
+            return
+        multihost.MultihostCoordinator(engine)
     chat_template = None
     if args.chat_template:
         chat_template = open(args.chat_template).read()
