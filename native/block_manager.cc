@@ -73,5 +73,8 @@ int64_t bm_block_table(void* h, const char* seq_id, int32_t* out,
 void bm_free_seq(void* h, const char* seq_id) {
   static_cast<BlockManager*>(h)->free_seq(seq_id);
 }
+void bm_free_seq_uncached(void* h, const char* seq_id) {
+  static_cast<BlockManager*>(h)->free_seq(seq_id, /*cache_blocks=*/false);
+}
 
 }  // extern "C"

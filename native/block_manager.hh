@@ -161,7 +161,10 @@ class BlockManager {
     return n;
   }
 
-  void free_seq(const std::string& seq_id) {
+  // cache_blocks=false drops the blocks' prefix hashes instead of parking
+  // them in the cached pool — for sequences whose KV was never fully
+  // written (e.g. a chunked prefill aborted mid-prompt).
+  void free_seq(const std::string& seq_id, bool cache_blocks = true) {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return;
     for (int32_t b : it->second.blocks) {
@@ -172,6 +175,7 @@ class BlockManager {
         continue;
       }
       if (rc != refcount_.end()) refcount_.erase(rc);
+      if (!cache_blocks) drop_hash(b);
       if (block_hash_.count(b)) {  // keep KV for prefix reuse, LRU order
         auto pos = cached_pos_.find(b);
         if (pos != cached_pos_.end()) cached_lru_.erase(pos->second);

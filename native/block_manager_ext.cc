@@ -206,10 +206,11 @@ PyObject* core_block_table(CoreObject* self, PyObject* arg) {
   return list_from_blocks(out.data(), n);
 }
 
-PyObject* core_free(CoreObject* self, PyObject* arg) {
-  const char* seq_id = PyUnicode_AsUTF8(arg);
-  if (!seq_id) return nullptr;
-  self->bm->free_seq(seq_id);
+PyObject* core_free(CoreObject* self, PyObject* args) {
+  const char* seq_id;
+  int cache_blocks = 1;
+  if (!PyArg_ParseTuple(args, "s|p", &seq_id, &cache_blocks)) return nullptr;
+  self->bm->free_seq(seq_id, cache_blocks != 0);
   Py_RETURN_NONE;
 }
 
@@ -227,7 +228,7 @@ PyMethodDef core_methods[] = {
     {"append_slot", (PyCFunction)core_append_slot, METH_O, ""},
     {"slot_for_token", (PyCFunction)core_slot_for_token, METH_VARARGS, ""},
     {"block_table", (PyCFunction)core_block_table, METH_O, ""},
-    {"free", (PyCFunction)core_free, METH_O, ""},
+    {"free", (PyCFunction)core_free, METH_VARARGS, ""},
     {nullptr, nullptr, 0, nullptr},
 };
 

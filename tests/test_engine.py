@@ -37,10 +37,15 @@ def test_greedy_deterministic_across_batsizes(engine):
 
 
 def test_sampled_modes(engine):
+    # ignore_eos + fixed seeds: the sampled stream may legitimately hit the
+    # eos id, and unseeded requests derive keys from process-randomized
+    # hash() — this test checks mode plumbing, not termination.
     reqs = engine.generate(
         ["abc", "def"],
-        [SamplingParams(max_tokens=4, temperature=0.7),
-         SamplingParams(max_tokens=4, temperature=0.9, top_k=20, top_p=0.9)])
+        [SamplingParams(max_tokens=4, temperature=0.7, seed=7,
+                        ignore_eos=True),
+         SamplingParams(max_tokens=4, temperature=0.9, top_k=20, top_p=0.9,
+                        seed=9, ignore_eos=True)])
     for r in reqs:
         assert len(r.output_token_ids) == 4
         assert all(0 <= t < 512 for t in r.output_token_ids)

@@ -254,3 +254,15 @@ def test_c_abi_via_ctypes(tmp_path):
     py.free("s1")
     assert lib.bm_num_free_blocks(h) == py.num_free_blocks
     lib.bm_destroy(h)
+
+
+def test_free_uncached_parity():
+    py, cc = make_pair(num_blocks=16, block_size=4)
+    prompt = list(range(12))
+    for bm in (py, cc):
+        bm.allocate("s", prompt)
+        bm.free("s", cache_blocks=False)
+    # nothing cached: identical prefix finds no blocks in either impl
+    assert py.lookup_prefix(prompt + [5]) == cc.lookup_prefix(prompt + [5]) \
+        == ([], 0)
+    assert py.num_free_blocks == cc.num_free_blocks == 16

@@ -152,6 +152,51 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 # --------------------------------------------------------------------------
+# Chunked prefill: one bounded chunk of a long prompt against the cache
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
+                  slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
+                  kv_cache: list, *, attn_impl: str = "reference"):
+    """Process one chunk of each prompt against the paged cache.
+
+    Long prompts run as a sequence of fixed-size chunks (bounded memory and
+    one compiled shape instead of a per-length bucket — the vLLM
+    chunked-prefill analog; the reference delegates this to the vLLM
+    container, kubernetes-single-node.yaml:14).
+
+    tokens: (B, C) chunk tokens (right-padded); ctx_lens: (B,) tokens
+    already in cache before this chunk; chunk_lens: (B,) valid tokens in the
+    chunk; slot_ids: (B, C) cache slots (PAD_SLOT on padding);
+    block_tables: (B, max_blocks).  Returns (last_logits (B, V), kv_cache)
+    where last_logits is taken at each sequence's final valid chunk row
+    (only meaningful on its last chunk).
+    """
+    B, C = tokens.shape
+    positions = ctx_lens[:, None] + jnp.arange(C)[None, :]
+    h = _embed(params, cfg, tokens, positions)
+    scale = cfg.head_dim ** -0.5
+    new_cache = []
+    for li, lp in enumerate(params["layers"]):
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions)
+        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
+        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
+        new_cache.append({"k": ck, "v": cv})
+        out = attn_ops.chunked_prefill_attention(
+            q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
+        out = out.reshape(B, C, cfg.q_size)
+        h = h + _linear(out, lp["o_proj"])
+        hn = _norm(h, lp["mlp_norm"], cfg)
+        h = h + _mlp(hn, lp, cfg)
+    last_idx = jnp.maximum(chunk_lens - 1, 0)
+    h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+    return _unembed(params, cfg, h_last), new_cache
+
+
+# --------------------------------------------------------------------------
 # Decode: one token per sequence against the paged cache
 # --------------------------------------------------------------------------
 

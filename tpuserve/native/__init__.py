@@ -155,8 +155,8 @@ class NativeBlockManager:
     def block_table(self, seq_id: str) -> list[int]:
         return self._core.block_table(seq_id)
 
-    def free(self, seq_id: str) -> None:
-        self._core.free(seq_id)
+    def free(self, seq_id: str, cache_blocks: bool = True) -> None:
+        self._core.free(seq_id, cache_blocks)
 
     def num_seqs(self) -> int:
         return self._core.num_seqs()

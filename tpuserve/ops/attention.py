@@ -75,6 +75,74 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                              v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                              ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
+                              scale: float, *, seg_size: int = 512) -> jnp.ndarray:
+    """Attention for one prefill CHUNK against the paged cache.
+
+    The chunk's K/V must already be written into the cache (so keys live at
+    sequence positions ``ctx_lens .. ctx_lens+chunk_lens``).  Each chunk
+    query attends to every cached token before it plus causally within the
+    chunk.  Keys are processed in ``seg_size`` segments with a flash-style
+    online softmax, so the transient score tensor is (B, Hq, C, seg_size)
+    instead of (B, Hq, C, S) — at 32k context and a 2k chunk the dense form
+    would be gigabytes per layer, defeating the point of chunking.
+
+    q: (B, C, Hq, D) chunk queries; k_cache/v_cache: (num_blocks, block_size,
+    Hkv, D); block_tables: (B, max_blocks); ctx_lens: (B,) tokens already in
+    cache BEFORE this chunk; chunk_lens: (B,) valid tokens in this chunk.
+    Returns (B, C, Hq, D).
+    """
+    B, C, Hq, D = q.shape
+    _, block_size, Hkv, _ = k_cache.shape
+    S = block_tables.shape[1] * block_size
+    k = k_cache[block_tables].reshape(B, S, Hkv, D)
+    v = v_cache[block_tables].reshape(B, S, Hkv, D)
+    n_rep = Hq // Hkv
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    seg = min(seg_size, S)
+    n_seg = -(-S // seg)
+    pad = n_seg * seg - S
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    k = k.reshape(B, n_seg, seg, Hq, D).astype(jnp.float32)
+    v = v.reshape(B, n_seg, seg, Hq, D).astype(jnp.float32)
+
+    q32 = q.astype(jnp.float32) * scale
+    qi = jnp.arange(C)[None, :, None]                    # query chunk index
+    q_valid = qi < chunk_lens[:, None, None]             # (B, C, 1)
+
+    def body(carry, seg_kv):
+        o, m, l, s0 = carry
+        ks, vs = seg_kv                                  # (B, seg, Hq, D)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, ks,
+                            preferred_element_type=jnp.float32)
+        j = s0 + jnp.arange(seg)[None, None, :]          # global key position
+        mask = (j <= ctx_lens[:, None, None] + qi) & q_valid & (j < S)
+        mask = mask[:, None, :, :]                       # (B, 1, C, seg)
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vs)
+        return (o, m_new, l, s0 + seg), None
+
+    o0 = jnp.zeros((B, Hq, C, D), jnp.float32)
+    m0 = jnp.full((B, Hq, C), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hq, C), jnp.float32)
+    (o, m, l, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, jnp.int32(0)),
+        (k.transpose(1, 0, 2, 3, 4), v.transpose(1, 0, 2, 3, 4)))
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)     # (B, C, Hq, D)
+
+
 def write_kv_cache(cache: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     """Scatter new K or V vectors into the paged cache.
 

@@ -182,7 +182,12 @@ class BlockManager:
     def block_table(self, seq_id: str) -> list[int]:
         return list(self._seqs[seq_id].blocks)
 
-    def free(self, seq_id: str) -> None:
+    def free(self, seq_id: str, cache_blocks: bool = True) -> None:
+        """Release a sequence's blocks.  ``cache_blocks=False`` drops their
+        prefix-cache hashes instead of parking them in the cached pool — for
+        sequences whose KV was never fully written (e.g. a chunked prefill
+        aborted mid-prompt), whose blocks would otherwise be served as
+        cached prefixes full of garbage."""
         alloc = self._seqs.pop(seq_id, None)
         if alloc is None:
             return
@@ -192,6 +197,8 @@ class BlockManager:
                 self._refcount[b] = rc
                 continue
             self._refcount.pop(b, None)
+            if not cache_blocks:
+                self._drop_hash(b)
             if b in self._block_hash:       # keep KV around for prefix reuse
                 self._cached[b] = None
                 self._cached.move_to_end(b)

@@ -164,7 +164,11 @@ class Engine:
             return False
         req.state = RequestState.FINISHED
         req.finish_reason = FinishReason.ABORT
-        self.block_manager.free(request_id)
+        # A chunk-prefilling request's later blocks hold no KV yet: freeing
+        # them into the prefix-cache pool would serve garbage to the next
+        # identical prefix.
+        partial = 0 < req.num_prefilled < req.num_tokens
+        self.block_manager.free(request_id, cache_blocks=not partial)
         self._detok.pop(request_id, None)
         return True
 
@@ -183,6 +187,8 @@ class Engine:
         t0 = time.monotonic()
         if batch.kind == "prefill":
             outputs = self._run_prefill(batch)
+        elif batch.kind == "prefill_chunk":
+            outputs = self._run_prefill_chunk(batch)
         else:
             outputs = self._run_decode(batch)
         self.stats.last_step_time = time.monotonic() - t0
@@ -240,6 +246,52 @@ class Engine:
         """Tokens to prefill — prompt plus, after a preemption, everything
         generated so far (the cache was dropped and must be rebuilt)."""
         return req.prompt_token_ids + req.output_token_ids
+
+    def _run_prefill_chunk(self, batch: ScheduledBatch) -> list[RequestOutput]:
+        """One fixed-size chunk of a long prompt (vLLM chunked-prefill
+        analog): bounded activation memory and a single compiled shape for
+        any prompt length.  The request re-enters the waiting queue until
+        its last chunk, which samples the first token."""
+        req = batch.requests[0]
+        C = batch.padded_len
+        ids = self._prefill_tokens(req)
+        if req.num_prefilled == 0:
+            shared, _cached = self.block_manager.lookup_prefix(ids)
+            self.block_manager.allocate(req.request_id, ids,
+                                        shared_blocks=shared)
+        done = req.num_prefilled
+        chunk = ids[done:done + C]
+        n = len(chunk)
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :n] = chunk
+        slot_ids = np.full((1, C), PAD_SLOT, np.int32)
+        for t in range(n):
+            slot_ids[0, t] = self.block_manager.slot_for_token(
+                req.request_id, done + t)
+        block_tables = np.zeros((1, self.cache_cfg.max_blocks_per_seq),
+                                np.int32)
+        bt = self.block_manager.block_table(req.request_id)
+        block_tables[0, :len(bt)] = bt
+        logits, self.kv_cache = transformer.prefill_chunk(
+            self.params, self.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(np.asarray([done], np.int32)),
+            jnp.asarray(np.asarray([n], np.int32)),
+            jnp.asarray(slot_ids), jnp.asarray(block_tables), self.kv_cache,
+            attn_impl=self.attn_impl)
+        req.num_prefilled = done + n
+        self.stats.num_prefill_steps += 1
+        if req.num_prefilled < len(ids):
+            # more chunks to go: back to the head of the queue
+            self.scheduler.waiting.appendleft(req)
+            return []
+        self.scheduler.mark_running([req])
+        new_tokens = self._sample(logits, [req], 1)
+        now = time.monotonic()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.stats.ttft_sum += now - req.arrival_time
+            self.stats.ttft_count += 1
+        return self._append_and_emit([req], new_tokens, from_prefill=True)
 
     # ---- decode -------------------------------------------------------
 
@@ -486,6 +538,20 @@ class Engine:
                 logits, self.kv_cache = transformer.decode_step(
                     self.params, self.model_cfg, tokens, positions, slots, bt,
                     seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+                self._warm_sampling(logits, sample_modes)
+            chunk = self.config.scheduler.prefill_chunk_size
+            if self.max_seq_len > chunk:
+                # long prompts hit the chunked path; its single (1, chunk)
+                # executable must be warm too or the first long request
+                # stalls the loop on a compile
+                tokens = jnp.zeros((1, chunk), jnp.int32)
+                slots = jnp.full((1, chunk), PAD_SLOT, jnp.int32)
+                bt = jnp.zeros((1, self.cache_cfg.max_blocks_per_seq),
+                               jnp.int32)
+                logits, self.kv_cache = transformer.prefill_chunk(
+                    self.params, self.model_cfg, tokens,
+                    jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
+                    slots, bt, self.kv_cache, attn_impl=self.attn_impl)
                 self._warm_sampling(logits, sample_modes)
         logits.block_until_ready()
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",

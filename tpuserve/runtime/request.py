@@ -71,6 +71,9 @@ class Request:
     finish_time: Optional[float] = None
     # logprob of each generated token + top alternatives (when requested)
     logprobs: list[dict] = dataclasses.field(default_factory=list)
+    # chunked prefill progress: prompt tokens already written to the cache
+    # (reset on preemption along with the cache itself)
+    num_prefilled: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -27,13 +27,18 @@ class SchedulerConfig:
     max_prefill_seqs: int = 8
     min_prefill_bucket: int = 32        # smallest padded prompt length
     min_decode_bucket: int = 4          # smallest padded decode batch
+    # Prompts longer than this run as a sequence of fixed-size chunks
+    # against the cache (ONE compiled shape instead of a giant per-length
+    # bucket; bounds prefill activation memory for long contexts).
+    prefill_chunk_size: int = 2048
 
 
 @dataclasses.dataclass
 class ScheduledBatch:
-    kind: str                            # "prefill" | "decode"
+    kind: str                            # "prefill" | "prefill_chunk" | "decode"
     requests: list[Request]
     # prefill only: padded token length all prompts in the batch share
+    # (for prefill_chunk: the fixed chunk size)
     padded_len: int = 0
     # decode only: padded batch size
     padded_batch: int = 0
@@ -96,6 +101,25 @@ class Scheduler:
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
         if not self.waiting or len(self.running) >= self.cfg.max_num_seqs:
             return None
+        # A long prompt runs chunk-by-chunk, alone, at the fixed chunk shape.
+        # A partially-prefilled request ANYWHERE in the queue continues
+        # first: it already holds KV blocks, and it can end up behind other
+        # waiting requests when a decode-OOM preemption appendlefts its
+        # victim — if it could not be scheduled from there, its blocks would
+        # never drain and the engine would livelock.
+        for req in self.waiting:
+            if req.num_prefilled > 0:
+                self.waiting.remove(req)
+                return ScheduledBatch(kind="prefill_chunk", requests=[req],
+                                      padded_len=self.cfg.prefill_chunk_size)
+        head = self.waiting[0]
+        if head.num_tokens > self.cfg.prefill_chunk_size:
+            need = self.block_manager.blocks_needed(head.num_tokens) + 1
+            if need > self.block_manager.num_free_blocks:
+                return None      # wait for blocks to free up
+            self.waiting.popleft()
+            return ScheduledBatch(kind="prefill_chunk", requests=[head],
+                                  padded_len=self.cfg.prefill_chunk_size)
         picked: list[Request] = []
         bucket = 0
         reserved = 0   # blocks spoken for by requests already picked this batch
@@ -142,5 +166,6 @@ class Scheduler:
         self.block_manager.free(req.request_id)
         # Re-prefill will recompute the full context (prompt + generated).
         req.state = RequestState.PREEMPTED
+        req.num_prefilled = 0
         self.waiting.appendleft(req)
         return req
