@@ -156,3 +156,21 @@ def test_mid_chunk_request_resumes_from_any_queue_position(fp32_cfg):
     while eng.has_work():
         eng.step()
     assert eng.block_manager.num_seqs() == 0
+
+
+def test_long_prompt_behind_short_head_still_chunks(fp32_cfg):
+    """A long prompt queued behind a short one must go through the chunked
+    path, not get batched into a giant one-shot prefill bucket."""
+    eng = _engine(8, fp32_cfg)
+    p = SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True)
+    eng.add_request(prompt_token_ids=[1, 2, 3], params=p)          # short head
+    eng.add_request(prompt_token_ids=list(range(1, 21)), params=p) # long, 20 > 8
+    batch = eng.scheduler.schedule()
+    assert batch.kind == "prefill"
+    assert len(batch.requests) == 1          # the long one was NOT batched in
+    eng.scheduler.waiting.appendleft(batch.requests[0])
+    while eng.has_work():
+        eng.step()
+    long_req = [r for r in eng.requests.values()
+                if len(r.prompt_token_ids) == 20][0]
+    assert long_req.num_prefilled == 20      # chunked path was used

@@ -155,11 +155,11 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # Chunked prefill: one bounded chunk of a long prompt against the cache
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_cache",))
 def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                   slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                  kv_cache: list, *, attn_impl: str = "reference"):
+                  kv_cache: list):
     """Process one chunk of each prompt against the paged cache.
 
     Long prompts run as a sequence of fixed-size chunks (bounded memory and
@@ -173,6 +173,10 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     block_tables: (B, max_blocks).  Returns (last_logits (B, V), kv_cache)
     where last_logits is taken at each sequence's final valid chunk row
     (only meaningful on its last chunk).
+
+    Attention is always the segmented online-softmax implementation in
+    ops/attention.py (no Pallas variant yet, unlike prefill/decode_step) —
+    XLA fuses the per-segment einsums acceptably and memory stays bounded.
     """
     B, C = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(C)[None, :]

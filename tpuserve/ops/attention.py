@@ -97,11 +97,12 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     B, C, Hq, D = q.shape
     _, block_size, Hkv, _ = k_cache.shape
     S = block_tables.shape[1] * block_size
+    G = Hq // Hkv
+    # K/V stay in cache dtype with Hkv heads until inside the scan body —
+    # expanding to Hq heads / fp32 up front would build an n_rep x 2 larger
+    # transient than the cache itself at long context.
     k = k_cache[block_tables].reshape(B, S, Hkv, D)
     v = v_cache[block_tables].reshape(B, S, Hkv, D)
-    n_rep = Hq // Hkv
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
 
     seg = min(seg_size, S)
     n_seg = -(-S // seg)
@@ -109,18 +110,21 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    k = k.reshape(B, n_seg, seg, Hq, D).astype(jnp.float32)
-    v = v.reshape(B, n_seg, seg, Hq, D).astype(jnp.float32)
+    k = k.reshape(B, n_seg, seg, Hkv, D)
+    v = v.reshape(B, n_seg, seg, Hkv, D)
 
-    q32 = q.astype(jnp.float32) * scale
+    # grouped-query layout: (B, C, Hkv, G, D) so the einsum contracts per
+    # kv-head without materializing repeated K/V
+    q_r = (q.astype(jnp.float32) * scale).reshape(B, C, Hkv, G, D)
     qi = jnp.arange(C)[None, :, None]                    # query chunk index
     q_valid = qi < chunk_lens[:, None, None]             # (B, C, 1)
 
     def body(carry, seg_kv):
         o, m, l, s0 = carry
-        ks, vs = seg_kv                                  # (B, seg, Hq, D)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, ks,
+        ks, vs = seg_kv                                  # (B, seg, Hkv, D)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q_r, ks,
                             preferred_element_type=jnp.float32)
+        scores = scores.reshape(B, Hq, C, seg)
         j = s0 + jnp.arange(seg)[None, None, :]          # global key position
         mask = (j <= ctx_lens[:, None, None] + qi) & q_valid & (j < S)
         mask = mask[:, None, :, :]                       # (B, 1, C, seg)
@@ -130,7 +134,10 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vs)
+        pv = jnp.einsum("bhgqk,bkhd->bhgqd",
+                        p.reshape(B, Hkv, G, C, seg), vs,
+                        preferred_element_type=jnp.float32)
+        o = o * alpha[..., None] + pv.reshape(B, Hq, C, D)
         return (o, m_new, l, s0 + seg), None
 
     o0 = jnp.zeros((B, Hq, C, D), jnp.float32)

@@ -126,6 +126,11 @@ class Scheduler:
         while (self.waiting and len(picked) < self.cfg.max_prefill_seqs
                and len(self.running) + len(picked) < self.cfg.max_num_seqs):
             req = self.waiting[0]
+            if req.num_tokens > self.cfg.prefill_chunk_size:
+                # long prompt behind the head: leave it for its own chunked
+                # step — batching it here would one-shot prefill a giant
+                # uncompiled bucket
+                break
             # All prompts in one prefill batch share a padded length bucket.
             # num_tokens (not num_prompt_tokens): a preempted request
             # re-prefills its prompt plus everything generated so far.
