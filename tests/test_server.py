@@ -167,3 +167,26 @@ def test_malformed_sampling_fields(server):
         "prompt": "x", "temperature": None, "max_tokens": 2,
         "top_p": None, "ignore_eos": True})
     assert status == 200
+
+
+def test_debug_profile_endpoint(server):
+    import os
+    status, resp = _get(server + "/debug/profile?seconds=0.2")
+    assert status == 200
+    assert resp["seconds"] == pytest.approx(0.2, abs=0.01)
+    trace_dir = resp["trace_dir"]
+    assert os.path.isdir(trace_dir)
+    # jax wrote a TensorBoard-loadable profile under plugins/profile/
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found += files
+    assert found, "profile capture produced no files"
+
+
+def test_tracer_noop_without_endpoint(monkeypatch):
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    from tpuserve.server.tracing import RequestTracer
+    t = RequestTracer()
+    assert not t.active
+    with t.request_span("x", foo=1) as span:
+        span.set_attribute("a", "b")     # no-op, must not raise

@@ -239,6 +239,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"status": "ready"})
             else:
                 self._error(503, "not ready", "server_error")
+        elif self.path.startswith("/debug/profile"):
+            # jax.profiler capture (SURVEY.md §5: the reference has no
+            # profiler; this is the TPU-native story).  Blocks this handler
+            # thread only; the engine keeps serving while being traced.
+            from urllib.parse import parse_qs, urlparse
+            from tpuserve.server.tracing import capture_profile
+            try:
+                q = parse_qs(urlparse(self.path).query)
+                seconds = float(q.get("seconds", ["2"])[0])
+                self._json(200, capture_profile(seconds))
+            except Exception as e:
+                self._error(500, f"profile capture failed: {e}",
+                            "server_error")
         else:
             self._error(404, f"no route {self.path}")
 
@@ -256,13 +269,19 @@ class _Handler(BaseHTTPRequestHandler):
         stream = bool(body.get("stream", False))
         kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
                   else {"prompt": prompt})
+        from tpuserve.server.tracing import get_tracer
         try:
-            if stream:
-                # _stream_response owns its error handling: once SSE headers
-                # are out, a second status line would corrupt the stream.
-                self._stream_response(body, params, chat, kwargs)
-            else:
-                self._full_response(body, params, chat, kwargs)
+            with get_tracer().request_span(
+                    self.path, **{"gen_ai.request.model": self.ctx.model_name,
+                                  "gen_ai.request.max_tokens": params.max_tokens,
+                                  "tpuserve.stream": stream}):
+                if stream:
+                    # _stream_response owns its error handling: once SSE
+                    # headers are out, a second status line would corrupt
+                    # the stream.
+                    self._stream_response(body, params, chat, kwargs)
+                else:
+                    self._full_response(body, params, chat, kwargs)
         except BrokenPipeError:
             pass
         except Exception as e:               # engine-side failure, pre-headers

@@ -1,0 +1,108 @@
+"""Request tracing + on-demand device profiling.
+
+The reference stands up an OTLP trace receiver (grpc 4317 / http 4318) with
+a traces pipeline but nothing ever emits a span (reference:
+otel-observability-setup.yaml:504-509,633-636; SURVEY.md §5 "plumbing
+exists, no real trace backend, and nothing emits traces").  Here the engine
+server emits one span per API request so that pipeline actually carries
+data.  The OpenTelemetry SDK is optional: when it isn't importable or no
+OTLP endpoint is configured, everything degrades to a no-op with the same
+API (the container image does not bake opentelemetry).
+
+Profiling: ``capture_profile`` wraps ``jax.profiler`` trace capture — the
+TPU-native replacement for the profilers the reference never had
+(SURVEY.md §5 "No profiler anywhere").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import tempfile
+import time
+
+logger = logging.getLogger("tpuserve.tracing")
+
+
+class _NoopSpan:
+    def set_attribute(self, key, value):  # pragma: no cover - trivial
+        pass
+
+
+class RequestTracer:
+    """One span per served request; OTLP-backed when available, no-op
+    otherwise.  ``request_span`` never raises."""
+
+    def __init__(self):
+        self._tracer = None
+        endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        if not endpoint:
+            return
+        try:
+            from opentelemetry import trace
+            from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+                OTLPSpanExporter)
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+            provider = TracerProvider(resource=Resource.create(
+                {"service.name": os.environ.get("OTEL_SERVICE_NAME",
+                                                "tpuserve")}))
+            provider.add_span_processor(
+                BatchSpanProcessor(OTLPSpanExporter()))
+            trace.set_tracer_provider(provider)
+            self._tracer = trace.get_tracer("tpuserve")
+            logger.info("OTLP tracing enabled -> %s", endpoint)
+        except Exception as e:   # SDK absent or misconfigured: no-op
+            logger.info("OTLP tracing unavailable (%s); spans are no-ops", e)
+
+    @property
+    def active(self) -> bool:
+        return self._tracer is not None
+
+    @contextlib.contextmanager
+    def request_span(self, name: str, **attrs):
+        if self._tracer is None:
+            yield _NoopSpan()
+            return
+        try:
+            cm = self._tracer.start_as_current_span(name)
+            span = cm.__enter__()
+        except Exception:
+            yield _NoopSpan()
+            return
+        try:
+            for k, v in attrs.items():
+                if v is not None:
+                    span.set_attribute(k, v)
+            yield span
+        finally:
+            cm.__exit__(None, None, None)
+
+
+_tracer: RequestTracer | None = None
+
+
+def get_tracer() -> RequestTracer:
+    global _tracer
+    if _tracer is None:
+        _tracer = RequestTracer()
+    return _tracer
+
+
+def capture_profile(seconds: float, out_dir: str | None = None) -> dict:
+    """Capture a jax.profiler device trace for ``seconds``.
+
+    Returns {"trace_dir": path, "seconds": n}.  The directory holds the
+    TensorBoard-loadable profile (plugins/profile/...).
+    """
+    import jax
+    seconds = min(max(seconds, 0.1), 60.0)
+    out_dir = out_dir or tempfile.mkdtemp(prefix="tpuserve-profile-")
+    jax.profiler.start_trace(out_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    return {"trace_dir": out_dir, "seconds": seconds}
