@@ -55,9 +55,9 @@ class BlockManager {
 
   // Longest cached whole-block prefix; at least one token stays uncached.
   int64_t lookup_prefix(const int32_t* tokens, int64_t n, int32_t* out,
-                        int64_t max_out) {
+                        int64_t max_out, bool count_stats = true) {
     if (!enable_prefix_) return 0;
-    ++prefix_queries_;
+    if (count_stats) ++prefix_queries_;
     int64_t max_full = (n - 1) / block_size_;
     uint64_t h = 0;
     int64_t got = 0;
@@ -67,7 +67,7 @@ class BlockManager {
       if (it == prefix_.end()) break;
       out[got++] = it->second;
     }
-    if (got > 0) ++prefix_hits_;
+    if (got > 0 && count_stats) ++prefix_hits_;
     return got;
   }
 

@@ -95,14 +95,18 @@ PyObject* core_prefix_queries(CoreObject* self, PyObject*) {
   return PyLong_FromLongLong(self->bm->prefix_queries());
 }
 
-PyObject* core_lookup_prefix(CoreObject* self, PyObject* arg) {
+PyObject* core_lookup_prefix(CoreObject* self, PyObject* args) {
+  PyObject* list;
+  int count_stats = 1;
+  if (!PyArg_ParseTuple(args, "O|p", &list, &count_stats)) return nullptr;
   std::vector<int32_t> tokens;
-  if (!tokens_from_list(arg, &tokens)) return nullptr;
+  if (!tokens_from_list(list, &tokens)) return nullptr;
   std::vector<int32_t> out(tokens.size() + 1);  // >= max possible blocks
   int64_t n = self->bm->lookup_prefix(tokens.data(),
                                       static_cast<int64_t>(tokens.size()),
                                       out.data(),
-                                      static_cast<int64_t>(out.size()));
+                                      static_cast<int64_t>(out.size()),
+                                      count_stats != 0);
   return list_from_blocks(out.data(), n);
 }
 
@@ -221,7 +225,7 @@ PyMethodDef core_methods[] = {
     {"can_allocate", (PyCFunction)core_can_allocate, METH_O, ""},
     {"prefix_hits", (PyCFunction)core_prefix_hits, METH_NOARGS, ""},
     {"prefix_queries", (PyCFunction)core_prefix_queries, METH_NOARGS, ""},
-    {"lookup_prefix", (PyCFunction)core_lookup_prefix, METH_O, ""},
+    {"lookup_prefix", (PyCFunction)core_lookup_prefix, METH_VARARGS, ""},
     {"allocate", (PyCFunction)core_allocate, METH_VARARGS, ""},
     {"needs_new_block", (PyCFunction)core_needs_new_block, METH_O, ""},
     {"can_append", (PyCFunction)core_can_append, METH_O, ""},

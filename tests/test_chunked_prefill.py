@@ -174,3 +174,46 @@ def test_long_prompt_behind_short_head_still_chunks(fp32_cfg):
     long_req = [r for r in eng.requests.values()
                 if len(r.prompt_token_ids) == 20][0]
     assert long_req.num_prefilled == 20      # chunked path was used
+
+
+def test_prefix_cache_compute_skip(fp32_cfg):
+    """A repeated prompt reuses cached KV: one chunk step computes only the
+    uncached tail, and outputs are identical to a cold run."""
+    eng = Engine(
+        EngineConfig(model="tiny-qwen3",
+                     cache=CacheConfig(block_size=4, num_blocks=128,
+                                       max_blocks_per_seq=24),
+                     scheduler=SchedulerConfig(max_num_seqs=4,
+                                               prefill_chunk_size=64),
+                     enable_prefix_caching=True),
+        model_cfg=fp32_cfg)
+    prompt = list(range(1, 23))      # 22 tokens = 5 full blocks + tail
+    p = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    cold = eng.generate([prompt], p)[0].output_token_ids
+    steps_before = eng.stats.num_prefill_steps
+    hits_before = eng.block_manager.prefix_hits
+    warm = eng.generate([prompt], p)[0].output_token_ids
+    assert warm == cold
+    assert eng.block_manager.prefix_hits == hits_before + 1
+    # warm run: exactly one chunk step over the uncached tail
+    assert eng.stats.num_prefill_steps == steps_before + 1
+    assert eng.block_manager.num_seqs() == 0
+
+
+def test_preempted_request_reprefills_from_cache(fp32_cfg):
+    """After preemption, the re-prefill hits the request's own freed hashed
+    blocks and skips recomputing them (recompute-with-cache)."""
+    eng = Engine(
+        EngineConfig(model="tiny-qwen3",
+                     cache=CacheConfig(block_size=4, num_blocks=12,
+                                       max_blocks_per_seq=10),
+                     scheduler=SchedulerConfig(max_num_seqs=3),
+                     enable_prefix_caching=True),
+        model_cfg=fp32_cfg)
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    outs = eng.generate([[1, 2, 3, 4, 5, 6, 7, 8],
+                         [9, 8, 7, 6, 5],
+                         [4, 4, 4]], p)
+    for r in outs:
+        assert len(r.output_token_ids) == 10
+    assert eng.block_manager.num_seqs() == 0

@@ -128,8 +128,9 @@ class NativeBlockManager:
 
     # ---- prefix cache ---------------------------------------------------
 
-    def lookup_prefix(self, token_ids) -> tuple[list[int], int]:
-        blocks = self._core.lookup_prefix(list(token_ids))
+    def lookup_prefix(self, token_ids,
+                      count_stats: bool = True) -> tuple[list[int], int]:
+        blocks = self._core.lookup_prefix(list(token_ids), count_stats)
         return blocks, len(blocks) * self.block_size
 
     # ---- allocation -----------------------------------------------------

@@ -78,15 +78,20 @@ class BlockManager:
     def _chain_hash(prev_hash: int, tokens: tuple[int, ...]) -> int:
         return hash((prev_hash, tokens))
 
-    def lookup_prefix(self, token_ids: list[int]) -> tuple[list[int], int]:
+    def lookup_prefix(self, token_ids: list[int],
+                      count_stats: bool = True) -> tuple[list[int], int]:
         """Longest cached prefix: returns (physical blocks, num cached tokens).
 
         Only whole blocks are reusable, and at least one token must remain
         un-cached so prefill has something to compute.
+        ``count_stats=False`` for routing peeks (the scheduler probes the
+        cache to pick a prefill path; only the engine's real lookup should
+        move the hit-rate metrics).
         """
         if not self.enable_prefix_caching:
             return [], 0
-        self.prefix_queries += 1
+        if count_stats:
+            self.prefix_queries += 1
         blocks: list[int] = []
         h = 0
         max_full = (len(token_ids) - 1) // self.block_size
@@ -97,7 +102,7 @@ class BlockManager:
             if phys is None:
                 break
             blocks.append(phys)
-        if blocks:
+        if blocks and count_stats:
             self.prefix_hits += 1
         return blocks, len(blocks) * self.block_size
 
@@ -122,9 +127,11 @@ class BlockManager:
         """Allocate blocks for a prompt; ``shared_blocks`` are prefix-cache
         hits (revived / ref-counted, never copied).
 
-        Note: sharing currently dedups KV *memory* across identical prefixes;
-        the prefill still recomputes and rewrites identical KV into shared
-        blocks (compute skip lands with chunked prefill)."""
+        Sharing dedups KV memory across identical prefixes.  The batched
+        prefill path still rewrites identical KV into shared blocks (one
+        shared padded shape, no per-request skip); the chunked path starts
+        at the cached offset and skips the recompute entirely
+        (engine._run_prefill_chunk)."""
         assert seq_id not in self._seqs, f"{seq_id} already allocated"
         shared_blocks = shared_blocks or []
         need = self.blocks_needed(len(prompt_token_ids)) - len(shared_blocks)

@@ -290,9 +290,15 @@ class Engine:
         C = batch.padded_len
         ids = self._prefill_tokens(req)
         if req.num_prefilled == 0:
-            shared, _cached = self.block_manager.lookup_prefix(ids)
+            shared, cached = self.block_manager.lookup_prefix(ids)
             self.block_manager.allocate(req.request_id, ids,
                                         shared_blocks=shared)
+            # Compute skip: the shared blocks already hold valid KV for the
+            # cached tokens, so prefill starts at the cached offset instead
+            # of recomputing them (lookup always leaves >= 1 token to
+            # compute, so the last chunk exists and samples the first
+            # token).
+            req.num_prefilled = cached
         done = req.num_prefilled
         chunk = ids[done:done + C]
         n = len(chunk)

@@ -113,7 +113,14 @@ class Scheduler:
                 return ScheduledBatch(kind="prefill_chunk", requests=[req],
                                       padded_len=self.cfg.prefill_chunk_size)
         head = self.waiting[0]
-        if head.num_tokens > self.cfg.prefill_chunk_size:
+        # Long prompts chunk by necessity; prompts with a prefix-cache hit
+        # chunk by choice — the chunked path can START at the cached offset
+        # and skip recomputing the cached tokens entirely (the batched path
+        # has one shared padded shape and cannot skip per-request).
+        _, head_cached = self.block_manager.lookup_prefix(
+            head.prompt_token_ids + head.output_token_ids, count_stats=False)
+        if (head.num_tokens > self.cfg.prefill_chunk_size
+                or head_cached > 0):
             need = self.block_manager.blocks_needed(head.num_tokens) + 1
             if need > self.block_manager.num_free_blocks:
                 return None      # wait for blocks to free up
