@@ -141,6 +141,36 @@ class BlockManager {
     return static_cast<int64_t>(block) * block_size_ + offset;
   }
 
+  // Grow the block table to hold total_tokens slots without advancing the
+  // written-token counter.  Returns 0, or -1 OOM, -2 unknown seq.
+  int64_t reserve(const std::string& seq_id, int64_t total_tokens) {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    SeqAlloc& a = it->second;
+    int64_t need = blocks_needed(total_tokens) -
+                   static_cast<int64_t>(a.blocks.size());
+    if (need > num_free_blocks()) return -1;
+    for (int64_t i = 0; i < need; ++i) {
+      int32_t b = pop_free_block();
+      refcount_[b] = 1;
+      a.blocks.push_back(b);
+    }
+    return 0;
+  }
+
+  // Commit n written tokens.  Returns 0, or -2 unknown seq, -3 beyond
+  // reserved capacity.
+  int64_t advance(const std::string& seq_id, int64_t n) {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    SeqAlloc& a = it->second;
+    if (a.num_tokens + n >
+        static_cast<int64_t>(a.blocks.size()) * block_size_)
+      return -3;
+    a.num_tokens += n;
+    return 0;
+  }
+
   int64_t slot_for_token(const std::string& seq_id, int64_t idx) const {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;

@@ -165,6 +165,38 @@ PyObject* core_can_append(CoreObject* self, PyObject* arg) {
   return PyBool_FromLong(r);
 }
 
+PyObject* core_reserve(CoreObject* self, PyObject* args) {
+  const char* seq_id;
+  long long total;
+  if (!PyArg_ParseTuple(args, "sL", &seq_id, &total)) return nullptr;
+  int64_t r = self->bm->reserve(seq_id, total);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, seq_id);
+    return nullptr;
+  }
+  if (r == -1) {
+    PyErr_SetString(PyExc_MemoryError, "out of KV blocks on reserve");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* core_advance(CoreObject* self, PyObject* args) {
+  const char* seq_id;
+  long long n;
+  if (!PyArg_ParseTuple(args, "sL", &seq_id, &n)) return nullptr;
+  int64_t r = self->bm->advance(seq_id, n);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, seq_id);
+    return nullptr;
+  }
+  if (r == -3) {
+    PyErr_SetString(PyExc_ValueError, "advance beyond reserved capacity");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
 PyObject* core_append_slot(CoreObject* self, PyObject* arg) {
   const char* seq_id = PyUnicode_AsUTF8(arg);
   if (!seq_id) return nullptr;
@@ -230,6 +262,8 @@ PyMethodDef core_methods[] = {
     {"needs_new_block", (PyCFunction)core_needs_new_block, METH_O, ""},
     {"can_append", (PyCFunction)core_can_append, METH_O, ""},
     {"append_slot", (PyCFunction)core_append_slot, METH_O, ""},
+    {"reserve", (PyCFunction)core_reserve, METH_VARARGS, ""},
+    {"advance", (PyCFunction)core_advance, METH_VARARGS, ""},
     {"slot_for_token", (PyCFunction)core_slot_for_token, METH_VARARGS, ""},
     {"block_table", (PyCFunction)core_block_table, METH_O, ""},
     {"free", (PyCFunction)core_free, METH_VARARGS, ""},

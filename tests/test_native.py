@@ -266,3 +266,23 @@ def test_free_uncached_parity():
     assert py.lookup_prefix(prompt + [5]) == cc.lookup_prefix(prompt + [5]) \
         == ([], 0)
     assert py.num_free_blocks == cc.num_free_blocks == 16
+
+
+def test_reserve_advance_parity():
+    py, cc = make_pair(num_blocks=16, block_size=4, prefix=False)
+    for bm in (py, cc):
+        bm.allocate("s", [1, 2, 3, 4, 5])      # 5 tokens, 2 blocks
+        bm.reserve("s", 11)                    # 3 blocks total
+    assert py.num_free_blocks == cc.num_free_blocks
+    assert py.block_table("s") == cc.block_table("s")
+    # slots computable across the reserved window without advancing
+    for idx in (5, 8, 10):
+        assert py.slot_for_token("s", idx) == cc.slot_for_token("s", idx)
+    for bm in (py, cc):
+        bm.advance("s", 3)
+        with pytest.raises(ValueError):
+            bm.advance("s", 100)
+    # next append continues from the committed position
+    assert py.append_slot("s") == cc.append_slot("s")
+    py.free("s"); cc.free("s")
+    assert py.num_free_blocks == cc.num_free_blocks == 16

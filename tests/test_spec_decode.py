@@ -1,0 +1,99 @@
+"""Speculative decoding: n-gram proposals, greedy acceptance, and end-to-end
+equivalence with the plain decode loop."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.kv_cache import CacheConfig
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.scheduler import SchedulerConfig
+from tpuserve.runtime.spec import SpecConfig, accept_greedy, ngram_propose
+
+
+def test_ngram_propose_basic():
+    ids = [1, 2, 3, 9, 9, 1, 2, 3]
+    # trailing 3-gram (1,2,3) occurred at 0; continuation is [9, 9, 1]
+    assert ngram_propose(ids, 3) == [9, 9, 1]
+    # nothing repeats
+    assert ngram_propose([1, 2, 3, 4], 3) == []
+    # short history falls back to shorter n-grams
+    assert ngram_propose([5, 5], 2) == [5]
+
+
+def test_accept_greedy():
+    assert accept_greedy([7, 8, 9], [7, 8, 9, 4]) == [7, 8, 9, 4]
+    assert accept_greedy([7, 8, 9], [7, 5, 0, 0]) == [7, 5]
+    assert accept_greedy([7], [3, 0]) == [3]
+    assert accept_greedy([], [6]) == [6]
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return dataclasses.replace(get_model_config("tiny-qwen3"),
+                               dtype="float32")
+
+
+def _engine(cfg, spec):
+    return Engine(
+        EngineConfig(model="tiny-qwen3",
+                     cache=CacheConfig(block_size=4, num_blocks=256,
+                                       max_blocks_per_seq=32),
+                     scheduler=SchedulerConfig(max_num_seqs=4),
+                     enable_prefix_caching=False,
+                     pipeline_decode=False,
+                     speculative=spec),
+        model_cfg=cfg)
+
+
+def test_spec_equals_plain_greedy(cfg):
+    # repetitive prompts so the n-gram proposer actually fires
+    prompts = [[1, 2, 3, 4] * 5, [7, 8, 7, 8, 7, 8, 9], [5, 6, 5, 6, 5, 6]]
+    p = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    plain = _engine(cfg, None).generate(prompts, p)
+    eng = _engine(cfg, SpecConfig(num_draft_tokens=4))
+    specd = eng.generate(prompts, p)
+    for a, b in zip(plain, specd):
+        assert a.output_token_ids == b.output_token_ids
+    assert eng.stats.spec_steps > 0
+    assert eng.block_manager.num_seqs() == 0
+
+
+def test_spec_random_prompts_still_correct(cfg):
+    # random prompts: proposer rarely fires; fallback path must be exact
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 200, size=9).tolist() for _ in range(3)]
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    plain = _engine(cfg, None).generate(prompts, p)
+    specd = _engine(cfg, SpecConfig(num_draft_tokens=3)).generate(prompts, p)
+    for a, b in zip(plain, specd):
+        assert a.output_token_ids == b.output_token_ids
+
+
+def test_spec_sampled_batch_uses_plain_path(cfg):
+    eng = _engine(cfg, SpecConfig(num_draft_tokens=4))
+    p = SamplingParams(max_tokens=6, temperature=0.8, seed=3,
+                      ignore_eos=True)
+    outs = eng.generate([[1, 2, 1, 2, 1, 2]], p)
+    assert len(outs[0].output_token_ids) == 6
+    assert eng.stats.spec_steps == 0          # sampled -> no speculation
+
+
+def test_spec_eos_and_max_tokens(cfg):
+    eng = _engine(cfg, SpecConfig(num_draft_tokens=4))
+    p = SamplingParams(max_tokens=5, temperature=0.0)   # eos allowed
+    outs = eng.generate([[2, 3, 2, 3, 2, 3]], p)
+    r = outs[0]
+    assert len(r.output_token_ids) <= 5
+    assert r.finish_reason is not None
+    assert eng.block_manager.num_seqs() == 0
+
+
+def test_spec_acceptance_stats(cfg):
+    eng = _engine(cfg, SpecConfig(num_draft_tokens=4))
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    eng.generate([[1, 1, 1, 1, 1, 1, 1, 1]], p)
+    assert eng.stats.spec_proposed >= eng.stats.spec_accepted >= 0

@@ -178,6 +178,24 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     ops/attention.py (no Pallas variant yet, unlike prefill/decode_step) —
     XLA fuses the per-segment einsums acceptably and memory stays bounded.
     """
+    h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
+                                slot_ids, block_tables, kv_cache)
+    last_idx = jnp.maximum(chunk_lens - 1, 0)
+    h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+    return _unembed(params, cfg, h_last), new_cache
+
+
+# --------------------------------------------------------------------------
+# Speculative verify: score a draft window, return per-row greedy argmax
+# --------------------------------------------------------------------------
+
+def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
+                 slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
+                 kv_cache: list):
+    """Shared layer loop for cache-relative windows: writes the window's KV
+    and attends against cached context + causal-within-window.  Used by both
+    prefill_chunk (last-row logits) and decode_verify (all-row argmax)."""
     B, C = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(C)[None, :]
     h = _embed(params, cfg, tokens, positions)
@@ -195,9 +213,29 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
         h = h + _mlp(hn, lp, cfg)
-    last_idx = jnp.maximum(chunk_lens - 1, 0)
-    h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
-    return _unembed(params, cfg, h_last), new_cache
+    return h, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_cache",))
+def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
+                  slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
+                  kv_cache: list):
+    """Verify a speculative draft window in one pass.
+
+    Same trunk as :func:`prefill_chunk` but returns the greedy argmax at
+    EVERY row — ``pred[:, j]`` is the model's next token after consuming
+    row j, which is all greedy draft acceptance needs (returning (B, K, V)
+    logits would move hundreds of MB for nothing).
+
+    tokens: (B, K) = [last_sampled, draft_0, ..]; ctx_lens: (B,) tokens in
+    cache before the window; chunk_lens: (B,) valid rows; slot_ids: (B, K);
+    block_tables: (B, max_blocks).  Returns (pred (B, K) int32, kv_cache).
+    """
+    h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
+                                slot_ids, block_tables, kv_cache)
+    logits = _unembed(params, cfg, h)                       # (B, K, V)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
 
 
 # --------------------------------------------------------------------------

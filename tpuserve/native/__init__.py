@@ -150,6 +150,12 @@ class NativeBlockManager:
     def append_slot(self, seq_id: str) -> int:
         return self._core.append_slot(seq_id)
 
+    def reserve(self, seq_id: str, total_tokens: int) -> None:
+        self._core.reserve(seq_id, total_tokens)
+
+    def advance(self, seq_id: str, n: int) -> None:
+        self._core.advance(seq_id, n)
+
     def slot_for_token(self, seq_id: str, token_idx: int) -> int:
         return self._core.slot_for_token(seq_id, token_idx)
 

@@ -4,10 +4,11 @@ from tpuserve.runtime.block_manager import BlockManager
 from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
 from tpuserve.runtime.scheduler import Scheduler, SchedulerConfig, ScheduledBatch
 from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.spec import SpecConfig
 
 __all__ = [
     "FinishReason", "Request", "RequestOutput", "RequestState", "SamplingParams",
     "BlockManager", "CacheConfig", "create_kv_cache",
     "Scheduler", "SchedulerConfig", "ScheduledBatch",
-    "Engine", "EngineConfig",
+    "Engine", "EngineConfig", "SpecConfig",
 ]

@@ -179,6 +179,26 @@ class BlockManager:
         alloc.num_tokens += 1
         return block * self.block_size + offset
 
+    def reserve(self, seq_id: str, total_tokens: int) -> None:
+        """Grow the block table to hold ``total_tokens`` slots WITHOUT
+        advancing the written-token counter (speculative decoding writes a
+        draft window first and only commits the accepted length)."""
+        alloc = self._seqs[seq_id]
+        need = self.blocks_needed(total_tokens) - len(alloc.blocks)
+        if need > self.num_free_blocks:
+            raise MemoryError("out of KV blocks on reserve")
+        for _ in range(need):
+            b = self._pop_free_block()
+            self._refcount[b] = 1
+            alloc.blocks.append(b)
+
+    def advance(self, seq_id: str, n: int) -> None:
+        """Commit ``n`` written tokens (slots must already be reserved)."""
+        alloc = self._seqs[seq_id]
+        if alloc.num_tokens + n > len(alloc.blocks) * self.block_size:
+            raise ValueError("advance beyond reserved capacity")
+        alloc.num_tokens += n
+
     def slot_for_token(self, seq_id: str, token_idx: int) -> int:
         alloc = self._seqs[seq_id]
         if token_idx < 0:

@@ -60,6 +60,10 @@ class EngineConfig:
     # (synchronous backend — nothing overlaps, the extra dispatches only
     # cost; measured 2.6x slower on the CPU smoke bench).
     pipeline_decode: Optional[bool] = None
+    # Speculative decoding (n-gram prompt-lookup drafts + one verify pass,
+    # runtime/spec.py).  None disables.  Greedy batches only; sampled /
+    # penalty / logprob batches run the normal decode path.
+    speculative: Optional["SpecConfig"] = None
 
     def resolve_pipeline_decode(self) -> bool:
         if self.pipeline_decode is not None:
@@ -80,6 +84,9 @@ class EngineStats:
     generated_tokens: int = 0
     preemptions: int = 0
     requests_finished: int = 0
+    spec_steps: int = 0
+    spec_proposed: int = 0           # draft tokens offered to the verifier
+    spec_accepted: int = 0           # draft tokens accepted
     ttft_sum: float = 0.0
     ttft_count: int = 0
     # recent per-token latencies (decode step wall time / batch)
@@ -144,6 +151,11 @@ class Engine:
         self._greedy_cache: dict[int, tuple] = {}
         self._pending: Optional[PendingDecode] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
+        # Speculation needs a single process: followers can't mirror the
+        # data-dependent verify shapes (parallel/multihost broadcasts only
+        # the two fixed step kinds).
+        self._spec = (config.speculative
+                      if jax.process_count() == 1 else None)
         self._req_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._eos_ids = set(self.tokenizer.eos_token_ids)
@@ -223,6 +235,11 @@ class Engine:
             outputs = self._run_prefill(batch)
         elif batch.kind == "prefill_chunk":
             outputs = self._run_prefill_chunk(batch)
+        elif (self._spec is not None
+              and all(r.params.greedy and not r.params.needs_penalties
+                      and r.params.logprobs is None
+                      for r in batch.requests)):
+            outputs = self._run_decode_spec(batch)
         else:
             outputs = self._run_decode(batch)
         self.stats.last_step_time = time.monotonic() - t0
@@ -422,6 +439,78 @@ class Engine:
         new_tokens = self._sample(logits, reqs, B)
         return outputs + self._append_and_emit(reqs, new_tokens)
 
+    def _run_decode_spec(self, batch: ScheduledBatch) -> list[RequestOutput]:
+        """Speculative decode step: n-gram drafts verified in one pass
+        (runtime/spec.py).  Emits 1..k+1 tokens per sequence per weight
+        pass; falls back to the normal decode path when nothing can be
+        proposed or the draft window doesn't fit."""
+        from tpuserve.runtime import spec as spec_mod
+        outputs: list[RequestOutput] = []
+        if self._pending is not None:           # spec steps are synchronous
+            outputs += self._flush_pending()
+        reqs = [r for r in batch.requests if not r.finished]
+        if not reqs:
+            return outputs
+        k = self._spec.num_draft_tokens
+        K = k + 1
+        drafts = [spec_mod.ngram_propose(
+            r.prompt_token_ids + r.output_token_ids, k,
+            self._spec.max_ngram, self._spec.min_ngram,
+            self._spec.max_lookback) for r in reqs]
+        cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
+        # The verify pass costs every row ~(k+1)x a decode step; it only
+        # pays when enough of the batch actually has drafts to accept.
+        coverage = sum(1 for d in drafts if d) / len(drafts)
+        if (coverage < self._spec.min_batch_coverage
+                or any(r.num_tokens - 1 + K > cap for r in reqs)):
+            return outputs + self._run_decode(batch)
+        base = []
+        try:
+            for r in reqs:
+                nt = r.num_tokens - 1            # input-token position
+                self.block_manager.reserve(r.request_id, nt + K)
+                base.append(nt)
+        except MemoryError:
+            # over-reserved blocks stay attached; they're used as the
+            # sequence grows or freed with it
+            return outputs + self._run_decode(batch)
+        B = self.scheduler.decode_bucket(len(reqs))
+        tokens = np.zeros((B, K), np.int32)
+        slot_ids = np.full((B, K), PAD_SLOT, np.int32)
+        ctx_lens = np.zeros((B,), np.int32)
+        chunk_lens = np.ones((B,), np.int32)
+        block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq),
+                                np.int32)
+        for i, r in enumerate(reqs):
+            d = drafts[i]
+            tokens[i, 0] = r.output_token_ids[-1]
+            tokens[i, 1:1 + len(d)] = d
+            ctx_lens[i] = base[i]
+            chunk_lens[i] = 1 + len(d)
+            for j in range(K):
+                slot_ids[i, j] = self.block_manager.slot_for_token(
+                    r.request_id, base[i] + j)
+            bt = self.block_manager.block_table(r.request_id)
+            block_tables[i, :len(bt)] = bt
+        pred, self.kv_cache = transformer.decode_verify(
+            self.params, self.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
+            jnp.asarray(slot_ids), jnp.asarray(block_tables), self.kv_cache)
+        pred_h = np.asarray(jax.device_get(pred))
+        self.stats.num_decode_steps += 1
+        self.stats.spec_steps += 1
+        for i, r in enumerate(reqs):
+            emitted = spec_mod.accept_greedy(drafts[i], pred_h[i])
+            self.stats.spec_proposed += len(drafts[i])
+            self.stats.spec_accepted += len(emitted) - 1
+            self.block_manager.advance(r.request_id, len(emitted))
+            for tok in emitted:
+                out = self._emit_one(r, tok)
+                outputs.append(out)
+                if out.finished:
+                    break
+        return outputs
+
     def _flush_pending(self) -> list[RequestOutput]:
         """Read the in-flight decode step's tokens and run the host-side
         bookkeeping (append, detokenize, stop checks, emission)."""
@@ -534,35 +623,36 @@ class Engine:
 
     def _append_and_emit(self, reqs: list[Request], new_tokens: np.ndarray,
                          from_prefill: bool = False) -> list[RequestOutput]:
-        outputs = []
-        for req, tok in zip(reqs, new_tokens):
-            tok = int(tok)
-            req.output_token_ids.append(tok)
-            self.stats.generated_tokens += 1
-            delta = self._detok[req.request_id].add(tok)
-            reason = None
-            if req.params.stop:
-                delta, stopped = self._match_stop(req, delta)   # mutates output_text on stop
-                if stopped:
-                    reason = FinishReason.STOP
-            else:
-                req.output_text += delta
-            if reason is None:
-                reason = check_stop(req, self._eos_ids, self.max_seq_len)
-            finished = reason is not None
-            if finished:
-                req.finish_reason = reason
-                req.finish_time = time.monotonic()
-                self.scheduler.finish(req)
-                self.stats.requests_finished += 1
-                self._detok.pop(req.request_id, None)
-            outputs.append(RequestOutput(
-                request_id=req.request_id, new_token_ids=[tok], new_text=delta,
-                finished=finished, finish_reason=reason,
-                num_prompt_tokens=req.num_prompt_tokens,
-                num_output_tokens=len(req.output_token_ids),
-                from_prefill=from_prefill))
-        return outputs
+        return [self._emit_one(req, int(tok), from_prefill)
+                for req, tok in zip(reqs, new_tokens)]
+
+    def _emit_one(self, req: Request, tok: int,
+                  from_prefill: bool = False) -> RequestOutput:
+        req.output_token_ids.append(tok)
+        self.stats.generated_tokens += 1
+        delta = self._detok[req.request_id].add(tok)
+        reason = None
+        if req.params.stop:
+            delta, stopped = self._match_stop(req, delta)   # mutates output_text on stop
+            if stopped:
+                reason = FinishReason.STOP
+        else:
+            req.output_text += delta
+        if reason is None:
+            reason = check_stop(req, self._eos_ids, self.max_seq_len)
+        finished = reason is not None
+        if finished:
+            req.finish_reason = reason
+            req.finish_time = time.monotonic()
+            self.scheduler.finish(req)
+            self.stats.requests_finished += 1
+            self._detok.pop(req.request_id, None)
+        return RequestOutput(
+            request_id=req.request_id, new_token_ids=[tok], new_text=delta,
+            finished=finished, finish_reason=reason,
+            num_prompt_tokens=req.num_prompt_tokens,
+            num_output_tokens=len(req.output_token_ids),
+            from_prefill=from_prefill)
 
     def _match_stop(self, req: Request, delta: str) -> tuple[str, bool]:
         """Bounded stop-string search over the tail.  Appends ``delta`` to

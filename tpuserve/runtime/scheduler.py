@@ -82,6 +82,21 @@ class Scheduler:
     def prefill_bucket(self, n: int) -> int:
         return max(next_power_of_2(n), self.cfg.min_prefill_bucket)
 
+    def _chunk_bucket(self, remaining: int) -> int:
+        """Padded length for a chunked prefill step: a short tail compiles a
+        small power-of-two bucket instead of the full chunk shape."""
+        return min(self.cfg.prefill_chunk_size, self.prefill_bucket(remaining))
+
+    def _pop_head_for_chunking(self, head: Request,
+                               cached: int = 0) -> Optional[ScheduledBatch]:
+        need = self.block_manager.blocks_needed(head.num_tokens) + 1
+        if need > self.block_manager.num_free_blocks:
+            return None          # wait for blocks to free up
+        self.waiting.popleft()
+        return ScheduledBatch(kind="prefill_chunk", requests=[head],
+                              padded_len=self._chunk_bucket(
+                                  head.num_tokens - cached))
+
     def decode_bucket(self, n: int) -> int:
         return min(max(next_power_of_2(n), self.cfg.min_decode_bucket),
                    next_power_of_2(self.cfg.max_num_seqs))
@@ -101,32 +116,36 @@ class Scheduler:
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
         if not self.waiting or len(self.running) >= self.cfg.max_num_seqs:
             return None
-        # A long prompt runs chunk-by-chunk, alone, at the fixed chunk shape.
-        # A partially-prefilled request ANYWHERE in the queue continues
-        # first: it already holds KV blocks, and it can end up behind other
-        # waiting requests when a decode-OOM preemption appendlefts its
-        # victim — if it could not be scheduled from there, its blocks would
-        # never drain and the engine would livelock.
+        # A long prompt runs chunk-by-chunk, alone.  A partially-prefilled
+        # request ANYWHERE in the queue continues first: it already holds KV
+        # blocks, and it can end up behind other waiting requests when a
+        # decode-OOM preemption appendlefts its victim — if it could not be
+        # scheduled from there, its blocks would never drain and the engine
+        # would livelock.
         for req in self.waiting:
             if req.num_prefilled > 0:
                 self.waiting.remove(req)
                 return ScheduledBatch(kind="prefill_chunk", requests=[req],
-                                      padded_len=self.cfg.prefill_chunk_size)
+                                      padded_len=self._chunk_bucket(
+                                          req.num_tokens - req.num_prefilled))
         head = self.waiting[0]
-        # Long prompts chunk by necessity; prompts with a prefix-cache hit
-        # chunk by choice — the chunked path can START at the cached offset
-        # and skip recomputing the cached tokens entirely (the batched path
-        # has one shared padded shape and cannot skip per-request).
-        _, head_cached = self.block_manager.lookup_prefix(
-            head.prompt_token_ids + head.output_token_ids, count_stats=False)
-        if (head.num_tokens > self.cfg.prefill_chunk_size
-                or head_cached > 0):
-            need = self.block_manager.blocks_needed(head.num_tokens) + 1
-            if need > self.block_manager.num_free_blocks:
-                return None      # wait for blocks to free up
-            self.waiting.popleft()
-            return ScheduledBatch(kind="prefill_chunk", requests=[head],
-                                  padded_len=self.cfg.prefill_chunk_size)
+        # Long prompts chunk by necessity (checked first — no cache probe,
+        # which would re-hash an unbounded prompt every scheduling cycle
+        # while it waits for blocks).
+        if head.num_tokens > self.cfg.prefill_chunk_size:
+            return self._pop_head_for_chunking(head)
+        # Prompts with a SUBSTANTIAL prefix-cache hit chunk by choice — the
+        # chunked path starts at the cached offset and skips the recompute.
+        # A small hit stays on the batched path: recomputing a few cached
+        # tokens is far cheaper than giving up prefill batching.
+        cached = 0
+        if self.block_manager.enable_prefix_caching:
+            _, cached = self.block_manager.lookup_prefix(
+                head.prompt_token_ids + head.output_token_ids,
+                count_stats=False)
+        if cached >= max(2 * self.block_manager.block_size,
+                         head.num_tokens // 4):
+            return self._pop_head_for_chunking(head, cached)
         picked: list[Request] = []
         bucket = 0
         reserved = 0   # blocks spoken for by requests already picked this batch

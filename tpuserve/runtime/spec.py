@@ -1,0 +1,70 @@
+"""Speculative decoding: n-gram prompt-lookup drafts + batched verify.
+
+Draft proposal is model-free "prompt lookup": the trailing n-gram of
+(prompt + generated) is matched against the sequence's own history and the
+continuation of its most recent earlier occurrence is proposed.  The target
+model then scores the whole draft window in ONE ``decode_verify`` pass and
+accepts the longest matching prefix plus one bonus token — so a step emits
+1..k+1 tokens for one weight pass.  Greedy-only (rejection sampling for
+temperature batches falls back to normal decode in the engine).
+
+This covers the speculative-decoding capability of the vLLM container the
+reference deploys (reference: kubernetes-single-node.yaml:14) without
+needing a separate draft model — none is available in an air-gapped pod,
+and prompt lookup shines on the summarization/extraction workloads where
+speculation pays at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    num_draft_tokens: int = 4        # k: draft window is k+1 rows
+    max_ngram: int = 3               # longest trailing n-gram to match
+    min_ngram: int = 1
+    # only the most recent window is scanned for matches: the proposer runs
+    # on the synchronous host hot path every step, so its cost must not
+    # grow with context length
+    max_lookback: int = 1024
+    # run the (k+1)-row verify pass only when at least this fraction of the
+    # batch has a proposal — draft-less rows pay the full window cost to
+    # emit one token
+    min_batch_coverage: float = 0.5
+
+
+def ngram_propose(ids: list[int], k: int, max_ngram: int = 3,
+                  min_ngram: int = 1, max_lookback: int = 1024) -> list[int]:
+    """Propose up to ``k`` draft tokens from the sequence's own history.
+
+    Finds the most recent occurrence of the trailing n-gram within the last
+    ``max_lookback`` tokens (longest n first) and returns the tokens that
+    followed it.
+    """
+    if len(ids) > max_lookback:
+        ids = ids[-max_lookback:]
+    L = len(ids)
+    for n in range(max_ngram, min_ngram - 1, -1):
+        if L < n + 1:
+            continue
+        tail = ids[L - n:]
+        # most recent occurrence strictly before the trailing one, with at
+        # least one continuation token available
+        for j in range(L - n - 1, -1, -1):
+            if ids[j:j + n] == tail:
+                cont = ids[j + n:j + n + k]
+                if cont:
+                    return cont
+    return []
+
+
+def accept_greedy(draft: list[int], pred) -> list[int]:
+    """Longest draft prefix matching the model's greedy predictions, plus
+    the bonus token.  ``pred[j]`` is the model's next token after row j
+    (row 0 = the last accepted token, rows 1.. = draft tokens)."""
+    a = 0
+    while a < len(draft) and int(pred[a]) == draft[a]:
+        a += 1
+    return draft[:a] + [int(pred[a])]

@@ -437,6 +437,9 @@ def main(argv=None):
     ap.add_argument("--chat-template", default=None,
                     help="path to a Jinja chat template overriding the "
                          "tokenizer's (ConfigMap-mounted in K8s)")
+    ap.add_argument("--speculative-k", type=int, default=0,
+                    help="n-gram speculative decoding with k draft tokens "
+                         "(0 disables; greedy requests only)")
     ap.add_argument("--multihost", action="store_true",
                     help="join a multi-host TPU slice via jax.distributed "
                          "(GKE injects TPU_WORKER_* env); process 0 serves, "
@@ -448,13 +451,17 @@ def main(argv=None):
     if args.multihost:
         from tpuserve.parallel.mesh import multihost_initialize
         multihost_initialize()
+    spec = None
+    if args.speculative_k > 0:
+        from tpuserve.runtime.spec import SpecConfig
+        spec = SpecConfig(num_draft_tokens=args.speculative_k)
     ecfg = EngineConfig(
         model=args.model, checkpoint_dir=args.checkpoint_dir,
         cache=CacheConfig(block_size=args.block_size,
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq),
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
-        attn_impl=args.attn_impl)
+        attn_impl=args.attn_impl, speculative=spec)
     mesh = None
     if args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh
