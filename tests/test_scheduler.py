@@ -77,6 +77,35 @@ def test_preempt_last_moves_to_waiting_front():
     assert s.num_running == 1
 
 
+def test_decode_interleaved_between_prefill_chunks():
+    """A long prompt's multi-step chunked admission must not starve running
+    decodes: after each chunk step, one decode step runs first (bounded ITL
+    — ADVICE r1: vLLM mixes decode into chunk batches for the same reason)."""
+    s, bm = mk_sched(prefill_chunk_size=8)
+    runner = mk_req("running", 4)
+    bm.allocate("running", runner.prompt_token_ids)
+    s.mark_running([runner])
+    s.add(mk_req("long", 40))                      # 5 chunks of 8
+    kinds = []
+    for _ in range(6):
+        batch = s.schedule()
+        kinds.append(batch.kind)
+        if batch.kind == "prefill_chunk":
+            req = batch.requests[0]
+            if req.num_prefilled == 0:
+                bm.allocate(req.request_id, req.prompt_token_ids)
+            req.num_prefilled += batch.padded_len
+            if req.num_prefilled < req.num_tokens:
+                s.waiting.appendleft(req)          # engine re-queues mid-chunk
+            else:
+                s.mark_running([req])
+    assert kinds[0] == "prefill_chunk"
+    # every chunk is followed by a decode step, never two chunks in a row
+    for a, b in zip(kinds, kinds[1:]):
+        assert not (a == "prefill_chunk" and b == "prefill_chunk")
+    assert "decode" in kinds
+
+
 def test_finish_frees_blocks():
     s, bm = mk_sched()
     r = mk_req("a", 8)

@@ -190,3 +190,40 @@ def test_tracer_noop_without_endpoint(monkeypatch):
     assert not t.active
     with t.request_span("x", foo=1) as span:
         span.set_attribute("a", "b")     # no-op, must not raise
+
+
+def test_tracer_records_exceptions():
+    """A failing request must close its span with the real exc_info so OTLP
+    exports error status (ADVICE r1: __exit__(None, None, None) in a finally
+    block exported failed requests as successful spans)."""
+    from tpuserve.server.tracing import RequestTracer
+
+    seen = {}
+
+    class _CM:
+        def __enter__(self):
+            return _Span()
+
+        def __exit__(self, exc_type, exc, tb):
+            seen["exc_info"] = (exc_type, exc, tb)
+            return False
+
+    class _Span:
+        def set_attribute(self, *a):
+            pass
+
+    class _FakeTracer:
+        def start_as_current_span(self, name):
+            return _CM()
+
+    t = RequestTracer.__new__(RequestTracer)
+    t._tracer = _FakeTracer()
+    with pytest.raises(RuntimeError, match="boom"):
+        with t.request_span("req"):
+            raise RuntimeError("boom")
+    assert seen["exc_info"][0] is RuntimeError
+    assert str(seen["exc_info"][1]) == "boom"
+    # and the non-raising path still closes cleanly
+    with t.request_span("ok"):
+        pass
+    assert seen["exc_info"] == (None, None, None)

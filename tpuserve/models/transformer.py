@@ -116,15 +116,20 @@ def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
 # Prefill: process full (padded) prompts, write KV cache, return last logits
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
 def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             prompt_lens: jnp.ndarray, slot_ids: jnp.ndarray,
-            kv_cache: list, *, attn_impl: str = "reference"):
+            kv_cache: list, *, attn_impl: str = "reference", mesh=None):
     """Run full prompts through the model.
 
     tokens: (B, T) right-padded prompts; prompt_lens: (B,); slot_ids: (B, T)
     flat cache slots per token (PAD_SLOT for padding); kv_cache: per-layer
     list of {"k","v"} paged caches.  Returns (last_logits (B, V), kv_cache).
+
+    ``mesh``: static; when set with attn_impl="pallas", the Pallas kernels
+    run head-parallel over the tp axis via shard_map (ops/pallas_tp.py) —
+    GSPMD cannot partition a pallas_call on its own.
     """
     B, T = tokens.shape
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
@@ -137,7 +142,10 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
         cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
         new_cache.append({"k": ck, "v": cv})
-        if attn_impl == "pallas":
+        if attn_impl == "pallas" and mesh is not None:
+            from tpuserve.ops.pallas_tp import flash_prefill_attention_tp
+            out = flash_prefill_attention_tp(q, k, v, prompt_lens, scale, mesh)
+        elif attn_impl == "pallas":
             from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
             out = flash_prefill_attention(q, k, v, prompt_lens, scale)
         else:
@@ -242,16 +250,19 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # Decode: one token per sequence against the paged cache
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl"), donate_argnames=("kv_cache",))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
 def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                 positions: jnp.ndarray, slot_ids: jnp.ndarray,
                 block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                kv_cache: list, *, attn_impl: str = "reference"):
+                kv_cache: list, *, attn_impl: str = "reference", mesh=None):
     """One decode step for a batch of sequences.
 
     tokens/positions/slot_ids/seq_lens: (B,); block_tables: (B, max_blocks).
     seq_lens includes the token being decoded (its K/V is written first).
     Returns (logits (B, V), kv_cache).
+
+    ``mesh``: static; see :func:`prefill` — head-parallel Pallas under tp.
     """
     B = tokens.shape[0]
     h = _embed(params, cfg, tokens, positions)                 # (B, H)
@@ -263,7 +274,11 @@ def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
         cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
         new_cache.append({"k": ck, "v": cv})
-        if attn_impl == "pallas":
+        if attn_impl == "pallas" and mesh is not None:
+            from tpuserve.ops.pallas_tp import paged_decode_attention_tp
+            out = paged_decode_attention_tp(q, ck, cv, block_tables, seq_lens,
+                                            scale, mesh)
+        elif attn_impl == "pallas":
             from tpuserve.ops.pallas_paged_attention import paged_decode_attention as impl
             out = impl(q, ck, cv, block_tables, seq_lens, scale)
         else:

@@ -1,0 +1,17 @@
+"""Version shims shared by the shard_map users (ring attention, Pallas TP).
+
+One home for the jax-version detection so the replication-check kwarg
+mapping can't drift between call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    shard_map = jax.shard_map
+    CHECK_KWARG = {"check_vma": False}
+except AttributeError:  # pragma: no cover - older jax
+    # the experimental API spells the replication-check kwarg differently
+    from jax.experimental.shard_map import shard_map
+    CHECK_KWARG = {"check_rep": False}

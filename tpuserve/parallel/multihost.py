@@ -4,17 +4,38 @@ On a multi-host slice (e.g. v5e-16 = 4 hosts x 4 chips), every process must
 enter the same jitted computation with the same shapes or the SPMD program
 deadlocks.  Only the coordinator (process 0) runs the HTTP server and the
 scheduler; it broadcasts a step descriptor (op + batch arrays) to follower
-processes, then all processes execute the same ``transformer.prefill`` /
-``decode_step`` over the global mesh, with GSPMD routing collectives over
-ICI/DCN.  This replaces the NCCL/MPI rendezvous inside the vLLM container
-the reference delegates multi-GPU serving to (reference: SURVEY.md §2.2
-"Distributed comm backend"; BASELINE config "Qwen2-72B TP=8 multi-host
-v5e-16").
+processes, then all processes execute the same device computation over the
+global mesh, with GSPMD routing collectives over ICI/DCN.  This replaces the
+NCCL/MPI rendezvous inside the vLLM container the reference delegates
+multi-GPU serving to (reference: SURVEY.md §2.2 "Distributed comm backend";
+BASELINE config "Qwen2-72B TP=8 multi-host v5e-16").
 
 Protocol (all broadcasts via ``multihost_utils.broadcast_one_to_all``,
 fixed-shape so every host agrees):
-  1. header (4,) int32: [op, B, L, pad]  (op: 0=prefill, 1=decode, 2=stop)
-  2. op-specific arrays padded to (B,) / (B, L) from the header.
+  1. header (4,) int32: [op, B, aux, extra]
+     op: 0=prefill, 1=decode, 2=stop, 3=prefill_chunk, 4=sample
+     aux:   padded length L (prefill) | max_blocks M (decode)
+            | chunk length C (prefill_chunk) | unused (sample)
+     extra: max_blocks M (prefill_chunk) | sampler mode index (sample)
+            | unused otherwise.
+  2. op-specific arrays with shapes derived from the header.
+
+The protocol covers EVERY device computation the engine can run in
+multi-host mode: prefill, decode, chunked prefill, warmup (which reuses the
+same three), and sampling.  Sampling is part of the protocol because
+``sample_tokens`` is its own jit over the mesh-global logits — process 0
+cannot launch it alone; followers keep the logits from their last exec op
+and mirror the sampler call.  The sampler is compiled with a fully-replicated
+output sharding so the (B,) token vector is addressable on every process and
+the coordinator can ``device_get`` it without another collective.
+
+Limitations (enforced by the engine, documented here):
+  - sampling penalties and logprobs: rejected at ``add_request`` — they are
+    additional jits over global logits the protocol doesn't mirror;
+  - speculative decoding: disabled (data-dependent verify shapes can't be
+    mirrored with fixed-shape broadcasts);
+  - pipelined decode: disabled (the per-step host sync it avoids is exactly
+    what lockstep broadcasting requires).
 
 Single-process (jax.process_count() == 1) everything degenerates to direct
 execution — that is the CI-testable path; real multi-host needs a slice.
@@ -30,7 +51,9 @@ import numpy as np
 
 logger = logging.getLogger("tpuserve.multihost")
 
-OP_PREFILL, OP_DECODE, OP_STOP = 0, 1, 2
+OP_PREFILL, OP_DECODE, OP_STOP, OP_PREFILL_CHUNK, OP_SAMPLE = 0, 1, 2, 3, 4
+
+SAMPLE_MODES = ("greedy", "temperature", "full")
 
 
 def _broadcast(x):
@@ -42,6 +65,25 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+_replicated_samplers: dict = {}
+
+
+def _replicated_sample(mesh, logits, keys, temperature, top_k, top_p, mode):
+    """sample_tokens compiled with a fully-replicated output so every
+    process holds the complete (B,) token vector (device_get-safe)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpuserve.ops import sampling as sampling_ops
+    key = (mesh, mode)
+    fn = _replicated_samplers.get(key)
+    if fn is None:
+        fn = jax.jit(
+            lambda l, k, t, tk, tp: sampling_ops.sample_tokens(
+                l, k, t, tk, tp, mode=mode),
+            out_shardings=NamedSharding(mesh, P()))
+        _replicated_samplers[key] = fn
+    return fn(logits, keys, temperature, top_k, top_p)
+
+
 class MultihostCoordinator:
     """Wraps an Engine's execution hooks so every step is mirrored to the
     follower processes before running.  No-op when single-process."""
@@ -50,8 +92,12 @@ class MultihostCoordinator:
         self.engine = engine
         self._active = jax.process_count() > 1
         if self._active:
+            if engine.mesh is None:
+                raise ValueError("multi-host serving requires a device mesh")
             engine._exec_prefill = self._prefill
             engine._exec_decode = self._decode
+            engine._exec_prefill_chunk = self._prefill_chunk
+            engine._exec_sample = self._sample
         # else: leave the direct hooks in place
 
     def _prefill(self, tokens, prompt_lens, slot_ids):
@@ -65,7 +111,7 @@ class MultihostCoordinator:
         return transformer.prefill(
             eng.params, eng.model_cfg, jnp.asarray(tokens),
             jnp.asarray(prompt_lens), jnp.asarray(slot_ids), eng.kv_cache,
-            attn_impl=eng.attn_impl)
+            attn_impl=eng.attn_impl, mesh=eng._attn_mesh)
 
     def _decode(self, tokens, positions, slot_ids, block_tables, seq_lens):
         from tpuserve.models import transformer
@@ -82,7 +128,39 @@ class MultihostCoordinator:
             eng.params, eng.model_cfg, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(slot_ids),
             jnp.asarray(block_tables), jnp.asarray(seq_lens), eng.kv_cache,
-            attn_impl=eng.attn_impl)
+            attn_impl=eng.attn_impl, mesh=eng._attn_mesh)
+
+    def _prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
+                       block_tables):
+        from tpuserve.models import transformer
+        eng = self.engine
+        B, C = tokens.shape
+        M = block_tables.shape[1]
+        # chunk steps need two extents: aux carries the chunk length C and
+        # the (otherwise unused) mode slot carries max_blocks M
+        _broadcast(np.asarray([OP_PREFILL_CHUNK, B, C, M], np.int32))
+        tokens = _broadcast(np.asarray(tokens))
+        ctx_lens = _broadcast(np.asarray(ctx_lens))
+        chunk_lens = _broadcast(np.asarray(chunk_lens))
+        slot_ids = _broadcast(np.asarray(slot_ids))
+        block_tables = _broadcast(np.asarray(block_tables))
+        return transformer.prefill_chunk(
+            eng.params, eng.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
+            jnp.asarray(slot_ids), jnp.asarray(block_tables), eng.kv_cache)
+
+    def _sample(self, logits, keys, temperature, top_k, top_p, *, mode):
+        eng = self.engine
+        B = logits.shape[0]
+        _broadcast(np.asarray(
+            [OP_SAMPLE, B, 0, SAMPLE_MODES.index(mode)], np.int32))
+        keys = _broadcast(np.asarray(keys))
+        temperature = _broadcast(np.asarray(temperature, np.float32))
+        top_k = _broadcast(np.asarray(top_k, np.int32))
+        top_p = _broadcast(np.asarray(top_p, np.float32))
+        return _replicated_sample(
+            eng.mesh, logits, jnp.asarray(keys), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p), mode)
 
     def stop_followers(self) -> None:
         if self._active:
@@ -94,6 +172,8 @@ def follower_loop(engine) -> None:
 
     The engine must be constructed identically on every process (same
     config/checkpoint/seed) so params and cache match shard-for-shard.
+    Followers keep the logits of their last exec op: a subsequent OP_SAMPLE
+    mirrors the coordinator's sampler call on them.
     """
     from tpuserve.models import transformer
     if jax.process_count() == 1:
@@ -101,29 +181,54 @@ def follower_loop(engine) -> None:
         return
     logger.info("follower %d/%d entering lockstep loop",
                 jax.process_index(), jax.process_count())
+    logits = None
     while True:
         header = np.asarray(_broadcast(np.zeros((4,), np.int32)))
-        op, B, L, _ = (int(x) for x in header)
+        op, B, aux, mode_idx = (int(x) for x in header)
         if op == OP_STOP:
             logger.info("follower %d: stop", jax.process_index())
             return
         if op == OP_PREFILL:
-            tokens = _broadcast(np.zeros((B, L), np.int32))
+            tokens = _broadcast(np.zeros((B, aux), np.int32))
             lens = _broadcast(np.zeros((B,), np.int32))
-            slots = _broadcast(np.zeros((B, L), np.int32))
+            slots = _broadcast(np.zeros((B, aux), np.int32))
             logits, engine.kv_cache = transformer.prefill(
                 engine.params, engine.model_cfg, jnp.asarray(tokens),
                 jnp.asarray(lens), jnp.asarray(slots), engine.kv_cache,
-                attn_impl=engine.attn_impl)
-        else:
+                attn_impl=engine.attn_impl, mesh=engine._attn_mesh)
+        elif op == OP_DECODE:
             tokens = _broadcast(np.zeros((B,), np.int32))
             positions = _broadcast(np.zeros((B,), np.int32))
             slots = _broadcast(np.zeros((B,), np.int32))
-            bt = _broadcast(np.zeros((B, L), np.int32))
+            bt = _broadcast(np.zeros((B, aux), np.int32))
             seq_lens = _broadcast(np.zeros((B,), np.int32))
             logits, engine.kv_cache = transformer.decode_step(
                 engine.params, engine.model_cfg, jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(slots), jnp.asarray(bt),
                 jnp.asarray(seq_lens), engine.kv_cache,
-                attn_impl=engine.attn_impl)
-        del logits   # followers never read the result; coordinator samples
+                attn_impl=engine.attn_impl, mesh=engine._attn_mesh)
+        elif op == OP_PREFILL_CHUNK:
+            C, M = aux, mode_idx
+            tokens = _broadcast(np.zeros((B, C), np.int32))
+            ctx_lens = _broadcast(np.zeros((B,), np.int32))
+            chunk_lens = _broadcast(np.zeros((B,), np.int32))
+            slots = _broadcast(np.zeros((B, C), np.int32))
+            bt = _broadcast(np.zeros((B, M), np.int32))
+            logits, engine.kv_cache = transformer.prefill_chunk(
+                engine.params, engine.model_cfg, jnp.asarray(tokens),
+                jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
+                jnp.asarray(slots), jnp.asarray(bt), engine.kv_cache)
+        elif op == OP_SAMPLE:
+            keys = _broadcast(np.zeros((B, 2), np.uint32))
+            temperature = _broadcast(np.zeros((B,), np.float32))
+            top_k = _broadcast(np.zeros((B,), np.int32))
+            top_p = _broadcast(np.zeros((B,), np.float32))
+            # mirror the sampler on the held logits; followers never read
+            # the (replicated) result — the coordinator does
+            _replicated_sample(
+                engine.mesh, logits, jnp.asarray(keys),
+                jnp.asarray(temperature), jnp.asarray(top_k),
+                jnp.asarray(top_p), SAMPLE_MODES[mode_idx])
+        else:
+            raise RuntimeError(f"follower {jax.process_index()}: "
+                               f"unknown lockstep op {op}")

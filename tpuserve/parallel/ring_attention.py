@@ -35,10 +35,7 @@ from tpuserve.ops.attention import NEG_INF, repeat_kv
 
 AXIS_SP = "sp"
 
-try:  # jax >= 0.4.35 exposes shard_map at the top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from tpuserve.parallel.compat import CHECK_KWARG as _CHECK_KWARG, shard_map
 
 
 def make_sp_mesh(sp: int | None = None, devices=None) -> Mesh:
@@ -112,7 +109,7 @@ def ring_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     fn = shard_map(
         partial(_ring_shard, scale=scale, axis=axis, axis_size=n),
         mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
-        check_vma=False)
+        **_CHECK_KWARG)
     return fn(q, k, v, prompt_lens)
 
 
@@ -151,5 +148,5 @@ def ulysses_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     fn = shard_map(
         partial(_ulysses_shard, scale=scale, axis=axis, axis_size=n),
         mesh=mesh, in_specs=(spec, spec, spec, P(None)), out_specs=spec,
-        check_vma=False)
+        **_CHECK_KWARG)
     return fn(q, k, v, prompt_lens)

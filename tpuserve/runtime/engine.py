@@ -66,6 +66,13 @@ class EngineConfig:
     speculative: Optional["SpecConfig"] = None
 
     def resolve_pipeline_decode(self) -> bool:
+        # Multi-host lockstep serialises every device computation through the
+        # broadcast protocol; the pipelined path's _select_tokens jit over
+        # device-resident global tokens cannot run on the coordinator alone,
+        # and the per-step host sync it avoids is exactly what lockstep
+        # requires anyway.  See parallel/multihost.py "Limitations".
+        if jax.process_count() > 1:
+            return False
         if self.pipeline_decode is not None:
             return self.pipeline_decode
         return jax.default_backend() == "tpu"
@@ -126,20 +133,29 @@ class Engine:
         self.params = params
         if mesh is not None:
             # Tensor-parallel placement: GSPMD inserts the ICI collectives.
-            from tpuserve.parallel.mesh import AXIS_TP
             from tpuserve.parallel.sharding import cache_shardings, shard_params
             self.params = shard_params(self.params, self.model_cfg, mesh)
             self.kv_cache = create_kv_cache(
                 self.model_cfg, self.cache_cfg,
                 shardings=cache_shardings(self.model_cfg, mesh))
-            if mesh.shape.get(AXIS_TP, 1) > 1 and self.attn_impl == "pallas":
-                # The Pallas kernels don't carry SPMD partitioning rules yet;
-                # under TP the einsum reference path partitions cleanly.
-                logger.warning("attn_impl=pallas is not TP-partitionable yet; "
-                               "falling back to reference under tp>1")
-                self.attn_impl = "reference"
         else:
             self.kv_cache = create_kv_cache(self.model_cfg, self.cache_cfg)
+        # Pallas under TP: head-parallel shard_map (ops/pallas_tp.py) keeps
+        # the fused kernels when kv-heads split evenly over tp; otherwise the
+        # einsum reference path (which GSPMD partitions on its own) remains
+        # the fallback.
+        self._attn_mesh = None
+        if mesh is not None and self.attn_impl == "pallas":
+            from tpuserve.ops.pallas_tp import tp_partitionable
+            from tpuserve.parallel.mesh import AXIS_TP
+            if tp_partitionable(self.model_cfg.num_kv_heads, mesh):
+                self._attn_mesh = mesh
+            elif mesh.shape.get(AXIS_TP, 1) > 1:
+                logger.warning(
+                    "attn_impl=pallas needs num_kv_heads %% tp == 0 "
+                    "(%d %% %d); falling back to reference attention",
+                    self.model_cfg.num_kv_heads, mesh.shape.get(AXIS_TP, 1))
+                self.attn_impl = "reference"
         self.block_manager = create_block_manager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
@@ -152,8 +168,8 @@ class Engine:
         self._pending: Optional[PendingDecode] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
         # Speculation needs a single process: followers can't mirror the
-        # data-dependent verify shapes (parallel/multihost broadcasts only
-        # the two fixed step kinds).
+        # data-dependent verify shapes (parallel/multihost broadcasts
+        # fixed-shape step kinds only).
         self._spec = (config.speculative
                       if jax.process_count() == 1 else None)
         self._req_counter = itertools.count()
@@ -187,6 +203,15 @@ class Engine:
         prompt_token_ids = list(prompt_token_ids)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
+        if jax.process_count() > 1 and (params.needs_penalties
+                                        or params.logprobs is not None):
+            # Penalty/logprob ops are separate jits over the mesh-global
+            # logits; the lockstep protocol mirrors prefill/decode/sample
+            # only.  Rejected at intake rather than deadlocking in SPMD.
+            # See parallel/multihost.py "Limitations".
+            raise ValueError(
+                "sampling penalties and logprobs are not supported in "
+                "multi-host serving mode")
         if len(prompt_token_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds max sequence "
@@ -250,17 +275,40 @@ class Engine:
         return sub
 
     # ---- execution hooks (multi-host coordinators wrap these to broadcast
-    # each step to follower processes before running it — parallel/multihost)
+    # each step to follower processes before running it — parallel/multihost).
+    # EVERY transformer.* / sample_tokens call in this class goes through a
+    # hook; tests/test_multihost.py asserts that by AST so a new call site
+    # can't silently bypass the lockstep protocol (the round-1 deadlock).
 
     def _exec_prefill(self, tokens, prompt_lens, slot_ids):
         return transformer.prefill(
             self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
-            self.kv_cache, attn_impl=self.attn_impl)
+            self.kv_cache, attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_decode(self, tokens, positions, slot_ids, block_tables, seq_lens):
         return transformer.decode_step(
             self.params, self.model_cfg, tokens, positions, slot_ids,
-            block_tables, seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+            block_tables, seq_lens, self.kv_cache, attn_impl=self.attn_impl,
+            mesh=self._attn_mesh)
+
+    def _exec_prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
+                            block_tables):
+        return transformer.prefill_chunk(
+            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+            slot_ids, block_tables, self.kv_cache)
+
+    def _exec_decode_verify(self, tokens, ctx_lens, chunk_lens, slot_ids,
+                            block_tables):
+        # Speculative decoding is single-process only (gated in __init__),
+        # so no coordinator wraps this hook; it exists so the AST coverage
+        # test can hold the "no direct transformer calls" line everywhere.
+        return transformer.decode_verify(
+            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+            slot_ids, block_tables, self.kv_cache)
+
+    def _exec_sample(self, logits, keys, temperature, top_k, top_p, *, mode):
+        return sampling_ops.sample_tokens(
+            logits, keys, temperature, top_k, top_p, mode=mode)
 
     # ---- prefill ------------------------------------------------------
 
@@ -329,11 +377,11 @@ class Engine:
                                 np.int32)
         bt = self.block_manager.block_table(req.request_id)
         block_tables[0, :len(bt)] = bt
-        logits, self.kv_cache = transformer.prefill_chunk(
-            self.params, self.model_cfg, jnp.asarray(tokens),
+        logits, self.kv_cache = self._exec_prefill_chunk(
+            jnp.asarray(tokens),
             jnp.asarray(np.asarray([done], np.int32)),
             jnp.asarray(np.asarray([n], np.int32)),
-            jnp.asarray(slot_ids), jnp.asarray(block_tables), self.kv_cache)
+            jnp.asarray(slot_ids), jnp.asarray(block_tables))
         req.num_prefilled = done + n
         self.stats.num_prefill_steps += 1
         if req.num_prefilled < len(ids):
@@ -492,10 +540,10 @@ class Engine:
                     r.request_id, base[i] + j)
             bt = self.block_manager.block_table(r.request_id)
             block_tables[i, :len(bt)] = bt
-        pred, self.kv_cache = transformer.decode_verify(
-            self.params, self.model_cfg, jnp.asarray(tokens),
-            jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
-            jnp.asarray(slot_ids), jnp.asarray(block_tables), self.kv_cache)
+        pred, self.kv_cache = self._exec_decode_verify(
+            jnp.asarray(tokens), jnp.asarray(ctx_lens),
+            jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
+            jnp.asarray(block_tables))
         pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
@@ -548,7 +596,7 @@ class Engine:
         still on device (pipelined decode) — their sampling-key step index
         is one ahead of the host-visible output length."""
         if all(r.params.greedy for r in reqs):
-            return sampling_ops.sample_tokens(
+            return self._exec_sample(
                 logits, *self._greedy_dummies(B), mode="greedy")
         mode = ("temperature"
                 if not any(r.params.needs_truncation for r in reqs) else "full")
@@ -567,7 +615,7 @@ class Engine:
             step = len(r.output_token_ids) + (1 if r.request_id in in_flight
                                               else 0)
             keys[i] = (np.uint32(salt & 0xFFFFFFFF), np.uint32(step))
-        return sampling_ops.sample_tokens(
+        return self._exec_sample(
             logits, jnp.asarray(keys), jnp.asarray(temperature),
             jnp.asarray(top_k), jnp.asarray(top_p), mode=mode)
 
@@ -722,15 +770,17 @@ class Engine:
         # real prefill despite a warmed identical shape).  Round 2 runs every
         # bucket again with the settled layouts, so the steady-state
         # executables all exist before the first request arrives.
+        # All device work below goes through the _exec_* hooks: on a
+        # multi-host slice the coordinator's warmup broadcasts every step to
+        # the followers (already in follower_loop), so startup compiles in
+        # lockstep instead of deadlocking the SPMD program (round-1 bug).
         for _round in range(2):
             for bucket in prefill_buckets:
                 B, L = bucket if isinstance(bucket, tuple) else (1, bucket)
                 tokens = jnp.zeros((B, L), jnp.int32)
                 lens = jnp.ones((B,), jnp.int32)
                 slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
-                logits, self.kv_cache = transformer.prefill(
-                    self.params, self.model_cfg, tokens, lens, slots,
-                    self.kv_cache, attn_impl=self.attn_impl)
+                logits, self.kv_cache = self._exec_prefill(tokens, lens, slots)
                 self._warm_sampling(logits, sample_modes)
             for B in decode_buckets:
                 tokens = jnp.zeros((B,), jnp.int32)
@@ -738,9 +788,8 @@ class Engine:
                 slots = jnp.full((B,), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
                 seq_lens = jnp.ones((B,), jnp.int32)
-                logits, self.kv_cache = transformer.decode_step(
-                    self.params, self.model_cfg, tokens, positions, slots, bt,
-                    seq_lens, self.kv_cache, attn_impl=self.attn_impl)
+                logits, self.kv_cache = self._exec_decode(
+                    tokens, positions, slots, bt, seq_lens)
                 self._warm_sampling(logits, sample_modes)
             chunk = self.config.scheduler.prefill_chunk_size
             if self.max_seq_len > chunk:
@@ -751,10 +800,9 @@ class Engine:
                 slots = jnp.full((1, chunk), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                jnp.int32)
-                logits, self.kv_cache = transformer.prefill_chunk(
-                    self.params, self.model_cfg, tokens,
-                    jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
-                    slots, bt, self.kv_cache)
+                logits, self.kv_cache = self._exec_prefill_chunk(
+                    tokens, jnp.zeros((1,), jnp.int32),
+                    jnp.ones((1,), jnp.int32), slots, bt)
                 self._warm_sampling(logits, sample_modes)
         logits.block_until_ready()
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",
@@ -769,5 +817,4 @@ class Engine:
         B = logits.shape[0]
         keys, temp, top_k, top_p = self._greedy_dummies(B)
         for mode in modes:
-            sampling_ops.sample_tokens(logits, keys, temp, top_k, top_p,
-                                       mode=mode)
+            self._exec_sample(logits, keys, temp, top_k, top_p, mode=mode)

@@ -52,6 +52,12 @@ class Scheduler:
         self.max_model_len = max_model_len
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
+        # Set after scheduling a chunked-prefill step: the next cycle runs a
+        # decode step first (if anything is running) so in-flight streams get
+        # a token between chunks — without this, a 32k prompt at the 2048
+        # chunk size stalls every running decode for ~16 consecutive steps
+        # (vLLM bounds ITL the same way by mixing decode into chunk batches).
+        self._interleave_decode = False
 
     # ---- intake ---------------------------------------------------------
 
@@ -103,9 +109,18 @@ class Scheduler:
 
     def schedule(self) -> Optional[ScheduledBatch]:
         """Pick the next batch.  Prefill-priority: admit waiting work first
-        (keeps TTFT low and the decode batch full), then decode."""
+        (keeps TTFT low and the decode batch full), then decode.  Exception:
+        directly after a chunked-prefill step, one decode step runs first so
+        a long prompt's multi-step admission cannot starve in-flight streams
+        (bounded inter-token latency)."""
+        if self._interleave_decode and self.running:
+            self._interleave_decode = False
+            return ScheduledBatch(
+                kind="decode", requests=list(self.running),
+                padded_batch=self.decode_bucket(len(self.running)))
         batch = self._schedule_prefill()
         if batch is not None:
+            self._interleave_decode = batch.kind == "prefill_chunk"
             return batch
         if self.running:
             return ScheduledBatch(

@@ -466,6 +466,13 @@ def main(argv=None):
     if args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh
         mesh = make_mesh(MeshConfig(dp=1, tp=args.tp))
+    elif args.multihost:
+        # Lockstep serving needs a global mesh on EVERY process; default to
+        # TP over all devices.  Deciding this here (before the
+        # coordinator/follower split) matters: a coordinator-only failure
+        # would strand followers in broadcast_one_to_all forever.
+        from tpuserve.parallel import make_mesh
+        mesh = make_mesh()
     if args.disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         engine = DisaggregatedEngine(ecfg, ecfg, mesh=mesh)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import sys
 import tempfile
 import time
 
@@ -77,7 +78,13 @@ class RequestTracer:
                 if v is not None:
                     span.set_attribute(k, v)
             yield span
-        finally:
+        except BaseException:
+            # propagate the real exc_info so the span records error status —
+            # a bare __exit__(None, None, None) would export failed requests
+            # as successful spans
+            if not cm.__exit__(*sys.exc_info()):
+                raise
+        else:
             cm.__exit__(None, None, None)
 
 
