@@ -1,0 +1,27 @@
+# Engine / gateway / downloader container for the TPU serving stack.
+#
+# Every serving manifest (engine Deployment/StatefulSet, gateway, model
+# download Job — provision/manifests.py) runs this one image with a
+# different command.  The reference deploys pullable upstream images
+# (reference: kubernetes-single-node.yaml:14 pins vllm/vllm-openai;
+# llm-d-deploy.yaml:140-145 clones the llm-d charts); this repo ships its
+# own engine, so it ships its own image: build + push happen in the deploy
+# pipeline (provision/image.py).
+FROM python:3.12-slim
+
+# jax with the TPU runtime (libtpu) from Google's release index, plus the
+# optional extras the engine uses when present (HF tokenizers/downloads).
+RUN pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir \
+      transformers huggingface_hub safetensors pyyaml prometheus-client
+
+COPY . /opt/tpuserve
+RUN pip install --no-cache-dir /opt/tpuserve && rm -rf /root/.cache
+
+# engine API/metrics port + gateway port (DeployConfig.engine_port/gateway_port)
+EXPOSE 8000 8080
+
+# Default: the OpenAI-compatible engine server; manifests override the
+# command for the gateway and download-Job roles.
+CMD ["python", "-m", "tpuserve.server", "--host", "0.0.0.0", "--port", "8000"]

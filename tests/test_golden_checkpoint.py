@@ -1,0 +1,96 @@
+"""Golden checkpoint test: transformers-authored weights, logit parity.
+
+The name-mapping tests in test_models.py write their own safetensors with
+hand-typed HF names; this test has *transformers itself* author a tiny
+Qwen3-shaped checkpoint (same fused/rope/qk-norm settings as the real
+Qwen3-0.6B the pipeline serves — reference: llm-d-deploy.yaml:118) and
+checks our loader + forward pass reproduce transformers' CPU logits.  The
+first real-weight load on TPU is then not the first time the mapping meets
+authentic tensor names/layouts (VERDICT r1 next-round #8).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from tpuserve.models import transformer, weights
+from tpuserve.models.config import config_from_hf_json
+
+
+TINY_QWEN3 = dict(
+    vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    head_dim=16, max_position_embeddings=512, rope_theta=1e6,
+    rms_norm_eps=1e-6, tie_word_embeddings=True,
+    bos_token_id=0, eos_token_id=1,
+)
+
+
+@pytest.fixture(scope="module")
+def golden_ckpt(tmp_path_factory):
+    """transformers writes the checkpoint; nothing hand-named."""
+    path = tmp_path_factory.mktemp("qwen3-golden")
+    torch.manual_seed(0)
+    hf_cfg = transformers.Qwen3Config(**TINY_QWEN3)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    model = model.to(torch.float32).eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return path, model
+
+
+def test_qwen3_config_roundtrip(golden_ckpt):
+    path, _ = golden_ckpt
+    hf = json.loads((path / "config.json").read_text())
+    cfg = config_from_hf_json("tiny-golden", hf)
+    assert cfg.qk_norm is True                      # Qwen3 trait
+    assert cfg.num_kv_heads == 2 and cfg.head_dim == 16
+    assert cfg.tie_word_embeddings is True
+    assert cfg.rope_theta == 1e6
+
+
+def test_qwen3_logits_match_transformers(golden_ckpt):
+    path, model = golden_ckpt
+    hf = json.loads((path / "config.json").read_text())
+    cfg = config_from_hf_json("tiny-golden", hf)
+    import dataclasses
+    cfg = dataclasses.replace(cfg, dtype="float32")
+    params = weights.load_hf_checkpoint(cfg, str(path))
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(2, TINY_QWEN3["vocab_size"], size=(2, 12))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(transformer.forward(
+        params, cfg, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_qwen3_engine_greedy_matches_transformers(golden_ckpt):
+    """End-to-end: the serving engine (paged cache, bucketed prefill/decode)
+    greedy-decodes the same continuation transformers produces."""
+    path, model = golden_ckpt
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+    eng = Engine(EngineConfig(
+        model=str(path), checkpoint_dir=str(path),
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(min_prefill_bucket=8, min_decode_bucket=2)))
+    prompt = [5, 6, 7, 8, 9]
+    n_gen = 8
+    out = eng.generate([prompt], SamplingParams(
+        max_tokens=n_gen, temperature=0.0, ignore_eos=True))[0]
+
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        hf_out = model.generate(
+            ids, max_new_tokens=n_gen, do_sample=False,
+            eos_token_id=None, pad_token_id=0)
+    expect = hf_out[0, len(prompt):].tolist()
+    assert out.output_token_ids == expect

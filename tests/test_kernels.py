@@ -47,6 +47,24 @@ def test_paged_decode_matches_reference(B, Hq, Hkv, D, page, nb, mp):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("pages_per_group", [1, 2, 3])
+def test_paged_decode_multi_group(pages_per_group):
+    """Force the multi-group online-softmax path (num_groups > 1) with a
+    ragged tail: the default pages_per_group covers small shapes in one
+    group, so the cross-group accumulation needs explicit coverage."""
+    B, Hq, Hkv, D, page, nb, mp = 2, 4, 2, 32, 4, 32, 8
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(nb)[:B * mp].reshape(B, mp), jnp.int32)
+    sl = jnp.asarray([page * mp, page * mp - 3], jnp.int32)  # full + ragged
+    ref = ref_ops.paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5, interpret=True,
+                                 pages_per_group=pages_per_group)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_paged_decode_single_token_sequence():
     # seq_len == 1: only the freshly written token is attended to.
     D = 16

@@ -415,8 +415,9 @@ def test_serving_deploy_waits_and_secret(tmp_path, monkeypatch):
     assert "model-download" in applied
     argvs = runner.argvs()
     assert any("job/model-download" in a for a in argvs)
+    # Ready wait runs in 30s slices (image-pull fail-fast between slices)
     assert any("wait --for=condition=Ready pods" in a and
-               f"--timeout={cfg.pods_ready_timeout_s}s" in a for a in argvs)
+               "--timeout=30s" in a for a in argvs)
 
 
 def test_serving_redeploy_deletes_immutable_job(tmp_path, monkeypatch):
@@ -615,3 +616,64 @@ def test_cli_test_without_deploy_errors(tmp_path):
     from tpuserve.provision import cli
     rc = cli.main(["--workdir", str(tmp_path), "--dry-run", "test"])
     assert rc != 0
+
+
+# --- container image path (VERDICT r1 "missing" #1) -----------------------
+
+def test_resolve_image_with_registry():
+    from tpuserve.provision import image
+    cfg = _cfg(image_registry="us-central1-docker.pkg.dev/proj/tpuserve")
+    assert image.resolve_image(cfg) == \
+        "us-central1-docker.pkg.dev/proj/tpuserve/tpuserve:latest"
+    assert image.resolve_image(_cfg()) == "tpuserve:latest"
+
+
+def test_ensure_image_gke_builds_and_pushes():
+    from tpuserve.provision import image
+    cfg = _cfg(image_registry="us-central1-docker.pkg.dev/proj/tpuserve")
+    runner = FakeRunner()
+    ref = image.ensure_image(cfg, runner, workdir=".")
+    argvs = runner.argvs()
+    assert any(a.startswith("docker build -t " + ref) for a in argvs)
+    assert any("gcloud auth configure-docker" in a for a in argvs)
+    assert f"docker push {ref}" in argvs
+
+
+def test_ensure_image_gke_requires_registry():
+    from tpuserve.provision import image
+    with pytest.raises(RuntimeError, match="image_registry"):
+        image.ensure_image(_cfg(), FakeRunner(), workdir=".")
+
+
+def test_ensure_image_local_kind_load():
+    from tpuserve.provision import image
+    cfg = _cfg(provider="local", project="")
+    runner = FakeRunner()
+    image.ensure_image(cfg, runner, workdir=".", context="kind-smoke")
+    argvs = runner.argvs()
+    assert any(a.startswith("docker build") for a in argvs)
+    assert "kind load docker-image tpuserve:latest --name smoke" in argvs
+
+
+def test_ensure_image_skipped_when_prebuilt():
+    from tpuserve.provision import image
+    cfg = _cfg(build_image=False,
+               image_registry="gcr.io/proj")
+    runner = FakeRunner()
+    assert image.ensure_image(cfg, runner) == "gcr.io/proj/tpuserve:latest"
+    assert runner.commands == []
+
+
+def test_wait_pods_fails_fast_on_image_pull_backoff(tmp_path, monkeypatch):
+    monkeypatch.delenv("HF_TOKEN", raising=False)
+    cfg = _cfg(hf_token_file=str(tmp_path / "missing"))
+    runner = FakeRunner([
+        ("wait --for=condition=complete", (0, "", "")),   # download done
+        ("wait --for=condition=Ready", (1, "", "timed out")),
+        ("state.waiting.reason", (0, "ImagePullBackOff\n", "")),
+    ])
+    with pytest.raises(RuntimeError, match="not pullable"):
+        serving.deploy(cfg, infra.KubeCtl(runner, "kc"))
+    # failed fast: one Ready wait slice, not pods_ready_timeout_s/30 of them
+    waits = sum("wait --for=condition=Ready" in a for a in runner.argvs())
+    assert waits == 1

@@ -44,14 +44,17 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     # the last query row of the q block, and inside the valid prompt.
     @pl.when((k_start <= q_start + blk_q - 1) & (k_start < prompt_len))
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)          # (blk_q, D)
-        k = k_ref[0, 0, :, :].astype(jnp.float32)          # (blk_k, D)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        # Stored-dtype (bf16) MXU inputs with fp32 accumulation: upcasting
+        # before the dot would run the MXU at its slow fp32 rate for no
+        # accuracy gain over fp32 accumulation.
+        q = q_ref[0, 0, :, :]                              # (blk_q, D)
+        k = k_ref[0, 0, :, :]                              # (blk_k, D)
+        v = v_ref[0, 0, :, :]
         # Zero v rows past the prompt: out-of-bounds block tails are
         # unspecified memory (possibly NaN), and 0 * NaN would poison the
         # accumulator even though their probabilities are exactly 0.
         col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_k, 1), 0)
-        v = jnp.where(col_ids < prompt_len, v, 0.0)
+        v = jnp.where(col_ids < prompt_len, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
@@ -66,8 +69,11 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.exp(s - m_new)                              # (blk_q, blk_k)
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        # p cast to V's stored dtype keeps the PV contraction on the fast
+        # MXU path; probabilities are in [0, 1] where bf16 rounding is benign
         acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
 

@@ -3,10 +3,23 @@
 One grid program per sequence; the sequence's KV pages are DMA'd from HBM
 into a double-buffered VMEM scratch using the block table (scalar-prefetched
 so page addresses are known before the kernel body runs), with an online
-softmax accumulated across pages.  This is the TPU-native replacement for the
-CUDA paged-attention kernels inside the vLLM image the reference deploys
-(reference: kubernetes-single-node.yaml:14; SURVEY.md §2.2, §7 "hard parts" —
-see also PAPERS.md "Ragged Paged Attention").
+softmax accumulated across page *groups*.  This is the TPU-native
+replacement for the CUDA paged-attention kernels inside the vLLM image the
+reference deploys (reference: kubernetes-single-node.yaml:14; SURVEY.md
+§2.2, §7 "hard parts" — see also PAPERS.md "Ragged Paged Attention").
+
+Two levers matter for decode throughput here (VERDICT r1 asked for both):
+
+- **Native-dtype MXU dots.**  The QK and PV contractions consume q/k/v in
+  their stored dtype (bf16 KV cache) with fp32 accumulation
+  (``preferred_element_type``) — upcasting to fp32 *before* the dot, as
+  round 1 did, runs the MXU at its slow fp32 rate for no accuracy gain
+  over fp32 accumulation.
+- **Page groups.**  Each loop iteration consumes ``G`` pages at once: one
+  (group, D) x (D, G*page) contraction instead of G skinny per-page dots,
+  amortising loop/relayout overhead and keeping the MXU fed; the
+  double-buffered group prefetch overlaps the next G page DMAs with
+  compute.
 
 Semantics match ``tpuserve.ops.attention.paged_decode_attention``; verified
 against it in interpret mode on CPU.
@@ -23,51 +36,80 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Target K rows per compute iteration: G = ceil(TARGET_GROUP_ROWS / page).
+# 512 rows x 128 lanes is deep enough to amortise relayout/loop overhead
+# while 2 slots x (K+V) x 512 rows x 8 kv heads x 128 x 2B = 4 MiB stays
+# comfortably inside VMEM next to the q/output blocks.
+TARGET_GROUP_ROWS = 512
+
 
 def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
-                         k_scr, v_scr, sems, *, scale, page_size, max_pages,
+                         k_scr, v_scr, sems, *, scale, page_size, pages_g,
                          num_kv_heads, group, head_dim):
     b = pl.program_id(0)
     seq_len = sl_ref[b]
     num_pages = pl.cdiv(seq_len, page_size)
+    num_groups = pl.cdiv(num_pages, pages_g)
 
-    def start_copy(i, slot):
-        page = bt_ref[b, i]
-        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sems.at[0, slot]).start()
-        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sems.at[1, slot]).start()
+    def start_group(g, slot):
+        def copy_one(j, _):
+            @pl.when(g * pages_g + j < num_pages)
+            def _():
+                page = bt_ref[b, g * pages_g + j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).start()
+            return 0
+        jax.lax.fori_loop(0, pages_g, copy_one, 0)
 
-    def wait_copy(i, slot):
-        page = bt_ref[b, i]
-        pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot], sems.at[0, slot]).wait()
-        pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot], sems.at[1, slot]).wait()
+    def wait_group(g, slot):
+        def wait_one(j, _):
+            @pl.when(g * pages_g + j < num_pages)
+            def _():
+                page = bt_ref[b, g * pages_g + j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).wait()
+            return 0
+        jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
-    start_copy(0, 0)
+    start_group(0, 0)
 
-    q = q_ref[0].astype(jnp.float32) * scale                  # (Hq, D)
-    q_r = q.reshape(num_kv_heads, group, head_dim)
+    rows_g = pages_g * page_size
+    q_r = q_ref[0].reshape(num_kv_heads, group, head_dim)   # stored dtype
 
     m0 = jnp.full((num_kv_heads, group, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
     acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
 
-    def body(i, carry):
+    def body(g, carry):
         m_prev, l_prev, acc_prev = carry
-        slot = jax.lax.rem(i, 2)
+        slot = jax.lax.rem(g, 2)
 
-        @pl.when(i + 1 < num_pages)
+        @pl.when(g + 1 < num_groups)
         def _prefetch():
-            start_copy(i + 1, 1 - slot)
+            start_group(g + 1, 1 - slot)
 
-        wait_copy(i, slot)
-        k = k_scr[slot].astype(jnp.float32)                    # (page, Hkv, D)
-        v = v_scr[slot].astype(jnp.float32)
-        k_t = jnp.swapaxes(k, 0, 1)                            # (Hkv, page, D)
-        v_t = jnp.swapaxes(v, 0, 1)
-        # (Hkv, group, D) x (Hkv, page, D) -> (Hkv, group, page)
-        s = jax.lax.dot_general(q_r, k_t, (((2,), (2,)), ((0,), (0,))),
-                                preferred_element_type=jnp.float32)
-        pos = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (num_kv_heads, group, page_size), 2)
+        wait_group(g, slot)
+        # (pages_g, page, Hkv, D) -> (Hkv, rows_g, D), stored dtype
+        k = jnp.swapaxes(k_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
+                         0, 1)
+        v = jnp.swapaxes(v_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
+                         0, 1)
+        # Zero V rows past the sequence: pages of the group that were never
+        # DMA'd hold unspecified scratch (possibly NaN), and 0 * NaN would
+        # poison the accumulator even though those probabilities are 0.
+        row_pos = g * rows_g + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, rows_g, 1), 1)
+        v = jnp.where(row_pos < seq_len, v, jnp.zeros_like(v))
+        # (Hkv, group, D) x (Hkv, rows, D) -> (Hkv, group, rows); bf16 MXU
+        # inputs, fp32 accumulation; scale applied to the fp32 product.
+        s = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        pos = g * rows_g + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, group, rows_g), 2)
         s = jnp.where(pos < seq_len, s, NEG_INF)
 
         m_cur = jnp.max(s, axis=2, keepdims=True)
@@ -75,23 +117,26 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
         p = jnp.exp(s - m_new)
         correction = jnp.exp(m_prev - m_new)
         l_new = l_prev * correction + jnp.sum(p, axis=2, keepdims=True)
-        # (Hkv, group, page) x (Hkv, page, D) -> (Hkv, group, D)
-        pv = jax.lax.dot_general(p, v_t, (((2,), (1,)), ((0,), (0,))),
+        # Invalid rows have p == 0 exactly, so stale scratch V cannot leak;
+        # p in V's dtype keeps the second contraction on the fast MXU path.
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((2,), (1,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         acc_new = acc_prev * correction + pv
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_groups, body, (m0, l0, acc0))
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / safe_l).reshape(num_kv_heads * group, head_dim)
     o_ref[0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "pages_per_group"))
 def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
-                           interpret: bool | None = None) -> jnp.ndarray:
+                           interpret: bool | None = None,
+                           pages_per_group: int | None = None) -> jnp.ndarray:
     """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
     block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D)."""
     B, Hq, D = q.shape
@@ -100,10 +145,13 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     group = Hq // Hkv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    pages_g = pages_per_group or max(
+        1, -(-TARGET_GROUP_ROWS // page_size))
+    pages_g = min(pages_g, max_pages)
 
     kernel = functools.partial(
         _paged_decode_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages, num_kv_heads=Hkv, group=group, head_dim=D)
+        pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
@@ -114,9 +162,9 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hkv, D), k_cache.dtype),
-            pltpu.VMEM((2, page_size, Hkv, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
+            pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, pages_g)),
         ],
     )
     return pl.pallas_call(

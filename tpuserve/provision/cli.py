@@ -16,7 +16,7 @@ import logging
 import sys
 
 from tpuserve.provision import cluster as cluster_layer
-from tpuserve.provision import infra, observability, serving, smoke
+from tpuserve.provision import image, infra, observability, serving, smoke
 from tpuserve.provision.config import DeployConfig, load_config
 from tpuserve.provision.inventory import (details_path, latest_inventory,
                                           parse_details, read_inventory)
@@ -39,23 +39,28 @@ def _kube_for_latest(workdir: str, runner: CommandRunner) -> tuple:
 
 def deploy(cfg: DeployConfig, runner: CommandRunner,
            workdir: str = ".") -> None:
-    print("==> [1/5] Provisioning infrastructure "
+    print("==> [1/6] Provisioning infrastructure "
           f"(provider={cfg.provider}, tpu={cfg.tpu_type})")
     rec = infra.provision(cfg, runner, workdir)
     import os
     kube = infra.KubeCtl(runner, os.path.join(workdir, rec.kubeconfig_file))
 
-    print("==> [2/5] Bootstrapping cluster (storage, metrics stack)")
+    print(f"==> [2/6] Building engine image ({image.resolve_image(cfg)})")
+    cfg.image = image.ensure_image(cfg, runner, workdir,
+                                   context=rec.endpoint or "")
+    cfg.image_registry = ""        # now folded into cfg.image
+
+    print("==> [3/6] Bootstrapping cluster (storage, metrics stack)")
     cluster_layer.bootstrap(cfg, kube)
 
-    print(f"==> [3/5] Deploying serving stack (model={cfg.model}, "
+    print(f"==> [4/6] Deploying serving stack (model={cfg.model}, "
           f"tp={cfg.tensor_parallel}, disagg={cfg.disaggregated})")
     serving.deploy(cfg, kube)
 
-    print("==> [4/5] Running API smoke tests")
+    print("==> [5/6] Running API smoke tests")
     smoke.run_smoke_tests(cfg, kube)
 
-    print("==> [5/5] Setting up observability (OTEL → Prometheus)")
+    print("==> [6/6] Setting up observability (OTEL → Prometheus)")
     observability.setup(cfg, kube)
     observability.verify(cfg, kube)
 

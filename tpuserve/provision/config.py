@@ -38,7 +38,15 @@ class DeployConfig:
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
-    image: str = "tpuserve:latest"         # engine container image
+    image: str = "tpuserve:latest"         # engine container image (tag)
+    # Registry prefix the image is pushed to and pulled from (e.g.
+    # "us-central1-docker.pkg.dev/PROJECT/tpuserve").  Required for
+    # provider=gke (nodes can't pull a local-only tag); empty on
+    # provider=local, where the image is side-loaded into kind/minikube.
+    image_registry: str = ""
+    # Build+push/load the image during deploy (provision/image.py).  False =
+    # the image reference is already pullable (CI pushed it).
+    build_image: bool = True
     hf_token_file: str = "~/.cache/huggingface/token"  # reference: llm-d-deploy.yaml:117
     chat_template: Optional[str] = None    # name of a bundled template (phi/opt)
     engine_port: int = 8000                # vLLM-compatible metrics port (otel-observability-setup.yaml:379)

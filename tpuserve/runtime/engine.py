@@ -791,6 +791,15 @@ class Engine:
                 logits, self.kv_cache = self._exec_decode(
                     tokens, positions, slots, bt, seq_lens)
                 self._warm_sampling(logits, sample_modes)
+                if self._spec is not None:
+                    # the speculative verify pass is its own executable;
+                    # left cold, the first spec step stalls on its compile
+                    K = self._spec.num_draft_tokens + 1
+                    vtok = jnp.zeros((B, K), jnp.int32)
+                    vslots = jnp.full((B, K), PAD_SLOT, jnp.int32)
+                    _, self.kv_cache = self._exec_decode_verify(
+                        vtok, jnp.zeros((B,), jnp.int32),
+                        jnp.ones((B,), jnp.int32), vslots, bt)
             chunk = self.config.scheduler.prefill_chunk_size
             if self.max_seq_len > chunk:
                 # long prompts hit the chunked path; its single (1, chunk)
