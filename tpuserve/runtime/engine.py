@@ -227,6 +227,51 @@ class Engine:
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
 
+    def adopt_prefilled(self, request_id: str,
+                        prompt_token_ids: Sequence[int], first_token: int,
+                        params: SamplingParams, seq_kv: list) -> str:
+        """Adopt a sequence prefilled on another pod (cross-pod
+        disaggregation, parallel/disagg_net.py): allocate blocks, scatter
+        the transferred KV pages into this cache, and drop the request
+        straight into the running decode batch — no recompute.
+
+        ``seq_kv``: per-layer {"k","v"} page arrays as produced by
+        ``parallel.disagg.extract_seq_kv`` (power-of-two padded block
+        count).  The first token's text was already emitted by the prefill
+        pod; it seeds the detokenizer here but is not re-emitted.  Raises
+        ``MemoryError`` when the pool lacks blocks or sequence slots (the
+        caller maps it to backpressure, e.g. HTTP 503).
+        """
+        from tpuserve.parallel.disagg import insert_seq_kv
+        prompt_token_ids = list(prompt_token_ids)
+        if request_id in self.requests:
+            raise ValueError(f"request {request_id} already exists")
+        if len(prompt_token_ids) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} exceeds max "
+                f"sequence length {self.max_seq_len}")
+        need = self.block_manager.blocks_needed(len(prompt_token_ids)) + 1
+        if (need > self.block_manager.num_free_blocks
+                or self.scheduler.num_running
+                >= self.config.scheduler.max_num_seqs):
+            raise MemoryError("decode pool at capacity")
+        req = Request(request_id=request_id,
+                      prompt_token_ids=prompt_token_ids, params=params)
+        alloc = self.block_manager.allocate(request_id, prompt_token_ids)
+        seq_kv = [{"k": jnp.asarray(l["k"]), "v": jnp.asarray(l["v"])}
+                  for l in seq_kv]
+        self.kv_cache = insert_seq_kv(self.kv_cache, seq_kv, alloc.blocks)
+        req.output_token_ids.append(first_token)
+        req.state = RequestState.RUNNING
+        req.first_token_time = time.monotonic()
+        detok = IncrementalDetokenizer(self.tokenizer)
+        detok.add(first_token)        # seed; its text streamed prefill-side
+        self._detok[request_id] = detok
+        self.requests[request_id] = req
+        self.scheduler.running.append(req)
+        self.stats.prompt_tokens += len(prompt_token_ids)
+        return request_id
+
     def abort_request(self, request_id: str) -> bool:
         req = self.scheduler.abort(request_id)
         if req is None:

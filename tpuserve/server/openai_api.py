@@ -40,6 +40,10 @@ class ServerConfig:
     # Export tpu_* device metrics alongside vllm_* on /metrics — the engine
     # owns the chips, so it is the authoritative DCGM-analog source.
     tpu_metrics: bool = True
+    # Decode-pool role (cross-pod disaggregation): accept KV migrations on
+    # POST /internal/migrate (parallel/disagg_net.py).  Off unless the pod
+    # is started with --role decode.
+    allow_kv_migration: bool = False
 
 
 def _num(body: dict, key: str, default, cast):
@@ -256,6 +260,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
 
     def do_POST(self):
+        if self.path == "/internal/migrate":
+            self._handle_migrate()
+            return
         chat = self.path == "/v1/chat/completions"
         if self.path not in ("/v1/completions", "/v1/chat/completions"):
             self._error(404, f"no route {self.path}")
@@ -291,6 +298,71 @@ class _Handler(BaseHTTPRequestHandler):
                     self._error(500, str(e), "server_error")
                 except Exception:
                     pass
+
+    # ---- cross-pod disaggregation (decode-pool side) --------------------
+
+    MAX_MIGRATION_BYTES = 1 << 30      # KV pages for one long sequence
+
+    def _handle_migrate(self):
+        """Adopt a prefilled sequence from a prefill pod and stream its
+        remaining tokens back as JSON lines over a close-delimited response
+        (parallel/disagg_net.py is the peer)."""
+        ctx = self.ctx
+        if not ctx.config.allow_kv_migration:
+            self._error(403, "this pod is not a decode pool "
+                             "(start with --role decode)")
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not 0 < length <= self.MAX_MIGRATION_BYTES:
+                self.close_connection = True
+                raise ValueError(f"bad migration payload size {length}")
+            from tpuserve.parallel.disagg_net import deserialize_migration
+            meta, seq_kv = deserialize_migration(self.rfile.read(length))
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        try:
+            rid, q = ctx.runner.submit_prefilled(meta, seq_kv)
+        except MemoryError as e:
+            self._error(503, str(e), "server_error")   # pool-full backpressure
+            return
+        except Exception as e:
+            self._error(400, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        import queue as _queue
+        deadline = time.monotonic() + ctx.config.request_timeout_s
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=max(deadline - time.monotonic(),
+                                             0.001))
+                except _queue.Empty:
+                    ctx.runner.abort(rid)
+                    break
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    break
+                line = json.dumps({
+                    "new_token_ids": item.new_token_ids,
+                    "new_text": item.new_text,
+                    "finished": item.finished,
+                    "finish_reason": (item.finish_reason.value
+                                      if item.finish_reason else None),
+                }) + "\n"
+                self.wfile.write(line.encode())
+                self.wfile.flush()
+        except BrokenPipeError:
+            # prefill pod went away (client abort): stop generating
+            ctx.runner.abort(rid)
+        finally:
+            getattr(ctx.engine, "requests", {}).pop(rid, None)
 
     # ---- response shapes ------------------------------------------------
 

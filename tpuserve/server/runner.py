@@ -37,6 +37,18 @@ class _Abort:
     request_id: str
 
 
+@dataclasses.dataclass
+class _InjectPrefilled:
+    """Cross-pod disaggregation: a sequence prefilled on another pod, to be
+    adopted into this engine's decode batch (parallel/disagg_net.py)."""
+    meta: dict
+    seq_kv: list
+    out_queue: "queue.Queue[RequestOutput | Exception | None]"
+    rid_event: threading.Event
+    assigned_id: Optional[str] = None
+    error: Optional[Exception] = None
+
+
 class AsyncEngineRunner:
     """Runs engine.step() on a dedicated thread; routes outputs to callers.
 
@@ -99,6 +111,23 @@ class AsyncEngineRunner:
         self._intake.put(_Abort(request_id))
         self._wake.set()
 
+    def submit_prefilled(self, meta: dict, seq_kv: list
+                         ) -> tuple[str, "queue.Queue"]:
+        """Adopt a migrated (already-prefilled) sequence on the engine loop
+        thread; raises the loop-side error (MemoryError = pool full, which
+        the HTTP layer maps to 503 backpressure)."""
+        msg = _InjectPrefilled(meta=meta, seq_kv=seq_kv,
+                               out_queue=queue.Queue(),
+                               rid_event=threading.Event())
+        self._intake.put(msg)
+        self._wake.set()
+        msg.rid_event.wait(timeout=60)
+        if msg.error is not None:
+            raise msg.error
+        if msg.assigned_id is None:
+            raise TimeoutError("engine loop did not accept the migration")
+        return msg.assigned_id, msg.out_queue
+
     def generate_sync(self, prompt=None, prompt_token_ids=None, params=None,
                       timeout: float = 600.0):
         """Blocking convenience: returns (list[RequestOutput], request_id)."""
@@ -132,6 +161,27 @@ class AsyncEngineRunner:
                     self._last_token_time.pop(msg.request_id, None)
                     if q is not None:
                         q.put(None)
+                continue
+            if isinstance(msg, _InjectPrefilled):
+                from tpuserve.parallel.disagg_net import sampling_from_dict
+                m = msg.meta
+                try:
+                    rid = self.engine.adopt_prefilled(
+                        m["request_id"], m["prompt_token_ids"],
+                        m["first_token"], sampling_from_dict(m["params"]),
+                        msg.seq_kv)
+                except Exception as e:
+                    msg.error = e
+                    msg.rid_event.set()
+                    continue
+                msg.assigned_id = rid
+                self._out_queues[rid] = msg.out_queue
+                self._req_started[rid] = time.monotonic()
+                self._last_token_time[rid] = self._req_started[rid]
+                if self.metrics:
+                    self.metrics.request_total.inc()
+                    self.metrics.prompt_tokens.inc(len(m["prompt_token_ids"]))
+                msg.rid_event.set()
                 continue
             try:
                 rid = self.engine.add_request(

@@ -13,11 +13,64 @@ tpuserve/provision/observability.py.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
+import os
 import threading
 import time
 
 logger = logging.getLogger("tpuserve.tpu_metrics")
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeApiReader:
+    """Minimal in-cluster API reader (stdlib only — the image carries no
+    kubernetes client).  Used by the standalone DaemonSet to derive
+    node-level TPU allocation from the API server, the way DCGM's node
+    metrics come from NVML rather than the owning process."""
+
+    def __init__(self, sa_dir: str = _SA_DIR, host: str | None = None):
+        self.sa_dir = sa_dir
+        self.host = host or os.environ.get("KUBERNETES_SERVICE_HOST")
+        self.port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+
+    @property
+    def available(self) -> bool:
+        return bool(self.host) and os.path.isfile(
+            os.path.join(self.sa_dir, "token"))
+
+    def get(self, path: str) -> dict:
+        import ssl
+        import urllib.request
+        token = open(os.path.join(self.sa_dir, "token")).read().strip()
+        ctx = ssl.create_default_context(
+            cafile=os.path.join(self.sa_dir, "ca.crt"))
+        req = urllib.request.Request(
+            f"https://{self.host}:{self.port}{path}",
+            headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(req, timeout=10, context=ctx) as r:
+            return json.loads(r.read())
+
+    def node_tpu_allocatable(self, node: str) -> int:
+        data = self.get(f"/api/v1/nodes/{node}")
+        return int(data["status"]["allocatable"].get("google.com/tpu", 0))
+
+    def node_tpu_allocated(self, node: str) -> int:
+        """Sum of google.com/tpu requests across non-terminal pods bound to
+        the node — what the scheduler considers in use."""
+        data = self.get("/api/v1/pods?fieldSelector="
+                        f"spec.nodeName%3D{node}")
+        total = 0
+        for pod in data.get("items", ()):
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            for c in pod.get("spec", {}).get("containers", ()):
+                req = (c.get("resources", {}).get("requests", {})
+                       .get("google.com/tpu"))
+                if req:
+                    total += int(req)
+        return total
 
 
 class TpuMetricsExporter:
@@ -28,31 +81,51 @@ class TpuMetricsExporter:
       authoritative source, like vLLM's in-process GPU metrics.
     - standalone (standalone=True): node-level DaemonSet.  libtpu is
       single-owner per host, so initializing jax here would either steal the
-      chips from the engine or fail — instead it reports device inventory
-      from the /dev/accel* / /dev/vfio chardevs without touching the runtime
-      (HBM/duty metrics stay with the embedded exporter).
+      chips from the engine or fail — instead every gauge comes from sources
+      a bystander can read: chip inventory from the /dev/accel* / /dev/vfio
+      chardevs, and allocatable/allocated chip counts from the Kubernetes
+      API (node status + pod resource requests on this node).  HBM/duty
+      metrics stay with the embedded exporter — the standalone mode exports
+      no gauge it cannot truthfully populate.
     """
 
     def __init__(self, interval_s: float = 5.0, registry=None,
-                 standalone: bool = False):
+                 standalone: bool = False, kube: "KubeApiReader" = None,
+                 node_name: str | None = None):
         from prometheus_client import REGISTRY, Gauge
         self.registry = registry or REGISTRY
         self.interval_s = interval_s
         self.standalone = standalone
+        self.kube = kube if kube is not None else KubeApiReader()
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
         labels = ["device", "kind"]
 
         def gauge(name, doc):
             return Gauge(name, doc, labels, registry=self.registry)
 
-        self.hbm_used = gauge("tpu_hbm_used_bytes",
-                              "HBM bytes in use (DCGM_FI_DEV_FB_USED analog)")
-        self.hbm_total = gauge("tpu_hbm_total_bytes",
-                               "HBM capacity (DCGM_FI_DEV_FB_TOTAL analog)")
-        self.duty_cycle = gauge("tpu_duty_cycle_percent",
-                                "TensorCore duty cycle (DCGM_FI_DEV_GPU_UTIL analog)")
         from prometheus_client import Gauge as _G
-        self.device_count = _G("tpu_device_count", "Visible TPU devices",
-                               registry=self.registry)
+        if standalone:
+            # node-level gauges only — every one has a real data source
+            self.device_count = _G("tpu_device_count",
+                                   "TPU chardevs visible on the node",
+                                   registry=self.registry)
+            self.allocatable = _G(
+                "tpu_node_allocatable_chips",
+                "google.com/tpu the node advertises (kubelet allocatable)",
+                ["node"], registry=self.registry)
+            self.allocated = _G(
+                "tpu_node_allocated_chips",
+                "google.com/tpu requested by non-terminal pods on the node",
+                ["node"], registry=self.registry)
+        else:
+            self.hbm_used = gauge("tpu_hbm_used_bytes",
+                                  "HBM bytes in use (DCGM_FI_DEV_FB_USED analog)")
+            self.hbm_total = gauge("tpu_hbm_total_bytes",
+                                   "HBM capacity (DCGM_FI_DEV_FB_TOTAL analog)")
+            self.duty_cycle = gauge("tpu_duty_cycle_percent",
+                                    "TensorCore duty cycle (DCGM_FI_DEV_GPU_UTIL analog)")
+            self.device_count = _G("tpu_device_count", "Visible TPU devices",
+                                   registry=self.registry)
         self._stop = threading.Event()
         self._probe_busy_s = 0.0
         self._window_start = time.monotonic()
@@ -85,18 +158,22 @@ class TpuMetricsExporter:
             self.duty_cycle.labels(device=name, kind=d.device_kind).set(duty)
 
     def _collect_node_level(self) -> None:
-        """Count TPU chardevs without initializing libtpu (which would
-        contend with the engine for chip ownership)."""
+        """Node-level collection without initializing libtpu (which would
+        contend with the engine for chip ownership): chardev inventory plus
+        allocation counts read from the Kubernetes API."""
         import glob
         devs = sorted(set(glob.glob("/dev/accel*") +
                           glob.glob("/dev/vfio/[0-9]*")))
         self.device_count.set(len(devs))
-        for path in devs:
-            name = path.rsplit("/", 1)[-1]
-            # inventory-only: HBM/duty metrics come from the embedded
-            # exporter inside the engine that owns the runtime
-            self.hbm_used.labels(device=name, kind="tpu-node").set(0)
-            self.hbm_total.labels(device=name, kind="tpu-node").set(0)
+        if not (self.node_name and self.kube.available):
+            return            # outside a cluster: inventory only
+        try:
+            self.allocatable.labels(node=self.node_name).set(
+                self.kube.node_tpu_allocatable(self.node_name))
+            self.allocated.labels(node=self.node_name).set(
+                self.kube.node_tpu_allocated(self.node_name))
+        except Exception as e:
+            logger.warning("node allocation metrics unavailable: %s", e)
 
     def record_busy(self, seconds: float) -> None:
         """Engines embedding the exporter report device-busy time here; the
