@@ -52,6 +52,25 @@ def _probe_backend_once() -> bool:
         return False                 # hung init == dead tunnel
 
 
+def _degrade_to_cpu(reason: str) -> None:
+    """Re-exec this bench on CPU with the DEGRADED marker set.  Used both
+    when the pre-flight probe fails and when the TPU tunnel dies *mid-run*
+    (a compile can fail UNAVAILABLE half an hour in) — either way the
+    driver must still get its one JSON line, and that line must scream
+    that it is not a TPU result."""
+    import sys
+    env = os.environ.copy()
+    env["TPUSERVE_BENCH_REEXEC"] = "1"
+    env["TPUSERVE_BENCH_DEGRADED"] = reason
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop the axon sitecustomize so the dead tunnel can't hang CPU init
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if p and "axon" not in p)
+    print(f"DEGRADED: {reason}; re-running on cpu", flush=True)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def _ensure_live_backend(retry: bool = True) -> None:
     """The axon TPU tunnel, when unhealthy, hangs ANY jax backend init —
     even under JAX_PLATFORMS=cpu.  Probe it in a killable subprocess,
@@ -72,19 +91,9 @@ def _ensure_live_backend(retry: bool = True) -> None:
             print(f"tpu backend probe {i + 1}/{attempts} failed; "
                   f"retrying in {backoffs[i]}s", flush=True)
             time.sleep(backoffs[i])
-    env = os.environ.copy()
-    env["TPUSERVE_BENCH_REEXEC"] = "1"
-    env["TPUSERVE_BENCH_DEGRADED"] = (
+    _degrade_to_cpu(
         f"tpu backend unavailable after {attempts} probes; CPU fallback — "
         f"NOT a TPU result")
-    env["JAX_PLATFORMS"] = "cpu"
-    # drop the axon sitecustomize so the dead tunnel can't hang CPU init
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":")
-        if p and "axon" not in p)
-    print(f"tpu backend unavailable after {attempts} probes; "
-          "re-running on cpu (DEGRADED)", flush=True)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
@@ -240,8 +249,17 @@ def main(argv=None):
     params = SamplingParams(max_tokens=gen_len, temperature=0.0,
                             ignore_eos=True)
 
-    _warm(engine, batch, prompt_len)
-    r = _run_workload(engine, prompts, params)
+    try:
+        _warm(engine, batch, prompt_len)
+        r = _run_workload(engine, prompts, params)
+    except Exception as e:                        # noqa: BLE001
+        # The axon tunnel can die mid-run (UNAVAILABLE from a compile 30
+        # minutes in).  On TPU that is an infra failure, not a bench
+        # failure: fall back so the driver still gets its JSON line.
+        if on_tpu and not os.environ.get("TPUSERVE_BENCH_REEXEC"):
+            _degrade_to_cpu(f"tpu run failed mid-flight ({type(e).__name__}: "
+                            f"{str(e)[:200]}); CPU fallback — NOT a TPU result")
+        raise
 
     stats = r["stats"]
     gen_tokens = r["gen_tokens"]
