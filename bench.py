@@ -98,7 +98,7 @@ def _ensure_live_backend(retry: bool = True) -> None:
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
-                  prefix_caching=False):
+                  prefix_caching=False, multi_step=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -121,7 +121,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
         spec = SpecConfig(num_draft_tokens=spec_k)
     cfg = EngineConfig(model=model, cache=cache, scheduler=sched,
                        attn_impl=attn_impl, enable_prefix_caching=prefix_caching,
-                       pipeline_decode=pipeline, speculative=spec)
+                       pipeline_decode=pipeline, speculative=spec,
+                       multi_step=multi_step)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -178,6 +179,9 @@ def main(argv=None):
     ap.add_argument("--attn", default=None,
                     choices=["auto", "pallas", "reference"])
     ap.add_argument("--no-pipeline", action="store_true")
+    ap.add_argument("--multi-step", type=int, default=None, metavar="S",
+                    help="fused decode window size (default: auto — 8 on "
+                         "TPU, off on CPU); 1 disables")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K draft tokens on a "
                          "repetitive-prompt workload")
@@ -231,7 +235,7 @@ def main(argv=None):
     pipeline = False if args.no_pipeline else None
     engine = _build_engine(model, batch, prompt_len, gen_len,
                            attn_impl=attn_impl, pipeline=pipeline,
-                           spec_k=args.spec)
+                           spec_k=args.spec, multi_step=args.multi_step)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -280,6 +284,7 @@ def main(argv=None):
         "model": eng0.model_cfg.name,
         "backend": jax.default_backend(),
         "attn_impl": eng0.attn_impl,
+        "multi_step": eng0._multi_step,
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -304,11 +309,21 @@ def main(argv=None):
                           if stats.num_decode_steps else 0.0,
         }
     if args.compare_disagg:
-        d_engine = _build_engine(model, batch, prompt_len, gen_len,
-                                 attn_impl=attn_impl, pipeline=pipeline,
-                                 disagg=True)
-        _warm(d_engine, batch, prompt_len)
-        dr = _run_workload(d_engine, prompts, params)
+        try:
+            d_engine = _build_engine(model, batch, prompt_len, gen_len,
+                                     attn_impl=attn_impl, pipeline=pipeline,
+                                     disagg=True, multi_step=args.multi_step)
+            _warm(d_engine, batch, prompt_len)
+            dr = _run_workload(d_engine, prompts, params)
+        except Exception as e:                    # noqa: BLE001
+            # same mid-flight tunnel-death guard as the primary run: the
+            # JSON line must still be emitted
+            if on_tpu and not os.environ.get("TPUSERVE_BENCH_REEXEC"):
+                _degrade_to_cpu(
+                    f"disagg comparison failed mid-flight "
+                    f"({type(e).__name__}: {str(e)[:200]}); CPU fallback — "
+                    f"NOT a TPU result")
+            raise
         d_decode = dr["gen_tokens"] - batch
         d_tok_s = d_decode / dr["decode_s"] if dr["decode_s"] else 0.0
         out["disagg"] = {

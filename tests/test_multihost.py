@@ -90,10 +90,10 @@ def test_coordinator_wraps_every_multihost_hook():
     wrapper; the follower loop handles every op the coordinator can send."""
     src = inspect.getsource(multihost)
     for hook in ("_exec_prefill", "_exec_decode", "_exec_prefill_chunk",
-                 "_exec_sample"):
+                 "_exec_sample", "_exec_decode_multi"):
         assert f"engine.{hook}" in src, f"coordinator never wraps {hook}"
     for op in ("OP_PREFILL", "OP_DECODE", "OP_PREFILL_CHUNK", "OP_SAMPLE",
-               "OP_STOP"):
+               "OP_DECODE_MULTI", "OP_STOP"):
         assert src.count(op) >= 2, f"{op} not used by both protocol sides"
 
 
@@ -101,7 +101,7 @@ def test_coordinator_wraps_every_multihost_hook():
 # 2. Multi-process gating
 # ---------------------------------------------------------------------------
 
-def _tiny_engine(mesh=None, **sched_kw):
+def _tiny_engine(mesh=None, multi_step=None, **sched_kw):
     cfg = EngineConfig(
         model="tiny-qwen3",
         cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
@@ -109,7 +109,7 @@ def _tiny_engine(mesh=None, **sched_kw):
         scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
                                   min_decode_bucket=4, **sched_kw),
         attn_impl="reference",
-        speculative=None)
+        speculative=None, multi_step=multi_step)
     mc = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
     return Engine(cfg, model_cfg=mc, mesh=mesh)
 
@@ -201,6 +201,42 @@ def test_lockstep_replay(monkeypatch):
             rtol=1e-5, atol=1e-5,
             err_msg=f"layer {li} K cache diverged between coordinator "
                     f"and follower")
+
+
+def test_lockstep_replay_multi_step(monkeypatch):
+    """OP_DECODE_MULTI: the fused window broadcasts once per S tokens;
+    the follower mirrors the whole window (sampling fused in, so no
+    OP_SAMPLE follows) and the caches stay in lockstep."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    tape = _Tape()
+    monkeypatch.setattr(multihost, "_broadcast", tape)
+    mesh = make_mesh(MeshConfig(dp=1, tp=1))
+
+    coord = _tiny_engine(mesh=mesh, multi_step=3)
+    coordinator = multihost.MultihostCoordinator(coord)
+    windows = []
+    orig_hook = coord._exec_decode_multi
+    coord._exec_decode_multi = (
+        lambda *a, **k: (windows.append(k["steps"]), orig_hook(*a, **k))[1])
+    sampled = SamplingParams(max_tokens=7, temperature=0.7, seed=1,
+                             ignore_eos=True)
+    greedy = SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True)
+    reqs = coord.generate([[5, 6, 7], [8, 9]], [greedy, sampled])
+    assert all(len(r.output_token_ids) == 7 for r in reqs)
+    coordinator.stop_followers()
+    assert windows, "multi-step engine never used the window hook"
+
+    tape.replaying = True
+    follower = _tiny_engine(mesh=mesh, multi_step=3)
+    multihost.follower_loop(follower)
+    assert tape.pos == len(tape.values), (
+        f"follower consumed {tape.pos}/{len(tape.values)} broadcasts — "
+        "protocol desync")
+    for li, (ck, fk) in enumerate(zip(coord.kv_cache, follower.kv_cache)):
+        np.testing.assert_allclose(
+            np.asarray(ck["k"]), np.asarray(fk["k"]),
+            rtol=1e-5, atol=1e-5,
+            err_msg=f"layer {li} K cache diverged (multi-step)")
 
 
 def test_warmup_goes_through_hooks(monkeypatch):

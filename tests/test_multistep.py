@@ -1,0 +1,128 @@
+"""Multi-step (fused-window) decode: transformer.decode_multi +
+Engine._run_decode_multi.
+
+The windowed path must be token-for-token identical to the single-step
+path: same greedy argmax, same seeded sampling streams (the per-row key
+construction folds the step index the same way), same stop semantics
+(tokens past EOS / max_tokens are dropped at emit).  Equivalence is
+asserted engine-vs-engine with identical seeds (identical random weights
+— float32 on CPU so logits match bitwise).
+"""
+
+import dataclasses
+
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.kv_cache import CacheConfig
+from tpuserve.runtime.request import FinishReason, SamplingParams
+from tpuserve.runtime.scheduler import SchedulerConfig
+
+
+def _engine(multi_step=None, num_blocks=64, max_blocks_per_seq=16,
+            **eng_kw):
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=num_blocks,
+                          max_blocks_per_seq=max_blocks_per_seq,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=4),
+        attn_impl="reference", multi_step=multi_step, **eng_kw)
+    mc = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
+    return Engine(cfg, model_cfg=mc)
+
+
+PROMPTS = [[5, 6, 7], [11, 12, 13, 14, 15, 16, 17], [200, 201]]
+
+
+def _ids(reqs):
+    return [r.output_token_ids for r in reqs]
+
+
+def test_greedy_window_matches_single_step():
+    # max_tokens=10 is not a multiple of the window (4): the final window
+    # overruns and the extra tokens must be dropped at emit
+    params = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    multi = _engine(multi_step=4).generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+    assert all(len(r.output_token_ids) == 10 for r in multi)
+
+
+def test_seeded_sampling_window_matches_single_step():
+    params = [SamplingParams(max_tokens=9, temperature=0.8, seed=s,
+                             ignore_eos=True) for s in (1, 2, 3)]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    multi = _engine(multi_step=4).generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+
+
+def test_mixed_greedy_and_sampled_batch():
+    params = [SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True),
+              SamplingParams(max_tokens=8, temperature=0.9, seed=7,
+                             ignore_eos=True),
+              SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    multi = _engine(multi_step=4).generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+
+
+def test_truncation_request_falls_back_to_single_step():
+    # top-k needs the sorting sampler -> the window path must decline
+    # (return None pre-side-effect) and the single-step path serve it
+    eng = _engine(multi_step=4)
+    params = SamplingParams(max_tokens=6, temperature=0.9, top_k=5, seed=1,
+                            ignore_eos=True)
+    reqs = eng.generate(PROMPTS[:1], params)
+    assert len(reqs[0].output_token_ids) == 6
+    base = _engine(multi_step=1).generate(PROMPTS[:1], params)
+    assert _ids(reqs) == _ids(base)
+
+
+def test_logprobs_request_falls_back():
+    eng = _engine(multi_step=4)
+    params = SamplingParams(max_tokens=5, temperature=0.0, logprobs=3,
+                            ignore_eos=True)
+    reqs = eng.generate(PROMPTS[:1], params)
+    assert len(reqs[0].output_token_ids) == 5
+    assert len(reqs[0].logprobs) == 5
+
+
+def test_window_counts_device_steps():
+    eng = _engine(multi_step=4)
+    eng.generate(PROMPTS[:1], SamplingParams(max_tokens=8, temperature=0.0,
+                                             ignore_eos=True))
+    # 8 tokens: 1 from prefill, 7 from ceil(7/4)=2 windows = 8 device steps
+    assert eng.stats.num_decode_steps == 8
+
+
+def test_capacity_fallback_near_full_cache():
+    # pool sized so the 4-token window reserve fails part-way: the engine
+    # must fall back to single-step (which preempts) and still finish
+    eng = _engine(multi_step=4, num_blocks=14, max_blocks_per_seq=8)
+    params = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    reqs = eng.generate(PROMPTS, params)
+    assert all(len(r.output_token_ids) == 12 for r in reqs)
+    base = _engine(multi_step=1, num_blocks=14,
+                   max_blocks_per_seq=8).generate(PROMPTS, params)
+    assert _ids(reqs) == _ids(base)
+
+
+def test_length_cap_mid_window():
+    # max_seq_len = (num_blocks-1)*block_size bounded by max_blocks_per_seq
+    # capacity; a request that hits the cap mid-window must stop exactly at
+    # the cap with FinishReason.LENGTH, extra window tokens dropped
+    eng = _engine(multi_step=4, num_blocks=10, max_blocks_per_seq=8)
+    params = SamplingParams(max_tokens=1000, temperature=0.0, ignore_eos=True)
+    [req] = eng.generate(PROMPTS[:1], params)
+    assert req.finish_reason == FinishReason.LENGTH
+    assert req.num_tokens <= eng.max_seq_len
+    # engine fully drained, blocks freed
+    assert eng.block_manager.num_seqs() == 0
+
+
+def test_auto_resolution_off_on_cpu():
+    assert _engine(multi_step=None)._multi_step == 1
+    assert _engine(multi_step=6)._multi_step == 6

@@ -250,20 +250,14 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # Decode: one token per sequence against the paged cache
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
-         donate_argnames=("kv_cache",))
-def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
-                positions: jnp.ndarray, slot_ids: jnp.ndarray,
-                block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                kv_cache: list, *, attn_impl: str = "reference", mesh=None):
-    """One decode step for a batch of sequences.
-
-    tokens/positions/slot_ids/seq_lens: (B,); block_tables: (B, max_blocks).
-    seq_lens includes the token being decoded (its K/V is written first).
-    Returns (logits (B, V), kv_cache).
-
-    ``mesh``: static; see :func:`prefill` — head-parallel Pallas under tp.
-    """
+def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 positions: jnp.ndarray, slot_ids: jnp.ndarray,
+                 block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                 kv_cache: list, attn_impl: str, mesh):
+    """Shared single-token decode trunk: write the token's KV, attend
+    against the paged cache, return (logits (B, V), new kv_cache).  Used by
+    :func:`decode_step` (one dispatch per token) and :func:`decode_multi`
+    (scanned — one dispatch per window)."""
     B = tokens.shape[0]
     h = _embed(params, cfg, tokens, positions)                 # (B, H)
     scale = cfg.head_dim ** -0.5
@@ -288,6 +282,92 @@ def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         hn = _norm(h, lp["mlp_norm"], cfg)
         h = h + _mlp(hn, lp, cfg)
     return _unembed(params, cfg, h), new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
+def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                positions: jnp.ndarray, slot_ids: jnp.ndarray,
+                block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                kv_cache: list, *, attn_impl: str = "reference", mesh=None):
+    """One decode step for a batch of sequences.
+
+    tokens/positions/slot_ids/seq_lens: (B,); block_tables: (B, max_blocks).
+    seq_lens includes the token being decoded (its K/V is written first).
+    Returns (logits (B, V), kv_cache).
+
+    ``mesh``: static; see :func:`prefill` — head-parallel Pallas under tp.
+    """
+    return _decode_body(params, cfg, tokens, positions, slot_ids,
+                        block_tables, seq_lens, kv_cache, attn_impl, mesh)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "steps", "mode", "attn_impl", "mesh",
+                          "out_mesh"),
+         donate_argnames=("kv_cache",))
+def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 positions: jnp.ndarray, block_tables: jnp.ndarray,
+                 seq_lens: jnp.ndarray, active: jnp.ndarray,
+                 keys: jnp.ndarray, temperature: jnp.ndarray,
+                 kv_cache: list, *, steps: int, mode: str = "greedy",
+                 attn_impl: str = "reference", mesh=None, out_mesh=None):
+    """``steps`` fused decode+sample iterations in ONE dispatch.
+
+    The sampled token feeds the next iteration entirely on device
+    (``lax.scan`` over the shared decode trunk), so the host syncs once per
+    window instead of once per token — the decisive lever when dispatch
+    latency is non-trivial (remote TPU backends, multi-host lockstep
+    broadcasts).  This is the JetStream-style on-device decode loop that
+    replaces the per-step CUDA launches inside the vLLM image the reference
+    deploys (reference: kubernetes-single-node.yaml:14).
+
+    tokens/positions/seq_lens: (B,) first-iteration state, same meaning as
+    :func:`decode_step`; active: (B,) bool marking real rows (padding rows
+    never write KV); keys: (B, 2) uint32 per-row sampling keys whose second
+    word is the row's step index (folded +s each iteration, matching the
+    engine's per-step key construction); temperature: (B,).
+    ``mode``: "greedy" (argmax; keys/temperature ignored) or "temperature".
+    Cache slots for the whole window must be pre-reserved: slot ids are
+    computed on device from ``block_tables`` and the advancing positions.
+    Returns (tokens (B, steps) int32, kv_cache).
+    """
+    B = tokens.shape[0]
+    block_size = kv_cache[0]["k"].shape[1]
+    step_key = jnp.array([0, 1], jnp.uint32)[None, :]
+
+    def one(carry, s):
+        toks, pos, lens, cache = carry
+        slot = (jnp.take_along_axis(block_tables,
+                                    (pos // block_size)[:, None], axis=1)[:, 0]
+                * block_size + pos % block_size)
+        slot = jnp.where(active, slot, attn_ops.PAD_SLOT)
+        logits, cache = _decode_body(params, cfg, toks, pos, slot,
+                                     block_tables, lens, cache,
+                                     attn_impl, mesh)
+        if mode == "greedy":
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            from tpuserve.ops import sampling as sampling_ops
+            nxt = sampling_ops.sample_tokens(
+                logits, keys + step_key * s.astype(jnp.uint32), temperature,
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+                mode="temperature")
+        return (nxt, pos + 1, lens + 1, cache), nxt
+
+    carry = (tokens, positions, seq_lens, kv_cache)
+    (_, _, _, kv_cache), outs = jax.lax.scan(
+        one, carry, jnp.arange(steps, dtype=jnp.int32))
+    out = jnp.swapaxes(outs, 0, 1)                             # (B, steps)
+    if out_mesh is not None:
+        # Multi-host lockstep device_gets the window on the coordinator;
+        # force the small token matrix to be fully replicated/addressable.
+        # ``out_mesh`` is the engine's full mesh — distinct from ``mesh``,
+        # which is only set when the Pallas kernels are head-partitionable.
+        from jax.sharding import NamedSharding, PartitionSpec
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(out_mesh, PartitionSpec()))
+    return out, kv_cache
 
 
 # --------------------------------------------------------------------------

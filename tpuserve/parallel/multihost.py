@@ -13,16 +13,18 @@ BASELINE config "Qwen2-72B TP=8 multi-host v5e-16").
 Protocol (all broadcasts via ``multihost_utils.broadcast_one_to_all``,
 fixed-shape so every host agrees):
   1. header (4,) int32: [op, B, aux, extra]
-     op: 0=prefill, 1=decode, 2=stop, 3=prefill_chunk, 4=sample
-     aux:   padded length L (prefill) | max_blocks M (decode)
+     op: 0=prefill, 1=decode, 2=stop, 3=prefill_chunk, 4=sample,
+         5=decode_multi
+     aux:   padded length L (prefill) | max_blocks M (decode, decode_multi)
             | chunk length C (prefill_chunk) | unused (sample)
      extra: max_blocks M (prefill_chunk) | sampler mode index (sample)
-            | unused otherwise.
+            | steps * 4 + mode index (decode_multi) | unused otherwise.
   2. op-specific arrays with shapes derived from the header.
 
 The protocol covers EVERY device computation the engine can run in
-multi-host mode: prefill, decode, chunked prefill, warmup (which reuses the
-same three), and sampling.  Sampling is part of the protocol because
+multi-host mode: prefill, decode, multi-step decode windows (sampling
+fused in-window — one broadcast per S tokens), chunked prefill, warmup
+(which reuses the same ops), and sampling.  Sampling is part of the protocol because
 ``sample_tokens`` is its own jit over the mesh-global logits — process 0
 cannot launch it alone; followers keep the logits from their last exec op
 and mirror the sampler call.  The sampler is compiled with a fully-replicated
@@ -52,6 +54,7 @@ import numpy as np
 logger = logging.getLogger("tpuserve.multihost")
 
 OP_PREFILL, OP_DECODE, OP_STOP, OP_PREFILL_CHUNK, OP_SAMPLE = 0, 1, 2, 3, 4
+OP_DECODE_MULTI = 5
 
 SAMPLE_MODES = ("greedy", "temperature", "full")
 
@@ -98,6 +101,7 @@ class MultihostCoordinator:
             engine._exec_decode = self._decode
             engine._exec_prefill_chunk = self._prefill_chunk
             engine._exec_sample = self._sample
+            engine._exec_decode_multi = self._decode_multi
         # else: leave the direct hooks in place
 
     def _prefill(self, tokens, prompt_lens, slot_ids):
@@ -148,6 +152,30 @@ class MultihostCoordinator:
             eng.params, eng.model_cfg, jnp.asarray(tokens),
             jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
             jnp.asarray(slot_ids), jnp.asarray(block_tables), eng.kv_cache)
+
+    def _decode_multi(self, tokens, positions, block_tables, seq_lens,
+                      active, keys, temperature, *, steps, mode):
+        from tpuserve.models import transformer
+        eng = self.engine
+        B = tokens.shape[0]
+        M = block_tables.shape[1]
+        _broadcast(np.asarray(
+            [OP_DECODE_MULTI, B, M, steps * 4 + SAMPLE_MODES.index(mode)],
+            np.int32))
+        tokens = _broadcast(np.asarray(tokens))
+        positions = _broadcast(np.asarray(positions))
+        block_tables = _broadcast(np.asarray(block_tables))
+        seq_lens = _broadcast(np.asarray(seq_lens))
+        active = _broadcast(np.asarray(active, np.int32))
+        keys = _broadcast(np.asarray(keys))
+        temperature = _broadcast(np.asarray(temperature, np.float32))
+        return transformer.decode_multi(
+            eng.params, eng.model_cfg, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(block_tables),
+            jnp.asarray(seq_lens), jnp.asarray(np.asarray(active, bool)),
+            jnp.asarray(keys), jnp.asarray(temperature), eng.kv_cache,
+            steps=steps, mode=mode, attn_impl=eng.attn_impl,
+            mesh=eng._attn_mesh, out_mesh=eng.mesh)
 
     def _sample(self, logits, keys, temperature, top_k, top_p, *, mode):
         eng = self.engine
@@ -207,6 +235,25 @@ def follower_loop(engine) -> None:
                 jnp.asarray(positions), jnp.asarray(slots), jnp.asarray(bt),
                 jnp.asarray(seq_lens), engine.kv_cache,
                 attn_impl=engine.attn_impl, mesh=engine._attn_mesh)
+        elif op == OP_DECODE_MULTI:
+            M, steps, mode = aux, mode_idx // 4, SAMPLE_MODES[mode_idx % 4]
+            tokens = _broadcast(np.zeros((B,), np.int32))
+            positions = _broadcast(np.zeros((B,), np.int32))
+            bt = _broadcast(np.zeros((B, M), np.int32))
+            seq_lens = _broadcast(np.zeros((B,), np.int32))
+            active = _broadcast(np.zeros((B,), np.int32))
+            keys = _broadcast(np.zeros((B, 2), np.uint32))
+            temperature = _broadcast(np.zeros((B,), np.float32))
+            # sampling happens inside the window, so no OP_SAMPLE follows
+            # a decode_multi; the replicated token matrix is discarded here
+            _, engine.kv_cache = transformer.decode_multi(
+                engine.params, engine.model_cfg, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(bt),
+                jnp.asarray(seq_lens),
+                jnp.asarray(np.asarray(active, bool)), jnp.asarray(keys),
+                jnp.asarray(temperature), engine.kv_cache, steps=steps,
+                mode=mode, attn_impl=engine.attn_impl,
+                mesh=engine._attn_mesh, out_mesh=engine.mesh)
         elif op == OP_PREFILL_CHUNK:
             C, M = aux, mode_idx
             tokens = _broadcast(np.zeros((B, C), np.int32))

@@ -64,6 +64,14 @@ class EngineConfig:
     # runtime/spec.py).  None disables.  Greedy batches only; sampled /
     # penalty / logprob batches run the normal decode path.
     speculative: Optional["SpecConfig"] = None
+    # Multi-step decode: run N fused decode+sample iterations per dispatch
+    # (models/transformer.decode_multi) — the host syncs once per window
+    # instead of once per token.  Batches needing penalties, logprobs or
+    # top-k/top-p truncation fall back to single-step.  None = auto: 8 on
+    # TPU (dispatch latency amortised N-fold; decisive on tunneled or
+    # multi-host backends), 1 (off) on CPU where the synchronous backend
+    # gains little and tests expect per-token streaming.
+    multi_step: Optional[int] = None
 
     def resolve_pipeline_decode(self) -> bool:
         # Multi-host lockstep serialises every device computation through the
@@ -81,6 +89,11 @@ class EngineConfig:
         if self.attn_impl != "auto":
             return self.attn_impl
         return "pallas" if jax.default_backend() == "tpu" else "reference"
+
+    def resolve_multi_step(self) -> int:
+        if self.multi_step is not None:
+            return max(1, self.multi_step)
+        return 8 if jax.default_backend() == "tpu" else 1
 
 
 @dataclasses.dataclass
@@ -167,6 +180,7 @@ class Engine:
         self._greedy_cache: dict[int, tuple] = {}
         self._pending: Optional[PendingDecode] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
+        self._multi_step = config.resolve_multi_step()
         # Speculation needs a single process: followers can't mirror the
         # data-dependent verify shapes (parallel/multihost broadcasts
         # fixed-shape step kinds only).
@@ -311,7 +325,11 @@ class Engine:
                       for r in batch.requests)):
             outputs = self._run_decode_spec(batch)
         else:
-            outputs = self._run_decode(batch)
+            outputs = None
+            if self._multi_step > 1:
+                outputs = self._run_decode_multi(batch)  # None = ineligible
+            if outputs is None:
+                outputs = self._run_decode(batch)
         self.stats.last_step_time = time.monotonic() - t0
         return outputs
 
@@ -350,6 +368,14 @@ class Engine:
         return transformer.decode_verify(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
             slot_ids, block_tables, self.kv_cache)
+
+    def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
+                           active, keys, temperature, *, steps, mode):
+        return transformer.decode_multi(
+            self.params, self.model_cfg, tokens, positions, block_tables,
+            seq_lens, active, keys, temperature, self.kv_cache, steps=steps,
+            mode=mode, attn_impl=self.attn_impl, mesh=self._attn_mesh,
+            out_mesh=self.mesh)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *, mode):
         return sampling_ops.sample_tokens(
@@ -443,6 +469,83 @@ class Engine:
         return self._append_and_emit([req], new_tokens, from_prefill=True)
 
     # ---- decode -------------------------------------------------------
+
+    def _run_decode_multi(self, batch: ScheduledBatch
+                          ) -> Optional[list[RequestOutput]]:
+        """Run a ``multi_step``-token decode window in one dispatch
+        (transformer.decode_multi): sampled tokens feed the next iteration
+        on device, the host reads the whole (B, S) window once.  Tokens a
+        request cannot use (EOS / max_tokens / stop string mid-window) are
+        dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
+
+        Returns None — before any side effect — when the batch needs
+        per-step host work (penalties, logprobs, top-k/top-p truncation);
+        falls back to the single-step path internally when cache capacity
+        can't cover the window.
+        """
+        S = self._multi_step
+        if any(r.params.needs_penalties or r.params.logprobs is not None
+               or r.params.needs_truncation
+               for r in batch.requests):
+            return None
+        outputs = self._flush_pending()
+        reqs = [r for r in batch.requests if not r.finished]
+        if not reqs:
+            return outputs
+        cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
+        ok = all(r.num_tokens - 1 + S <= cap for r in reqs)
+        if ok:
+            try:
+                # over-reserved blocks on a MemoryError stay attached; the
+                # sequence uses them as it grows or frees them with itself
+                for r in reqs:
+                    self.block_manager.reserve(r.request_id,
+                                               r.num_tokens - 1 + S)
+            except MemoryError:
+                ok = False
+        if not ok:
+            return outputs + self._run_decode(batch)
+        B = self.scheduler.decode_bucket(len(reqs))
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        seq_lens = np.ones((B,), np.int32)
+        active = np.zeros((B,), bool)
+        keys = np.zeros((B, 2), np.uint32)
+        temperature = np.zeros((B,), np.float32)
+        block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq),
+                                np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i] = r.output_token_ids[-1]
+            positions[i] = r.num_tokens - 1
+            seq_lens[i] = r.num_tokens
+            active[i] = True
+            salt = (r.params.seed if r.params.seed is not None
+                    else self.config.seed ^ (hash(r.request_id) & 0x7FFFFFFF))
+            keys[i] = (np.uint32(salt & 0xFFFFFFFF),
+                       np.uint32(len(r.output_token_ids)))
+            temperature[i] = r.params.temperature
+            bt = self.block_manager.block_table(r.request_id)
+            block_tables[i, :len(bt)] = bt
+        mode = ("greedy" if all(r.params.greedy for r in reqs)
+                else "temperature")
+        toks, self.kv_cache = self._exec_decode_multi(
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(block_tables), jnp.asarray(seq_lens),
+            jnp.asarray(active), jnp.asarray(keys),
+            jnp.asarray(temperature), steps=S, mode=mode)
+        self.stats.num_decode_steps += S
+        toks_h = np.asarray(jax.device_get(toks))
+        # Commit the window's written KV BEFORE emitting: a request that
+        # finishes mid-window frees its blocks inside _emit_one.
+        for r in reqs:
+            self.block_manager.advance(r.request_id, S)
+        for i, r in enumerate(reqs):
+            for s in range(S):
+                out = self._emit_one(r, int(toks_h[i, s]))
+                outputs.append(out)
+                if out.finished:
+                    break
+        return outputs
 
     def _run_decode(self, batch: ScheduledBatch) -> list[RequestOutput]:
         outputs: list[RequestOutput] = []
@@ -836,6 +939,18 @@ class Engine:
                 logits, self.kv_cache = self._exec_decode(
                     tokens, positions, slots, bt, seq_lens)
                 self._warm_sampling(logits, sample_modes)
+                if self._multi_step > 1:
+                    # the windowed executable is the steady-state decode
+                    # path; left cold it stalls the first real window
+                    active = jnp.zeros((B,), bool)
+                    keys = jnp.zeros((B, 2), jnp.uint32)
+                    temp = jnp.zeros((B,), jnp.float32)
+                    for mode in ("greedy", "temperature"):
+                        if mode != "greedy" and mode not in sample_modes:
+                            continue
+                        _, self.kv_cache = self._exec_decode_multi(
+                            tokens, positions, bt, seq_lens, active, keys,
+                            temp, steps=self._multi_step, mode=mode)
                 if self._spec is not None:
                     # the speculative verify pass is its own executable;
                     # left cold, the first spec step stalls on its compile

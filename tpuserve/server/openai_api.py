@@ -512,6 +512,10 @@ def main(argv=None):
     ap.add_argument("--speculative-k", type=int, default=0,
                     help="n-gram speculative decoding with k draft tokens "
                          "(0 disables; greedy requests only)")
+    ap.add_argument("--multi-step", type=int, default=None,
+                    help="fused decode window size — S decode+sample steps "
+                         "per dispatch (default: auto — 8 on TPU, off on "
+                         "CPU; 1 disables).  Tokens stream in bursts of S")
     ap.add_argument("--multihost", action="store_true",
                     help="join a multi-host TPU slice via jax.distributed "
                          "(GKE injects TPU_WORKER_* env); process 0 serves, "
@@ -533,7 +537,8 @@ def main(argv=None):
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq),
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
-        attn_impl=args.attn_impl, speculative=spec)
+        attn_impl=args.attn_impl, speculative=spec,
+        multi_step=args.multi_step)
     mesh = None
     if args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh
