@@ -253,17 +253,27 @@ def main(argv=None):
     params = SamplingParams(max_tokens=gen_len, temperature=0.0,
                             ignore_eos=True)
 
-    try:
+    import contextlib
+
+    @contextlib.contextmanager
+    def tpu_guard(what):
+        """The axon tunnel can die mid-run (UNAVAILABLE from a compile 30
+        minutes in).  On TPU that is an infra failure, not a bench failure:
+        fall back so the driver still gets its JSON line.  One policy for
+        every measured section — a guard that misses the REEXEC check
+        would re-exec forever."""
+        try:
+            yield
+        except Exception as e:                    # noqa: BLE001
+            if on_tpu and not os.environ.get("TPUSERVE_BENCH_REEXEC"):
+                _degrade_to_cpu(f"{what} failed mid-flight "
+                                f"({type(e).__name__}: {str(e)[:200]}); "
+                                f"CPU fallback — NOT a TPU result")
+            raise
+
+    with tpu_guard("tpu run"):
         _warm(engine, batch, prompt_len)
         r = _run_workload(engine, prompts, params)
-    except Exception as e:                        # noqa: BLE001
-        # The axon tunnel can die mid-run (UNAVAILABLE from a compile 30
-        # minutes in).  On TPU that is an infra failure, not a bench
-        # failure: fall back so the driver still gets its JSON line.
-        if on_tpu and not os.environ.get("TPUSERVE_BENCH_REEXEC"):
-            _degrade_to_cpu(f"tpu run failed mid-flight ({type(e).__name__}: "
-                            f"{str(e)[:200]}); CPU fallback — NOT a TPU result")
-        raise
 
     stats = r["stats"]
     gen_tokens = r["gen_tokens"]
@@ -309,21 +319,12 @@ def main(argv=None):
                           if stats.num_decode_steps else 0.0,
         }
     if args.compare_disagg:
-        try:
+        with tpu_guard("disagg comparison"):
             d_engine = _build_engine(model, batch, prompt_len, gen_len,
                                      attn_impl=attn_impl, pipeline=pipeline,
                                      disagg=True, multi_step=args.multi_step)
             _warm(d_engine, batch, prompt_len)
             dr = _run_workload(d_engine, prompts, params)
-        except Exception as e:                    # noqa: BLE001
-            # same mid-flight tunnel-death guard as the primary run: the
-            # JSON line must still be emitted
-            if on_tpu and not os.environ.get("TPUSERVE_BENCH_REEXEC"):
-                _degrade_to_cpu(
-                    f"disagg comparison failed mid-flight "
-                    f"({type(e).__name__}: {str(e)[:200]}); CPU fallback — "
-                    f"NOT a TPU result")
-            raise
         d_decode = dr["gen_tokens"] - batch
         d_tok_s = d_decode / dr["decode_s"] if dr["decode_s"] else 0.0
         out["disagg"] = {

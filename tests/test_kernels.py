@@ -75,3 +75,79 @@ def test_paged_decode_single_token_sequence():
     sl = jnp.asarray([1], jnp.int32)
     out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5, interpret=True)
     np.testing.assert_allclose(np.asarray(out), 7.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Paged window attention (chunked prefill / spec verify)
+# ---------------------------------------------------------------------------
+
+def _window_setup(rng, B, C, Hq, Hkv, D, page, nb, mp, max_ctx):
+    """Random cache + a written window at ctx_lens..ctx_lens+chunk_lens."""
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    q = jnp.asarray(rng.standard_normal((B, C, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    # disjoint block tables per sequence
+    bt = np.zeros((B, mp), np.int32)
+    for b in range(B):
+        bt[b] = np.arange(b * mp, (b + 1) * mp) % nb
+    ctx = rng.integers(0, max_ctx + 1, (B,)).astype(np.int32)
+    chunk = rng.integers(1, C + 1, (B,)).astype(np.int32)
+    # keep every window inside the block table
+    cap = mp * page
+    for b in range(B):
+        ctx[b] = min(ctx[b], cap - int(chunk[b]))
+    return (paged_window_attention, q, kc, vc, jnp.asarray(bt),
+            jnp.asarray(ctx), jnp.asarray(chunk))
+
+
+@pytest.mark.parametrize("B,C,Hq,Hkv,D,page,nb,mp,max_ctx,blk_q", [
+    (2, 16, 4, 2, 16, 4, 24, 8, 12, 8),    # GQA, chunk crosses q blocks
+    (1, 32, 8, 8, 64, 16, 16, 8, 90, 16),  # MHA, long context
+    (3, 8, 16, 2, 128, 32, 16, 4, 50, 8),  # deep GQA group, one q block
+])
+def test_paged_window_matches_reference(B, C, Hq, Hkv, D, page, nb, mp,
+                                        max_ctx, blk_q):
+    rng = np.random.default_rng(B * C + Hq)
+    fn, q, kc, vc, bt, ctx, chunk = _window_setup(
+        rng, B, C, Hq, Hkv, D, page, nb, mp, max_ctx)
+    ref = ref_ops.chunked_prefill_attention(q, kc, vc, bt, ctx, chunk,
+                                            D ** -0.5)
+    out = fn(q, kc, vc, bt, ctx, chunk, D ** -0.5, interpret=True,
+             blk_q=blk_q)
+    for b in range(B):
+        n = int(chunk[b])           # rows past chunk_lens are never read
+        np.testing.assert_allclose(np.asarray(out[b, :n]),
+                                   np.asarray(ref[b, :n]), atol=2e-5)
+
+
+def test_paged_window_zero_context():
+    # first chunk of a prompt: pure causal within the window
+    rng = np.random.default_rng(7)
+    fn, q, kc, vc, bt, _, chunk = _window_setup(
+        rng, 2, 16, 4, 2, 32, 4, 16, 8, 0)
+    ctx = jnp.zeros((2,), jnp.int32)
+    ref = ref_ops.chunked_prefill_attention(q, kc, vc, bt, ctx, chunk,
+                                            32 ** -0.5)
+    out = fn(q, kc, vc, bt, ctx, chunk, 32 ** -0.5, interpret=True, blk_q=8)
+    for b in range(2):
+        n = int(chunk[b])
+        np.testing.assert_allclose(np.asarray(out[b, :n]),
+                                   np.asarray(ref[b, :n]), atol=2e-5)
+
+
+def test_paged_window_multi_group():
+    # context long enough to span several DMA page groups
+    rng = np.random.default_rng(11)
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    B, C, Hq, Hkv, D, page, nb, mp = 1, 8, 4, 2, 32, 4, 64, 32
+    fn, q, kc, vc, bt, ctx, chunk = _window_setup(
+        rng, B, C, Hq, Hkv, D, page, nb, mp, 100)
+    ctx = jnp.asarray([100], jnp.int32)
+    chunk = jnp.asarray([8], jnp.int32)
+    ref = ref_ops.chunked_prefill_attention(q, kc, vc, bt, ctx, chunk,
+                                            D ** -0.5)
+    out = paged_window_attention(q, kc, vc, bt, ctx, chunk, D ** -0.5,
+                                 interpret=True, blk_q=8, pages_per_group=3)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                               atol=2e-5)

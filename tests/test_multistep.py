@@ -126,3 +126,29 @@ def test_length_cap_mid_window():
 def test_auto_resolution_off_on_cpu():
     assert _engine(multi_step=None)._multi_step == 1
     assert _engine(multi_step=6)._multi_step == 6
+
+
+def test_chunked_prefill_pallas_matches_reference():
+    """Long prompts route through prefill_chunk; with attn_impl=pallas the
+    paged window kernel (interpret mode on CPU) must produce the same
+    stream as the reference attention."""
+    from tpuserve.runtime.scheduler import SchedulerConfig
+
+    def build(attn_impl):
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=4,
+                                      prefill_chunk_size=8),
+            attn_impl=attn_impl, enable_prefix_caching=False)
+        mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                                 dtype="float32")
+        return Engine(cfg, model_cfg=mc)
+
+    long_prompt = [list(range(1, 21))]       # 20 tokens > chunk size 8
+    params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    ref = build("reference").generate(long_prompt, params)
+    pal = build("pallas").generate(long_prompt, params)
+    assert _ids(pal) == _ids(ref)

@@ -163,11 +163,13 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # Chunked prefill: one bounded chunk of a long prompt against the cache
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_cache",))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
 def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                   slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                  kv_cache: list):
+                  kv_cache: list, *, attn_impl: str = "reference",
+                  mesh=None):
     """Process one chunk of each prompt against the paged cache.
 
     Long prompts run as a sequence of fixed-size chunks (bounded memory and
@@ -182,12 +184,14 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     where last_logits is taken at each sequence's final valid chunk row
     (only meaningful on its last chunk).
 
-    Attention is always the segmented online-softmax implementation in
-    ops/attention.py (no Pallas variant yet, unlike prefill/decode_step) —
-    XLA fuses the per-segment einsums acceptably and memory stays bounded.
+    ``attn_impl="pallas"`` runs the paged window kernel
+    (ops/pallas_chunked_prefill.py); "reference" uses the segmented
+    online-softmax einsum in ops/attention.py.  ``mesh``: static; when set
+    with pallas, the kernel runs head-parallel over tp via shard_map.
     """
     h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
-                                slot_ids, block_tables, kv_cache)
+                                slot_ids, block_tables, kv_cache,
+                                attn_impl=attn_impl, mesh=mesh)
     last_idx = jnp.maximum(chunk_lens - 1, 0)
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
     return _unembed(params, cfg, h_last), new_cache
@@ -200,7 +204,7 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                  slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                 kv_cache: list):
+                 kv_cache: list, *, attn_impl: str = "reference", mesh=None):
     """Shared layer loop for cache-relative windows: writes the window's KV
     and attends against cached context + causal-within-window.  Used by both
     prefill_chunk (last-row logits) and decode_verify (all-row argmax)."""
@@ -215,8 +219,17 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
         cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
         new_cache.append({"k": ck, "v": cv})
-        out = attn_ops.chunked_prefill_attention(
-            q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
+        if attn_impl == "pallas" and mesh is not None:
+            from tpuserve.ops.pallas_tp import paged_window_attention_tp
+            out = paged_window_attention_tp(
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale, mesh)
+        elif attn_impl == "pallas":
+            from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+            out = paged_window_attention(
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
+        else:
+            out = attn_ops.chunked_prefill_attention(
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
         out = out.reshape(B, C, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
@@ -224,11 +237,13 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return h, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_cache",))
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
 def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                   slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                  kv_cache: list):
+                  kv_cache: list, *, attn_impl: str = "reference",
+                  mesh=None):
     """Verify a speculative draft window in one pass.
 
     Same trunk as :func:`prefill_chunk` but returns the greedy argmax at
@@ -241,7 +256,8 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     block_tables: (B, max_blocks).  Returns (pred (B, K) int32, kv_cache).
     """
     h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
-                                slot_ids, block_tables, kv_cache)
+                                slot_ids, block_tables, kv_cache,
+                                attn_impl=attn_impl, mesh=mesh)
     logits = _unembed(params, cfg, h)                       # (B, K, V)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
 

@@ -1,0 +1,193 @@
+"""Pallas TPU paged window attention: a chunk of queries against the cache.
+
+Serves the two cache-relative window paths that previously only had the
+segmented einsum implementation (models/transformer.py `_chunk_trunk`):
+chunked prefill of long prompts and the speculative-decode verify pass.
+One grid program per (sequence, query block); the sequence's KV pages are
+DMA'd from HBM into double-buffered VMEM scratch via the scalar-prefetched
+block table — the same page-group pipeline as the paged decode kernel
+(pallas_paged_attention.py) — with an online softmax over page groups and a
+causal-within-window mask on top of the cached context.
+
+Semantics match ``tpuserve.ops.attention.chunked_prefill_attention``;
+verified against it in interpret mode on CPU.  The reference repo delegates
+all attention to the CUDA kernels inside the vLLM image it deploys
+(reference: kubernetes-single-node.yaml:14; SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# Target K rows per compute iteration (same rationale as the decode kernel:
+# deep enough to amortise relayout/loop overhead, small enough that the
+# double-buffered K+V scratch stays well inside VMEM).
+TARGET_GROUP_ROWS = 512
+
+
+def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
+                   k_scr, v_scr, sems, *, scale, page_size, pages_g,
+                   num_kv_heads, group, head_dim, blk_q):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    ctx = ctx_ref[b]
+    total = ctx + chunk_ref[b]                 # written keys in the cache
+    q_start = ctx + qi * blk_q                 # global position of q row 0
+    # Causal limit for this q block: its last row attends to keys
+    # <= q_start + blk_q - 1; never beyond the written keys.
+    kv_limit = jnp.minimum(total, q_start + blk_q)
+    num_pages = pl.cdiv(kv_limit, page_size)
+    num_groups = pl.cdiv(num_pages, pages_g)
+
+    def start_group(g, slot):
+        def copy_one(j, _):
+            @pl.when(g * pages_g + j < num_pages)
+            def _():
+                page = bt_ref[b, g * pages_g + j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).start()
+            return 0
+        jax.lax.fori_loop(0, pages_g, copy_one, 0)
+
+    def wait_group(g, slot):
+        def wait_one(j, _):
+            @pl.when(g * pages_g + j < num_pages)
+            def _():
+                page = bt_ref[b, g * pages_g + j]
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).wait()
+            return 0
+        jax.lax.fori_loop(0, pages_g, wait_one, 0)
+
+    start_group(0, 0)
+
+    rows_g = pages_g * page_size
+    rows_q = blk_q * group
+    # (blk_q, Hq, D) -> (Hkv, blk_q*G, D): per-kv-head grouped layout so one
+    # (blk_q*G, D) x (D, rows_g) contraction serves each kv head.  Row
+    # ordering within a kv head is (chunk index, group member): r // G is
+    # the chunk index.
+    q_r = jnp.swapaxes(
+        q_ref[0].reshape(blk_q, num_kv_heads, group, head_dim),
+        0, 1).reshape(num_kv_heads, rows_q, head_dim)
+
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (num_kv_heads, rows_q, 1), 1) // group
+
+    m0 = jnp.full((num_kv_heads, rows_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, rows_q, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, rows_q, head_dim), jnp.float32)
+
+    def body(g, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = jax.lax.rem(g, 2)
+
+        @pl.when(g + 1 < num_groups)
+        def _prefetch():
+            start_group(g + 1, 1 - slot)
+
+        wait_group(g, slot)
+        k = jnp.swapaxes(k_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
+                         0, 1)
+        v = jnp.swapaxes(v_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
+                         0, 1)
+        # Zero V rows past THIS PROGRAM'S loaded range: pages beyond
+        # kv_limit are never DMA'd (even when within the written keys —
+        # early q blocks stop at their causal limit), so their scratch is
+        # unspecified (possibly NaN) and 0 * NaN would poison the
+        # accumulator even though those probabilities are 0.
+        row_pos = g * rows_g + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, rows_g, 1), 1)
+        v = jnp.where(row_pos < kv_limit, v, jnp.zeros_like(v))
+        s = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = g * rows_g + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, rows_q, rows_g), 2)
+        mask = kpos <= q_pos                       # causal + context
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((2,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.float32)
+        acc_new = acc_prev * correction + pv
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_groups, body, (m0, l0, acc0))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / safe_l                            # (Hkv, blk_q*G, D)
+    out = out.reshape(num_kv_heads, blk_q, group, head_dim)
+    o_ref[0] = jnp.swapaxes(out, 0, 1).reshape(
+        blk_q, num_kv_heads * group, head_dim).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "blk_q",
+                                             "pages_per_group"))
+def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                           v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                           ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
+                           scale: float, interpret: bool | None = None,
+                           blk_q: int = 128,
+                           pages_per_group: int | None = None) -> jnp.ndarray:
+    """q: (B, C, Hq, D) window queries; k_cache/v_cache: (num_blocks, page,
+    Hkv, D) with the window's KV already written; block_tables: (B,
+    max_pages) int32; ctx_lens/chunk_lens: (B,). -> (B, C, Hq, D).
+
+    Query row i of sequence b sits at global position ``ctx_lens[b] + i``
+    and attends causally to every key at or before it.  Rows past
+    ``chunk_lens[b]`` produce zeros (never read by the engine).
+    """
+    B, C, Hq, D = q.shape
+    num_blocks, page_size, Hkv, _ = k_cache.shape
+    max_pages = block_tables.shape[1]
+    group = Hq // Hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    blk_q = min(blk_q, C)
+    pages_g = pages_per_group or max(1, -(-TARGET_GROUP_ROWS // page_size))
+    pages_g = min(pages_g, max_pages)
+
+    kernel = functools.partial(
+        _window_kernel, scale=scale, page_size=page_size, pages_g=pages_g,
+        num_kv_heads=Hkv, group=group, head_dim=D, blk_q=blk_q)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, pl.cdiv(C, blk_q)),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, Hq, D),
+                         lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, Hq, D),
+                               lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
+            pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, pages_g)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables, ctx_lens, chunk_lens, q, k_cache, v_cache)

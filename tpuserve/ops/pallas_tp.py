@@ -54,6 +54,24 @@ def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
     return fn(q, k_cache, v_cache, block_tables, seq_lens)
 
 
+def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
+                              chunk_lens, scale: float, mesh: Mesh):
+    """Head-parallel paged window attention (chunked prefill) over tp.
+
+    q: (B, C, Hq, D) head-sharded; k/v_cache kv-head-sharded;
+    block_tables/ctx_lens/chunk_lens replicated.
+    """
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    q_spec = P(None, None, AXIS_TP, None)
+    kv_spec = P(None, None, AXIS_TP, None)
+    fn = shard_map(
+        partial(paged_window_attention, scale=scale),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None), P(None)),
+        out_specs=q_spec, **_CHECK_KWARG)
+    return fn(q, k_cache, v_cache, block_tables, ctx_lens, chunk_lens)
+
+
 def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,
                                mesh: Mesh):
     """Head-parallel flash prefill attention over the tp axis.

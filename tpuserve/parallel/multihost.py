@@ -151,7 +151,8 @@ class MultihostCoordinator:
         return transformer.prefill_chunk(
             eng.params, eng.model_cfg, jnp.asarray(tokens),
             jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
-            jnp.asarray(slot_ids), jnp.asarray(block_tables), eng.kv_cache)
+            jnp.asarray(slot_ids), jnp.asarray(block_tables), eng.kv_cache,
+            attn_impl=eng.attn_impl, mesh=eng._attn_mesh)
 
     def _decode_multi(self, tokens, positions, block_tables, seq_lens,
                       active, keys, temperature, *, steps, mode):
@@ -264,7 +265,8 @@ def follower_loop(engine) -> None:
             logits, engine.kv_cache = transformer.prefill_chunk(
                 engine.params, engine.model_cfg, jnp.asarray(tokens),
                 jnp.asarray(ctx_lens), jnp.asarray(chunk_lens),
-                jnp.asarray(slots), jnp.asarray(bt), engine.kv_cache)
+                jnp.asarray(slots), jnp.asarray(bt), engine.kv_cache,
+                attn_impl=engine.attn_impl, mesh=engine._attn_mesh)
         elif op == OP_SAMPLE:
             keys = _broadcast(np.zeros((B, 2), np.uint32))
             temperature = _broadcast(np.zeros((B,), np.float32))

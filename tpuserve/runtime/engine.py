@@ -337,6 +337,33 @@ class Engine:
         self._rng_key, sub = jax.random.split(self._rng_key)
         return sub
 
+    def _row_key(self, req: Request, extra_step: int = 0) -> tuple:
+        """Per-row sampling key (salt, step): deterministic for seeded
+        requests no matter which batches/windows the request lands in.
+        Single source of truth — the fused-window and single-step paths
+        must derive keys identically or seeded streams diverge between
+        multi_step settings."""
+        salt = (req.params.seed if req.params.seed is not None
+                else self.config.seed ^ (hash(req.request_id) & 0x7FFFFFFF))
+        step = len(req.output_token_ids) + extra_step
+        return (np.uint32(salt & 0xFFFFFFFF), np.uint32(step))
+
+    def _try_reserve_window(self, reqs: list[Request], window: int) -> bool:
+        """Reserve ``window`` KV slots past each request's written tokens
+        (fused decode windows, speculative draft windows).  On failure the
+        over-reserved blocks of earlier requests stay attached — they're
+        used as the sequence grows or freed with it."""
+        cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
+        if any(r.num_tokens - 1 + window > cap for r in reqs):
+            return False
+        try:
+            for r in reqs:
+                self.block_manager.reserve(r.request_id,
+                                           r.num_tokens - 1 + window)
+        except MemoryError:
+            return False
+        return True
+
     # ---- execution hooks (multi-host coordinators wrap these to broadcast
     # each step to follower processes before running it — parallel/multihost).
     # EVERY transformer.* / sample_tokens call in this class goes through a
@@ -358,13 +385,17 @@ class Engine:
                             block_tables):
         return transformer.prefill_chunk(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
-            slot_ids, block_tables, self.kv_cache)
+            slot_ids, block_tables, self.kv_cache,
+            attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_decode_verify(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables):
         # Speculative decoding is single-process only (gated in __init__),
         # so no coordinator wraps this hook; it exists so the AST coverage
         # test can hold the "no direct transformer calls" line everywhere.
+        # Verify windows are a handful of rows — below the Pallas kernel's
+        # tiling minima and cheap for the segmented einsum — so this stays
+        # on the reference attention regardless of attn_impl.
         return transformer.decode_verify(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
             slot_ids, block_tables, self.kv_cache)
@@ -492,18 +523,7 @@ class Engine:
         reqs = [r for r in batch.requests if not r.finished]
         if not reqs:
             return outputs
-        cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
-        ok = all(r.num_tokens - 1 + S <= cap for r in reqs)
-        if ok:
-            try:
-                # over-reserved blocks on a MemoryError stay attached; the
-                # sequence uses them as it grows or frees them with itself
-                for r in reqs:
-                    self.block_manager.reserve(r.request_id,
-                                               r.num_tokens - 1 + S)
-            except MemoryError:
-                ok = False
-        if not ok:
+        if not self._try_reserve_window(reqs, S):
             return outputs + self._run_decode(batch)
         B = self.scheduler.decode_bucket(len(reqs))
         tokens = np.zeros((B,), np.int32)
@@ -519,10 +539,7 @@ class Engine:
             positions[i] = r.num_tokens - 1
             seq_lens[i] = r.num_tokens
             active[i] = True
-            salt = (r.params.seed if r.params.seed is not None
-                    else self.config.seed ^ (hash(r.request_id) & 0x7FFFFFFF))
-            keys[i] = (np.uint32(salt & 0xFFFFFFFF),
-                       np.uint32(len(r.output_token_ids)))
+            keys[i] = self._row_key(r)
             temperature[i] = r.params.temperature
             bt = self.block_manager.block_table(r.request_id)
             block_tables[i, :len(bt)] = bt
@@ -653,23 +670,13 @@ class Engine:
             r.prompt_token_ids + r.output_token_ids, k,
             self._spec.max_ngram, self._spec.min_ngram,
             self._spec.max_lookback) for r in reqs]
-        cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
         # The verify pass costs every row ~(k+1)x a decode step; it only
         # pays when enough of the batch actually has drafts to accept.
         coverage = sum(1 for d in drafts if d) / len(drafts)
         if (coverage < self._spec.min_batch_coverage
-                or any(r.num_tokens - 1 + K > cap for r in reqs)):
+                or not self._try_reserve_window(reqs, K)):
             return outputs + self._run_decode(batch)
-        base = []
-        try:
-            for r in reqs:
-                nt = r.num_tokens - 1            # input-token position
-                self.block_manager.reserve(r.request_id, nt + K)
-                base.append(nt)
-        except MemoryError:
-            # over-reserved blocks stay attached; they're used as the
-            # sequence grows or freed with it
-            return outputs + self._run_decode(batch)
+        base = [r.num_tokens - 1 for r in reqs]  # input-token positions
         B = self.scheduler.decode_bucket(len(reqs))
         tokens = np.zeros((B, K), np.int32)
         slot_ids = np.full((B, K), PAD_SLOT, np.int32)
@@ -756,13 +763,8 @@ class Engine:
             temperature[i] = r.params.temperature
             top_k[i] = r.params.top_k
             top_p[i] = r.params.top_p
-            # Per-row key: deterministic for seeded requests no matter
-            # which batches the request lands in.
-            salt = (r.params.seed if r.params.seed is not None
-                    else self.config.seed ^ (hash(r.request_id) & 0x7FFFFFFF))
-            step = len(r.output_token_ids) + (1 if r.request_id in in_flight
-                                              else 0)
-            keys[i] = (np.uint32(salt & 0xFFFFFFFF), np.uint32(step))
+            keys[i] = self._row_key(
+                r, extra_step=1 if r.request_id in in_flight else 0)
         return self._exec_sample(
             logits, jnp.asarray(keys), jnp.asarray(temperature),
             jnp.asarray(top_k), jnp.asarray(top_p), mode=mode)
