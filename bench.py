@@ -98,7 +98,7 @@ def _ensure_live_backend(retry: bool = True) -> None:
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
-                  prefix_caching=False, multi_step=None):
+                  prefix_caching=False, multi_step=None, quantization=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -122,7 +122,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     cfg = EngineConfig(model=model, cache=cache, scheduler=sched,
                        attn_impl=attn_impl, enable_prefix_caching=prefix_caching,
                        pipeline_decode=pipeline, speculative=spec,
-                       multi_step=multi_step)
+                       multi_step=multi_step, quantization=quantization)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -182,6 +182,8 @@ def main(argv=None):
     ap.add_argument("--multi-step", type=int, default=None, metavar="S",
                     help="fused decode window size (default: auto — 8 on "
                          "TPU, off on CPU); 1 disables")
+    ap.add_argument("--quant", default=None, choices=["int8"],
+                    help="weight-only quantization variant")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K draft tokens on a "
                          "repetitive-prompt workload")
@@ -235,7 +237,8 @@ def main(argv=None):
     pipeline = False if args.no_pipeline else None
     engine = _build_engine(model, batch, prompt_len, gen_len,
                            attn_impl=attn_impl, pipeline=pipeline,
-                           spec_k=args.spec, multi_step=args.multi_step)
+                           spec_k=args.spec, multi_step=args.multi_step,
+                           quantization=args.quant)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -295,6 +298,7 @@ def main(argv=None):
         "backend": jax.default_backend(),
         "attn_impl": eng0.attn_impl,
         "multi_step": eng0._multi_step,
+        "quantization": eng0.config.quantization,
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -322,7 +326,8 @@ def main(argv=None):
         with tpu_guard("disagg comparison"):
             d_engine = _build_engine(model, batch, prompt_len, gen_len,
                                      attn_impl=attn_impl, pipeline=pipeline,
-                                     disagg=True, multi_step=args.multi_step)
+                                     disagg=True, multi_step=args.multi_step,
+                                     quantization=args.quant)
             _warm(d_engine, batch, prompt_len)
             dr = _run_workload(d_engine, prompts, params)
         d_decode = dr["gen_tokens"] - batch

@@ -51,7 +51,15 @@ def _norm(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
 
 
 def _linear(x: jnp.ndarray, p: dict) -> jnp.ndarray:
-    y = x @ p["kernel"]
+    w = p["kernel"]
+    if "scale" in p:
+        # int8 weight-only quantization (models/weights.py
+        # quantize_params_int8): XLA fuses the convert into the matmul
+        # loop, so HBM reads int8 while the MXU runs at its bf16 rate; the
+        # per-output-channel scale applies after the contraction.
+        y = (x @ w.astype(x.dtype)) * p["scale"].astype(x.dtype)
+    else:
+        y = x @ w
     if "bias" in p:
         y = y + p["bias"].astype(y.dtype)
     return y
@@ -97,6 +105,10 @@ def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray):
 def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
            positions: jnp.ndarray) -> jnp.ndarray:
     h = params["embed"]["weight"][tokens]
+    if "scale" in params["embed"]:        # int8 embed: per-vocab-row scale
+        dtype = jnp.dtype(cfg.dtype)
+        h = (h.astype(dtype)
+             * params["embed"]["scale"][tokens][..., None].astype(dtype))
     if cfg.pos == "learned":
         h = h + params["pos_embed"]["weight"][positions + cfg.learned_pos_offset]
     return h
@@ -106,7 +118,11 @@ def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
     if cfg.final_layernorm:
         h = _norm(h, params["final_norm"], cfg)
     if cfg.tie_word_embeddings:
-        logits = h @ params["embed"]["weight"].T
+        ew = params["embed"]
+        if "scale" in ew:                 # tied int8: scale per logit column
+            logits = (h @ ew["weight"].T.astype(h.dtype)) * ew["scale"][None, :]
+        else:
+            logits = h @ ew["weight"].T
     else:
         logits = _linear(h, params["lm_head"])
     return logits.astype(jnp.float32)

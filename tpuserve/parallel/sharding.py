@@ -30,24 +30,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def _spec_for(path: str, cfg: ModelConfig) -> P:
     """PartitionSpec for one param, keyed on its pytree path string."""
-    # column-parallel kernels: (in, out) with out sharded
+    # column-parallel kernels: (in, out) with out sharded; int8 per-output
+    # quantization scales follow the out axis like biases
     if any(k in path for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
                                "up_proj", "fc1")):
         if path.endswith("kernel"):
             return P(None, AXIS_TP)
-        if path.endswith("bias"):
+        if path.endswith("bias") or path.endswith("scale"):
             return P(AXIS_TP)
-    # row-parallel kernels: (in, out) with in sharded; bias replicated
+    # row-parallel kernels: (in, out) with in sharded; bias and per-output
+    # scale replicated (the scale distributes over the psum of partials)
     if any(k in path for k in ("o_proj", "down_proj", "fc2")):
         if path.endswith("kernel"):
             return P(AXIS_TP, None)
         return P()
-    # vocab-parallel embeddings
+    # vocab-parallel embeddings; int8 per-vocab-row scale follows the vocab
+    # shards
     if path.startswith("embed.") or path.startswith("lm_head."):
         if path.endswith("weight"):
             return P(AXIS_TP, None)         # embed.weight: (V, H)
         if path.endswith("kernel"):
             return P(None, AXIS_TP)         # lm_head.kernel: (H, V)
+        if path.endswith("scale"):
+            return P(AXIS_TP)               # (V,) quantization scale
     # position tables, norms, qk-norm scales: replicated
     return P()
 

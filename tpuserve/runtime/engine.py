@@ -46,6 +46,10 @@ logger = logging.getLogger("tpuserve.engine")
 class EngineConfig:
     model: str = "Qwen/Qwen3-0.6B"
     checkpoint_dir: Optional[str] = None      # HF safetensors dir; None = random init
+    # Weight-only quantization: "int8" halves the per-step HBM weight
+    # traffic that bounds decode throughput (models/weights.py
+    # quantize_params_int8).  None = full precision.
+    quantization: Optional[str] = None
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     attn_impl: str = "auto"                   # "auto" | "reference" | "pallas"
@@ -135,6 +139,10 @@ class Engine:
     def __init__(self, config: EngineConfig, *, params=None,
                  model_cfg: ModelConfig | None = None, mesh=None):
         self.config = config
+        if config.quantization not in (None, "int8"):
+            # reject before the (potentially multi-GB) checkpoint load
+            raise ValueError(f"unknown quantization {config.quantization!r};"
+                             " supported: int8")
         self.model_cfg = model_cfg or get_model_config(config.model)
         self.cache_cfg = config.cache
         self.attn_impl = config.resolve_attn_impl()
@@ -143,6 +151,10 @@ class Engine:
                                         vocab_size=self.model_cfg.vocab_size)
         if params is None:
             params = load_or_init(self.model_cfg, config.checkpoint_dir, config.seed)
+        if config.quantization == "int8":
+            from tpuserve.models.weights import quantize_params_int8
+            if "scale" not in params["embed"]:    # not already quantized
+                params = quantize_params_int8(params)
         self.params = params
         if mesh is not None:
             # Tensor-parallel placement: GSPMD inserts the ICI collectives.

@@ -516,6 +516,9 @@ def main(argv=None):
                     help="fused decode window size — S decode+sample steps "
                          "per dispatch (default: auto — 8 on TPU, off on "
                          "CPU; 1 disables).  Tokens stream in bursts of S")
+    ap.add_argument("--quantization", default=None, choices=["int8"],
+                    help="weight-only quantization (int8 halves decode's "
+                         "HBM weight traffic)")
     ap.add_argument("--multihost", action="store_true",
                     help="join a multi-host TPU slice via jax.distributed "
                          "(GKE injects TPU_WORKER_* env); process 0 serves, "
@@ -538,7 +541,7 @@ def main(argv=None):
                           max_blocks_per_seq=args.max_blocks_per_seq),
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
         attn_impl=args.attn_impl, speculative=spec,
-        multi_step=args.multi_step)
+        multi_step=args.multi_step, quantization=args.quantization)
     mesh = None
     if args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh
