@@ -1,0 +1,168 @@
+"""Cross-pod disaggregated prefill/decode (parallel/disagg_net.py): wire
+codec, the prefill-pod facade, and the full two-server HTTP path.
+
+llm-d's deployment shape is separate, independently-scalable prefill and
+decode pools (reference: llm-d-deploy.yaml:147-151); here the prefill pod
+prefills locally, POSTs the sequence's KV pages to the decode pod's
+/internal/migrate, and relays the streamed tokens back.  Both engines are
+built with the same seed, so the cross-pod stream must exactly equal a
+colocated engine's greedy stream.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.parallel import disagg_net
+from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.kv_cache import CacheConfig
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.scheduler import SchedulerConfig
+
+
+def _ecfg(**kw):
+    return EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=4),
+        attn_impl="reference", **kw)
+
+
+# ---------------------------------------------------------------------------
+# Wire codec
+# ---------------------------------------------------------------------------
+
+def test_migration_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    import ml_dtypes
+    seq_kv = [{"k": rng.standard_normal((2, 4, 2, 8)).astype(ml_dtypes.bfloat16),
+               "v": rng.standard_normal((2, 4, 2, 8)).astype(np.float32)}
+              for _ in range(3)]
+    meta = {"request_id": "r1", "prompt_token_ids": [1, 2, 3],
+            "first_token": 7, "params": disagg_net.sampling_to_dict(
+                SamplingParams(max_tokens=5, temperature=0.5, seed=3))}
+    blob = disagg_net.serialize_migration(meta, seq_kv)
+    meta2, kv2 = disagg_net.deserialize_migration(blob)
+    assert meta2["request_id"] == "r1"
+    assert disagg_net.sampling_from_dict(meta2["params"]).seed == 3
+    for a, b in zip(seq_kv, kv2):
+        assert a["k"].dtype == b["k"].dtype
+        np.testing.assert_array_equal(np.asarray(a["k"], np.float32),
+                                      np.asarray(b["k"], np.float32))
+        np.testing.assert_array_equal(a["v"], b["v"])
+
+
+def test_migration_codec_rejects_garbage():
+    with pytest.raises(ValueError, match="migration"):
+        disagg_net.deserialize_migration(b"nope" + b"\x00" * 64)
+
+
+# ---------------------------------------------------------------------------
+# Full cross-pod path over HTTP: decode server + prefill facade
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def decode_server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    eng = Engine(_ecfg())
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0,
+                                         allow_kv_migration=True))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}", eng
+    srv.shutdown()
+
+
+def test_cross_pod_stream_matches_colocated(decode_server):
+    url, decode_eng = decode_server
+    handoff = disagg_net.PrefillHandoffEngine(_ecfg(), url)
+    params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [[5, 6, 7], [11, 12, 13, 14, 15]]
+    reqs = handoff.generate(prompts, params)
+    colocated = Engine(_ecfg()).generate(prompts, params)
+    assert [r.output_token_ids for r in reqs] == \
+        [r.output_token_ids for r in colocated]
+    # the prefill pod holds no KV after the handoff; the decode pod drained
+    assert handoff.prefill.block_manager.num_seqs() == 0
+    assert decode_eng.block_manager.num_seqs() == 0
+
+
+def test_cross_pod_decode_pool_full_backpressure():
+    # a decode pool without enough free KV blocks 503s the migration; after
+    # the bounded retries the prefill pod surfaces an aborted request
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    tiny = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=4, max_blocks_per_seq=4,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=4),
+        attn_impl="reference")
+    eng = Engine(tiny)
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0,
+                                         allow_kv_migration=True))
+    port = srv.start()
+    try:
+        handoff = disagg_net.PrefillHandoffEngine(
+            _ecfg(), f"http://127.0.0.1:{port}")
+        handoff.MIGRATE_RETRIES = 1
+        [req] = handoff.generate(
+            [list(range(1, 14))],        # needs 5 blocks; the pool has 4
+            [SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)])
+        from tpuserve.runtime.request import FinishReason
+        assert req.finish_reason == FinishReason.ABORT
+    finally:
+        srv.shutdown()
+
+
+def test_cross_pod_server_to_server(decode_server):
+    """Completions POSTed to a prefill-role server stream tokens produced
+    by the decode pod."""
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    url, _ = decode_server
+    handoff = disagg_net.PrefillHandoffEngine(_ecfg(), url)
+    srv = OpenAIServer(handoff, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"model": "tiny-qwen3", "prompt": "hello pods",
+                             "max_tokens": 6, "temperature": 0,
+                             "ignore_eos": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert body["usage"]["completion_tokens"] == 6
+        assert body["choices"][0]["finish_reason"] == "length"
+    finally:
+        srv.shutdown()
+
+
+def test_manifests_cross_pod_topology():
+    from tpuserve.provision.config import DeployConfig
+    from tpuserve.provision.manifests import serving_manifests
+    cfg = DeployConfig(disaggregated=True, disagg_cross_pod=True,
+                       prefill_replicas=2, decode_replicas=3,
+                       provider="local", build_image=False)
+    objs = serving_manifests(cfg)
+    by_name = {o["metadata"]["name"]: o for o in objs
+               if o["kind"] == "Deployment"}
+    assert by_name["tpuserve-prefill"]["spec"]["replicas"] == 2
+    assert by_name["tpuserve-decode"]["spec"]["replicas"] == 3
+    p_args = by_name["tpuserve-prefill"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert "--role" in p_args and "prefill" in p_args
+    assert "--decode-url" in p_args
+    d_args = by_name["tpuserve-decode"]["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert "decode" in d_args
+    svcs = {o["metadata"]["name"] for o in objs if o["kind"] == "Service"}
+    assert {"tpuserve-prefill", "tpuserve-decode"} <= svcs
+    gw = next(o for o in objs if o["metadata"]["name"].startswith(
+        "tpuserve-gateway") and o["kind"] == "Deployment")
+    gw_args = gw["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert any("tpuserve-prefill" in a for a in gw_args)

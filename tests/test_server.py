@@ -227,3 +227,51 @@ def test_tracer_records_exceptions():
     with t.request_span("ok"):
         pass
     assert seen["exc_info"] == (None, None, None)
+
+
+def test_n_choices(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "count with me", "max_tokens": 5,
+        "temperature": 0.9, "seed": 11, "n": 3, "ignore_eos": True})
+    assert status == 200
+    assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+    assert len(body["choices"]) == 3
+    assert body["usage"]["completion_tokens"] == 15
+    # re-running with the same seed reproduces the same choice set
+    _, body2 = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "count with me", "max_tokens": 5,
+        "temperature": 0.9, "seed": 11, "n": 3, "ignore_eos": True})
+    assert [c["text"] for c in body["choices"]] == \
+        [c["text"] for c in body2["choices"]]
+
+
+def test_n_choices_chat_and_bounds(server):
+    status, body = _post(server + "/v1/chat/completions", {
+        "model": "tiny-qwen3", "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0.5, "n": 2, "ignore_eos": True})
+    assert status == 200
+    assert len(body["choices"]) == 2
+    assert body["choices"][1]["message"]["role"] == "assistant"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "n": 99})
+    assert e.value.code == 400
+
+
+def test_n_choices_streaming(server):
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": "tiny-qwen3", "prompt": "stream n",
+                         "max_tokens": 4, "temperature": 0.7, "seed": 3,
+                         "n": 2, "stream": True,
+                         "ignore_eos": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    chunks = [json.loads(line[len("data: "):]) for line in raw.splitlines()
+              if line.startswith("data: ") and "[DONE]" not in line]
+    seen = {c["choices"][0]["index"] for c in chunks}
+    assert seen == {0, 1}
+    finished = [c for c in chunks
+                if c["choices"][0]["finish_reason"] == "length"]
+    assert len(finished) == 2

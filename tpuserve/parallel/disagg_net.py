@@ -184,6 +184,13 @@ class PrefillHandoffEngine:
                 outputs.append(self._relayed.get_nowait())
             except queue.Empty:
                 break
+        if not outputs and not self.prefill.scheduler.has_work():
+            # Only relays in flight: block briefly for the next streamed
+            # token so the runner loop doesn't spin on empty steps.
+            try:
+                outputs.append(self._relayed.get(timeout=0.02))
+            except queue.Empty:
+                pass
         return outputs
 
     # -- migration ------------------------------------------------------

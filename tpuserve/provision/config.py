@@ -35,6 +35,14 @@ class DeployConfig:
     replicas: int = 1                      # DP via replica count + gateway LB
     tensor_parallel: int = 4               # chips per replica, sharded over ICI
     disaggregated: bool = False            # prefill/decode pool split (llm-d topology)
+    # Cross-pod disaggregation: SEPARATE prefill and decode Deployments,
+    # independently scalable (llm-d's actual topology; KV rides the pod
+    # network via /internal/migrate — parallel/disagg_net.py).  False keeps
+    # both pools in one pod with the KV handoff over ICI, which is strictly
+    # cheaper within a slice (parallel/disagg.py).
+    disagg_cross_pod: bool = False
+    prefill_replicas: int = 1              # cross-pod: prefill pool size
+    decode_replicas: int = 1               # cross-pod: decode pool size
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
@@ -73,6 +81,9 @@ class DeployConfig:
             raise ValueError(f"unknown provider {self.provider!r}")
         if self.tensor_parallel < 1 or self.replicas < 1:
             raise ValueError("replicas and tensor_parallel must be >= 1")
+        if self.prefill_replicas < 1 or self.decode_replicas < 1:
+            raise ValueError("prefill_replicas and decode_replicas must "
+                             "be >= 1")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.

@@ -380,12 +380,32 @@ def serving_manifests(cfg: DeployConfig) -> list[dict]:
         objs.append(gateway_deployment(cfg, backends))
         objs.append(gateway_service(cfg))
         return objs
-    if cfg.disaggregated:
+    if cfg.disaggregated and cfg.disagg_cross_pod:
+        # Cross-pod disaggregation: SEPARATE prefill and decode pools,
+        # independently scalable (llm-d's actual deployment shape,
+        # llm-d-deploy.yaml:147-151).  Completions hit the prefill pool;
+        # each sequence's KV migrates to the decode pool over the pod
+        # network via /internal/migrate (parallel/disagg_net.py), and the
+        # decode pod streams tokens back through the same connection.
+        decode_url = (f"http://tpuserve-decode.{cfg.namespace}"
+                      f".svc.cluster.local:{cfg.engine_port}")
+        objs.append(engine_deployment(
+            cfg, role="decode", replicas=cfg.decode_replicas,
+            extra_args=["--role", "decode"]))
+        objs.append(engine_service(cfg, role="decode"))
+        objs.append(engine_deployment(
+            cfg, role="prefill", replicas=cfg.prefill_replicas,
+            extra_args=["--role", "prefill", "--decode-url", decode_url]))
+        objs.append(engine_service(cfg, role="prefill"))
+        backends = [f"http://tpuserve-prefill.{cfg.namespace}"
+                    f".svc.cluster.local:{cfg.engine_port}"]
+    elif cfg.disaggregated:
         # Disaggregated prefill/decode (llm-d's headline topology, SURVEY.md
         # §2.2; BASELINE 'Llama-3-8B disaggregated' config).  TPU-idiomatic
-        # form: each pod runs BOTH pools in-process with KV handoff over ICI
-        # within its slice (tpuserve/parallel/disagg.py) — not separate
-        # network-connected pods, because ICI beats any pod-to-pod path.
+        # default form: each pod runs BOTH pools in-process with KV handoff
+        # over ICI within its slice (tpuserve/parallel/disagg.py) — ICI
+        # beats any pod-to-pod path; set disagg_cross_pod for independent
+        # pool scaling at the cost of a network KV hop.
         objs.append(engine_deployment(cfg, role="disagg",
                                       extra_args=["--disagg"]))
         objs.append(engine_service(cfg, role="disagg"))

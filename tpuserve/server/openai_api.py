@@ -156,6 +156,14 @@ class OpenAIServer:
 
     # ---- request handling (called from handler threads) ----------------
 
+    MAX_CHOICES = 8
+
+    def parse_n(self, body: dict) -> int:
+        n = body.get("n", 1)
+        if not isinstance(n, int) or not 1 <= n <= self.MAX_CHOICES:
+            raise ValueError(f"'n' must be an integer in 1..{self.MAX_CHOICES}")
+        return n
+
     def handle_completion(self, body: dict, chat: bool):
         if chat:
             messages = body.get("messages")
@@ -270,6 +278,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = self._read_body()
             prompt, params = self.ctx.handle_completion(body, chat)
+            n = self.ctx.parse_n(body)
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, str(e))
             return
@@ -286,9 +295,9 @@ class _Handler(BaseHTTPRequestHandler):
                     # _stream_response owns its error handling: once SSE
                     # headers are out, a second status line would corrupt
                     # the stream.
-                    self._stream_response(body, params, chat, kwargs)
+                    self._stream_response(body, params, chat, kwargs, n)
                 else:
-                    self._full_response(body, params, chat, kwargs)
+                    self._full_response(body, params, chat, kwargs, n)
         except BrokenPipeError:
             pass
         except Exception as e:               # engine-side failure, pre-headers
@@ -366,67 +375,107 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- response shapes ------------------------------------------------
 
-    def _full_response(self, body, params, chat, kwargs):
+    @staticmethod
+    def _choice_params(params, i: int, n: int):
+        """Per-choice sampling params for n > 1: a seeded request's choices
+        sample distinct deterministic streams (seed+i); unseeded requests
+        already decorrelate via their per-request salt.  (The choices share
+        prompt KV through the prefix cache, on by default.)"""
+        if n == 1 or params.seed is None:
+            return params
+        return dataclasses.replace(params, seed=params.seed + i)
+
+    def _submit_choices(self, params, kwargs, n):
+        """Submit the n per-choice requests; if one fails mid-list, abort
+        the already-accepted ones so they don't generate to max_tokens and
+        leak their engine records."""
         ctx = self.ctx
-        t0 = time.monotonic()
-        rid, q = ctx.runner.submit(params=params, **kwargs)
-        text_parts, token_ids, logprob_entries = [], [], []
-        finish_reason = "stop"
-        deadline = t0 + ctx.config.request_timeout_s
-        import queue as _queue
-        while True:
-            try:
-                item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
-            except _queue.Empty:
-                # Abandoning without aborting would leave the engine
-                # generating to max_tokens and leak the record.
+        submits = []
+        try:
+            for i in range(n):
+                submits.append(ctx.runner.submit(
+                    params=self._choice_params(params, i, n), **kwargs))
+        except Exception:
+            for rid, _ in submits:
                 ctx.runner.abort(rid)
                 ctx.engine.requests.pop(rid, None)
-                self._error(504, "request timed out", "server_error")
-                return
-            if item is None:
-                break
-            if isinstance(item, Exception):
+            raise
+        return submits
+
+    def _full_response(self, body, params, chat, kwargs, n=1):
+        ctx = self.ctx
+        t0 = time.monotonic()
+        submits = self._submit_choices(params, kwargs, n)
+        deadline = t0 + ctx.config.request_timeout_s
+        import queue as _queue
+
+        def fail(code, message, etype="invalid_request_error"):
+            for rid, _ in submits:
+                ctx.runner.abort(rid)
                 ctx.engine.requests.pop(rid, None)
-                if isinstance(item, ValueError):   # rejected at intake
-                    self._error(400, str(item))
-                else:                              # engine-side fault
-                    self._error(500, str(item), "server_error")
-                return
-            text_parts.append(item.new_text)
-            token_ids.extend(item.new_token_ids)
-            if item.finish_reason is not None:
-                finish_reason = item.finish_reason.value
-        req = ctx.engine.requests.pop(rid, None)
-        text = "".join(text_parts)
-        if req is not None and params.logprobs is not None:
-            logprob_entries = req.logprobs
+            self._error(code, message, etype)
+
+        choices = []
+        prompt_tokens = 0
+        completion_tokens = 0
+        for idx, (rid, q) in enumerate(submits):
+            text_parts, token_ids, logprob_entries = [], [], []
+            finish_reason = "stop"
+            while True:
+                try:
+                    item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+                except _queue.Empty:
+                    # Abandoning without aborting would leave the engine
+                    # generating to max_tokens and leak the record.
+                    fail(504, "request timed out", "server_error")
+                    return
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    if isinstance(item, ValueError):   # rejected at intake
+                        fail(400, str(item))
+                    else:                              # engine-side fault
+                        fail(500, str(item), "server_error")
+                    return
+                text_parts.append(item.new_text)
+                token_ids.extend(item.new_token_ids)
+                if item.finish_reason is not None:
+                    finish_reason = item.finish_reason.value
+            req = ctx.engine.requests.pop(rid, None)
+            text = "".join(text_parts)
+            if req is not None and params.logprobs is not None:
+                logprob_entries = req.logprobs
+            if req is not None:
+                prompt_tokens = req.num_prompt_tokens
+            completion_tokens += len(token_ids)
+            if chat:
+                choice = {"index": idx,
+                          "message": {"role": "assistant", "content": text},
+                          "finish_reason": finish_reason}
+            else:
+                choice = {"index": idx, "text": text,
+                          "finish_reason": finish_reason}
+                if logprob_entries:
+                    choice["logprobs"] = {
+                        "token_logprobs": [e["logprob"] for e in logprob_entries],
+                        "tokens": [e["token_id"] for e in logprob_entries],
+                        "top_logprobs": [dict(e["top"]) for e in logprob_entries],
+                    }
+            choices.append(choice)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         usage = {
-            "prompt_tokens": req.num_prompt_tokens if req else None,
-            "completion_tokens": len(token_ids),
-            "total_tokens": (req.num_prompt_tokens if req else 0) + len(token_ids),
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
         }
-        if chat:
-            choice = {"index": 0, "message": {"role": "assistant", "content": text},
-                      "finish_reason": finish_reason}
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "text": text, "finish_reason": finish_reason}
-            if logprob_entries:
-                choice["logprobs"] = {
-                    "token_logprobs": [e["logprob"] for e in logprob_entries],
-                    "tokens": [e["token_id"] for e in logprob_entries],
-                    "top_logprobs": [dict(e["top"]) for e in logprob_entries],
-                }
-            obj = "text_completion"
+        obj = "chat.completion" if chat else "text_completion"
         self._json(200, {"id": oid, "object": obj, "created": int(time.time()),
-                         "model": ctx.model_name, "choices": [choice],
+                         "model": ctx.model_name, "choices": choices,
                          "usage": usage})
 
-    def _stream_response(self, body, params, chat, kwargs):
+    def _stream_response(self, body, params, chat, kwargs, n=1):
         ctx = self.ctx
-        rid, q = ctx.runner.submit(params=params, **kwargs)
+        submits = self._submit_choices(params, kwargs, n)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -439,34 +488,67 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(hex(len(data))[2:].encode() + b"\r\n" + data + b"\r\n")
             self.wfile.flush()
 
-        deadline = time.monotonic() + ctx.config.request_timeout_s
+        def abort_all():
+            for rid, _ in submits:
+                ctx.runner.abort(rid)
+
+        # n > 1: merge the per-choice output queues into one, tagged with
+        # the choice index, so chunks interleave as they are produced (the
+        # OpenAI streaming shape — each chunk carries its choice index).
         import queue as _queue
+        if n == 1:
+            merged = None
+        else:
+            merged = _queue.Queue()
+            import threading as _threading
+
+            def pump(idx, q):
+                while True:
+                    item = q.get()
+                    merged.put((idx, item))
+                    if item is None or isinstance(item, Exception):
+                        return
+            for i, (_, q) in enumerate(submits):
+                _threading.Thread(target=pump, args=(i, q),
+                                  daemon=True).start()
+
+        deadline = time.monotonic() + ctx.config.request_timeout_s
         try:
             if chat:
-                send_chunk({"id": oid, "object": "chat.completion.chunk",
-                            "model": ctx.model_name,
-                            "choices": [{"index": 0,
-                                         "delta": {"role": "assistant"},
-                                         "finish_reason": None}]})
-            while True:
+                for i in range(n):
+                    send_chunk({"id": oid, "object": "chat.completion.chunk",
+                                "model": ctx.model_name,
+                                "choices": [{"index": i,
+                                             "delta": {"role": "assistant"},
+                                             "finish_reason": None}]})
+            live = n
+            while live:
                 try:
-                    item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
+                    if merged is None:
+                        idx, item = 0, submits[0][1].get(
+                            timeout=max(deadline - time.monotonic(), 0.001))
+                    else:
+                        idx, item = merged.get(
+                            timeout=max(deadline - time.monotonic(), 0.001))
                 except _queue.Empty:
-                    ctx.runner.abort(rid)
+                    abort_all()
                     send_chunk({"error": {"message": "request timed out"}})
                     break
                 if item is None:
-                    break
+                    live -= 1
+                    continue
                 if isinstance(item, Exception):
                     send_chunk({"error": {"message": str(item)}})
-                    break
+                    live -= 1
+                    continue
                 finish = item.finish_reason.value if item.finish_reason else None
                 if chat:
                     delta = {"content": item.new_text} if item.new_text else {}
-                    choice = {"index": 0, "delta": delta, "finish_reason": finish}
+                    choice = {"index": idx, "delta": delta,
+                              "finish_reason": finish}
                     obj = "chat.completion.chunk"
                 else:
-                    choice = {"index": 0, "text": item.new_text,
+                    choice = {"index": idx, "text": item.new_text,
                               "finish_reason": finish}
                     obj = "text_completion"
                 send_chunk({"id": oid, "object": obj, "created": int(time.time()),
@@ -476,12 +558,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
-            ctx.runner.abort(rid)       # client went away mid-stream
+            abort_all()                 # client went away mid-stream
         except Exception:
             logger.exception("streaming failed")
-            ctx.runner.abort(rid)
+            abort_all()
         finally:
-            ctx.engine.requests.pop(rid, None)
+            for rid, _ in submits:
+                ctx.engine.requests.pop(rid, None)
 
 
 def main(argv=None):
@@ -506,6 +589,14 @@ def main(argv=None):
     ap.add_argument("--disagg", action="store_true",
                     help="disaggregated prefill/decode pools in-process "
                          "(KV handoff over ICI within the slice)")
+    ap.add_argument("--role", default=None, choices=["prefill", "decode"],
+                    help="cross-pod disaggregation (parallel/disagg_net.py):"
+                         " 'prefill' prefills locally and migrates KV to the"
+                         " decode pool at --decode-url; 'decode' accepts"
+                         " migrations on /internal/migrate")
+    ap.add_argument("--decode-url", default=None,
+                    help="decode-pool base URL (required with"
+                         " --role prefill)")
     ap.add_argument("--chat-template", default=None,
                     help="path to a Jinja chat template overriding the "
                          "tokenizer's (ConfigMap-mounted in K8s)")
@@ -553,7 +644,15 @@ def main(argv=None):
         # would strand followers in broadcast_one_to_all forever.
         from tpuserve.parallel import make_mesh
         mesh = make_mesh()
-    if args.disagg:
+    if args.role and (args.disagg or args.multihost):
+        ap.error("--role prefill/decode is its own topology; drop "
+                 "--disagg/--multihost")
+    if args.role == "prefill":
+        if not args.decode_url:
+            ap.error("--role prefill requires --decode-url")
+        from tpuserve.parallel.disagg_net import PrefillHandoffEngine
+        engine = PrefillHandoffEngine(ecfg, args.decode_url, mesh=mesh)
+    elif args.disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         engine = DisaggregatedEngine(ecfg, ecfg, mesh=mesh)
     else:
@@ -571,8 +670,9 @@ def main(argv=None):
     chat_template = None
     if args.chat_template:
         chat_template = open(args.chat_template).read()
-    server = OpenAIServer(engine, ServerConfig(host=args.host, port=args.port,
-                                               chat_template=chat_template))
+    server = OpenAIServer(engine, ServerConfig(
+        host=args.host, port=args.port, chat_template=chat_template,
+        allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)
     try:
