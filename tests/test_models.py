@@ -207,3 +207,29 @@ def test_get_model_config_from_checkpoint_dir(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(cfg_json))
     cfg = get_model_config(str(tmp_path))
     assert cfg.hidden_size == 32 and cfg.head_dim == 8
+
+
+def test_orbax_roundtrip(tmp_path):
+    """Weight persistence (the reference parks weights on PVCs,
+    llm-d-deploy.yaml:195-215; here orbax is the cache format)."""
+    import dataclasses
+    import jax.numpy as jnp
+    import numpy as np
+    from tpuserve.models import weights
+    from tpuserve.models.config import get_model_config
+    cfg = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
+    params = weights.init_params(cfg, seed=3)
+    path = str(tmp_path / "ckpt")
+    weights.save_orbax(params, path)
+    restored = weights.restore_orbax(cfg, path)
+    a = np.asarray(params["layers"][0]["q_proj"]["kernel"])
+    b = np.asarray(restored["layers"][0]["q_proj"]["kernel"])
+    np.testing.assert_array_equal(a, b)
+    # quantized pytrees (int8 + scales) survive the same path
+    qp = weights.quantize_params_int8(params)
+    qpath = str(tmp_path / "ckpt-int8")
+    weights.save_orbax(qp, qpath)
+    qr = weights.restore_orbax(cfg, qpath, target_params=qp)
+    assert qr["layers"][0]["q_proj"]["kernel"].dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(qp["embed"]["scale"]), np.asarray(qr["embed"]["scale"]))

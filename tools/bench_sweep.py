@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Run the bench across its variants and append results to BENCHMARKS.md.
+
+Each variant is one `python bench.py ...` subprocess (fresh backend, shared
+persistent XLA compile cache, so repeat sweeps skip the multi-minute model
+compiles).  Variants run in a deliberate order — smallest compile first —
+so a flaky TPU tunnel yields partial results instead of nothing; every
+completed variant is appended to BENCHMARKS.md and bench_sweep.jsonl
+immediately.
+
+Usage: python tools/bench_sweep.py [--quick] [--only NAME[,NAME...]]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VARIANTS = [
+    # (name, args) — ordered smallest-compile-first
+    ("base-multistep8", []),                       # TPU defaults: S=8, pallas
+    ("multistep1", ["--multi-step", "1"]),
+    ("multistep16", ["--multi-step", "16"]),
+    ("multistep32", ["--multi-step", "32"]),
+    ("no-pipeline", ["--no-pipeline", "--multi-step", "1"]),
+    ("attn-reference", ["--attn", "reference"]),
+    ("int8", ["--quant", "int8"]),
+    ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"]),
+    ("spec4", ["--spec", "4"]),
+    ("disagg", ["--compare-disagg"]),
+]
+
+QUICK = ["base-multistep8", "multistep1", "int8"]
+
+
+def run_variant(name: str, args: list[str], timeout: int) -> dict | None:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + args
+    print(f"=== {name}: {' '.join(cmd)}", flush=True)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=ROOT)
+    except subprocess.TimeoutExpired:
+        print(f"--- {name}: TIMEOUT after {timeout}s", flush=True)
+        return None
+    result = None
+    for l in (proc.stdout or "").splitlines():
+        l = l.strip()
+        if l.startswith("{") and '"metric"' in l:
+            try:
+                result = json.loads(l)
+            except json.JSONDecodeError:
+                continue
+    if result is None:
+        print(f"--- {name}: no JSON (rc={proc.returncode})\n"
+              f"{(proc.stderr or '')[-2000:]}", flush=True)
+        return None
+    if proc.returncode != 0:
+        # measured but died in teardown (e.g. tunnel loss after the print):
+        # keep the number, but never indistinguishable from a healthy run
+        result["rc"] = proc.returncode
+    result["variant"] = name
+    return result
+
+
+_HEADER_WRITTEN = False
+
+
+def append_markdown(r: dict) -> None:
+    """Append ONE result row immediately — a crash or Ctrl-C mid-sweep must
+    not lose the variants that already completed."""
+    global _HEADER_WRITTEN
+    path = os.path.join(ROOT, "BENCHMARKS.md")
+    new_file = not os.path.exists(path)
+    with open(path, "a") as f:
+        if new_file:
+            f.write("# Measured benchmarks\n\n"
+                    "Decode throughput per chip on the headline workload "
+                    "(Qwen3-0.6B, batch 64, 128 in / 128 out) across engine "
+                    "variants.  Target: 2,000 tok/s/chip (BASELINE.md); the "
+                    "reference publishes no numbers (SURVEY.md §6).\n")
+        if not _HEADER_WRITTEN:
+            stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M")
+            f.write(f"\n## Sweep @ {stamp}\n\n")
+            f.write("| variant | backend | tok/s/chip | vs target | TTFT ms "
+                    "| attn | S | quant | notes |\n"
+                    "|---|---|---|---|---|---|---|---|---|\n")
+            _HEADER_WRITTEN = True
+        notes = []
+        if r.get("degraded"):
+            notes.append("DEGRADED")
+        if r.get("rc"):
+            notes.append(f"rc={r['rc']} (died post-measurement)")
+        if "spec" in r:
+            notes.append(f"accept={r['spec']['acceptance']}, "
+                         f"tok/step={r['spec']['tokens_per_step']}")
+        if "disagg" in r:
+            notes.append(f"disagg={r['disagg']['decode_tok_s']} "
+                         f"({r['disagg']['vs_colocated']}x)")
+        f.write(f"| {r['variant']} | {r['backend']} | {r['value']} | "
+                f"{r['vs_baseline']} | {r['ttft_ms']} | {r['attn_impl']} "
+                f"| {r.get('multi_step')} | {r.get('quantization') or '-'}"
+                f" | {'; '.join(notes) or '-'} |\n")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="three-variant sweep only")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated variant names")
+    ap.add_argument("--timeout", type=int, default=5400,
+                    help="per-variant timeout (first compile through a "
+                         "tunnel can take >30 min)")
+    args = ap.parse_args()
+    known = [n for n, _ in VARIANTS]
+    if args.only:
+        names = [n.strip() for n in args.only.split(",")]
+        unknown = sorted(set(names) - set(known))
+        if unknown:
+            ap.error(f"unknown variants {unknown}; known: {known}")
+    else:
+        names = QUICK if args.quick else known
+    count = 0
+    log = open(os.path.join(ROOT, "bench_sweep.jsonl"), "a")
+    for name, vargs in VARIANTS:
+        if name not in names:
+            continue
+        r = run_variant(name, vargs, args.timeout)
+        if r is not None:
+            print(json.dumps(r), flush=True)
+            log.write(json.dumps(r) + "\n")
+            log.flush()
+            append_markdown(r)       # per-variant: partial sweeps survive
+            count += 1
+    print(f"appended {count} results to BENCHMARKS.md" if count
+          else "no results", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -288,11 +288,15 @@ def save_orbax(params: Params, path: str) -> None:
     ckptr.wait_until_finished()
 
 
-def restore_orbax(cfg: ModelConfig, path: str) -> Params:
+def restore_orbax(cfg: ModelConfig, path: str,
+                  target_params: Params | None = None) -> Params:
+    """Restore a params pytree.  ``target_params`` supplies the target
+    structure when it differs from a fresh ``init_params`` tree (e.g. an
+    int8-quantized checkpoint, whose linears carry kernel+scale)."""
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
     target = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-        init_params(cfg),
+        target_params if target_params is not None else init_params(cfg),
     )
     return ckptr.restore(os.path.abspath(path), target)
