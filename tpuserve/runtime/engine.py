@@ -111,6 +111,10 @@ class EngineStats:
     spec_steps: int = 0
     spec_proposed: int = 0           # draft tokens offered to the verifier
     spec_accepted: int = 0           # draft tokens accepted
+    # multi-step windows: tokens computed past a request's stop point
+    # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
+    # fused window, worth watching when tuning multi_step
+    window_overrun_tokens: int = 0
     ttft_sum: float = 0.0
     ttft_count: int = 0
     # recent per-token latencies (decode step wall time / batch)
@@ -439,8 +443,8 @@ class Engine:
             self.block_manager.allocate(req.request_id, ids, shared_blocks=shared)
             tokens[i, :len(ids)] = ids
             prompt_lens[i] = len(ids)
-            for t in range(len(ids)):
-                slot_ids[i, t] = self.block_manager.slot_for_token(req.request_id, t)
+            slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
+                                                       len(ids))
         logits, self.kv_cache = self._exec_prefill(
             jnp.asarray(tokens), jnp.asarray(prompt_lens),
             jnp.asarray(slot_ids))
@@ -459,6 +463,20 @@ class Engine:
         """Tokens to prefill — prompt plus, after a preemption, everything
         generated so far (the cache was dropped and must be rebuilt)."""
         return req.prompt_token_ids + req.output_token_ids
+
+    def _token_slots(self, request_id: str, start: int, n: int,
+                     block_table=None) -> np.ndarray:
+        """Flat cache slots for token indices [start, start+n) — the
+        vectorized form of ``block_manager.slot_for_token`` (a per-token
+        Python loop costs ~10 ms of host time per batch-64 prefill, which
+        is pure TTFT).  Pass ``block_table`` when the caller already
+        fetched it to skip a second manager round-trip."""
+        bs = self.cache_cfg.block_size
+        if block_table is None:
+            block_table = self.block_manager.block_table(request_id)
+        bt = np.asarray(block_table, np.int64)
+        t = np.arange(start, start + n)
+        return (bt[t // bs] * bs + t % bs).astype(np.int32)
 
     def _run_prefill_chunk(self, batch: ScheduledBatch) -> list[RequestOutput]:
         """One fixed-size chunk of a long prompt (vLLM chunked-prefill
@@ -484,12 +502,11 @@ class Engine:
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :n] = chunk
         slot_ids = np.full((1, C), PAD_SLOT, np.int32)
-        for t in range(n):
-            slot_ids[0, t] = self.block_manager.slot_for_token(
-                req.request_id, done + t)
+        bt = self.block_manager.block_table(req.request_id)
+        slot_ids[0, :n] = self._token_slots(req.request_id, done, n,
+                                            block_table=bt)
         block_tables = np.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
-        bt = self.block_manager.block_table(req.request_id)
         block_tables[0, :len(bt)] = bt
         logits, self.kv_cache = self._exec_prefill_chunk(
             jnp.asarray(tokens),
@@ -573,6 +590,7 @@ class Engine:
                 out = self._emit_one(r, int(toks_h[i, s]))
                 outputs.append(out)
                 if out.finished:
+                    self.stats.window_overrun_tokens += S - 1 - s
                     break
         return outputs
 
@@ -702,10 +720,9 @@ class Engine:
             tokens[i, 1:1 + len(d)] = d
             ctx_lens[i] = base[i]
             chunk_lens[i] = 1 + len(d)
-            for j in range(K):
-                slot_ids[i, j] = self.block_manager.slot_for_token(
-                    r.request_id, base[i] + j)
             bt = self.block_manager.block_table(r.request_id)
+            slot_ids[i] = self._token_slots(r.request_id, base[i], K,
+                                            block_table=bt)
             block_tables[i, :len(bt)] = bt
         pred, self.kv_cache = self._exec_decode_verify(
             jnp.asarray(tokens), jnp.asarray(ctx_lens),
