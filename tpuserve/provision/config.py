@@ -186,6 +186,17 @@ PRESETS: dict[str, dict] = {
         "tensor_parallel": 8, "replicas": 2,
         "storage_size": "200Gi", "model_pvc_size": "300Gi",
     },
+    # cross-pod variant of the disaggregated config: separate prefill and
+    # decode Deployments on their own v5e-4 slices, independently scalable
+    # (llm-d's actual topology; KV rides the pod network — disagg_net.py)
+    "llama3-8b-disagg-xpod-v5e8": {
+        "model": "meta-llama/Meta-Llama-3-8B-Instruct",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t", "num_nodes": 2,
+        "tensor_parallel": 4,
+        "disaggregated": True, "disagg_cross_pod": True,
+        "prefill_replicas": 1, "decode_replicas": 1,
+    },
     # harness-friendly CPU smoke path (BASELINE "CPU smoke" config)
     "cpu-smoke": {
         "provider": "local", "model": "tiny-qwen3",
