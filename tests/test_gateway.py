@@ -115,3 +115,44 @@ def test_gateway_bad_request_passthrough(stack):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(stack["url"] + "/v1/completions", {"prompt": ""})
     assert ei.value.code == 400
+
+
+def test_gateway_connect_failover():
+    """A backend that refuses connections costs a retry on the next
+    backend, not a client-visible 502 — only when EVERY backend is
+    unreachable does the gateway 502."""
+    srv, live_url = _mk_server()
+    dead_url = "http://127.0.0.1:1"          # nothing listens on port 1
+    gw = Gateway([dead_url, live_url],
+                 GatewayConfig(host="127.0.0.1", port=0,
+                               health_interval_s=3600))  # no health rescue
+    gport = gw.start()
+    try:
+        # least-loaded picks the dead backend first (list order tiebreak);
+        # the relay must fail over to the live one transparently
+        status, body = _post(f"http://127.0.0.1:{gport}/v1/completions",
+                             {"model": "tiny-qwen3", "prompt": "failover",
+                              "max_tokens": 4, "temperature": 0,
+                              "ignore_eos": True})
+        assert status == 200
+        assert body["usage"]["completion_tokens"] == 4
+        # the failed connect counted against the dead backend (ejection
+        # takes 2 consecutive failures)
+        assert any(b.consecutive_failures >= 1 for b in gw.backends)
+    finally:
+        gw.shutdown()
+        srv.shutdown()
+
+
+def test_gateway_all_backends_unreachable():
+    gw = Gateway(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                 GatewayConfig(host="127.0.0.1", port=0,
+                               health_interval_s=3600))
+    gport = gw.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"http://127.0.0.1:{gport}/v1/completions",
+                  {"model": "x", "prompt": "y"})
+        assert e.value.code == 502
+    finally:
+        gw.shutdown()

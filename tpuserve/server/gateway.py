@@ -25,12 +25,29 @@ import json
 import logging
 import threading
 import time
+import urllib.error
 import urllib.request
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 logger = logging.getLogger("tpuserve.gateway")
+
+
+def _is_connect_failure(e: Exception) -> bool:
+    """True when the backend never received the request (connection refused
+    / unreachable / DNS) — the only failures safe to fail over, since
+    retrying a request the backend may already be executing would duplicate
+    inference work."""
+    import errno
+    import socket
+    if not isinstance(e, urllib.error.URLError):
+        return False
+    r = e.reason
+    if isinstance(r, (ConnectionRefusedError, socket.gaierror)):
+        return True
+    return (isinstance(r, OSError) and r.errno in
+            (errno.ECONNREFUSED, errno.EHOSTUNREACH, errno.ENETUNREACH))
 
 
 @dataclasses.dataclass
@@ -82,10 +99,21 @@ class Gateway:
         except Exception:
             return None
 
-    def pick_backend(self, body: bytes | None = None) -> Backend:
+    def pick_backend(self, body: bytes | None = None,
+                     exclude: set[str] | None = None) -> Backend:
+        """Pick the least-loaded healthy backend (prefix affinity first).
+        ``exclude``: URLs already tried this request (connect-failure
+        failover) — skipped unless nothing else remains."""
         with self._lock:
-            healthy = [b for b in self.backends if b.healthy]
-            pool = healthy or self.backends
+            ex = exclude or set()
+            # preference order: healthy+untried > any untried (a backend
+            # merely flagged by the health loop beats re-dialing one that
+            # just refused THIS request) > anything
+            healthy = [b for b in self.backends
+                       if b.healthy and b.url not in ex]
+            pool = (healthy
+                    or [b for b in self.backends if b.url not in ex]
+                    or self.backends)
             key = self._prefix_key(body) if body else None
             if key is not None:
                 url = self._affinity.get(key)
@@ -173,6 +201,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         logger.debug("%s " + fmt, self.address_string(), *args)
 
+    def _send_json_safely(self, code: int, data: bytes) -> None:
+        """Write a JSON response, swallowing client-gone errors (the
+        client may have hung up while backends were being tried)."""
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def _relay(self, method: str):
         ctx = self.ctx
         if self.path == "/gateway/status":
@@ -185,10 +225,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None
-        backend = ctx.pick_backend(body if method == "POST" else None)
+        # Connect-level failover: an unreachable backend costs one retry on
+        # the next candidate, not a client-visible 502, as long as another
+        # backend remains untried (no response bytes have flowed yet, so
+        # the retry is safe for streaming and non-streaming alike).
+        tried: set[str] = set()
         backend_ok = True      # only upstream failures count against it
         headers_sent = False
-        try:
+        while True:
+            backend = ctx.pick_backend(body if method == "POST" else None,
+                                       exclude=tried)
             try:
                 req = urllib.request.Request(
                     backend.url + self.path, data=body, method=method,
@@ -196,31 +242,36 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                         "Content-Type", "application/json")})
                 resp_ctx = urllib.request.urlopen(
                     req, timeout=ctx.config.upstream_timeout_s)
+                break
             except urllib.error.HTTPError as e:
                 # an HTTP error *response* from the backend: relay it;
-                # 5xx counts against the backend's health
-                backend_ok = e.code < 500
-                data = e.read()
-                self.send_response(e.code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                headers_sent = True
-                self.wfile.write(data)
+                # 5xx counts against the backend's health.  Release before
+                # writing — a client that hung up must not leak the
+                # backend's outstanding count.
+                ctx.release(backend, ok=e.code < 500)
+                try:
+                    data = e.read()
+                except Exception:        # body lost mid-flight
+                    data = b'{"error":{"message":"upstream error"}}'
+                self._send_json_safely(e.code, data)
                 return
             except Exception as e:
-                backend_ok = False
+                ctx.release(backend, ok=False)
                 logger.warning("upstream %s failed: %s", backend.url, e)
-                data = json.dumps({"error": {
-                    "message": f"upstream {backend.url} unreachable",
-                    "type": "bad_gateway"}}).encode()
-                self.send_response(502)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                headers_sent = True
-                self.wfile.write(data)
+                if _is_connect_failure(e):
+                    tried.add(backend.url)
+                    if len(tried) < len(ctx.backends):
+                        continue
+                    msg = "all upstream backends unreachable"
+                else:
+                    # the backend may already be executing the request
+                    # (read timeout / mid-request reset): retrying would
+                    # duplicate inference work — surface the failure
+                    msg = f"upstream {backend.url} failed mid-request"
+                self._send_json_safely(502, json.dumps({"error": {
+                    "message": msg, "type": "bad_gateway"}}).encode())
                 return
+        try:
             with resp_ctx as resp:
                 self.send_response(resp.status)
                 ctype = resp.headers.get("Content-Type", "application/json")
