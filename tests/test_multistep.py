@@ -212,3 +212,25 @@ def test_randomized_stream_equivalence_under_pressure(seed):
         "workload no longer preempts — the test is vacuous; tighten "
         "num_blocks")
     assert ids4 == ids1
+
+
+def test_window_with_pallas_kernels():
+    """decode_multi scans the decode trunk with the Pallas paged-attention
+    kernel inside (interpret mode on CPU) — the exact composition the TPU
+    path runs; must match the reference engine token-for-token."""
+    def build(attn_impl, multi_step):
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=4),
+            attn_impl=attn_impl, multi_step=multi_step)
+        mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                                 dtype="float32")
+        return Engine(cfg, model_cfg=mc)
+
+    params = SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True)
+    ref = build("reference", 1).generate(PROMPTS, params)
+    pal = build("pallas", 3).generate(PROMPTS, params)
+    assert _ids(pal) == _ids(ref)

@@ -45,6 +45,13 @@ class ModelConfig:
     bos_token_id: Optional[int] = None
     eos_token_id: Optional[int] = None
     dtype: str = "bfloat16"
+    # Mixture-of-experts (Qwen3-MoE-style): 0 experts = dense MLP.  The
+    # router picks num_experts_per_tok experts per token; expert MLPs use
+    # moe_intermediate_size (falls back to intermediate_size).
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+    norm_topk_prob: bool = True      # renormalise the top-k router weights
 
     @property
     def q_size(self) -> int:
@@ -55,11 +62,19 @@ class ModelConfig:
         return self.num_kv_heads * self.head_dim
 
     @property
+    def expert_intermediate_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
+
+    @property
     def num_params(self) -> int:
         """Approximate parameter count (embeddings counted once if tied)."""
         h, i, l, v = self.hidden_size, self.intermediate_size, self.num_layers, self.vocab_size
         attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
-        mlp = (3 if self.mlp_style == "gated" else 2) * h * i
+        if self.num_experts:
+            mlp = (self.num_experts * 3 * h * self.expert_intermediate_size
+                   + h * self.num_experts)
+        else:
+            mlp = (3 if self.mlp_style == "gated" else 2) * h * i
         embed = v * h * (1 if self.tie_word_embeddings else 2)
         return l * (attn + mlp) + embed
 
@@ -123,8 +138,15 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             mlp_bias=True,
             **common,
         )
-    # Llama / Qwen2 / Qwen3 / Phi-3 all share the rotary+gated-MLP skeleton.
+    # Llama / Qwen2 / Qwen3 / Phi-3 all share the rotary+gated-MLP skeleton;
+    # the Qwen3-MoE variant swaps the MLP for routed experts.
     nh = hf["num_attention_heads"]
+    moe = {}
+    if hf.get("num_experts"):
+        moe = dict(num_experts=hf["num_experts"],
+                   num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+                   moe_intermediate_size=hf.get("moe_intermediate_size"),
+                   norm_topk_prob=hf.get("norm_topk_prob", True))
     return ModelConfig(
         intermediate_size=hf["intermediate_size"],
         num_kv_heads=hf.get("num_key_value_heads", nh),
@@ -138,6 +160,7 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
         qk_norm="qwen3" in family,
         attention_bias="qwen2" in family or hf.get("attention_bias", False),
+        **moe,
         **common,
     )
 
@@ -196,6 +219,18 @@ register_model_config(ModelConfig(
     bos_token_id=2, eos_token_id=2,
 ), "opt-1.3b")
 
+# Mixture-of-experts family (Qwen3-MoE): routed experts replace the dense
+# MLP; serves with expert-parallel sharding over the mesh 'ep' axis.
+register_model_config(ModelConfig(
+    name="Qwen/Qwen3-30B-A3B",
+    vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+    num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
+    max_position_embeddings=40960, rope_theta=1e6, norm_eps=1e-6,
+    qk_norm=True, tie_word_embeddings=False,
+    num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+    bos_token_id=151643, eos_token_id=151645,
+), "qwen3-30b-a3b")
+
 # Tiny configs for tests / CPU smoke (one per architectural family).
 register_model_config(ModelConfig(
     name="tiny-qwen3",
@@ -203,6 +238,15 @@ register_model_config(ModelConfig(
     num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
     max_position_embeddings=512, rope_theta=1e6,
     qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
+))
+
+register_model_config(ModelConfig(
+    name="tiny-moe",
+    vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    max_position_embeddings=512, rope_theta=1e6,
+    qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
+    num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
 ))
 
 register_model_config(ModelConfig(

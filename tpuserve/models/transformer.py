@@ -76,10 +76,48 @@ def _act(x: jnp.ndarray, name: str) -> jnp.ndarray:
 
 
 def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
+    if cfg.num_experts:
+        return _moe_mlp(x, p, cfg)
     if cfg.mlp_style == "gated":
         gate = _act(_linear(x, p["gate_proj"]), cfg.act)
         return _linear(gate * _linear(x, p["up_proj"]), p["down_proj"])
     return _linear(_act(_linear(x, p["fc1"]), cfg.act), p["fc2"])
+
+
+def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
+    """Mixture-of-experts MLP (Qwen3-MoE-style): softmax router picks
+    ``num_experts_per_tok`` experts per token; their gated-MLP outputs are
+    combined with the (optionally renormalised) router weights.
+
+    Dispatch is DENSE: every expert runs on every token and non-selected
+    experts contribute with weight zero.  That is the XLA-friendly form —
+    static shapes, no ragged gather/scatter — and it makes expert
+    parallelism pure GSPMD: expert kernels are stacked (E, ...) and sharded
+    over the mesh 'ep' axis (parallel/sharding.py), so each shard computes
+    only its local experts and one psum combines the weighted outputs.
+    The compute overcost vs sparse dispatch is E/k on the MLP FLOPs; at
+    serving batch sizes the step stays HBM-bound reading the expert
+    weights, which EP divides by the axis size.  (Capacity-based one-hot
+    dispatch is the optimisation path when token count >> E.)
+    """
+    shape = x.shape
+    xt = x.reshape(-1, shape[-1])                              # (T, H)
+    T = xt.shape[0]
+    router = _linear(xt, p["router"]).astype(jnp.float32)      # (T, E)
+    probs = jax.nn.softmax(router, axis=-1)
+    k = cfg.num_experts_per_tok
+    topv, topi = jax.lax.top_k(probs, k)                       # (T, k)
+    if cfg.norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    combine = jnp.zeros_like(probs).at[
+        jnp.arange(T)[:, None], topi].set(topv)                # (T, E)
+    ek = p["experts"]
+    g = jnp.einsum("th,ehi->tei", xt, ek["gate_proj"]["kernel"])
+    u = jnp.einsum("th,ehi->tei", xt, ek["up_proj"]["kernel"])
+    h = _act(g, cfg.act) * u
+    o = jnp.einsum("tei,eih->teh", h, ek["down_proj"]["kernel"])
+    y = jnp.einsum("teh,te->th", o, combine.astype(o.dtype))
+    return y.reshape(shape)
 
 
 # --------------------------------------------------------------------------

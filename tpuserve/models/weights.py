@@ -67,7 +67,19 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
         if cfg.qk_norm:
             lp["q_norm"] = {"scale": jnp.ones((d,), dtype)}
             lp["k_norm"] = {"scale": jnp.ones((d,), dtype)}
-        if cfg.mlp_style == "gated":
+        if cfg.num_experts:
+            ei = cfg.expert_intermediate_size
+            E = cfg.num_experts
+
+            def experts(n_in, n_out):
+                return {"kernel": jnp.asarray(
+                    rng.standard_normal((E, n_in, n_out), dtype=np.float32)
+                    / np.sqrt(n_in), dtype=dtype)}
+            lp["router"] = dense(h, E, False)
+            lp["experts"] = {"gate_proj": experts(h, ei),
+                             "up_proj": experts(h, ei),
+                             "down_proj": experts(ei, h)}
+        elif cfg.mlp_style == "gated":
             lp["gate_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
             lp["up_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
             lp["down_proj"] = dense(cfg.intermediate_size, h, cfg.mlp_bias)
@@ -152,14 +164,22 @@ def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
         if cfg.qk_norm:
             lp["q_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.q_norm.weight"), dtype=dtype)}
             lp["k_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.k_norm.weight"), dtype=dtype)}
-        if pre + "mlp.gate_up_proj.weight" in raw:              # Phi-3 fused mlp
+        if cfg.num_experts:                                     # Qwen3-MoE
+            lp["router"] = {"kernel": _t(get(pre + "mlp.gate.weight"), dtype)}
+            lp["experts"] = {
+                proj: {"kernel": jnp.stack([
+                    _t(get(pre + f"mlp.experts.{e}.{proj}.weight"), dtype)
+                    for e in range(cfg.num_experts)])}
+                for proj in ("gate_proj", "up_proj", "down_proj")}
+        elif pre + "mlp.gate_up_proj.weight" in raw:            # Phi-3 fused mlp
             gu = jnp.asarray(raw[pre + "mlp.gate_up_proj.weight"], dtype=dtype)
             g, u = jnp.split(gu, 2, axis=0)
             lp["gate_proj"], lp["up_proj"] = {"kernel": g.T}, {"kernel": u.T}
         else:
             lp["gate_proj"] = dense(pre + "mlp.gate_proj.weight")
             lp["up_proj"] = dense(pre + "mlp.up_proj.weight")
-        lp["down_proj"] = dense(pre + "mlp.down_proj.weight")
+        if not cfg.num_experts:
+            lp["down_proj"] = dense(pre + "mlp.down_proj.weight")
         layers.append(lp)
 
     params = {

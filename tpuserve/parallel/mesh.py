@@ -7,6 +7,7 @@ over ICI/DCN).
 Axes:
 - ``dp``: data parallel (batch split; gradient psum when fine-tuning).
 - ``tp``: tensor parallel (attention heads / MLP columns over ICI).
+- ``ep``: expert parallel (MoE expert dim; models/transformer._moe_mlp).
 
 Multi-host: ``jax.distributed.initialize()`` + the same mesh over all
 processes' devices — XLA routes collectives over ICI within a slice and DCN
@@ -22,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DP = "dp"
+AXIS_EP = "ep"
 AXIS_TP = "tp"
 
 
@@ -29,16 +31,19 @@ AXIS_TP = "tp"
 class MeshConfig:
     dp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.ep * self.tp
 
 
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
-    """Build a (dp, tp) mesh.  Default: all local devices on the tp axis
+    """Build a (dp, ep, tp) mesh.  Default: all local devices on the tp axis
     (serving wants TP over ICI; DP is usually the K8s replica count, matching
     the reference's llm-d topology where the gateway load-balances replicas).
+    ``ep`` shards the MoE expert dimension; size 1 (the default) makes the
+    axis invisible to dense models.
     """
     devices = list(devices if devices is not None else jax.devices())
     if cfg is None:
@@ -46,8 +51,9 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
     if cfg.num_devices > len(devices):
         raise ValueError(f"mesh {cfg} needs {cfg.num_devices} devices, "
                          f"have {len(devices)}")
-    grid = np.asarray(devices[:cfg.num_devices]).reshape(cfg.dp, cfg.tp)
-    return Mesh(grid, (AXIS_DP, AXIS_TP))
+    grid = np.asarray(devices[:cfg.num_devices]).reshape(cfg.dp, cfg.ep,
+                                                         cfg.tp)
+    return Mesh(grid, (AXIS_DP, AXIS_EP, AXIS_TP))
 
 
 def multihost_initialize(coordinator_address: str | None = None,

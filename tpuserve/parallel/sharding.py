@@ -21,7 +21,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuserve.models.config import ModelConfig
-from tpuserve.parallel.mesh import AXIS_TP
+from tpuserve.parallel.mesh import AXIS_EP, AXIS_TP
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -30,6 +30,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def _spec_for(path: str, cfg: ModelConfig) -> P:
     """PartitionSpec for one param, keyed on its pytree path string."""
+    # MoE: stacked expert kernels (E, in, out) shard over the ep axis —
+    # each shard computes its local experts for every token, one psum
+    # combines (models/transformer._moe_mlp).  Must precede the
+    # column-parallel match: expert paths contain "gate_proj"/"up_proj"
+    # too.  The router stays replicated (falls through to P()).
+    if "experts." in path:
+        if path.endswith("kernel"):
+            return P(AXIS_EP, None, None)
+        return P()
     # column-parallel kernels: (in, out) with out sharded; int8 per-output
     # quantization scales follow the out axis like biases
     if any(k in path for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
