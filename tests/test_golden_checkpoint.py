@@ -71,6 +71,80 @@ def test_qwen3_logits_match_transformers(golden_ckpt):
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
 
 
+"""Per-family golden checkpoints (VERDICT r2 #8): transformers authors the
+weights for every other registered family — Phi-3's fused qkv/gate_up, OPT's
+learned positions with the +2 offset, Llama, and Qwen3-MoE's routed experts —
+the exact layouts where real checkpoints diverge from hand-typed names."""
+
+
+def _phi3():
+    return transformers.Phi3ForCausalLM(transformers.Phi3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, bos_token_id=0, eos_token_id=1,
+        pad_token_id=0))
+
+
+def _opt():
+    return transformers.OPTForCausalLM(transformers.OPTConfig(
+        vocab_size=256, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=512,
+        word_embed_proj_dim=64, do_layer_norm_before=True,
+        bos_token_id=0, eos_token_id=1, pad_token_id=0))
+
+
+def _llama():
+    return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        bos_token_id=0, eos_token_id=1))
+
+
+def _qwen3_moe():
+    return transformers.Qwen3MoeForCausalLM(transformers.Qwen3MoeConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=512, rope_theta=1e6,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        norm_topk_prob=True, tie_word_embeddings=True,
+        bos_token_id=0, eos_token_id=1))
+
+
+_FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
+             "qwen3_moe": _qwen3_moe}
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+def test_family_logits_match_transformers(family, tmp_path):
+    torch.manual_seed(1)
+    model = _FAMILIES[family]().to(torch.float32).eval()
+    path = tmp_path / family
+    model.save_pretrained(path, safe_serialization=True)
+
+    hf = json.loads((path / "config.json").read_text())
+    cfg = config_from_hf_json(f"tiny-{family}", hf)
+    import dataclasses
+    cfg = dataclasses.replace(cfg, dtype="float32")
+    if family == "phi3":
+        assert cfg.mlp_style == "gated" and not cfg.qk_norm
+    if family == "opt":
+        assert cfg.pos == "learned" and cfg.learned_pos_offset == 2
+        assert cfg.norm == "layernorm" and cfg.act == "relu"
+    if family == "qwen3_moe":
+        assert cfg.num_experts == 4 and cfg.qk_norm
+    params = weights.load_hf_checkpoint(cfg, str(path))
+
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(2, cfg.vocab_size, size=(2, 12))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(transformer.forward(
+        params, cfg, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-4)
+
+
 def test_qwen3_engine_greedy_matches_transformers(golden_ckpt):
     """End-to-end: the serving engine (paged cache, bucketed prefill/decode)
     greedy-decodes the same continuation transformers produces."""

@@ -143,6 +143,20 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
     nh = hf["num_attention_heads"]
     moe = {}
     if hf.get("num_experts"):
+        # We build every layer as MoE; a checkpoint with interleaved dense
+        # layers (mlp_only_layers / decoder_sparse_step) would fail at weight
+        # load with missing mlp.experts.* keys or, worse, mis-serve.  Reject
+        # loudly until per-layer dense/MoE selection is supported.
+        if hf.get("mlp_only_layers"):
+            raise ValueError(
+                "Qwen3-MoE checkpoints with non-empty mlp_only_layers "
+                f"(got {hf['mlp_only_layers']}) interleave dense layers, "
+                "which this loader does not support yet")
+        if hf.get("decoder_sparse_step", 1) != 1:
+            raise ValueError(
+                "Qwen3-MoE checkpoints with decoder_sparse_step != 1 "
+                f"(got {hf['decoder_sparse_step']}) interleave dense layers, "
+                "which this loader does not support yet")
         moe = dict(num_experts=hf["num_experts"],
                    num_experts_per_tok=hf.get("num_experts_per_tok", 2),
                    moe_intermediate_size=hf.get("moe_intermediate_size"),

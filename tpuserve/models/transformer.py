@@ -112,10 +112,21 @@ def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     combine = jnp.zeros_like(probs).at[
         jnp.arange(T)[:, None], topi].set(topv)                # (T, E)
     ek = p["experts"]
-    g = jnp.einsum("th,ehi->tei", xt, ek["gate_proj"]["kernel"])
-    u = jnp.einsum("th,ehi->tei", xt, ek["up_proj"]["kernel"])
+
+    def expert_proj(spec: str, inp: jnp.ndarray, ep: dict) -> jnp.ndarray:
+        # int8 stacked expert kernels carry a per-expert-per-output-channel
+        # scale (E, out); as with _linear, XLA fuses the convert into the
+        # contraction so HBM reads int8 (weights.quantize_params_int8).
+        w = ep["kernel"]
+        y = jnp.einsum(spec, inp, w.astype(inp.dtype))
+        if "scale" in ep:
+            y = y * ep["scale"][None].astype(y.dtype)
+        return y
+
+    g = expert_proj("th,ehi->tei", xt, ek["gate_proj"])
+    u = expert_proj("th,ehi->tei", xt, ek["up_proj"])
     h = _act(g, cfg.act) * u
-    o = jnp.einsum("tei,eih->teh", h, ek["down_proj"]["kernel"])
+    o = expert_proj("tei,eih->teh", h, ek["down_proj"])
     y = jnp.einsum("teh,te->th", o, combine.astype(o.dtype))
     return y.reshape(shape)
 

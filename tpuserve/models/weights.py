@@ -251,17 +251,21 @@ def load_or_init(cfg: ModelConfig, ckpt_dir: str | None, seed: int = 0) -> Param
 # reference relies on exposes the same class of option (quantized serving);
 # here it is a one-flag engine feature (EngineConfig.quantization="int8").
 
-def _quantize_channelwise(w: jnp.ndarray, axis: int):
-    """w -> (int8 weights, float32 scale along every axis but ``axis``).
+def _quantize_channelwise(w: jnp.ndarray, axis: int | tuple[int, ...]):
+    """w -> (int8 weights, float32 scale along the kept ``axis`` axes).
 
     Symmetric: w ≈ w_q * scale, scale = max|w| / 127 per output channel.
+    ``axis`` may be a tuple (e.g. (0, 2) for stacked MoE expert kernels
+    (E, in, out): per-expert-per-output-channel scales shaped (E, out)).
     """
+    keep = (axis,) if isinstance(axis, int) else tuple(axis)
     w32 = np.asarray(w, np.float32)
-    reduce_axes = tuple(i for i in range(w32.ndim) if i != axis)
+    reduce_axes = tuple(i for i in range(w32.ndim) if i not in keep)
     amax = np.max(np.abs(w32), axis=reduce_axes, keepdims=True)
     scale = np.where(amax > 0, amax / 127.0, 1.0)
     q = np.clip(np.rint(w32 / scale), -127, 127).astype(np.int8)
-    return jnp.asarray(q), jnp.asarray(scale.reshape(-1), jnp.float32)
+    kept_shape = tuple(w32.shape[i] for i in sorted(keep))
+    return jnp.asarray(q), jnp.asarray(scale.reshape(kept_shape), jnp.float32)
 
 
 def quantize_params_int8(params: Params) -> Params:
@@ -280,10 +284,24 @@ def quantize_params_int8(params: Params) -> Params:
             out["bias"] = p["bias"]
         return out
 
+    def quant_experts(ep: dict) -> dict:
+        # Stacked expert kernels (E, in, out): per-expert-per-output-channel
+        # scales (E, out).  For MoE models the experts are the vast majority
+        # of weights, so skipping them would void the HBM saving int8 exists
+        # for (the r2 advisor caught exactly that).
+        out = {}
+        for proj, p in ep.items():
+            q, scale = _quantize_channelwise(p["kernel"], axis=(0, 2))
+            out[proj] = {"kernel": q, "scale": scale}
+        return out
+
     def quant_layer(lp: dict) -> dict:
         out = {}
         for name, p in lp.items():
-            out[name] = quant_linear(p) if "kernel" in p else p
+            if name == "experts":
+                out[name] = quant_experts(p)
+            else:
+                out[name] = quant_linear(p) if "kernel" in p else p
         return out
 
     new = {"layers": [quant_layer(lp) for lp in params["layers"]]}

@@ -38,6 +38,8 @@ def _spec_for(path: str, cfg: ModelConfig) -> P:
     if "experts." in path:
         if path.endswith("kernel"):
             return P(AXIS_EP, None, None)
+        if path.endswith("scale"):      # int8 (E, out) scales follow experts
+            return P(AXIS_EP, None)
         return P()
     # column-parallel kernels: (in, out) with out sharded; int8 per-output
     # quantization scales follow the out axis like biases
