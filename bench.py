@@ -72,6 +72,21 @@ def _probe_backend_once() -> bool:
         return False                 # hung init == dead tunnel
 
 
+def build_cpu_env(reason: str, base: dict | None = None) -> dict:
+    """Environment for a degraded CPU run: pin the CPU backend, skip the
+    probe, mark the output DEGRADED, and drop the axon sitecustomize so the
+    dead tunnel can't hang CPU init.  Shared with tools/bench_sweep.py
+    ``--cpu`` so sweep degradation can't drift from in-bench degradation."""
+    env = dict(base if base is not None else os.environ)
+    env["TPUSERVE_BENCH_REEXEC"] = "1"
+    env["TPUSERVE_BENCH_DEGRADED"] = reason
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if p and "axon" not in p)
+    return env
+
+
 def _degrade_to_cpu(reason: str) -> None:
     """Re-exec this bench on CPU with the DEGRADED marker set.  Used both
     when the pre-flight probe fails and when the TPU tunnel dies *mid-run*
@@ -79,16 +94,9 @@ def _degrade_to_cpu(reason: str) -> None:
     driver must still get its one JSON line, and that line must scream
     that it is not a TPU result."""
     import sys
-    env = os.environ.copy()
-    env["TPUSERVE_BENCH_REEXEC"] = "1"
-    env["TPUSERVE_BENCH_DEGRADED"] = reason
+    env = build_cpu_env(reason)
     if _PROBE_ERROR["text"]:
         env["TPUSERVE_BENCH_PROBE_ERROR"] = _PROBE_ERROR["text"]
-    env["JAX_PLATFORMS"] = "cpu"
-    # drop the axon sitecustomize so the dead tunnel can't hang CPU init
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":")
-        if p and "axon" not in p)
     print(f"DEGRADED: {reason}; re-running on cpu", flush=True)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 

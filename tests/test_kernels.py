@@ -65,6 +65,27 @@ def test_paged_decode_multi_group(pages_per_group):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("B,seqs_pp", [(5, 2), (11, 8), (4, 4)])
+def test_paged_decode_multi_seq_programs(B, seqs_pp):
+    """Multi-sequence grid programs (cross-sequence DMA pipeline): batch not
+    divisible by seqs_per_program exercises the zero-length padding path,
+    and mixed lengths exercise per-sequence group counts within a program."""
+    Hq, Hkv, D, page, nb, mp = 4, 2, 32, 4, 64, 8
+    rng = np.random.default_rng(B * 13 + seqs_pp)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(0, nb, (B, mp)), jnp.int32)
+    sl = np.asarray(rng.integers(1, page * mp + 1, (B,)), np.int32)
+    sl[0] = 1                       # single-token and full-length extremes
+    sl[-1] = page * mp
+    sl = jnp.asarray(sl)
+    ref = ref_ops.paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5, interpret=True,
+                                 pages_per_group=2, seqs_per_program=seqs_pp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_paged_decode_single_token_sequence():
     # seq_len == 1: only the freshly written token is attended to.
     D = 16

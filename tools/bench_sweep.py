@@ -8,7 +8,12 @@ so a flaky TPU tunnel yields partial results instead of nothing; every
 completed variant is appended to BENCHMARKS.md and bench_sweep.jsonl
 immediately.
 
-Usage: python tools/bench_sweep.py [--quick] [--only NAME[,NAME...]]
+``--cpu`` forces the whole sweep onto the CPU backend (skipping the
+TPU-tunnel probe entirely) and stamps every row DEGRADED — for recording
+relative variant behaviour when the chip is unreachable; CPU absolute
+numbers are meaningless against the TPU target.
+
+Usage: python tools/bench_sweep.py [--quick] [--cpu] [--only NAME[,..]]
 """
 
 from __future__ import annotations
@@ -22,29 +27,52 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-VARIANTS = [
-    # (name, args) — ordered smallest-compile-first
-    ("base-multistep8", []),                       # TPU defaults: S=8, pallas
-    ("multistep1", ["--multi-step", "1"]),
-    ("multistep16", ["--multi-step", "16"]),
-    ("multistep32", ["--multi-step", "32"]),
-    ("no-pipeline", ["--no-pipeline", "--multi-step", "1"]),
-    ("attn-reference", ["--attn", "reference"]),
-    ("int8", ["--quant", "int8"]),
-    ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"]),
-    ("spec4", ["--spec", "4"]),
-    ("disagg", ["--compare-disagg"]),
+VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
+    # (name, bench.py args, extra env) — ordered smallest-compile-first
+    ("base-multistep8", [], {}),                   # TPU defaults: S=8, pallas
+    ("multistep1", ["--multi-step", "1"], {}),
+    ("multistep16", ["--multi-step", "16"], {}),
+    ("multistep32", ["--multi-step", "32"], {}),
+    ("no-pipeline", ["--no-pipeline", "--multi-step", "1"], {}),
+    ("attn-reference", ["--attn", "reference"], {}),
+    # Paged-decode kernel knobs (pallas_paged_attention.py): sequences per
+    # grid program (cross-sequence DMA pipeline depth) and pages per group.
+    ("pallas-spp1", ["--attn", "pallas", "--multi-step", "1"],
+     {"TPUSERVE_SEQS_PER_PROGRAM": "1"}),
+    ("pallas-spp4", ["--attn", "pallas", "--multi-step", "1"],
+     {"TPUSERVE_SEQS_PER_PROGRAM": "4"}),
+    ("pallas-spp16", ["--attn", "pallas", "--multi-step", "1"],
+     {"TPUSERVE_SEQS_PER_PROGRAM": "16"}),
+    ("pallas-ppg4", ["--attn", "pallas", "--multi-step", "1"],
+     {"TPUSERVE_PAGES_PER_GROUP": "4"}),
+    ("pallas-ppg32", ["--attn", "pallas", "--multi-step", "1"],
+     {"TPUSERVE_PAGES_PER_GROUP": "32"}),
+    ("int8", ["--quant", "int8"], {}),
+    ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
+    ("spec4", ["--spec", "4"], {}),
+    ("disagg", ["--compare-disagg"], {}),
 ]
 
-QUICK = ["base-multistep8", "multistep1", "int8"]
+QUICK = ["base-multistep8", "multistep1", "int8", "disagg"]
 
 
-def run_variant(name: str, args: list[str], timeout: int) -> dict | None:
-    cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + args
+def cpu_env() -> dict[str, str]:
+    """Environment that pins bench.py to CPU and skips the tunnel probe
+    (bench.py's own degradation env builder, so the two can't drift)."""
+    sys.path.insert(0, ROOT)
+    from bench import build_cpu_env
+    return build_cpu_env(
+        "cpu-only sweep (--cpu): relative variant data, NOT a TPU result")
+
+
+def run_variant(name: str, args: list[str], timeout: int,
+                env: dict[str, str] | None = None,
+                bench_path: str | None = None) -> dict | None:
+    cmd = [sys.executable, bench_path or os.path.join(ROOT, "bench.py")] + args
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, cwd=ROOT)
+                              timeout=timeout, cwd=ROOT, env=env)
     except subprocess.TimeoutExpired:
         print(f"--- {name}: TIMEOUT after {timeout}s", flush=True)
         return None
@@ -68,14 +96,32 @@ def run_variant(name: str, args: list[str], timeout: int) -> dict | None:
     return result
 
 
+def format_row(r: dict) -> str:
+    notes = []
+    if r.get("degraded"):
+        notes.append("DEGRADED")
+    if r.get("rc"):
+        notes.append(f"rc={r['rc']} (died post-measurement)")
+    if "spec" in r:
+        notes.append(f"accept={r['spec']['acceptance']}, "
+                     f"tok/step={r['spec']['tokens_per_step']}")
+    if "disagg" in r:
+        notes.append(f"disagg={r['disagg']['decode_tok_s']} "
+                     f"({r['disagg']['vs_colocated']}x)")
+    return (f"| {r['variant']} | {r['backend']} | {r['value']} | "
+            f"{r['vs_baseline']} | {r['ttft_ms']} | {r['attn_impl']} "
+            f"| {r.get('multi_step')} | {r.get('quantization') or '-'}"
+            f" | {'; '.join(notes) or '-'} |\n")
+
+
 _HEADER_WRITTEN = False
 
 
-def append_markdown(r: dict) -> None:
+def append_markdown(r: dict, path: str | None = None) -> None:
     """Append ONE result row immediately — a crash or Ctrl-C mid-sweep must
     not lose the variants that already completed."""
     global _HEADER_WRITTEN
-    path = os.path.join(ROOT, "BENCHMARKS.md")
+    path = path or os.path.join(ROOT, "BENCHMARKS.md")
     new_file = not os.path.exists(path)
     with open(path, "a") as f:
         if new_file:
@@ -91,34 +137,23 @@ def append_markdown(r: dict) -> None:
                     "| attn | S | quant | notes |\n"
                     "|---|---|---|---|---|---|---|---|---|\n")
             _HEADER_WRITTEN = True
-        notes = []
-        if r.get("degraded"):
-            notes.append("DEGRADED")
-        if r.get("rc"):
-            notes.append(f"rc={r['rc']} (died post-measurement)")
-        if "spec" in r:
-            notes.append(f"accept={r['spec']['acceptance']}, "
-                         f"tok/step={r['spec']['tokens_per_step']}")
-        if "disagg" in r:
-            notes.append(f"disagg={r['disagg']['decode_tok_s']} "
-                         f"({r['disagg']['vs_colocated']}x)")
-        f.write(f"| {r['variant']} | {r['backend']} | {r['value']} | "
-                f"{r['vs_baseline']} | {r['ttft_ms']} | {r['attn_impl']} "
-                f"| {r.get('multi_step')} | {r.get('quantization') or '-'}"
-                f" | {'; '.join(notes) or '-'} |\n")
+        f.write(format_row(r))
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
-                    help="three-variant sweep only")
+                    help="four-variant sweep only")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (skip the tunnel probe); "
+                         "rows are stamped DEGRADED")
     ap.add_argument("--only", default=None,
                     help="comma-separated variant names")
     ap.add_argument("--timeout", type=int, default=5400,
                     help="per-variant timeout (first compile through a "
                          "tunnel can take >30 min)")
     args = ap.parse_args()
-    known = [n for n, _ in VARIANTS]
+    known = [n for n, _, _ in VARIANTS]
     if args.only:
         names = [n.strip() for n in args.only.split(",")]
         unknown = sorted(set(names) - set(known))
@@ -126,12 +161,17 @@ def main():
             ap.error(f"unknown variants {unknown}; known: {known}")
     else:
         names = QUICK if args.quick else known
+    base_env = cpu_env() if args.cpu else None
     count = 0
     log = open(os.path.join(ROOT, "bench_sweep.jsonl"), "a")
-    for name, vargs in VARIANTS:
+    for name, vargs, venv in VARIANTS:
         if name not in names:
             continue
-        r = run_variant(name, vargs, args.timeout)
+        env = None
+        if base_env is not None or venv:
+            env = dict(base_env if base_env is not None else os.environ)
+            env.update(venv)
+        r = run_variant(name, vargs, args.timeout, env=env)
         if r is not None:
             print(json.dumps(r), flush=True)
             log.write(json.dumps(r) + "\n")

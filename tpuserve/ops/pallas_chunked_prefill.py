@@ -175,8 +175,8 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         in_specs=[
             pl.BlockSpec((1, blk_q, Hq, D),
                          lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # k_cache stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # v_cache stays in HBM
         ],
         out_specs=pl.BlockSpec((1, blk_q, Hq, D),
                                lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),

@@ -1,33 +1,50 @@
 """Pallas TPU paged attention for single-token decode.
 
-One grid program per sequence; the sequence's KV pages are DMA'd from HBM
-into a double-buffered VMEM scratch using the block table (scalar-prefetched
-so page addresses are known before the kernel body runs), with an online
-softmax accumulated across page *groups*.  This is the TPU-native
-replacement for the CUDA paged-attention kernels inside the vLLM image the
-reference deploys (reference: kubernetes-single-node.yaml:14; SURVEY.md
-§2.2, §7 "hard parts" — see also PAPERS.md "Ragged Paged Attention").
+Each grid program now handles ``seqs_per_program`` sequences (VERDICT r2
+weak #3 asked for multi-sequence programs): the per-(sequence, page-group)
+KV chunks are DMA'd from HBM into a double-buffered VMEM scratch using the
+block table (scalar-prefetched so page addresses are known before the
+kernel body runs), with the prefetch pipeline running *across sequence
+boundaries* — while sequence ``s``'s last group is contracting, sequence
+``s+1``'s first group is already in flight.  A single-sequence-per-program
+grid exposes the full first-group DMA latency once per sequence (for the
+decode-typical one-group case that is *every* sequence, i.e. zero overlap);
+the flattened pipeline keeps HBM reads continuous for the whole batch.
 
-Two levers matter for decode throughput here (VERDICT r1 asked for both):
+This is the TPU-native replacement for the CUDA paged-attention kernels
+inside the vLLM image the reference deploys (reference:
+kubernetes-single-node.yaml:14; SURVEY.md §2.2, §7 "hard parts" — see also
+PAPERS.md "Ragged Paged Attention").
+
+Why the occupancy lever is DMA, not the MXU (BENCHMARKS.md carries the
+full analysis): decode reads each KV byte exactly once per step, so its
+arithmetic intensity is ~1 FLOP/byte — two orders of magnitude below the
+MXU's compute:bandwidth balance point.  The kernel is therefore
+bandwidth-bound by construction; padding the QK contraction to 128 q rows
+(e.g. cross-sequence block-diagonal packing) multiplies FLOPs by the
+packing factor for identical wall-clock at best.  What matters is (a)
+never letting the HBM pipe drain (the cross-sequence prefetch above) and
+(b) keeping the dots in the KV's stored dtype:
 
 - **Native-dtype MXU dots.**  The QK and PV contractions consume q/k/v in
   their stored dtype (bf16 KV cache) with fp32 accumulation
-  (``preferred_element_type``) — upcasting to fp32 *before* the dot, as
-  round 1 did, runs the MXU at its slow fp32 rate for no accuracy gain
-  over fp32 accumulation.
+  (``preferred_element_type``) — upcasting to fp32 *before* the dot runs
+  the MXU at its slow fp32 rate for no accuracy gain.
 - **Page groups.**  Each loop iteration consumes ``G`` pages at once: one
   (group, D) x (D, G*page) contraction instead of G skinny per-page dots,
-  amortising loop/relayout overhead and keeping the MXU fed; the
-  double-buffered group prefetch overlaps the next G page DMAs with
-  compute.
+  amortising loop/relayout overhead.
 
 Semantics match ``tpuserve.ops.attention.paged_decode_attention``; verified
 against it in interpret mode on CPU.
+
+Sweepable knobs (bench_sweep drives them via env, static at trace time):
+``TPUSERVE_PAGES_PER_GROUP`` and ``TPUSERVE_SEQS_PER_PROGRAM``.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,20 +59,42 @@ NEG_INF = -1e30
 # comfortably inside VMEM next to the q/output blocks.
 TARGET_GROUP_ROWS = 512
 
+# Sequences per grid program: deep enough that the cross-sequence DMA
+# pipeline hides each first-group latency behind the previous sequence's
+# compute.  The grid stays sequential ("arbitrary" dimension semantics):
+# programs are in fact independent, but flipping to "parallel" megacore
+# partitioning for a manual-DMA kernel is an optimization to land WITH a
+# TPU measurement, not before one.
+DEFAULT_SEQS_PER_PROGRAM = 8
+
+
+def _env_int(name: str) -> int | None:
+    val = os.environ.get(name)
+    return int(val) if val else None
+
 
 def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                          k_scr, v_scr, sems, *, scale, page_size, pages_g,
-                         num_kv_heads, group, head_dim):
-    b = pl.program_id(0)
-    seq_len = sl_ref[b]
-    num_pages = pl.cdiv(seq_len, page_size)
-    num_groups = pl.cdiv(num_pages, pages_g)
+                         num_kv_heads, group, head_dim, seqs_pp):
+    p = pl.program_id(0)
+    base = p * seqs_pp
+    rows_g = pages_g * page_size
 
-    def start_group(g, slot):
+    def num_pages(s):
+        return pl.cdiv(sl_ref[base + s], page_size)
+
+    def num_groups(s):
+        # >= 1 so padded/empty sequences keep the chunk pipeline uniform
+        # (their zero pages mean no DMAs start and no waits happen).
+        return jnp.maximum(pl.cdiv(sl_ref[base + s], rows_g), 1)
+
+    def start_chunk(s, g, slot):
+        np_s = num_pages(s)
+
         def copy_one(j, _):
-            @pl.when(g * pages_g + j < num_pages)
+            @pl.when(g * pages_g + j < np_s)
             def _():
-                page = bt_ref[b, g * pages_g + j]
+                page = bt_ref[base + s, g * pages_g + j]
                 pltpu.make_async_copy(
                     k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).start()
                 pltpu.make_async_copy(
@@ -63,11 +102,13 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
             return 0
         jax.lax.fori_loop(0, pages_g, copy_one, 0)
 
-    def wait_group(g, slot):
+    def wait_chunk(s, g, slot):
+        np_s = num_pages(s)
+
         def wait_one(j, _):
-            @pl.when(g * pages_g + j < num_pages)
+            @pl.when(g * pages_g + j < np_s)
             def _():
-                page = bt_ref[b, g * pages_g + j]
+                page = bt_ref[base + s, g * pages_g + j]
                 pltpu.make_async_copy(
                     k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).wait()
                 pltpu.make_async_copy(
@@ -75,104 +116,148 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
             return 0
         jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
-    start_group(0, 0)
+    start_chunk(0, 0, 0)
 
-    rows_g = pages_g * page_size
-    q_r = q_ref[0].reshape(num_kv_heads, group, head_dim)   # stored dtype
+    def seq_body(s, parity0):
+        seq_len = sl_ref[base + s]
+        ng = num_groups(s)
+        q_r = q_ref[pl.ds(s, 1)].reshape(num_kv_heads, group, head_dim)
 
-    m0 = jnp.full((num_kv_heads, group, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
-    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+        m0 = jnp.full((num_kv_heads, group, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
+        acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
 
-    def body(g, carry):
-        m_prev, l_prev, acc_prev = carry
-        slot = jax.lax.rem(g, 2)
+        def body(g, carry):
+            m_prev, l_prev, acc_prev = carry
+            slot = jax.lax.rem(parity0 + g, 2)
 
-        @pl.when(g + 1 < num_groups)
-        def _prefetch():
-            start_group(g + 1, 1 - slot)
+            # Prefetch the pipeline's next chunk into the other slot:
+            # this sequence's next group, or the next sequence's first.
+            @pl.when(g + 1 < ng)
+            def _prefetch_group():
+                start_chunk(s, g + 1, 1 - slot)
 
-        wait_group(g, slot)
-        # (pages_g, page, Hkv, D) -> (Hkv, rows_g, D), stored dtype
-        k = jnp.swapaxes(k_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
-                         0, 1)
-        v = jnp.swapaxes(v_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
-                         0, 1)
-        # Zero V rows past the sequence: pages of the group that were never
-        # DMA'd hold unspecified scratch (possibly NaN), and 0 * NaN would
-        # poison the accumulator even though those probabilities are 0.
-        row_pos = g * rows_g + jax.lax.broadcasted_iota(
-            jnp.int32, (num_kv_heads, rows_g, 1), 1)
-        v = jnp.where(row_pos < seq_len, v, jnp.zeros_like(v))
-        # (Hkv, group, D) x (Hkv, rows, D) -> (Hkv, group, rows); bf16 MXU
-        # inputs, fp32 accumulation; scale applied to the fp32 product.
-        s = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
-                                preferred_element_type=jnp.float32) * scale
-        pos = g * rows_g + jax.lax.broadcasted_iota(
-            jnp.int32, (num_kv_heads, group, rows_g), 2)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+            @pl.when((g + 1 == ng) & (s + 1 < seqs_pp))
+            def _prefetch_seq():
+                start_chunk(s + 1, 0, 1 - slot)
 
-        m_cur = jnp.max(s, axis=2, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        correction = jnp.exp(m_prev - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=2, keepdims=True)
-        # Invalid rows have p == 0 exactly, so stale scratch V cannot leak;
-        # p in V's dtype keeps the second contraction on the fast MXU path.
-        pv = jax.lax.dot_general(p.astype(v.dtype), v,
-                                 (((2,), (1,)), ((0,), (0,))),
-                                 preferred_element_type=jnp.float32)
-        acc_new = acc_prev * correction + pv
-        return m_new, l_new, acc_new
+            wait_chunk(s, g, slot)
+            # (pages_g, page, Hkv, D) -> (Hkv, rows_g, D), stored dtype
+            k = jnp.swapaxes(
+                k_scr[slot].reshape(rows_g, num_kv_heads, head_dim), 0, 1)
+            v = jnp.swapaxes(
+                v_scr[slot].reshape(rows_g, num_kv_heads, head_dim), 0, 1)
+            # Zero V rows past the sequence: pages of the group that were
+            # never DMA'd hold unspecified scratch (possibly NaN), and
+            # 0 * NaN would poison the accumulator even though those
+            # probabilities are 0.
+            row_pos = g * rows_g + jax.lax.broadcasted_iota(
+                jnp.int32, (num_kv_heads, rows_g, 1), 1)
+            v = jnp.where(row_pos < seq_len, v, jnp.zeros_like(v))
+            # (Hkv, group, D) x (Hkv, rows, D) -> (Hkv, group, rows); bf16
+            # MXU inputs, fp32 accumulation; scale on the fp32 product.
+            sc = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32) * scale
+            pos = g * rows_g + jax.lax.broadcasted_iota(
+                jnp.int32, (num_kv_heads, group, rows_g), 2)
+            sc = jnp.where(pos < seq_len, sc, NEG_INF)
 
-    m, l, acc = jax.lax.fori_loop(0, num_groups, body, (m0, l0, acc0))
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / safe_l).reshape(num_kv_heads * group, head_dim)
-    o_ref[0] = out.astype(o_ref.dtype)
+            m_cur = jnp.max(sc, axis=2, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            pr = jnp.exp(sc - m_new)
+            correction = jnp.exp(m_prev - m_new)
+            l_new = l_prev * correction + jnp.sum(pr, axis=2, keepdims=True)
+            # Invalid rows have pr == 0 exactly, so stale scratch V cannot
+            # leak; pr in V's dtype keeps the second contraction on the
+            # fast MXU path.
+            pv = jax.lax.dot_general(pr.astype(v.dtype), v,
+                                     (((2,), (1,)), ((0,), (0,))),
+                                     preferred_element_type=jnp.float32)
+            acc_new = acc_prev * correction + pv
+            return m_new, l_new, acc_new
+
+        m, l, acc = jax.lax.fori_loop(0, ng, body, (m0, l0, acc0))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc / safe_l).reshape(1, num_kv_heads * group, head_dim)
+        o_ref[pl.ds(s, 1)] = out.astype(o_ref.dtype)
+        return parity0 + ng
+
+    jax.lax.fori_loop(0, seqs_pp, seq_body, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret", "pages_per_group"))
 def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
                            interpret: bool | None = None,
-                           pages_per_group: int | None = None) -> jnp.ndarray:
+                           pages_per_group: int | None = None,
+                           seqs_per_program: int | None = None) -> jnp.ndarray:
     """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
-    block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D)."""
-    B, Hq, D = q.shape
-    num_blocks, page_size, Hkv, _ = k_cache.shape
+    block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D).
+
+    The env knobs are resolved HERE, outside jit, and passed as static
+    args — reading them inside the traced function would capture them at
+    first trace and silently ignore later changes (the jit cache key only
+    covers shapes and statics)."""
+    page_size = k_cache.shape[1]
     max_pages = block_tables.shape[1]
-    group = Hq // Hkv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    pages_g = pages_per_group or max(
-        1, -(-TARGET_GROUP_ROWS // page_size))
+    pages_g = (pages_per_group or _env_int("TPUSERVE_PAGES_PER_GROUP")
+               or max(1, -(-TARGET_GROUP_ROWS // page_size)))
     pages_g = min(pages_g, max_pages)
+    seqs_pp = (seqs_per_program or _env_int("TPUSERVE_SEQS_PER_PROGRAM")
+               or DEFAULT_SEQS_PER_PROGRAM)
+    seqs_pp = min(seqs_pp, q.shape[0])
+    return _paged_decode_attention(q, k_cache, v_cache, block_tables,
+                                   seq_lens, scale=scale,
+                                   interpret=interpret, pages_g=pages_g,
+                                   seqs_pp=seqs_pp)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret",
+                                             "pages_g", "seqs_pp"))
+def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
+                            scale: float, interpret: bool, pages_g: int,
+                            seqs_pp: int) -> jnp.ndarray:
+    B, Hq, D = q.shape
+    num_blocks, page_size, Hkv, _ = k_cache.shape
+    group = Hq // Hkv
+
+    # Pad the batch to a whole number of programs; padded rows have
+    # seq_len 0 (no DMAs, masked scores) and are sliced off below.
+    Bp = -(-B // seqs_pp) * seqs_pp
+    if Bp != B:
+        pad = Bp - B
+        q = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+        block_tables = jnp.pad(block_tables, ((0, pad), (0, 0)))
+        seq_lens = jnp.pad(seq_lens, ((0, pad),))
 
     kernel = functools.partial(
         _paged_decode_kernel, scale=scale, page_size=page_size,
-        pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D)
+        pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D,
+        seqs_pp=seqs_pp)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B,),
+        grid=(Bp // seqs_pp,),
         in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),      # k_cache stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),      # v_cache stays in HBM
+            pl.BlockSpec((seqs_pp, Hq, D), lambda p, bt, sl: (p, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),      # k_cache stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # v_cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
+        out_specs=pl.BlockSpec((seqs_pp, Hq, D), lambda p, bt, sl: (p, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
             pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2, pages_g)),
         ],
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct((Bp, Hq, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
     )(block_tables, seq_lens, q, k_cache, v_cache)
+    return out[:B]
