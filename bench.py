@@ -235,12 +235,19 @@ def main(argv=None):
     # Persistent XLA compile cache: repeat bench invocations in the same
     # container skip the multi-minute model compiles entirely.  One dir per
     # platform — a CPU fallback run must not load TPU-era AOT entries (or
-    # vice versa), which XLA warns may SIGILL.
+    # vice versa), which XLA warns may SIGILL.  In-cluster pods mount the
+    # same mechanism via JAX_COMPILATION_CACHE_DIR on the model PVC
+    # (provision/manifests.py), which takes precedence here too.
+    cache_dir = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or "/root/.cache/jax_comp_cache_"
+                 + os.environ.get("JAX_PLATFORMS", "default"))
+    cache_entries_before = 0
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            "/root/.cache/jax_comp_cache_"
-            + os.environ.get("JAX_PLATFORMS", "default"))
+        cache_entries_before = len(os.listdir(cache_dir))
+    except OSError:
+        pass
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
@@ -305,7 +312,9 @@ def main(argv=None):
             raise
 
     with tpu_guard("tpu run"):
+        t_warm = time.perf_counter()
         _warm(engine, batch, prompt_len)
+        warmup_s = time.perf_counter() - t_warm
         r = _run_workload(engine, prompts, params)
 
     stats = r["stats"]
@@ -336,6 +345,10 @@ def main(argv=None):
         "e2e_tok_s": round(gen_tokens / r["total_s"], 1),
         "prefill_s": round(r["prefill_s"], 3),
         "decode_s": round(r["decode_s"], 3),
+        # Startup-cost story (BASELINE TTFT budget): warmup wall-clock and
+        # whether the persistent XLA cache was warm when compiles started.
+        "warmup_s": round(warmup_s, 1),
+        "compile_cache": "warm" if cache_entries_before else "cold",
     }
     degraded = os.environ.get("TPUSERVE_BENCH_DEGRADED")
     if degraded:

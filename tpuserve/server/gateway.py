@@ -169,8 +169,9 @@ class Gateway:
         class Handler(_GatewayHandler):
             ctx = gw
 
-        self._httpd = ThreadingHTTPServer((self.config.host, self.config.port),
-                                          Handler)
+        from tpuserve.server.openai_api import _HTTPServer
+        self._httpd = _HTTPServer((self.config.host, self.config.port),
+                                  Handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True,
                          name="tpuserve-gateway").start()
         self._health_thread = threading.Thread(target=self._health_loop,
@@ -197,6 +198,9 @@ class Gateway:
 class _GatewayHandler(BaseHTTPRequestHandler):
     ctx: Gateway
     protocol_version = "HTTP/1.1"
+    # small chunked re-writes per relayed SSE event — same Nagle story as
+    # the engine server (tools/load_test.py)
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):
         logger.debug("%s " + fmt, self.address_string(), *args)

@@ -27,6 +27,14 @@ from tpuserve.server.runner import AsyncEngineRunner
 logger = logging.getLogger("tpuserve.server")
 
 
+class _HTTPServer(ThreadingHTTPServer):
+    # socketserver's default TCP accept backlog is 5: a burst of N>5
+    # simultaneous connects (batch arrivals are the NORMAL serving
+    # pattern) gets connection-reset before the handler ever runs.
+    # Found by tests/test_load.py with 32 concurrent streaming clients.
+    request_queue_size = 128
+
+
 @dataclasses.dataclass
 class ServerConfig:
     host: str = "0.0.0.0"
@@ -133,8 +141,8 @@ class OpenAIServer:
         class Handler(_Handler):
             ctx = server
 
-        self._httpd = ThreadingHTTPServer((self.config.host, self.config.port),
-                                          Handler)
+        self._httpd = _HTTPServer((self.config.host, self.config.port),
+                                  Handler)
         self._serve_thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="tpuserve-http")
@@ -194,6 +202,10 @@ class OpenAIServer:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # TCP_NODELAY: per-token SSE events are small writes; Nagle holding
+    # them for the delayed ACK adds ~40ms per decode step per stream
+    # under concurrent load (measured by tools/load_test.py).
+    disable_nagle_algorithm = True
     ctx: OpenAIServer
     protocol_version = "HTTP/1.1"
 
@@ -498,6 +510,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_response(self, body, params, chat, kwargs, n=1):
         ctx = self.ctx
+        # vLLM-compatible extension: carry each chunk's token ids so
+        # clients (and the load harness) can count tokens exactly — chunk
+        # count != token count under fused multi-step decode.
+        ret_ids = bool(body.get("return_token_ids"))
         submits = self._submit_choices(params, kwargs, n)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         self.send_response(200)
@@ -574,6 +590,8 @@ class _Handler(BaseHTTPRequestHandler):
                     choice = {"index": idx, "text": item.new_text,
                               "finish_reason": finish}
                     obj = "text_completion"
+                if ret_ids:
+                    choice["token_ids"] = list(item.new_token_ids)
                 send_chunk({"id": oid, "object": obj, "created": int(time.time()),
                             "model": ctx.model_name, "choices": [choice]})
             done = b"data: [DONE]\n\n"
