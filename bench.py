@@ -210,7 +210,7 @@ def main(argv=None):
                     choices=["auto", "pallas", "reference"])
     ap.add_argument("--no-pipeline", action="store_true")
     ap.add_argument("--multi-step", type=int, default=None, metavar="S",
-                    help="fused decode window size (default: auto — 8 on "
+                    help="fused decode window size (default: auto — 32 on "
                          "TPU, off on CPU); 1 disables")
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="weight-only quantization variant")

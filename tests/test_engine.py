@@ -1,6 +1,7 @@
 """Engine end-to-end on CPU: continuous batching, stops, preemption,
 prefix caching, determinism."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -125,6 +126,32 @@ def test_stop_string():
 
 def test_warmup_compiles(engine):
     engine.warmup(prefill_buckets=[8], decode_buckets=[2])
+
+
+def test_warmup_hard_syncs(engine, monkeypatch):
+    """Warmup must end with a real host transfer, not block_until_ready:
+    on the tunnelled axon TPU platform block_until_ready is a no-op, so
+    without a device_get the first real request's sync pays for the whole
+    queued warmup backlog (measured: 53 s of phantom TTFT on hardware)."""
+    import tpuserve.runtime.engine as engine_mod
+    calls = []
+    real = engine_mod.hard_sync
+    monkeypatch.setattr(engine_mod, "hard_sync",
+                        lambda x: (calls.append(1), real(x))[1])
+    engine.warmup(prefill_buckets=[8], decode_buckets=[2])
+    assert calls, "Engine.warmup no longer drains the device queue via hard_sync"
+
+
+def test_hard_sync_shapes():
+    from tpuserve.utils import hard_sync
+    x = jnp.arange(6.0).reshape(2, 3)
+    assert hard_sync(x) is x
+    scalar = jnp.float32(3.0)
+    assert hard_sync(scalar) is scalar
+    tree = {"a": jnp.zeros((2,)), "b": [jnp.ones(())]}
+    assert hard_sync(tree) is tree
+    assert hard_sync([]) == []
+    assert hard_sync(np.zeros(3)) is not None  # non-jax leaves tolerated
 
 
 def test_generate_params_length_mismatch(engine):

@@ -37,7 +37,7 @@ from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
 from tpuserve.runtime.request import (
     FinishReason, Request, RequestOutput, RequestState, SamplingParams, check_stop)
 from tpuserve.runtime.scheduler import ScheduledBatch, Scheduler, SchedulerConfig
-from tpuserve.utils import next_power_of_2
+from tpuserve.utils import hard_sync, next_power_of_2
 
 logger = logging.getLogger("tpuserve.engine")
 
@@ -97,7 +97,12 @@ class EngineConfig:
     def resolve_multi_step(self) -> int:
         if self.multi_step is not None:
             return max(1, self.multi_step)
-        return 8 if jax.default_backend() == "tpu" else 1
+        # 32 measured best on v5e (BENCHMARKS.md sweep 2026-07-30: S=8
+        # 2,855 → S=16 3,406 → S=32 4,210 tok/s/chip): each window ends in
+        # one host sync, so wider windows amortise the host round-trip;
+        # overrun waste (window_overrun_tokens) stays bounded by S-1 per
+        # finished sequence.
+        return 32 if jax.default_backend() == "tpu" else 1
 
 
 @dataclasses.dataclass
@@ -1004,7 +1009,11 @@ class Engine:
                     tokens, jnp.zeros((1,), jnp.int32),
                     jnp.ones((1,), jnp.int32), slots, bt)
                 self._warm_sampling(logits, sample_modes)
-        logits.block_until_ready()
+        # hard_sync, not block_until_ready: on the tunnelled axon platform
+        # block_until_ready is a no-op and the first real request's host
+        # transfer would pay for the entire queued warmup backlog (measured
+        #: 53 s of "TTFT" that was actually deferred warmup execution).
+        hard_sync(logits)
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",
                     prefill_buckets, decode_buckets)
 

@@ -646,7 +646,7 @@ def main(argv=None):
                          "(0 disables; greedy requests only)")
     ap.add_argument("--multi-step", type=int, default=None,
                     help="fused decode window size — S decode+sample steps "
-                         "per dispatch (default: auto — 8 on TPU, off on "
+                         "per dispatch (default: auto — 32 on TPU, off on "
                          "CPU; 1 disables).  Tokens stream in bursts of S")
     ap.add_argument("--quantization", default=None, choices=["int8"],
                     help="weight-only quantization (int8 halves decode's "

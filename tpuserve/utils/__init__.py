@@ -1,3 +1,4 @@
-from tpuserve.utils.misc import cdiv, round_up, pad_to, next_power_of_2
+from tpuserve.utils.misc import (cdiv, round_up, pad_to, next_power_of_2,
+                                 hard_sync)
 
-__all__ = ["cdiv", "round_up", "pad_to", "next_power_of_2"]
+__all__ = ["cdiv", "round_up", "pad_to", "next_power_of_2", "hard_sync"]

@@ -24,3 +24,28 @@ def pad_to(seq, length, pad_value=0):
     """Pad a python list to ``length`` with ``pad_value`` (truncates if longer)."""
     seq = list(seq)[:length]
     return seq + [pad_value] * (length - len(seq))
+
+
+def hard_sync(x):
+    """Drain the device execution queue behind array ``x`` and return ``x``.
+
+    ``Array.block_until_ready()`` is a no-op on some PJRT plugins (observed
+    on the tunnelled ``axon`` TPU platform: it returns immediately while
+    tens of seconds of queued executions are still in flight, so the *next*
+    host transfer pays for the whole backlog — measured as a 53 s first-real
+    -prefill after a "complete" warmup).  A host transfer is the one
+    operation every backend must order after all queued work, so this
+    fetches a single element of (the first leaf of) ``x`` instead.  Cost on
+    a healthy backend: one 4-byte D2H copy.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    for leaf in leaves:
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    for leaf in leaves:
+        if hasattr(leaf, "addressable_shards"):
+            jax.device_get(leaf[(0,) * leaf.ndim])
+            break
+    return x
