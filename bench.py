@@ -327,6 +327,12 @@ def main(argv=None):
     pstats = r["pstats"]
     ttft_ms = (1000.0 * pstats.ttft_sum / pstats.ttft_count
                if pstats.ttft_count else 0.0)
+    # per-request percentiles (the BASELINE target is p50, not mean)
+    ttfts = sorted(1000.0 * (rq.first_token_time - rq.arrival_time)
+                   for rq in eng0.requests.values()
+                   if rq.first_token_time is not None)
+    ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else 0.0
+    ttft_p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))] if ttfts else 0.0
 
     out = {
         "metric": "decode_throughput",
@@ -342,6 +348,8 @@ def main(argv=None):
         "prompt_len": prompt_len,
         "gen_len": gen_len,
         "ttft_ms": round(ttft_ms, 1),
+        "ttft_p50_ms": round(ttft_p50, 1),
+        "ttft_p99_ms": round(ttft_p99, 1),
         "e2e_tok_s": round(gen_tokens / r["total_s"], 1),
         "prefill_s": round(r["prefill_s"], 3),
         "decode_s": round(r["decode_s"], 3),

@@ -234,3 +234,162 @@ def test_window_with_pallas_kernels():
     ref = build("reference", 1).generate(PROMPTS, params)
     pal = build("pallas", 3).generate(PROMPTS, params)
     assert _ids(pal) == _ids(ref)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined windows: window W+1 dispatched from W's device-resident last
+# column before W's host sync (Engine._pending_window)
+# ---------------------------------------------------------------------------
+
+def test_pipelined_window_matches_single_step():
+    params = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    piped = _engine(multi_step=4, pipeline_decode=True).generate(PROMPTS,
+                                                                 params)
+    assert _ids(piped) == _ids(base)
+    assert all(len(r.output_token_ids) == 10 for r in piped)
+
+
+def test_pipelined_window_seeded_sampling():
+    params = [SamplingParams(max_tokens=9, temperature=0.8, seed=s,
+                             ignore_eos=True) for s in (1, 2, 3)]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    piped = _engine(multi_step=4, pipeline_decode=True).generate(PROMPTS,
+                                                                 params)
+    assert _ids(piped) == _ids(base)
+
+
+def test_pipelined_window_zombie_rows_on_eos():
+    """A request that hits EOS inside window W is only discovered at W's
+    flush — after window W+1 (containing its row) was already dispatched.
+    That zombie row's tokens must be dropped whole, its blocks freed
+    exactly once, and every other stream must be unaffected."""
+    probe = _engine(multi_step=1).generate(
+        PROMPTS, SamplingParams(max_tokens=12, temperature=0.0,
+                                ignore_eos=True))
+    # make a token that actually occurs mid-stream the EOS: request 0
+    # then stops mid-window while the others keep decoding
+    eos = probe[0].output_token_ids[5]
+
+    def run(multi_step, pipeline):
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=4),
+            attn_impl="reference", multi_step=multi_step,
+            pipeline_decode=pipeline)
+        mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                                 dtype="float32", eos_token_id=eos)
+        eng = Engine(cfg, model_cfg=mc)
+        outs = eng.generate(PROMPTS,
+                            SamplingParams(max_tokens=12, temperature=0.0))
+        return outs, eng
+
+    base, _ = run(1, False)
+    assert any(r.finish_reason == FinishReason.STOP for r in base), (
+        "probe EOS token never fired — test is vacuous")
+    piped, eng = run(4, True)
+    assert _ids(piped) == _ids(base)
+    assert [r.finish_reason for r in piped] == [r.finish_reason for r in base]
+    assert eng.block_manager.num_seqs() == 0          # no leaked blocks
+    assert eng._pending_window is None
+    assert eng.stats.window_overrun_tokens > 0        # zombies were counted
+
+
+def test_pipelined_window_staggered_arrivals():
+    """Fresh prefills join mid-stream: their first window input is a
+    host-known token mixed (via _select_tokens) with the in-flight
+    window's device tokens."""
+    params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    def run(multi_step, pipeline):
+        eng = _engine(multi_step=multi_step, pipeline_decode=pipeline)
+        rids, pending = [], [list(p) for p in PROMPTS]
+        while pending or eng.has_work():
+            if pending:
+                rids.append(eng.add_request(prompt_token_ids=pending.pop(0),
+                                            params=params))
+            eng.step()
+        return [eng.requests.pop(r).output_token_ids for r in rids]
+
+    assert run(4, True) == run(1, False)
+
+
+def test_pipelined_window_abort_in_flight():
+    """Abort while a window is in flight: the aborted row is dropped at
+    flush, the engine drains, and other requests are unaffected."""
+    params = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    eng = _engine(multi_step=4, pipeline_decode=True)
+    rids = [eng.add_request(prompt_token_ids=p, params=params)
+            for p in PROMPTS]
+    for _ in range(3):
+        eng.step()
+    assert eng._pending_window is not None
+    assert eng.abort_request(rids[1])
+    while eng.has_work():
+        eng.step()
+    assert eng.block_manager.num_seqs() == 0
+    done = [eng.requests[r] for r in rids]
+    assert done[1].finish_reason == FinishReason.ABORT
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    for i in (0, 2):                       # unaffected streams match base
+        assert done[i].output_token_ids == base[i].output_token_ids
+
+
+def test_pipelined_window_capacity_fallback():
+    eng = _engine(multi_step=4, pipeline_decode=True, num_blocks=14,
+                  max_blocks_per_seq=8)
+    params = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    reqs = eng.generate(PROMPTS, params)
+    base = _engine(multi_step=1, num_blocks=14,
+                   max_blocks_per_seq=8).generate(PROMPTS, params)
+    assert _ids(reqs) == _ids(base)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_randomized_pipelined_equivalence_under_pressure(seed):
+    """The randomized pressure workload (chunked prefills, staggered
+    arrivals, preemptions, prefix caching, mixed sampling) must produce
+    identical streams with pipelined windows on."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    n_req = 6
+    prompts = []
+    for i in range(n_req):
+        L = int(rng.integers(2, 20))
+        base = [7, 8, 9, 10] if i % 2 == 0 else []
+        prompts.append(base + rng.integers(1, 400, size=L).tolist())
+    params = []
+    for i in range(n_req):
+        if i % 3 == 0:
+            params.append(SamplingParams(max_tokens=int(rng.integers(3, 15)),
+                                         temperature=0.8, seed=100 + i,
+                                         ignore_eos=True))
+        else:
+            params.append(SamplingParams(max_tokens=int(rng.integers(3, 15)),
+                                         temperature=0.0, ignore_eos=True))
+
+    def run(multi_step, pipeline):
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=12,
+                              max_blocks_per_seq=12, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=4,
+                                      prefill_chunk_size=8),
+            attn_impl="reference", multi_step=multi_step,
+            pipeline_decode=pipeline, enable_prefix_caching=True)
+        mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                                 dtype="float32")
+        eng = Engine(cfg, model_cfg=mc)
+        rids, pending = [], list(zip(prompts, params))
+        while pending or eng.has_work():
+            if pending:
+                pr, pa = pending.pop(0)
+                rids.append(eng.add_request(prompt_token_ids=pr, params=pa))
+            eng.step()
+        return [eng.requests.pop(r).output_token_ids for r in rids]
+
+    assert run(4, True) == run(1, False)

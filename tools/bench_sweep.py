@@ -29,8 +29,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # (name, bench.py args, extra env) — ordered smallest-compile-first
-    ("base-multistep8", [], {}),                   # TPU defaults: S=8, pallas
+    ("base", [], {}),                              # TPU defaults: S=32, pallas, piped
     ("multistep1", ["--multi-step", "1"], {}),
+    ("multistep8", ["--multi-step", "8"], {}),
     ("multistep16", ["--multi-step", "16"], {}),
     ("multistep32", ["--multi-step", "32"], {}),
     ("no-pipeline", ["--no-pipeline", "--multi-step", "1"], {}),
@@ -53,7 +54,7 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("disagg", ["--compare-disagg"], {}),
 ]
 
-QUICK = ["base-multistep8", "multistep1", "int8", "disagg"]
+QUICK = ["base", "multistep1", "int8", "disagg"]
 
 
 def cpu_env() -> dict[str, str]:

@@ -134,6 +134,18 @@ class PendingDecode:
     toks: jax.Array                  # (B,) int32, device-resident
 
 
+@dataclasses.dataclass
+class PendingWindow:
+    """An in-flight fused multi-step window (pipelined): the (B, S) token
+    block stays on device while the NEXT window is dispatched from its last
+    column, so the host sync that ends every window overlaps the next
+    window's device time instead of serialising with it (BENCHMARKS.md
+    sweep: that sync is the decode floor — S=1 810 → S=32 4,210 tok/s)."""
+    reqs: list
+    toks: jax.Array                  # (B, S) int32, device-resident
+    steps: int
+
+
 @jax.jit
 def _select_tokens(toks, gather, host, use_host):
     """Next-step input tokens without a host round-trip: previous step's
@@ -200,6 +212,7 @@ class Engine:
         self._detok: dict[str, IncrementalDetokenizer] = {}
         self._greedy_cache: dict[int, tuple] = {}
         self._pending: Optional[PendingDecode] = None
+        self._pending_window: Optional[PendingWindow] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
         self._multi_step = config.resolve_multi_step()
         # Speculation needs a single process: followers can't mirror the
@@ -323,7 +336,8 @@ class Engine:
         return True
 
     def has_work(self) -> bool:
-        return self.scheduler.has_work() or self._pending is not None
+        return (self.scheduler.has_work() or self._pending is not None
+                or self._pending_window is not None)
 
     # ------------------------------------------------------------------
     # Step
@@ -334,7 +348,7 @@ class Engine:
         batch = self.scheduler.schedule()
         if batch is None:
             # nothing schedulable but a decode result may still be in flight
-            return self._flush_pending()
+            return self._flush_pending() + self._flush_window()
         t0 = time.monotonic()
         if batch.kind == "prefill":
             outputs = self._run_prefill(batch)
@@ -554,13 +568,33 @@ class Engine:
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
+        p = self._pending_window
         reqs = [r for r in batch.requests if not r.finished]
+        pend_idx: dict[str, int] = {}
+        if p is not None:
+            pend_idx = {r.request_id: i for i, r in enumerate(p.reqs)}
+            # host-known completion rules: a request whose in-flight window
+            # reaches max_tokens / max_model_len must not get another
+            # window — it finishes when ``p`` is flushed below.
+            reqs = [r for r in reqs
+                    if r.request_id not in pend_idx
+                    or (len(r.output_token_ids) + p.steps
+                        < r.params.max_tokens
+                        and r.num_tokens + p.steps < self.max_seq_len)]
         if not reqs:
-            return outputs
-        if not self._try_reserve_window(reqs, S):
+            return outputs + self._flush_window()
+        # Rows continuing from the in-flight window need p.steps extra KV
+        # slots (its advance hasn't run yet); reserving the conservative
+        # bound for every row over-reserves fresh rows by p.steps slots,
+        # which stay attached and get used as the sequence grows.
+        window_need = S + (p.steps if p is not None else 0)
+        if not self._try_reserve_window(reqs, window_need):
+            # _run_decode flushes the in-flight window before preempting
             return outputs + self._run_decode(batch)
         B = self.scheduler.decode_bucket(len(reqs))
-        tokens = np.zeros((B,), np.int32)
+        host_tokens = np.zeros((B,), np.int32)
+        use_host = np.ones((B,), bool)
+        gather = np.zeros((B,), np.int32)
         positions = np.zeros((B,), np.int32)
         seq_lens = np.ones((B,), np.int32)
         active = np.zeros((B,), bool)
@@ -569,22 +603,51 @@ class Engine:
         block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
         for i, r in enumerate(reqs):
-            tokens[i] = r.output_token_ids[-1]
-            positions[i] = r.num_tokens - 1
-            seq_lens[i] = r.num_tokens
+            pi = pend_idx.get(r.request_id)
+            extra = p.steps if pi is not None else 0
+            nt = r.num_tokens + extra
+            if pi is None:
+                host_tokens[i] = r.output_token_ids[-1]
+            else:
+                # input token = last column of the in-flight window,
+                # gathered on device — no host round-trip
+                use_host[i] = False
+                gather[i] = pi
+            positions[i] = nt - 1
+            seq_lens[i] = nt
             active[i] = True
-            keys[i] = self._row_key(r)
+            keys[i] = self._row_key(r, extra_step=extra)
             temperature[i] = r.params.temperature
             bt = self.block_manager.block_table(r.request_id)
             block_tables[i, :len(bt)] = bt
         mode = ("greedy" if all(r.params.greedy for r in reqs)
                 else "temperature")
+        if p is not None:
+            tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
+                                    jnp.asarray(host_tokens),
+                                    jnp.asarray(use_host))
+        else:
+            tokens = jnp.asarray(host_tokens)
         toks, self.kv_cache = self._exec_decode_multi(
-            jnp.asarray(tokens), jnp.asarray(positions),
+            tokens, jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(active), jnp.asarray(keys),
             jnp.asarray(temperature), steps=S, mode=mode)
         self.stats.num_decode_steps += S
+        if self._pipeline_decode:
+            # resolve the PREVIOUS window while this one runs on device.
+            # A request that turns out to have finished inside ``p`` (EOS /
+            # stop string) is already baked into this dispatch: its rows
+            # compute into blocks freed at the flush — safe because device
+            # executions run in dispatch order through the donated cache,
+            # so any later owner of those blocks overwrites the stale slots
+            # (same invariant the single-step pipeline established for its
+            # one-slot overrun) — and its tokens are dropped at the next
+            # flush.
+            outputs += self._flush_window()
+            self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
+                                                 steps=S)
+            return outputs
         toks_h = np.asarray(jax.device_get(toks))
         # Commit the window's written KV BEFORE emitting: a request that
         # finishes mid-window frees its blocks inside _emit_one.
@@ -599,8 +662,40 @@ class Engine:
                     break
         return outputs
 
+    def _flush_window(self) -> list[RequestOutput]:
+        """Read the in-flight fused window's tokens and run the deferred
+        host-side bookkeeping (KV commit, append, detokenize, stop checks,
+        emission).  Rows whose request finished while the window was in
+        flight (EOS in the previous window, abort) are dropped whole — all
+        their tokens are overrun."""
+        p, self._pending_window = self._pending_window, None
+        if p is None:
+            return []
+        toks_h = np.asarray(jax.device_get(p.toks))
+        outputs: list[RequestOutput] = []
+        # Commit written KV BEFORE emitting (finish frees blocks mid-loop);
+        # zombie rows' blocks were already freed at the previous flush.
+        for r in p.reqs:
+            if not r.finished:
+                self.block_manager.advance(r.request_id, p.steps)
+        for i, r in enumerate(p.reqs):
+            if r.finished:
+                self.stats.window_overrun_tokens += p.steps
+                continue
+            for s in range(p.steps):
+                out = self._emit_one(r, int(toks_h[i, s]))
+                outputs.append(out)
+                if out.finished:
+                    self.stats.window_overrun_tokens += p.steps - 1 - s
+                    break
+        return outputs
+
     def _run_decode(self, batch: ScheduledBatch) -> list[RequestOutput]:
         outputs: list[RequestOutput] = []
+        # resolve any in-flight fused window first: this path mutates
+        # request/block state (append_slot, preemption) that must see the
+        # window's finishes
+        outputs += self._flush_window()
         reqs = [r for r in batch.requests if not r.finished]
         pending = self._pending
         # Penalties/logprobs read host-side token history, which is one step
@@ -696,6 +791,7 @@ class Engine:
         outputs: list[RequestOutput] = []
         if self._pending is not None:           # spec steps are synchronous
             outputs += self._flush_pending()
+        outputs += self._flush_window()
         reqs = [r for r in batch.requests if not r.finished]
         if not reqs:
             return outputs

@@ -655,6 +655,13 @@ def main(argv=None):
                     help="join a multi-host TPU slice via jax.distributed "
                          "(GKE injects TPU_WORKER_* env); process 0 serves, "
                          "others follow in lockstep")
+    ap.add_argument("--pipeline", dest="pipeline", action="store_true",
+                    default=None,
+                    help="force pipelined decode (in-flight step/window "
+                         "resolved one engine iteration late); default: "
+                         "auto — on on TPU, off on CPU")
+    ap.add_argument("--no-pipeline", dest="pipeline", action="store_false",
+                    help="force synchronous decode")
     ap.add_argument("--no-warmup", action="store_true")
     args = ap.parse_args(argv)
 
@@ -673,7 +680,8 @@ def main(argv=None):
                           max_blocks_per_seq=args.max_blocks_per_seq),
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
         attn_impl=args.attn_impl, speculative=spec,
-        multi_step=args.multi_step, quantization=args.quantization)
+        multi_step=args.multi_step, pipeline_decode=args.pipeline,
+        quantization=args.quantization)
     mesh = None
     if args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh

@@ -45,7 +45,10 @@ def hard_sync(x):
         if hasattr(leaf, "block_until_ready"):
             leaf.block_until_ready()
     for leaf in leaves:
-        if hasattr(leaf, "addressable_shards"):
+        # cross-host sharded arrays can't be indexed/fetched from one
+        # process — block_until_ready (above) is all we can do for those
+        if (hasattr(leaf, "addressable_shards")
+                and getattr(leaf, "is_fully_addressable", False)):
             jax.device_get(leaf[(0,) * leaf.ndim])
             break
     return x
