@@ -186,9 +186,15 @@ def _run_workload(engine, prompts, params):
     while engine.has_work():
         d0 = stats.num_decode_steps
         t0 = time.perf_counter()
-        engine.step()
+        outs = engine.step()
         dt = time.perf_counter() - t0
-        if stats.num_decode_steps > d0:
+        # A drain step that only flushes the last pipelined window runs no
+        # NEW decode steps (d0 unchanged) but blocks on a full window of
+        # decode compute — classify by what the step emitted, not just by
+        # the dispatch counter, or the final window lands in prefill_time
+        # and inflates decode tok/s.
+        if (stats.num_decode_steps > d0
+                or any(not o.from_prefill for o in outs)):
             decode_time += dt
         else:
             prefill_time += dt

@@ -96,3 +96,51 @@ def test_disagg_decode_pool_too_small_rejected_at_intake():
     # nothing leaked into either pool
     assert not disagg.has_work()
     assert disagg.prefill.block_manager.num_seqs() == 0
+
+
+def test_disagg_with_pipelined_windows_matches_colocated():
+    """The decode pool running the TPU-default decode shape (pipelined
+    fused windows) must still match the plain colocated engine: adopted
+    sequences enter windows with host-known first tokens, and the pool
+    drains its in-flight window at the end."""
+    colocated = Engine(_cfg())
+    p = SamplingParams(max_tokens=9, temperature=0.0, ignore_eos=True)
+    prompts = ["Hello world", "abcdefgh", "xy"]
+    ref = colocated.generate(prompts, p)
+
+    disagg = DisaggregatedEngine(
+        _cfg(), _cfg(multi_step=4, pipeline_decode=True))
+    out = disagg.generate(prompts, p)
+    for r, o in zip(ref, out):
+        assert r.output_token_ids == o.output_token_ids
+    assert disagg.decode._pending_window is None
+    assert disagg.prefill.block_manager.num_seqs() == 0
+    assert disagg.decode.block_manager.num_seqs() == 0
+
+
+def test_disagg_zombie_only_window_drains():
+    """Regression (r3 review, CONFIRMED deadlock): when every row of the
+    decode pool's in-flight pipelined window has finished (abort / EOS
+    discovered at flush), the scheduler goes idle while the window flush is
+    still owed.  step() gated on scheduler.has_work() never flushed it, so
+    has_work() stayed True and generate()/the runner spun forever."""
+    disagg = DisaggregatedEngine(
+        _cfg(), _cfg(multi_step=4, pipeline_decode=True))
+    p = SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True)
+    rid = disagg.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    # run until the decode pool has a window in flight
+    for _ in range(200):
+        disagg.step()
+        if disagg.decode._pending_window is not None:
+            break
+    assert disagg.decode._pending_window is not None
+    # abort the only request: the in-flight window is now zombie-only
+    assert disagg.abort_request(rid)
+    for _ in range(50):
+        if not disagg.has_work():
+            break
+        disagg.step()
+    assert not disagg.has_work(), (
+        "disagg engine failed to drain a zombie-only pending window")
+    assert disagg.decode._pending_window is None
+    assert disagg.decode.block_manager.num_seqs() == 0

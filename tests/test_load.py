@@ -120,3 +120,37 @@ def test_identical_prompts_identical_greedy_streams(stack):
     out = _run_clients(stack["direct"], prompts)
     streams = [json.dumps(out[i]["ids"]) for i in range(16)]
     assert len(set(streams)) == 1, "greedy streams diverged across clients"
+
+
+@pytest.fixture(scope="module")
+def windowed_stack():
+    """Engine server running the TPU-default decode shape — pipelined fused
+    windows — so SSE bursts of S tokens from per-request pump threads are
+    load-tested on CPU too."""
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=256, max_blocks_per_seq=8),
+        scheduler=SchedulerConfig(max_num_seqs=16, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        multi_step=3, pipeline_decode=True))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def test_concurrent_streaming_pipelined_windows(windowed_stack):
+    """32 concurrent clients against pipelined fused windows (S=3, GEN=6
+    not a multiple-of-window edge is covered by max_tokens drops): every
+    stream complete, token counts exact."""
+    prompts = [[2 + (i % 7), 3, 4 + (i % 5)] for i in range(N_CLIENTS)]
+    out = _run_clients(windowed_stack, prompts)
+    for i in range(N_CLIENTS):
+        assert out[i]["n_tokens"] == GEN_TOKENS, (i, out[i])
+
+
+def test_identical_greedy_streams_pipelined_windows(windowed_stack):
+    prompts = [[7, 8, 9]] * 16
+    out = _run_clients(windowed_stack, prompts)
+    streams = [json.dumps(out[i]["ids"]) for i in range(16)]
+    assert len(set(streams)) == 1, "greedy streams diverged across clients"

@@ -52,6 +52,16 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
+    # Alternate served families (the reference's other models,
+    # kubernetes-single-node.yaml:15 / templates/*.yaml) — random-init
+    # weights (air-gapped build host), so throughput is real but text is
+    # not; smaller batch for the 3.8B phi to fit v5e HBM alongside KV.
+    ("phi3-mini", ["--model", "phi3-mini", "--batch", "32"], {}),
+    ("opt-1.3b", ["--model", "opt-1.3b"], {}),
+    # Startup-cost story (BASELINE TTFT budget): identical run against an
+    # EMPTY persistent compile cache — warmup_s cold vs the warm rows
+    # above is the pod-restart cost the manifests' cache PVC removes.
+    ("cold-cache", [], {"JAX_COMPILATION_CACHE_DIR": "/tmp/tpuserve-coldcache"}),
 ]
 
 QUICK = ["base", "multistep1", "int8", "disagg"]
@@ -172,6 +182,11 @@ def main():
         if base_env is not None or venv:
             env = dict(base_env if base_env is not None else os.environ)
             env.update(venv)
+        cache_override = venv.get("JAX_COMPILATION_CACHE_DIR", "")
+        if cache_override.startswith("/tmp/"):
+            # cold-cache variants must actually start cold on every sweep
+            import shutil
+            shutil.rmtree(cache_override, ignore_errors=True)
         r = run_variant(name, vargs, args.timeout, env=env)
         if r is not None:
             print(json.dumps(r), flush=True)

@@ -195,7 +195,11 @@ class DisaggregatedEngine:
                 if out.finished and out.request_id in self.prefill.requests:
                     self.decode.requests[out.request_id] = \
                         self.prefill.requests.pop(out.request_id)
-        if self.decode.scheduler.has_work():
+        # Engine-level has_work, NOT scheduler-level: a pending pipelined
+        # window whose rows all finished (zombie-only) leaves the scheduler
+        # idle while the flush is still owed — gating on the scheduler
+        # would spin generate() forever without ever flushing it.
+        if self.decode.has_work():
             outputs.extend(self.decode.step())
         if self._ready and not self.decode.scheduler.has_work():
             # Decode went idle this step; its free block count is now at its

@@ -196,7 +196,9 @@ class PrefillHandoffEngine:
     def step(self) -> list[RequestOutput]:
         outputs: list[RequestOutput] = []
         self._apply_pending_actions()
-        if self.prefill.scheduler.has_work():
+        # Engine-level has_work: local-decode fallback requests can leave a
+        # zombie-only pipelined window behind (scheduler idle, flush owed)
+        if self.prefill.has_work():
             outputs.extend(self.prefill.step())
             # Freshly prefilled requests: pull out of the local scheduler
             # (this pod never decodes) and hand off — mirror of
@@ -216,7 +218,7 @@ class PrefillHandoffEngine:
                 outputs.append(self._relayed.get_nowait())
             except queue.Empty:
                 break
-        if not outputs and not self.prefill.scheduler.has_work():
+        if not outputs and not self.prefill.has_work():
             # Only relays in flight: block briefly for the next streamed
             # token so the runner loop doesn't spin on empty steps.
             try:

@@ -648,19 +648,11 @@ class Engine:
             self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
                                                  steps=S)
             return outputs
-        toks_h = np.asarray(jax.device_get(toks))
-        # Commit the window's written KV BEFORE emitting: a request that
-        # finishes mid-window frees its blocks inside _emit_one.
-        for r in reqs:
-            self.block_manager.advance(r.request_id, S)
-        for i, r in enumerate(reqs):
-            for s in range(S):
-                out = self._emit_one(r, int(toks_h[i, s]))
-                outputs.append(out)
-                if out.finished:
-                    self.stats.window_overrun_tokens += S - 1 - s
-                    break
-        return outputs
+        # synchronous: flush the just-dispatched window immediately (one
+        # code path for the KV-commit-before-emit and overrun invariants)
+        self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
+                                             steps=S)
+        return outputs + self._flush_window()
 
     def _flush_window(self) -> list[RequestOutput]:
         """Read the in-flight fused window's tokens and run the deferred
