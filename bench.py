@@ -128,7 +128,8 @@ def _ensure_live_backend(retry: bool = True) -> None:
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
-                  prefix_caching=False, multi_step=None, quantization=None):
+                  prefix_caching=False, multi_step=None, quantization=None,
+                  prefill_split=1):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -139,12 +140,18 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     cache = CacheConfig(block_size=block_size,
                         num_blocks=batch * blocks_per_seq + 2 * batch,
                         max_blocks_per_seq=blocks_per_seq)
-    # Admit the whole batch in ONE prefill step: queueing behind 8-seq
-    # prefill batches is what dominates mean TTFT when all requests arrive
-    # at once (and one big batch keeps the MXU busier than eight small ones).
+    # Admit the whole batch in ONE prefill step by default: queueing behind
+    # 8-seq prefill batches is what dominates mean TTFT when all requests
+    # arrive at once (and one big batch keeps the MXU busier than eight
+    # small ones).  --prefill-split N trades that for p50: the first
+    # batch's requests see first tokens ~N× sooner while the last batch
+    # pays an extra dispatch round-trip.
+    seqs_per_batch = max(1, batch // max(1, prefill_split))
     sched = SchedulerConfig(max_num_seqs=batch,
-                            max_prefill_seqs=batch,
-                            max_prefill_tokens=max(8192, batch * prompt_len))
+                            max_prefill_seqs=seqs_per_batch,
+                            max_prefill_tokens=max(
+                                8192 // max(1, prefill_split),
+                                seqs_per_batch * prompt_len))
     spec = None
     if spec_k:
         from tpuserve.runtime.spec import SpecConfig
@@ -165,7 +172,15 @@ def _warm(engine, batch, prompt_len):
     from tpuserve.utils import next_power_of_2
     eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
     L = eng.scheduler.prefill_bucket(prompt_len)
-    eng.warmup(prefill_buckets=[(next_power_of_2(batch), L)],
+    # with --prefill-split the scheduler admits smaller prefill batches;
+    # warm EVERY prefill batch shape the run will hit — including the
+    # leftover batch of a non-dividing split — or the first real prefill
+    # recompiles (the 53 s phantom-TTFT failure mode)
+    per = min(batch, eng.scheduler.cfg.max_prefill_seqs)
+    buckets = {next_power_of_2(per)}
+    if batch % per:
+        buckets.add(next_power_of_2(batch % per))
+    eng.warmup(prefill_buckets=[(b, L) for b in sorted(buckets)],
                decode_buckets=[eng.scheduler.decode_bucket(batch)],
                sample_modes=("greedy",))
     if eng is not engine:
@@ -226,6 +241,9 @@ def main(argv=None):
     ap.add_argument("--compare-disagg", action="store_true",
                     help="also measure the disaggregated prefill/decode "
                          "engine on the same workload")
+    ap.add_argument("--prefill-split", type=int, default=1, metavar="N",
+                    help="admit the arrival burst in N prefill batches "
+                         "instead of one (p50-TTFT vs throughput trade)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -281,7 +299,8 @@ def main(argv=None):
     engine = _build_engine(model, batch, prompt_len, gen_len,
                            attn_impl=attn_impl, pipeline=pipeline,
                            spec_k=args.spec, multi_step=args.multi_step,
-                           quantization=args.quant)
+                           quantization=args.quant,
+                           prefill_split=args.prefill_split)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)

@@ -48,8 +48,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
      {"TPUSERVE_PAGES_PER_GROUP": "4"}),
     ("pallas-ppg32", ["--attn", "pallas", "--multi-step", "1"],
      {"TPUSERVE_PAGES_PER_GROUP": "32"}),
+    ("multistep64", ["--multi-step", "64"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
+    ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),
+    # p50-TTFT lever: admit the 64-request burst in 2/4 prefill batches
+    ("prefill-split2", ["--prefill-split", "2"], {}),
+    ("prefill-split4", ["--prefill-split", "4"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
     # Alternate served families (the reference's other models,

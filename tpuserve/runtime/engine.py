@@ -1075,6 +1075,16 @@ class Engine:
                         _, self.kv_cache = self._exec_decode_multi(
                             tokens, positions, bt, seq_lens, active, keys,
                             temp, steps=self._multi_step, mode=mode)
+                if self._pipeline_decode:
+                    # the pipelined paths chain steps/windows through
+                    # _select_tokens; left cold, its (tiny) compile stalls
+                    # the first chained dispatch mid-serving.  Both call
+                    # sites pass (B,) int32 tokens (the windowed one via
+                    # p.toks[:, -1]), so one shape covers them.
+                    _select_tokens(jnp.zeros((B,), jnp.int32),
+                                   jnp.zeros((B,), jnp.int32),
+                                   jnp.zeros((B,), jnp.int32),
+                                   jnp.zeros((B,), bool))
                 if self._spec is not None:
                     # the speculative verify pass is its own executable;
                     # left cold, the first spec step stalls on its compile

@@ -69,6 +69,11 @@ class ServerMetrics:
             "vllm_num_requests_running", "Requests in the decode batch")
         self.waiting = gauge(
             "vllm_num_requests_waiting", "Requests queued for prefill")
+        self.window_overrun = counter(
+            "tpuserve_window_overrun_tokens",
+            "Tokens computed past a request's stop point by fused "
+            "multi-step windows and dropped at emit (the cost knob for "
+            "--multi-step; no vLLM analog)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

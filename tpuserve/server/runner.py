@@ -258,12 +258,24 @@ class AsyncEngineRunner:
             total = sum(bm.num_blocks for bm in bms)
             free = sum(bm.num_free_blocks for bm in bms)
             self.metrics.kv_usage.set((total - free) / max(total, 1))
-        stats = getattr(eng, "stats", None)
-        if stats is not None and hasattr(stats, "preemptions"):
-            # counter semantics: advance to the engine's cumulative count
+        # engine-level stats live on the inner engines for the disagg
+        # wrappers (DisaggStats has neither counter) — same special-casing
+        # as the scheduler/block-manager reads above
+        inners = [e for e in (getattr(eng, "prefill", None),
+                              getattr(eng, "decode", None)) if e is not None]
+        stats_objs = [i.stats for i in (inners or [eng])
+                      if hasattr(i, "stats")]
+        preempt = sum(getattr(s, "preemptions", 0) for s in stats_objs)
+        if stats_objs:
+            # counter semantics: advance to the engines' cumulative count
             current = self.metrics.preemptions._value.get()
-            if stats.preemptions > current:
-                self.metrics.preemptions.inc(stats.preemptions - current)
+            if preempt > current:
+                self.metrics.preemptions.inc(preempt - current)
+            overrun = sum(getattr(s, "window_overrun_tokens", 0)
+                          for s in stats_objs)
+            current = self.metrics.window_overrun._value.get()
+            if overrun > current:
+                self.metrics.window_overrun.inc(overrun - current)
 
     def _loop(self) -> None:
         logger.info("engine loop started")
