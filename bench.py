@@ -166,26 +166,41 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     return Engine(cfg)
 
 
-def _warm(engine, batch, prompt_len):
-    """Pre-compile the exact bucket set the measured run will hit
-    (SURVEY.md §7: TTFT budget requires AOT warmup)."""
+def _prefill_warm_buckets(eng, batch, prompt_len):
+    """Every (B, L) prefill shape the scheduler will actually admit for
+    this uniform-prompt workload, derived with the scheduler's own
+    admission arithmetic (bucketed per-seq token charge against
+    max_prefill_tokens / max_prefill_seqs) — any shape missed here
+    recompiles inside the timed region (the 53 s phantom-TTFT failure
+    mode), including the leftover batch of a non-dividing split."""
     from tpuserve.utils import next_power_of_2
-    eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
+    cfg = eng.scheduler.cfg
+    if prompt_len > cfg.prefill_chunk_size:
+        # long prompts route through chunked prefill, whose single
+        # executable Engine.warmup compiles on its own — a batched
+        # full-prefill warm here would compile a never-dispatched shape
+        return []
     L = eng.scheduler.prefill_bucket(prompt_len)
-    # with --prefill-split the scheduler admits smaller prefill batches;
-    # warm EVERY prefill batch shape the run will hit — including the
-    # leftover batch of a non-dividing split — or the first real prefill
-    # recompiles (the 53 s phantom-TTFT failure mode)
-    per = min(batch, eng.scheduler.cfg.max_prefill_seqs)
+    per = min(batch, cfg.max_prefill_seqs,
+              max(1, cfg.max_prefill_tokens // L))
     buckets = {next_power_of_2(per)}
     if batch % per:
         buckets.add(next_power_of_2(batch % per))
-    eng.warmup(prefill_buckets=[(b, L) for b in sorted(buckets)],
+    return [(b, L) for b in sorted(buckets)]
+
+
+def _warm(engine, batch, prompt_len):
+    """Pre-compile the exact bucket set the measured run will hit
+    (SURVEY.md §7: TTFT budget requires AOT warmup)."""
+    eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
+    prefill_buckets = _prefill_warm_buckets(eng, batch, prompt_len)
+    eng.warmup(prefill_buckets=prefill_buckets,
                decode_buckets=[eng.scheduler.decode_bucket(batch)],
                sample_modes=("greedy",))
     if eng is not engine:
         engine.decode.warmup(
-            prefill_buckets=[(next_power_of_2(batch), L)],
+            prefill_buckets=_prefill_warm_buckets(engine.decode, batch,
+                                                  prompt_len),
             decode_buckets=[engine.decode.scheduler.decode_bucket(batch)],
             sample_modes=("greedy",))
 
@@ -340,6 +355,19 @@ def main(argv=None):
         t_warm = time.perf_counter()
         _warm(engine, batch, prompt_len)
         warmup_s = time.perf_counter() - t_warm
+        # Host<->device round-trip floor: every decode window and every
+        # TTFT pays at least one of these.  On the tunnelled axon backend
+        # this is tens of ms (vs ~0.1 ms on a local chip), so recording it
+        # separates engine cost from transport cost in ttft_ms.
+        import jax.numpy as jnp
+        one = jnp.zeros((), jnp.int32) + 1   # resident device scalar
+        jax.device_get(one)                  # settle any lazy init
+        rtts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.device_get(one + 1)
+            rtts.append(time.perf_counter() - t0)
+        host_rtt_ms = 1000.0 * sorted(rtts)[len(rtts) // 2]
         r = _run_workload(engine, prompts, params)
 
     stats = r["stats"]
@@ -381,6 +409,7 @@ def main(argv=None):
         # Startup-cost story (BASELINE TTFT budget): warmup wall-clock and
         # whether the persistent XLA cache was warm when compiles started.
         "warmup_s": round(warmup_s, 1),
+        "host_rtt_ms": round(host_rtt_ms, 2),
         "compile_cache": "warm" if cache_entries_before else "cold",
     }
     degraded = os.environ.get("TPUSERVE_BENCH_DEGRADED")
@@ -406,7 +435,8 @@ def main(argv=None):
             d_engine = _build_engine(model, batch, prompt_len, gen_len,
                                      attn_impl=attn_impl, pipeline=pipeline,
                                      disagg=True, multi_step=args.multi_step,
-                                     quantization=args.quant)
+                                     quantization=args.quant,
+                                     prefill_split=args.prefill_split)
             _warm(d_engine, batch, prompt_len)
             dr = _run_workload(d_engine, prompts, params)
         d_decode = dr["gen_tokens"] - batch

@@ -57,6 +57,11 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("prefill-split4", ["--prefill-split", "4"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
+    # Long-context path: prompts routed through chunked prefill (the
+    # Pallas windowed kernel) — the framework's long-context story on
+    # silicon, not just in interpret-mode tests
+    ("long-prompt", ["--prompt-len", "4096", "--gen-len", "64",
+                     "--batch", "4"], {}),
     # Alternate served families (the reference's other models,
     # kubernetes-single-node.yaml:15 / templates/*.yaml) — random-init
     # weights (air-gapped build host), so throughput is real but text is
