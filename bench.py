@@ -166,43 +166,54 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     return Engine(cfg)
 
 
-def _prefill_warm_buckets(eng, batch, prompt_len):
-    """Every (B, L) prefill shape the scheduler will actually admit for
-    this uniform-prompt workload, derived with the scheduler's own
-    admission arithmetic (bucketed per-seq token charge against
-    max_prefill_tokens / max_prefill_seqs) — any shape missed here
-    recompiles inside the timed region (the 53 s phantom-TTFT failure
-    mode), including the leftover batch of a non-dividing split."""
+def _warm_plan(eng, batch, prompt_len):
+    """Every executable shape the scheduler will actually dispatch for this
+    uniform-prompt workload, derived with the scheduler's own admission
+    arithmetic — any shape missed here recompiles inside the timed region
+    (the 53 s phantom-TTFT failure mode).  Returns a dict of warmup
+    kwargs.
+
+    Short prompts: batched prefill in admission-sized batches (bucketed
+    per-seq token charge against max_prefill_tokens / max_prefill_seqs),
+    including the leftover batch of a non-dividing split; one decode
+    bucket (prefill-priority admits the whole burst before decode starts).
+
+    Long prompts (> prefill_chunk_size): NO batched-prefill shape (the
+    chunked path never dispatches one) but every chunk bucket including
+    the padded tail of a non-multiple length, and every decode bucket from
+    1..batch — the scheduler interleaves decode steps between chunks while
+    the running set grows."""
     from tpuserve.utils import next_power_of_2
     cfg = eng.scheduler.cfg
     if prompt_len > cfg.prefill_chunk_size:
-        # long prompts route through chunked prefill, whose single
-        # executable Engine.warmup compiles on its own — a batched
-        # full-prefill warm here would compile a never-dispatched shape
-        return []
+        chunks, remaining = set(), prompt_len
+        while remaining > 0:
+            b = min(cfg.prefill_chunk_size,
+                    eng.scheduler.prefill_bucket(remaining))
+            chunks.add(b)
+            remaining -= min(remaining, b)
+        decode = sorted({eng.scheduler.decode_bucket(n)
+                         for n in range(1, batch + 1)})
+        return dict(prefill_buckets=[], chunk_buckets=sorted(chunks),
+                    decode_buckets=decode)
     L = eng.scheduler.prefill_bucket(prompt_len)
     per = min(batch, cfg.max_prefill_seqs,
               max(1, cfg.max_prefill_tokens // L))
     buckets = {next_power_of_2(per)}
     if batch % per:
         buckets.add(next_power_of_2(batch % per))
-    return [(b, L) for b in sorted(buckets)]
+    return dict(prefill_buckets=[(b, L) for b in sorted(buckets)],
+                decode_buckets=[eng.scheduler.decode_bucket(batch)])
 
 
 def _warm(engine, batch, prompt_len):
     """Pre-compile the exact bucket set the measured run will hit
     (SURVEY.md §7: TTFT budget requires AOT warmup)."""
     eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
-    prefill_buckets = _prefill_warm_buckets(eng, batch, prompt_len)
-    eng.warmup(prefill_buckets=prefill_buckets,
-               decode_buckets=[eng.scheduler.decode_bucket(batch)],
-               sample_modes=("greedy",))
+    eng.warmup(sample_modes=("greedy",), **_warm_plan(eng, batch, prompt_len))
     if eng is not engine:
-        engine.decode.warmup(
-            prefill_buckets=_prefill_warm_buckets(engine.decode, batch,
-                                                  prompt_len),
-            decode_buckets=[engine.decode.scheduler.decode_bucket(batch)],
-            sample_modes=("greedy",))
+        engine.decode.warmup(sample_modes=("greedy",),
+                             **_warm_plan(engine.decode, batch, prompt_len))
 
 
 def _run_workload(engine, prompts, params):

@@ -97,3 +97,27 @@ def test_spec_acceptance_stats(cfg):
     p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
     eng.generate([[1, 1, 1, 1, 1, 1, 1, 1]], p)
     assert eng.stats.spec_proposed >= eng.stats.spec_accepted >= 0
+
+
+def test_spec_composed_with_pipelined_windows(cfg):
+    """Speculative steps are synchronous; the step dispatcher prefers them
+    for clean greedy batches while multi-step windows (pipelined) serve
+    everything else.  An engine configured with BOTH must still match the
+    plain engine token-for-token and leave nothing in flight."""
+    prompts = [[1, 2, 3, 4] * 5, [7, 8, 7, 8, 7, 8, 9]]
+    p = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    plain = _engine(cfg, None).generate(prompts, p)
+    eng = Engine(
+        EngineConfig(model="tiny-qwen3",
+                     cache=CacheConfig(block_size=4, num_blocks=256,
+                                       max_blocks_per_seq=32),
+                     scheduler=SchedulerConfig(max_num_seqs=4),
+                     enable_prefix_caching=False,
+                     pipeline_decode=True, multi_step=4,
+                     speculative=SpecConfig(num_draft_tokens=4)),
+        model_cfg=cfg)
+    both = eng.generate(prompts, p)
+    for a, b in zip(plain, both):
+        assert a.output_token_ids == b.output_token_ids
+    assert eng._pending_window is None
+    assert eng.block_manager.num_seqs() == 0

@@ -68,6 +68,10 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # not; smaller batch for the 3.8B phi to fit v5e HBM alongside KV.
     ("phi3-mini", ["--model", "phi3-mini", "--batch", "32"], {}),
     ("opt-1.3b", ["--model", "opt-1.3b"], {}),
+    # Flagship-scale single chip: 8B int8 weights (~8 GB) + bf16 KV fit
+    # v5e's 16 GB HBM; random-init (air-gapped), throughput is real
+    ("llama3-8b-int8", ["--model", "llama3-8b", "--quant", "int8",
+                        "--batch", "16", "--gen-len", "64"], {}),
     # Startup-cost story (BASELINE TTFT budget): identical run against an
     # EMPTY persistent compile cache — warmup_s cold vs the warm rows
     # above is the pod-restart cost the manifests' cache PVC removes.

@@ -1023,18 +1023,29 @@ class Engine:
     # SURVEY.md §7 "TTFT ≤150 ms requires compile-cache warmup at startup")
     # ------------------------------------------------------------------
 
-    def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] = (),
+    def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] | None
+               = None,
                decode_buckets: Sequence[int] = (),
                sample_modes: Sequence[str] = ("greedy", "temperature", "full"),
+               chunk_buckets: Sequence[int] = (),
                ) -> None:
         """Pre-compile executables.  ``prefill_buckets`` entries are either a
         padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
         — _run_prefill pads the batch to a power of two, so warming only
-        batch 1 leaves the multi-sequence prefill shapes cold."""
-        prefill_buckets = list(prefill_buckets) or [
-            self.config.scheduler.min_prefill_bucket]
+        batch 1 leaves the multi-sequence prefill shapes cold.  An EMPTY
+        ``prefill_buckets`` list means "warm no batched prefill" (workloads
+        routed entirely through chunked prefill); None means "not
+        specified" and warms the minimum bucket.  ``chunk_buckets`` are
+        extra chunked-prefill padded lengths to warm beyond the full chunk
+        size (the padded TAIL chunk of a prompt that isn't an exact
+        multiple)."""
+        if prefill_buckets is None:
+            prefill_buckets = [self.config.scheduler.min_prefill_bucket]
+        else:
+            prefill_buckets = list(prefill_buckets)
         decode_buckets = list(decode_buckets) or [
             self.config.scheduler.min_decode_bucket]
+        logits = None
         # Two rounds: round 1 compiles each executable against the cache
         # layouts it happens to see; the kv_cache arrays that come OUT may
         # carry different XLA-chosen layouts, and a jitted call whose input
@@ -1095,12 +1106,16 @@ class Engine:
                         vtok, jnp.zeros((B,), jnp.int32),
                         jnp.ones((B,), jnp.int32), vslots, bt)
             chunk = self.config.scheduler.prefill_chunk_size
+            chunk_set = set(chunk_buckets)
             if self.max_seq_len > chunk:
-                # long prompts hit the chunked path; its single (1, chunk)
-                # executable must be warm too or the first long request
-                # stalls the loop on a compile
-                tokens = jnp.zeros((1, chunk), jnp.int32)
-                slots = jnp.full((1, chunk), PAD_SLOT, jnp.int32)
+                # long prompts hit the chunked path; the full-chunk
+                # executable must be warm or the first long request stalls
+                # the loop on a compile.  chunk_buckets adds the padded
+                # tail shapes of non-multiple prompt lengths.
+                chunk_set.add(chunk)
+            for C in sorted(chunk_set):
+                tokens = jnp.zeros((1, C), jnp.int32)
+                slots = jnp.full((1, C), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                jnp.int32)
                 logits, self.kv_cache = self._exec_prefill_chunk(
@@ -1111,7 +1126,8 @@ class Engine:
         # block_until_ready is a no-op and the first real request's host
         # transfer would pay for the entire queued warmup backlog (measured
         #: 53 s of "TTFT" that was actually deferred warmup execution).
-        hard_sync(logits)
+        if logits is not None:
+            hard_sync(logits)
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",
                     prefill_buckets, decode_buckets)
 
