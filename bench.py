@@ -281,7 +281,13 @@ def main(argv=None):
     ap.add_argument("--compare-disagg", action="store_true",
                     help="also measure the disaggregated prefill/decode "
                          "engine on the same workload")
-    ap.add_argument("--repeat", type=int, default=None, metavar="N",
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    ap.add_argument("--repeat", type=_positive, default=None, metavar="N",
                     help="run the measured workload N times and report the "
                          "median (default: 3 on TPU — tunnel-noise "
                          "rejection — 1 on CPU)")

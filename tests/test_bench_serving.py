@@ -1,0 +1,32 @@
+"""tools/bench_serving.py: client-observed serving latency benchmark.
+
+Asserts the harness end to end on CPU smoke shapes: streams arrive intact
+under both load modes, latency percentiles are populated and sane, and the
+JSON contract the sweep/judge consume is stable.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import bench_serving  # noqa: E402
+
+
+def test_closed_loop_burst():
+    out = bench_serving.main(["--smoke", "--clients", "4",
+                              "--num-requests", "8", "--no-md"])
+    assert out["lost_streams"] == 0
+    assert out["throughput_tok_s"] > 0
+    assert out["ttft_ms"]["p50"] > 0
+    assert out["ttft_ms"]["p99"] >= out["ttft_ms"]["p50"]
+    assert out["itl_ms"]["p99"] >= out["itl_ms"]["p50"] > 0
+    assert out["model"] == "tiny-qwen3"      # reports the model actually served
+
+
+def test_open_loop_poisson():
+    out = bench_serving.main(["--smoke", "--clients", "4",
+                              "--num-requests", "6", "--rate", "50",
+                              "--no-md"])
+    assert out["lost_streams"] == 0
+    assert out["rate_req_s"] == 50.0
