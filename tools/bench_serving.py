@@ -71,13 +71,18 @@ def _stream_request(url, prompt_ids, gen, record):
                 for ln in event.decode().splitlines():
                     if not ln.startswith("data: ") or ln.endswith("[DONE]"):
                         continue
-                    ids = json.loads(ln[len("data: "):])["choices"][0].get(
-                        "token_ids") or []
-                    # one SSE chunk carries >=1 tokens under fused windows;
-                    # attribute the kernel-delivery time to each token
-                    for _ in ids:
-                        tok_times.append(now)
-                    n_tokens += len(ids)
+                    choice = json.loads(ln[len("data: "):])["choices"][0]
+                    ids = choice.get("token_ids")
+                    if ids is None:
+                        # plain OpenAI server without the return_token_ids
+                        # extension: one chunk ~= one token
+                        k = 1 if choice.get("text") is not None else 0
+                    else:
+                        # one SSE chunk carries >=1 tokens under fused
+                        # windows; attribute kernel-delivery time to each
+                        k = len(ids)
+                    tok_times.extend([now] * k)
+                    n_tokens += k
     record["ttft_s"] = tok_times[0] - t_sent if tok_times else None
     record["gaps_s"] = [b - a for a, b in zip(tok_times, tok_times[1:])]
     record["n_tokens"] = n_tokens
@@ -99,10 +104,17 @@ def run_load(url, prompts, gen, rate):
                               args=(url, p, gen, records[i]))
         th.start()
         threads.append(th)
-    for th in threads:
+    hung = 0
+    for i, th in enumerate(threads):
         th.join(timeout=1800)
+        if th.is_alive():
+            # a stalled stream is exactly what this benchmark exists to
+            # catch — surface it loudly, don't let it masquerade as a
+            # quietly lost record
+            records[i]["hung"] = True
+            hung += 1
     wall = time.perf_counter() - t0
-    return records, wall
+    return records, wall, hung
 
 
 def main(argv=None):
@@ -120,15 +132,21 @@ def main(argv=None):
                          "starting one in-process")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke shapes")
+    ap.add_argument("--gateway", action="store_true",
+                    help="route through an in-process gateway (adds the "
+                         "relay hop the K8s deployment has)")
     ap.add_argument("--no-md", action="store_true",
                     help="don't append the BENCHMARKS.md section (tests)")
     args = ap.parse_args(argv)
+    if args.gateway and args.url:
+        ap.error("--gateway only applies to the in-process server; an "
+                 "external --url is measured as-is")
 
     import numpy as np
 
     # one derivation of the workload shape, shared by both branches
     n = args.num_requests or args.clients
-    srv = None
+    srv = gw = None
     if args.url:
         url = args.url
         backend = "external"
@@ -166,17 +184,33 @@ def main(argv=None):
         url = f"http://127.0.0.1:{srv.start()}"
         vocab = eng.model_cfg.vocab_size
         concurrency_capped = True             # max_num_seqs == clients
+        if args.gateway:
+            from tpuserve.server.gateway import Gateway, GatewayConfig
+            gw = Gateway([url], GatewayConfig(host="127.0.0.1", port=0,
+                                              health_interval_s=0.5))
+            url = f"http://127.0.0.1:{gw.start()}"
+            backend = backend + "+gateway"
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, vocab - 1, size=plen).tolist()
                for _ in range(n)]
 
-    # warmup burst: compile every bucket this concurrency hits, then measure
-    run_load(url, prompts[:args.clients], glen, 0.0)
-    records, wall = run_load(url, prompts, glen, args.rate)
+    # warmup burst: compile every bucket this concurrency hits — using
+    # DISJOINT prompts, since replaying the measured prompts would turn
+    # every timed prefill into a prefix-cache hit (the engine's prefix
+    # cache is on by default) and understate TTFT
+    warm_prompts = [np.random.default_rng(10_000 + i)
+                    .integers(1, vocab - 1, size=plen).tolist()
+                    for i in range(args.clients)]
+    run_load(url, warm_prompts, glen, 0.0)
+    records, wall, hung = run_load(url, prompts, glen, args.rate)
 
     good = [r for r in records if r.get("ttft_s") is not None]
     lost = len(records) - len(good)
+    if lost == len(records):
+        raise SystemExit(
+            "every stream lost — server emitted no countable tokens "
+            "(wrong --url contract?); refusing to report zeros")
     ttfts = sorted(1000.0 * r["ttft_s"] for r in good)
     gaps = sorted(1000.0 * g for r in good for g in r["gaps_s"])
     total_tokens = sum(r["n_tokens"] for r in good)
@@ -191,6 +225,7 @@ def main(argv=None):
         "prompt_len": plen,
         "gen_len": glen,
         "lost_streams": lost,
+        "hung_streams": hung,
         "throughput_tok_s": round(total_tokens / wall, 1),
         "ttft_ms": {"p50": round(_pct(ttfts, 0.50), 1),
                     "p90": round(_pct(ttfts, 0.90), 1),
@@ -200,6 +235,8 @@ def main(argv=None):
                    "p99": round(_pct(gaps, 0.99), 2)},
     }
     print(json.dumps(out))
+    if gw is not None:
+        gw.shutdown()
     if srv is not None:
         srv.shutdown()
     if args.no_md:
