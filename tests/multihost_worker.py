@@ -1,0 +1,63 @@
+"""Subprocess worker for the REAL two-process lockstep test
+(test_multihost_2proc.py).  Each rank initializes jax.distributed over the
+Gloo CPU backend with one local device, builds an identical engine over a
+global tp=2 mesh, and either serves (rank 0, MultihostCoordinator) or
+mirrors (rank 1, follower_loop).
+
+Run: python multihost_worker.py <rank> <coordinator_port> <out_json>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    rank, port, out_path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+
+    import jax
+    jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                               process_id=rank)
+    assert jax.process_count() == 2
+
+    import dataclasses
+
+    from tpuserve.models.config import get_model_config
+    from tpuserve.parallel import MeshConfig, make_mesh
+    from tpuserve.parallel.multihost import (MultihostCoordinator,
+                                             follower_loop)
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    # multi_step=3 so the run exercises OP_DECODE_MULTI (fused windows
+    # with in-window sampling) across processes, plus OP_PREFILL and
+    # OP_SAMPLE from the prefill's first token
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
+                          dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        attn_impl="reference", multi_step=3)
+    mc = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
+    eng = Engine(cfg, model_cfg=mc, mesh=mesh)
+
+    if rank == 0:
+        coord = MultihostCoordinator(eng)
+        outs = eng.generate(
+            [[5, 6, 7], [11, 12, 13, 14]],
+            SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True))
+        coord.stop_followers()
+        with open(out_path, "w") as f:
+            json.dump([o.output_token_ids for o in outs], f)
+    else:
+        follower_loop(eng)
+
+
+if __name__ == "__main__":
+    main()

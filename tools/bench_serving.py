@@ -75,8 +75,13 @@ def _stream_request(url, prompt_ids, gen, record):
                     ids = choice.get("token_ids")
                     if ids is None:
                         # plain OpenAI server without the return_token_ids
-                        # extension: one chunk ~= one token
-                        k = 1 if choice.get("text") is not None else 0
+                        # extension: one chunk ~= one token — except the
+                        # standard empty-text terminal chunk that only
+                        # carries finish_reason
+                        k = (1 if choice.get("text")
+                             or (choice.get("text") is not None
+                                 and not choice.get("finish_reason"))
+                             else 0)
                     else:
                         # one SSE chunk carries >=1 tokens under fused
                         # windows; attribute kernel-delivery time to each
@@ -87,6 +92,10 @@ def _stream_request(url, prompt_ids, gen, record):
     record["gaps_s"] = [b - a for a, b in zip(tok_times, tok_times[1:])]
     record["n_tokens"] = n_tokens
     record["done_s"] = (tok_times[-1] - t_sent) if tok_times else None
+    # written LAST: the main thread filters on this single atomic marker,
+    # so a thread finishing just past the join timeout can never expose a
+    # half-written record
+    record["ok"] = bool(tok_times)
 
 
 def run_load(url, prompts, gen, rate):
@@ -101,8 +110,9 @@ def run_load(url, prompts, gen, rate):
         if rate > 0 and i:
             time.sleep(float(rng.exponential(1.0 / rate)))
         th = threading.Thread(target=_stream_request,
-                              args=(url, p, gen, records[i]))
-        th.start()
+                              args=(url, p, gen, records[i]),
+                              daemon=True)   # a wedged stream must not
+        th.start()                           # block interpreter shutdown
         threads.append(th)
     hung = 0
     for i, th in enumerate(threads):
@@ -205,7 +215,7 @@ def main(argv=None):
     run_load(url, warm_prompts, glen, 0.0)
     records, wall, hung = run_load(url, prompts, glen, args.rate)
 
-    good = [r for r in records if r.get("ttft_s") is not None]
+    good = [r for r in records if r.get("ok")]
     lost = len(records) - len(good)
     if lost == len(records):
         raise SystemExit(
