@@ -4,7 +4,13 @@ Gloo CPU backend with one local device, builds an identical engine over a
 global tp=2 mesh, and either serves (rank 0, MultihostCoordinator) or
 mirrors (rank 1, follower_loop).
 
-Run: python multihost_worker.py <rank> <coordinator_port> <out_json>
+Run: python multihost_worker.py <rank> <coordinator_port> <out_json> [scenario]
+
+Scenarios (which lockstep ops the run exercises beyond OP_STOP):
+  windows (default) — OP_PREFILL, OP_SAMPLE (greedy), OP_DECODE_MULTI
+  chunked           — OP_PREFILL_CHUNK (long prompt), OP_DECODE
+                      (multi_step=1), OP_SAMPLE in greedy AND seeded
+                      temperature modes
 """
 
 import json
@@ -14,6 +20,7 @@ import sys
 
 def main():
     rank, port, out_path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    scenario = sys.argv[4] if len(sys.argv) > 4 else "windows"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -30,33 +37,62 @@ def main():
     from tpuserve.parallel import MeshConfig, make_mesh
     from tpuserve.parallel.multihost import (MultihostCoordinator,
                                              follower_loop)
-    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
-                                  SamplingParams, SchedulerConfig)
+    from tpuserve.runtime import Engine
 
     mesh = make_mesh(MeshConfig(dp=1, tp=2))
-    # multi_step=3 so the run exercises OP_DECODE_MULTI (fused windows
-    # with in-window sampling) across processes, plus OP_PREFILL and
-    # OP_SAMPLE from the prefill's first token
-    cfg = EngineConfig(
-        model="tiny-qwen3",
-        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
-                          dtype="float32"),
-        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
-                                  min_decode_bucket=2),
-        attn_impl="reference", multi_step=3)
+    cfg, prompts, params = build_scenario(scenario)
     mc = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
     eng = Engine(cfg, model_cfg=mc, mesh=mesh)
 
     if rank == 0:
         coord = MultihostCoordinator(eng)
-        outs = eng.generate(
-            [[5, 6, 7], [11, 12, 13, 14]],
-            SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True))
+        outs = eng.generate(prompts, params)
         coord.stop_followers()
         with open(out_path, "w") as f:
             json.dump([o.output_token_ids for o in outs], f)
     else:
         follower_loop(eng)
+
+
+def build_scenario(scenario):
+    """Shared by the worker and the test's single-device reference run."""
+    from tpuserve.runtime import (CacheConfig, EngineConfig, SamplingParams,
+                                  SchedulerConfig)
+    if scenario == "windows":
+        # multi_step=3 exercises OP_DECODE_MULTI (fused windows with
+        # in-window sampling), plus OP_PREFILL and greedy OP_SAMPLE from
+        # the prefill's first token
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2),
+            attn_impl="reference", multi_step=3)
+        prompts = [[5, 6, 7], [11, 12, 13, 14]]
+        params = SamplingParams(max_tokens=7, temperature=0.0,
+                                ignore_eos=True)
+        return cfg, prompts, params
+    if scenario == "chunked":
+        # a 20-token prompt against chunk size 8 routes through
+        # OP_PREFILL_CHUNK; multi_step=1 exercises plain OP_DECODE; the
+        # seeded temperature request exercises the non-greedy replicated
+        # sampler (OP_SAMPLE mode=temperature)
+        cfg = EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="float32"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2,
+                                      prefill_chunk_size=8),
+            attn_impl="reference", multi_step=1)
+        prompts = [list(range(1, 21)), [7, 8, 9]]
+        params = [SamplingParams(max_tokens=6, temperature=0.0,
+                                 ignore_eos=True),
+                  SamplingParams(max_tokens=6, temperature=0.8, seed=11,
+                                 ignore_eos=True)]
+        return cfg, prompts, params
+    raise ValueError(f"unknown scenario {scenario!r}")
 
 
 if __name__ == "__main__":

@@ -29,7 +29,8 @@ def _free_port():
 
 
 @pytest.mark.slow
-def test_two_process_lockstep_serving(tmp_path):
+@pytest.mark.parametrize("scenario", ["windows", "chunked"])
+def test_two_process_lockstep_serving(tmp_path, scenario):
     port = _free_port()
     out_path = tmp_path / "rank0.json"
     env = {k: v for k, v in os.environ.items()
@@ -39,7 +40,8 @@ def test_two_process_lockstep_serving(tmp_path):
     # collectives, and the other blocks forever inside a collective)
     logs = [open(tmp_path / f"rank{rank}.log", "wb") for rank in (0, 1)]
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(rank), str(port), str(out_path)],
+        [sys.executable, WORKER, str(rank), str(port), str(out_path),
+         scenario],
         env=env, cwd=ROOT, stdout=log, stderr=subprocess.STDOUT)
         for rank, log in zip((0, 1), logs)]
     try:
@@ -52,29 +54,25 @@ def test_two_process_lockstep_serving(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                p.wait()            # reap — no zombies / ResourceWarnings
         for log in logs:
             log.close()
 
     two_proc = json.loads(out_path.read_text())
-    assert [len(t) for t in two_proc] == [7, 7]
 
     # same workload on a plain single-device engine: the sharded lockstep
     # run must be token-identical (fp32 CPU; precedent:
     # test_parallel.py::test_tp_sharded_decode)
     import dataclasses
 
+    from multihost_worker import build_scenario
     from tpuserve.models.config import get_model_config
-    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
-                                  SamplingParams, SchedulerConfig)
-    cfg = EngineConfig(
-        model="tiny-qwen3",
-        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
-                          dtype="float32"),
-        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
-                                  min_decode_bucket=2),
-        attn_impl="reference", multi_step=3)
+    from tpuserve.runtime import Engine
+    cfg, prompts, params = build_scenario(scenario)
     mc = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
-    ref = Engine(cfg, model_cfg=mc).generate(
-        [[5, 6, 7], [11, 12, 13, 14]],
-        SamplingParams(max_tokens=7, temperature=0.0, ignore_eos=True))
+    ref = Engine(cfg, model_cfg=mc).generate(prompts, params)
+    # absolute count first (independent of the reference engine), then
+    # exact token equality
+    plist = params if isinstance(params, list) else [params] * len(prompts)
+    assert [len(t) for t in two_proc] == [p.max_tokens for p in plist]
     assert two_proc == [r.output_token_ids for r in ref]

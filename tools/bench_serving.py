@@ -78,10 +78,10 @@ def _stream_request(url, prompt_ids, gen, record):
                         # extension: one chunk ~= one token — except the
                         # standard empty-text terminal chunk that only
                         # carries finish_reason
-                        k = (1 if choice.get("text")
-                             or (choice.get("text") is not None
-                                 and not choice.get("finish_reason"))
-                             else 0)
+                        t = choice.get("text")
+                        k = (0 if t is None
+                             or (not t and choice.get("finish_reason"))
+                             else 1)
                     else:
                         # one SSE chunk carries >=1 tokens under fused
                         # windows; attribute kernel-delivery time to each
