@@ -112,8 +112,18 @@ def _qwen3_moe():
         bos_token_id=0, eos_token_id=1))
 
 
+def _qwen2():
+    # the Qwen2-72B TP=8 multi-host BASELINE config's family: qkv bias,
+    # no qk-norm — the two switches that distinguish it from Qwen3
+    return transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        bos_token_id=0, eos_token_id=1))
+
+
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
-             "qwen3_moe": _qwen3_moe}
+             "qwen3_moe": _qwen3_moe, "qwen2": _qwen2}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -134,6 +144,8 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.norm == "layernorm" and cfg.act == "relu"
     if family == "qwen3_moe":
         assert cfg.num_experts == 4 and cfg.qk_norm
+    if family == "qwen2":
+        assert cfg.attention_bias and not cfg.qk_norm
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)
