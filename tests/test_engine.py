@@ -204,3 +204,35 @@ def test_stop_string_truncated_from_output():
                                                 ignore_eos=True, stop=(stop_s,)))[0]
         assert stop_s not in r.output_text
         assert r.finish_reason == FinishReason.STOP
+
+
+def test_logit_bias_forces_and_bans_tokens(engine):
+    # +100 on one token makes greedy pick it every step; -100 bans it
+    forced = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True,
+                            logit_bias={7: 100.0})
+    out = engine.generate(["bias me"], forced)[0]
+    assert out.output_token_ids == [7] * 5
+
+    base = engine.generate(["bias me"],
+                           SamplingParams(max_tokens=5, temperature=0.0,
+                                          ignore_eos=True))[0]
+    banned = engine.generate(["bias me"],
+                             SamplingParams(max_tokens=5, temperature=0.0,
+                                            ignore_eos=True,
+                                            logit_bias={
+                                                base.output_token_ids[0]: -100.0}))[0]
+    assert banned.output_token_ids[0] != base.output_token_ids[0]
+
+
+def test_logit_bias_under_pipelined_windows():
+    # bias batches are ineligible for fused windows (sampling is fused
+    # in-window); the engine must fall back and still honor the bias
+    from tpuserve.runtime import Engine, EngineConfig, CacheConfig
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3", multi_step=4, pipeline_decode=True,
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16)))
+    out = eng.generate(["x"], SamplingParams(max_tokens=6, temperature=0.0,
+                                             ignore_eos=True,
+                                             logit_bias={9: 100.0}))[0]
+    assert out.output_token_ids == [9] * 6
+    assert eng.block_manager.num_seqs() == 0

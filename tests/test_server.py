@@ -275,3 +275,50 @@ def test_n_choices_streaming(server):
     finished = [c for c in chunks
                 if c["choices"][0]["finish_reason"] == "length"]
     assert len(finished) == 2
+
+
+def test_logit_bias_and_echo(server):
+    import json as _json
+    import urllib.request
+
+    url = server
+    # logit_bias forces the biased token every step (greedy)
+    body = {"prompt": "hi", "max_tokens": 4, "temperature": 0,
+            "ignore_eos": True, "logit_bias": {"7": 100},
+            "return_token_ids": True, "stream": True}
+    req = urllib.request.Request(url + "/v1/completions",
+                                 data=_json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    raw = urllib.request.urlopen(req).read().decode()
+    ids = [tid for ln in raw.splitlines()
+           if ln.startswith("data: ") and not ln.endswith("[DONE]")
+           for tid in _json.loads(ln[6:])["choices"][0]["token_ids"]]
+    assert ids == [7, 7, 7, 7]
+
+    # invalid logit_bias rejected with 400
+    bad = dict(body, logit_bias={"x": "y"})
+    import urllib.error
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            url + "/v1/completions", data=_json.dumps(bad).encode(),
+            headers={"Content-Type": "application/json"}))
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+    # echo prepends the prompt text (non-stream)
+    body2 = {"prompt": "hello", "max_tokens": 2, "temperature": 0,
+             "ignore_eos": True, "echo": True}
+    out = _json.loads(urllib.request.urlopen(urllib.request.Request(
+        url + "/v1/completions", data=_json.dumps(body2).encode(),
+        headers={"Content-Type": "application/json"})).read())
+    assert out["choices"][0]["text"].startswith("hello")
+
+    # echo leads the SSE stream
+    body3 = dict(body2, stream=True)
+    raw3 = urllib.request.urlopen(urllib.request.Request(
+        url + "/v1/completions", data=_json.dumps(body3).encode(),
+        headers={"Content-Type": "application/json"})).read().decode()
+    first = _json.loads([ln for ln in raw3.splitlines()
+                         if ln.startswith("data: ")][0][6:])
+    assert first["choices"][0]["text"] == "hello"

@@ -95,6 +95,20 @@ def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
     return jnp.where(seen, rep_logits, logits)
 
 
+@jax.jit
+def apply_logit_bias(logits: jnp.ndarray, bias_ids: jnp.ndarray,
+                     bias_vals: jnp.ndarray) -> jnp.ndarray:
+    """OpenAI logit_bias: additive per-token-id bias before sampling.
+
+    logits: (B, V); bias_ids: (B, K) int32 token ids (id >= V for padding,
+    scatter mode="drop" ignores it); bias_vals: (B, K) float32.
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    return logits.at[jnp.arange(B)[:, None], bias_ids].add(
+        bias_vals, mode="drop")
+
+
 @partial(jax.jit, static_argnames=("top_n",))
 def compute_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray, top_n: int):
     """Log-probabilities for the chosen tokens plus the top-N alternatives.

@@ -252,14 +252,15 @@ class Engine:
         if not prompt_token_ids:
             raise ValueError("empty prompt")
         if jax.process_count() > 1 and (params.needs_penalties
+                                        or params.needs_logit_bias
                                         or params.logprobs is not None):
-            # Penalty/logprob ops are separate jits over the mesh-global
-            # logits; the lockstep protocol mirrors prefill/decode/sample
-            # only.  Rejected at intake rather than deadlocking in SPMD.
-            # See parallel/multihost.py "Limitations".
+            # Penalty/bias/logprob ops are separate jits over the
+            # mesh-global logits; the lockstep protocol mirrors
+            # prefill/decode/sample only.  Rejected at intake rather than
+            # deadlocking in SPMD.  See parallel/multihost.py "Limitations".
             raise ValueError(
-                "sampling penalties and logprobs are not supported in "
-                "multi-host serving mode")
+                "sampling penalties, logit_bias, and logprobs are not "
+                "supported in multi-host serving mode")
         if len(prompt_token_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds max sequence "
@@ -356,6 +357,7 @@ class Engine:
             outputs = self._run_prefill_chunk(batch)
         elif (self._spec is not None
               and all(r.params.greedy and not r.params.needs_penalties
+                      and not r.params.needs_logit_bias
                       and r.params.logprobs is None
                       for r in batch.requests)):
             outputs = self._run_decode_spec(batch)
@@ -564,7 +566,7 @@ class Engine:
         """
         S = self._multi_step
         if any(r.params.needs_penalties or r.params.logprobs is not None
-               or r.params.needs_truncation
+               or r.params.needs_truncation or r.params.needs_logit_bias
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
@@ -766,6 +768,10 @@ class Engine:
             jnp.asarray(block_tables), jnp.asarray(seq_lens))
         self.stats.num_decode_steps += 1
         if pipeline_ok:
+            if any(r.params.needs_logit_bias for r in reqs):
+                # static per request (no host token history), so safe on
+                # the pipelined path — unlike penalties
+                logits = self._apply_logit_bias(logits, reqs, B)
             toks = self._sample_modes(logits, reqs, B, in_flight)
             # resolve the PREVIOUS step while this one runs on device
             outputs += self._flush_pending()
@@ -861,10 +867,29 @@ class Engine:
         n = len(reqs)
         if any(r.params.needs_penalties for r in reqs):
             logits = self._apply_penalties(logits, reqs, B)
+        if any(r.params.needs_logit_bias for r in reqs):
+            # applied before logprobs, like penalties: reported logprobs
+            # describe the distribution actually sampled from
+            logits = self._apply_logit_bias(logits, reqs, B)
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
         return np.asarray(jax.device_get(toks))[:n]
+
+    def _apply_logit_bias(self, logits: jnp.ndarray, reqs: list[Request],
+                          B: int) -> jnp.ndarray:
+        V = logits.shape[1]
+        K = next_power_of_2(max(len(r.params.logit_bias or {})
+                                for r in reqs) or 1)
+        ids = np.full((B, K), V, np.int32)          # V = dropped by scatter
+        vals = np.zeros((B, K), np.float32)
+        for i, r in enumerate(reqs):
+            for j, (tid, b) in enumerate(sorted(
+                    (r.params.logit_bias or {}).items())):
+                ids[i, j] = int(tid)
+                vals[i, j] = float(b)
+        return sampling_ops.apply_logit_bias(
+            logits, jnp.asarray(ids), jnp.asarray(vals))
 
     def _sample_modes(self, logits: jnp.ndarray, reqs: list[Request], B: int,
                       in_flight) -> jnp.ndarray:

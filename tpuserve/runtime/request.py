@@ -40,6 +40,9 @@ class SamplingParams:
     ignore_eos: bool = False
     seed: Optional[int] = None
     logprobs: Optional[int] = None      # top-N logprobs per generated token
+    # OpenAI logit_bias: token id -> additive bias (clamped to ±100 at the
+    # API layer); applied to the logits before every sampling step
+    logit_bias: Optional[dict[int, float]] = None
 
     @property
     def greedy(self) -> bool:
@@ -53,6 +56,10 @@ class SamplingParams:
     def needs_penalties(self) -> bool:
         return (self.presence_penalty != 0.0 or self.frequency_penalty != 0.0
                 or self.repetition_penalty != 1.0)
+
+    @property
+    def needs_logit_bias(self) -> bool:
+        return bool(self.logit_bias)
 
 
 @dataclasses.dataclass

@@ -80,6 +80,23 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     seed = body.get("seed")
     if seed is not None:
         seed = _num(body, "seed", None, int)
+    bias = body.get("logit_bias")
+    if bias is not None:
+        if not isinstance(bias, dict) or len(bias) > 300:
+            raise ValueError(
+                "'logit_bias' must be a {token_id: bias} object with at "
+                "most 300 entries")
+        try:
+            # OpenAI sends string keys and clamps bias to [-100, 100]
+            bias = {int(k): max(-100.0, min(100.0, float(v)))
+                    for k, v in bias.items()}
+        except (TypeError, ValueError):
+            raise ValueError("'logit_bias' keys must be token ids and "
+                             "values numbers") from None
+        if any(k < 0 for k in bias):
+            # negative ids would wrap NumPy-style in the scatter and bias
+            # the wrong token; ids >= vocab are dropped harmlessly
+            raise ValueError("'logit_bias' token ids must be >= 0")
     return SamplingParams(
         max_tokens=min(_num(body, "max_tokens", 16, int), cap),
         temperature=_num(body, "temperature", 1.0, float),
@@ -92,6 +109,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=seed,
         logprobs=n_logprobs,
+        logit_bias=bias,
     )
 
 
@@ -437,6 +455,15 @@ class _Handler(BaseHTTPRequestHandler):
             raise
         return submits
 
+    def _echo_text(self, body, chat, kwargs):
+        """OpenAI completions `echo`: the prompt text to prepend, or None."""
+        if chat or not body.get("echo"):
+            return None
+        if "prompt" in kwargs:
+            return kwargs["prompt"]
+        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
+        return eng.tokenizer.decode(kwargs["prompt_token_ids"])
+
     def _full_response(self, body, params, chat, kwargs, n=1):
         ctx = self.ctx
         t0 = time.monotonic()
@@ -453,6 +480,7 @@ class _Handler(BaseHTTPRequestHandler):
         choices = []
         prompt_tokens = 0
         completion_tokens = 0
+        echo_text = self._echo_text(body, chat, kwargs)
         for idx, (rid, q) in enumerate(submits):
             text_parts, token_ids, logprob_entries = [], [], []
             finish_reason = "stop"
@@ -478,6 +506,8 @@ class _Handler(BaseHTTPRequestHandler):
                     finish_reason = item.finish_reason.value
             req = ctx.engine.requests.pop(rid, None)
             text = "".join(text_parts)
+            if echo_text is not None:
+                text = echo_text + text
             if req is not None and params.logprobs is not None:
                 logprob_entries = req.logprobs
             if req is not None:
@@ -560,6 +590,21 @@ class _Handler(BaseHTTPRequestHandler):
                                 "choices": [{"index": i,
                                              "delta": {"role": "assistant"},
                                              "finish_reason": None}]})
+            echo_text = self._echo_text(body, chat, kwargs)
+            if echo_text is not None:
+                # OpenAI echo semantics: the prompt text leads the stream.
+                # Prompt tokens are not completion tokens, so token_ids is
+                # empty — but present when requested, preserving the
+                # every-chunk counting contract.
+                for i in range(n):
+                    choice = {"index": i, "text": echo_text,
+                              "finish_reason": None}
+                    if ret_ids:
+                        choice["token_ids"] = []
+                    send_chunk({"id": oid, "object": "text_completion",
+                                "created": int(time.time()),
+                                "model": ctx.model_name,
+                                "choices": [choice]})
             live = n
             while live:
                 try:
