@@ -884,8 +884,7 @@ class Engine:
         ids = np.full((B, K), V, np.int32)          # V = dropped by scatter
         vals = np.zeros((B, K), np.float32)
         for i, r in enumerate(reqs):
-            for j, (tid, b) in enumerate(sorted(
-                    (r.params.logit_bias or {}).items())):
+            for j, (tid, b) in enumerate(r.params.logit_bias_items()):
                 ids[i, j] = int(tid)
                 vals[i, j] = float(b)
         return sampling_ops.apply_logit_bias(

@@ -61,6 +61,15 @@ class SamplingParams:
     def needs_logit_bias(self) -> bool:
         return bool(self.logit_bias)
 
+    def logit_bias_items(self) -> tuple:
+        """Sorted (token_id, bias) pairs, computed once — the bias is
+        static per request but applied on every sampling step."""
+        cached = getattr(self, "_bias_items", None)
+        if cached is None:
+            cached = tuple(sorted((self.logit_bias or {}).items()))
+            object.__setattr__(self, "_bias_items", cached)
+        return cached
+
 
 @dataclasses.dataclass
 class Request:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 import threading
 import time
 import uuid
@@ -87,16 +88,24 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
                 "'logit_bias' must be a {token_id: bias} object with at "
                 "most 300 entries")
         try:
-            # OpenAI sends string keys and clamps bias to [-100, 100]
-            bias = {int(k): max(-100.0, min(100.0, float(v)))
-                    for k, v in bias.items()}
+            bias = {int(k): float(v) for k, v in bias.items()}
         except (TypeError, ValueError):
             raise ValueError("'logit_bias' keys must be token ids and "
                              "values numbers") from None
-        if any(k < 0 for k in bias):
+        if any(k < 0 or k >= 2**31 for k in bias):
             # negative ids would wrap NumPy-style in the scatter and bias
-            # the wrong token; ids >= vocab are dropped harmlessly
-            raise ValueError("'logit_bias' token ids must be >= 0")
+            # the wrong token; ids past int32 would overflow the scatter
+            # index array and crash the engine step (failing the whole
+            # batch); ids >= vocab are dropped harmlessly
+            raise ValueError(
+                "'logit_bias' token ids must be in [0, 2**31)")
+        if any(math.isnan(v) or math.isinf(v) for v in bias.values()):
+            # must run BEFORE the clamp: json.loads accepts NaN/Infinity
+            # literals, and max(-100, min(100, nan)) is 100 — a NaN would
+            # silently force the token
+            raise ValueError("'logit_bias' values must be finite")
+        # OpenAI semantics: bias clamped to [-100, 100]
+        bias = {k: max(-100.0, min(100.0, v)) for k, v in bias.items()}
     return SamplingParams(
         max_tokens=min(_num(body, "max_tokens", 16, int), cap),
         temperature=_num(body, "temperature", 1.0, float),
