@@ -236,3 +236,104 @@ def test_logit_bias_under_pipelined_windows():
                                              logit_bias={9: 100.0}))[0]
     assert out.output_token_ids == [9] * 6
     assert eng.block_manager.num_seqs() == 0
+
+
+def test_min_tokens_suppresses_eos():
+    """min_tokens masks EOS until the floor is reached: a model config
+    whose greedy argmax IS an eos token must keep generating, and the
+    windowed/pipelined engine must agree with the single-step one."""
+    import dataclasses
+    from tpuserve.models.config import get_model_config
+
+    # pick a prompt whose greedy stream has a token first occurring
+    # mid-stream (repetitive streams would stop the baseline too early)
+    probe = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16)))
+    prompt, eos = None, None
+    for cand in ("m", "hello", "abc", "Zq9", "prompt!", "x y z"):
+        ids = probe.generate([cand], SamplingParams(
+            max_tokens=10, temperature=0.0,
+            ignore_eos=True))[0].output_token_ids
+        hit = [t for i, t in enumerate(ids)
+               if 2 <= i <= 4 and t not in ids[:i]]
+        if hit:
+            prompt, eos = cand, hit[0]
+            break
+    assert prompt is not None, "no probe prompt yields a usable eos token"
+    mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                             eos_token_id=eos)
+
+    def run(**kw):
+        eng = Engine(EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16), **kw), model_cfg=mc)
+        return eng.generate([prompt], SamplingParams(max_tokens=10,
+                                                     temperature=0.0,
+                                                     min_tokens=6))[0]
+
+    # without min_tokens the stream stops at the eos (position 2)
+    short = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64,
+                          max_blocks_per_seq=16)), model_cfg=mc).generate(
+        [prompt], SamplingParams(max_tokens=10, temperature=0.0))[0]
+    assert short.finish_reason == FinishReason.STOP
+    assert 3 <= len(short.output_token_ids) <= 5     # stopped at the eos
+
+    plain = run()
+    assert len(plain.output_token_ids) >= 6
+    # the masked steps must not emit the eos token
+    assert eos not in plain.output_token_ids[:5]
+
+    piped = run(multi_step=4, pipeline_decode=True)
+    assert piped.output_token_ids == plain.output_token_ids
+
+
+def test_min_tokens_suppresses_stop_strings():
+    """vLLM semantics: stop strings must not terminate the stream before
+    min_tokens (text still streams)."""
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16))
+    # empty stop string matches after every token — without suppression the
+    # stream would stop at 1 token (see test_stop_string)
+    r = Engine(cfg).generate(
+        ["hi"], SamplingParams(max_tokens=12, temperature=0.0,
+                               ignore_eos=True, stop=("",),
+                               min_tokens=5))[0]
+    assert len(r.output_token_ids) == 5
+    assert r.finish_reason == FinishReason.STOP
+
+
+def test_min_tokens_single_step_pipeline_gate():
+    """The single-step pipelined path's mask-lift boundary runs one step
+    stale; the gate must hold the sync path one step LONGER (slack=1) so
+    the mask cannot lift early."""
+    import dataclasses
+    from tpuserve.models.config import get_model_config
+
+    probe = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16)))
+    ids = probe.generate(["abc"], SamplingParams(
+        max_tokens=10, temperature=0.0, ignore_eos=True))[0].output_token_ids
+    hit = [t for i, t in enumerate(ids) if 2 <= i <= 4 and t not in ids[:i]]
+    if not hit:
+        import pytest
+        pytest.skip("greedy stream too repetitive for an eos probe")
+    mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                             eos_token_id=hit[0])
+
+    def run(pipe):
+        eng = Engine(EngineConfig(
+            model="tiny-qwen3", multi_step=1, pipeline_decode=pipe,
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16)), model_cfg=mc)
+        return eng.generate(["abc"], SamplingParams(
+            max_tokens=10, temperature=0.0, min_tokens=6))[0]
+
+    piped, plain = run(True), run(False)
+    assert piped.output_token_ids == plain.output_token_ids
+    assert len(piped.output_token_ids) >= 6

@@ -322,3 +322,18 @@ def test_logit_bias_and_echo(server):
     first = _json.loads([ln for ln in raw3.splitlines()
                          if ln.startswith("data: ")][0][6:])
     assert first["choices"][0]["text"] == "hello"
+
+
+def test_min_tokens_param_accepted(server):
+    status, out = _post(server + "/v1/completions",
+                        {"prompt": "hi", "max_tokens": 4, "min_tokens": 99,
+                         "temperature": 0})
+    # min_tokens is clamped to max_tokens and the request completes
+    assert status == 200
+    assert out["usage"]["completion_tokens"] == 4
+
+    # the clamp itself (99 -> max_tokens), asserted on the parsed params
+    from tpuserve.server.openai_api import _sampling_from_request
+    p = _sampling_from_request({"max_tokens": 4, "min_tokens": 99}, cap=100)
+    assert p.min_tokens == 4
+    assert _sampling_from_request({"min_tokens": -3}, cap=100).min_tokens == 0

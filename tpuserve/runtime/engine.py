@@ -253,14 +253,15 @@ class Engine:
             raise ValueError("empty prompt")
         if jax.process_count() > 1 and (params.needs_penalties
                                         or params.needs_logit_bias
+                                        or params.needs_min_tokens
                                         or params.logprobs is not None):
             # Penalty/bias/logprob ops are separate jits over the
             # mesh-global logits; the lockstep protocol mirrors
             # prefill/decode/sample only.  Rejected at intake rather than
             # deadlocking in SPMD.  See parallel/multihost.py "Limitations".
             raise ValueError(
-                "sampling penalties, logit_bias, and logprobs are not "
-                "supported in multi-host serving mode")
+                "sampling penalties, logit_bias, min_tokens, and logprobs "
+                "are not supported in multi-host serving mode")
         if len(prompt_token_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds max sequence "
@@ -358,6 +359,9 @@ class Engine:
         elif (self._spec is not None
               and all(r.params.greedy and not r.params.needs_penalties
                       and not r.params.needs_logit_bias
+                      and not (r.params.needs_min_tokens
+                               and r.params.min_tokens_active(
+                                   len(r.output_token_ids)))
                       and r.params.logprobs is None
                       for r in batch.requests)):
             outputs = self._run_decode_spec(batch)
@@ -567,6 +571,8 @@ class Engine:
         S = self._multi_step
         if any(r.params.needs_penalties or r.params.logprobs is not None
                or r.params.needs_truncation or r.params.needs_logit_bias
+               or (r.params.needs_min_tokens
+                   and r.params.min_tokens_active(len(r.output_token_ids)))
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
@@ -696,6 +702,11 @@ class Engine:
         # stale under the pipeline — those batches run synchronously.
         pipeline_ok = self._pipeline_decode and not any(
             r.params.needs_penalties or r.params.logprobs is not None
+            # min_tokens reads host-side output lengths, one step stale
+            # under the pipeline — the mask could lift one step late/early
+            or (r.params.needs_min_tokens
+                and r.params.min_tokens_active(len(r.output_token_ids),
+                                               slack=1))
             for r in reqs)
         if pending is not None and not pipeline_ok:
             outputs += self._flush_pending()
@@ -871,6 +882,10 @@ class Engine:
             # applied before logprobs, like penalties: reported logprobs
             # describe the distribution actually sampled from
             logits = self._apply_logit_bias(logits, reqs, B)
+        if any(r.params.needs_min_tokens
+               and r.params.min_tokens_active(len(r.output_token_ids))
+               for r in reqs):
+            logits = self._apply_min_tokens(logits, reqs, B)
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
@@ -887,6 +902,25 @@ class Engine:
             for j, (tid, b) in enumerate(r.params.logit_bias_items()):
                 ids[i, j] = int(tid)
                 vals[i, j] = float(b)
+        return sampling_ops.apply_logit_bias(
+            logits, jnp.asarray(ids), jnp.asarray(vals))
+
+    def _apply_min_tokens(self, logits: jnp.ndarray, reqs: list[Request],
+                          B: int) -> jnp.ndarray:
+        """vLLM min_tokens: mask every EOS id (-1e9, not -inf — a fully
+        -masked row under temperature softmax must not produce NaN) for
+        rows that haven't generated min_tokens yet.  Reuses the bias
+        scatter."""
+        V = logits.shape[1]
+        eos = sorted(self._eos_ids)
+        K = next_power_of_2(len(eos) or 1)
+        ids = np.full((B, K), V, np.int32)
+        vals = np.zeros((B, K), np.float32)
+        for i, r in enumerate(reqs):
+            if (r.params.needs_min_tokens
+                    and r.params.min_tokens_active(len(r.output_token_ids))):
+                ids[i, :len(eos)] = eos
+                vals[i, :len(eos)] = -1e9
         return sampling_ops.apply_logit_bias(
             logits, jnp.asarray(ids), jnp.asarray(vals))
 
@@ -976,7 +1010,10 @@ class Engine:
         self.stats.generated_tokens += 1
         delta = self._detok[req.request_id].add(tok)
         reason = None
-        if req.params.stop:
+        if req.params.stop and not req.params.min_tokens_active(
+                len(req.output_token_ids)):
+            # vLLM min_tokens semantics: stop strings are suppressed (text
+            # still streams) until the floor is reached
             delta, stopped = self._match_stop(req, delta)   # mutates output_text on stop
             if stopped:
                 reason = FinishReason.STOP

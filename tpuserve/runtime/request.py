@@ -43,6 +43,9 @@ class SamplingParams:
     # OpenAI logit_bias: token id -> additive bias (clamped to ±100 at the
     # API layer); applied to the logits before every sampling step
     logit_bias: Optional[dict[int, float]] = None
+    # vLLM min_tokens: EOS is masked out of the logits and stop-string
+    # termination is suppressed until this many tokens have been generated
+    min_tokens: int = 0
 
     @property
     def greedy(self) -> bool:
@@ -60,6 +63,20 @@ class SamplingParams:
     @property
     def needs_logit_bias(self) -> bool:
         return bool(self.logit_bias)
+
+    @property
+    def needs_min_tokens(self) -> bool:
+        """Whether the EOS logits mask may be required (ignore_eos streams
+        never stop on EOS, so no mask — stop-string suppression is
+        host-side and needs no mask either)."""
+        return self.min_tokens > 0 and not self.ignore_eos
+
+    def min_tokens_active(self, n_generated: int, slack: int = 0) -> bool:
+        """True while the min_tokens floor is still in force after
+        ``n_generated`` tokens.  ``slack`` widens the window for callers
+        whose host-side length is stale (the pipelined decode path runs one
+        step behind) — the single place the boundary arithmetic lives."""
+        return self.min_tokens > 0 and n_generated < self.min_tokens + slack
 
     def logit_bias_items(self) -> tuple:
         """Sorted (token_id, bias) pairs, computed once — the bias is
@@ -129,7 +146,10 @@ def check_stop(req: Request, eos_token_ids: Sequence[int], max_model_len: int) -
     if not req.output_token_ids:
         return None
     last = req.output_token_ids[-1]
-    if not req.params.ignore_eos and last in eos_token_ids:
+    if (not req.params.ignore_eos and last in eos_token_ids
+            and not req.params.min_tokens_active(len(req.output_token_ids))):
+        # min_tokens: the logits mask should prevent EOS from being
+        # sampled at all; this guard covers any path where it leaks
         return FinishReason.STOP
     if len(req.output_token_ids) >= req.params.max_tokens:
         return FinishReason.LENGTH

@@ -106,8 +106,10 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
             raise ValueError("'logit_bias' values must be finite")
         # OpenAI semantics: bias clamped to [-100, 100]
         bias = {k: max(-100.0, min(100.0, v)) for k, v in bias.items()}
+    max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     return SamplingParams(
-        max_tokens=min(_num(body, "max_tokens", 16, int), cap),
+        max_tokens=max_tokens,
+        min_tokens=max(0, min(_num(body, "min_tokens", 0, int), max_tokens)),
         temperature=_num(body, "temperature", 1.0, float),
         top_k=_num(body, "top_k", 0, int),
         top_p=_num(body, "top_p", 1.0, float),
