@@ -337,3 +337,40 @@ def test_min_tokens_single_step_pipeline_gate():
     piped, plain = run(True), run(False)
     assert piped.output_token_ids == plain.output_token_ids
     assert len(piped.output_token_ids) >= 6
+
+
+def test_stop_token_ids():
+    """vLLM stop_token_ids: listed ids finish the stream like EOS (token
+    emitted, STOP reason), work under fused windows, respect min_tokens,
+    and apply even with ignore_eos."""
+    cfg = lambda **kw: EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        **kw)
+    base = Engine(cfg()).generate(
+        ["stop here"], SamplingParams(max_tokens=10, temperature=0.0,
+                                      ignore_eos=True))[0].output_token_ids
+    stop_tok = base[3]
+
+    r = Engine(cfg()).generate(
+        ["stop here"], SamplingParams(max_tokens=10, temperature=0.0,
+                                      ignore_eos=True,
+                                      stop_token_ids=(stop_tok,)))[0]
+    assert r.finish_reason == FinishReason.STOP
+    assert r.output_token_ids[-1] == stop_tok
+    assert len(r.output_token_ids) <= 4
+
+    # same under pipelined fused windows
+    rw = Engine(cfg(multi_step=4, pipeline_decode=True)).generate(
+        ["stop here"], SamplingParams(max_tokens=10, temperature=0.0,
+                                      ignore_eos=True,
+                                      stop_token_ids=(stop_tok,)))[0]
+    assert rw.output_token_ids == r.output_token_ids
+
+    # min_tokens masks the stop id until the floor
+    rm = Engine(cfg()).generate(
+        ["stop here"], SamplingParams(max_tokens=10, temperature=0.0,
+                                      ignore_eos=True, min_tokens=7,
+                                      stop_token_ids=(stop_tok,)))[0]
+    assert len(rm.output_token_ids) >= 7
+    assert stop_tok not in rm.output_token_ids[:6]

@@ -129,6 +129,11 @@ def sampling_to_dict(p: SamplingParams) -> dict:
 def sampling_from_dict(d: dict) -> SamplingParams:
     d = dict(d)
     d["stop"] = tuple(d.get("stop") or ())
+    d["stop_token_ids"] = tuple(d.get("stop_token_ids") or ())
+    if d.get("logit_bias"):
+        # JSON object keys arrive as strings
+        d["logit_bias"] = {int(k): float(v)
+                           for k, v in d["logit_bias"].items()}
     return SamplingParams(**d)
 
 

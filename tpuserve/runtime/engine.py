@@ -907,20 +907,27 @@ class Engine:
 
     def _apply_min_tokens(self, logits: jnp.ndarray, reqs: list[Request],
                           B: int) -> jnp.ndarray:
-        """vLLM min_tokens: mask every EOS id (-1e9, not -inf — a fully
-        -masked row under temperature softmax must not produce NaN) for
-        rows that haven't generated min_tokens yet.  Reuses the bias
-        scatter."""
+        """vLLM min_tokens: mask every EOS id and per-request
+        stop_token_ids (-1e9, not -inf — a fully-masked row under
+        temperature softmax must not produce NaN) for rows that haven't
+        generated min_tokens yet.  Reuses the bias scatter."""
         V = logits.shape[1]
         eos = sorted(self._eos_ids)
-        K = next_power_of_2(len(eos) or 1)
-        ids = np.full((B, K), V, np.int32)
-        vals = np.zeros((B, K), np.float32)
+        rows = {}
         for i, r in enumerate(reqs):
             if (r.params.needs_min_tokens
                     and r.params.min_tokens_active(len(r.output_token_ids))):
-                ids[i, :len(eos)] = eos
-                vals[i, :len(eos)] = -1e9
+                rows[i] = (([] if r.params.ignore_eos else eos)
+                           + list(r.params.stop_token_ids))
+        # width over MASKED rows only — a past-floor row with many
+        # stop_token_ids must not inflate the scatter bucket
+        K = next_power_of_2(max((len(v) for v in rows.values()), default=1)
+                            or 1)
+        ids = np.full((B, K), V, np.int32)
+        vals = np.zeros((B, K), np.float32)
+        for i, row in rows.items():
+            ids[i, :len(row)] = row
+            vals[i, :len(row)] = -1e9
         return sampling_ops.apply_logit_bias(
             logits, jnp.asarray(ids), jnp.asarray(vals))
 

@@ -46,6 +46,9 @@ class SamplingParams:
     # vLLM min_tokens: EOS is masked out of the logits and stop-string
     # termination is suppressed until this many tokens have been generated
     min_tokens: int = 0
+    # vLLM stop_token_ids: extra ids that finish the request like EOS does
+    # (the matched token is emitted; min_tokens suppresses these too)
+    stop_token_ids: tuple[int, ...] = ()
 
     @property
     def greedy(self) -> bool:
@@ -66,10 +69,12 @@ class SamplingParams:
 
     @property
     def needs_min_tokens(self) -> bool:
-        """Whether the EOS logits mask may be required (ignore_eos streams
-        never stop on EOS, so no mask — stop-string suppression is
-        host-side and needs no mask either)."""
-        return self.min_tokens > 0 and not self.ignore_eos
+        """Whether the stop-id logits mask may be required (ignore_eos
+        streams never stop on EOS, so no EOS mask — but stop_token_ids
+        still need masking; stop-string suppression is host-side and needs
+        no mask)."""
+        return self.min_tokens > 0 and (not self.ignore_eos
+                                        or bool(self.stop_token_ids))
 
     def min_tokens_active(self, n_generated: int, slack: int = 0) -> bool:
         """True while the min_tokens floor is still in force after
@@ -146,10 +151,12 @@ def check_stop(req: Request, eos_token_ids: Sequence[int], max_model_len: int) -
     if not req.output_token_ids:
         return None
     last = req.output_token_ids[-1]
-    if (not req.params.ignore_eos and last in eos_token_ids
-            and not req.params.min_tokens_active(len(req.output_token_ids))):
+    if (not req.params.min_tokens_active(len(req.output_token_ids))
+            and ((not req.params.ignore_eos and last in eos_token_ids)
+                 or last in req.params.stop_token_ids)):
         # min_tokens: the logits mask should prevent EOS from being
-        # sampled at all; this guard covers any path where it leaks
+        # sampled at all; this guard covers any path where it leaks.
+        # stop_token_ids finish unconditionally of ignore_eos (vLLM).
         return FinishReason.STOP
     if len(req.output_token_ids) >= req.params.max_tokens:
         return FinishReason.LENGTH

@@ -106,6 +106,13 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
             raise ValueError("'logit_bias' values must be finite")
         # OpenAI semantics: bias clamped to [-100, 100]
         bias = {k: max(-100.0, min(100.0, v)) for k, v in bias.items()}
+    stop_ids = body.get("stop_token_ids") or ()
+    if stop_ids:
+        if (not isinstance(stop_ids, (list, tuple)) or len(stop_ids) > 64
+                or not all(isinstance(t, int) and not isinstance(t, bool)
+                           and 0 <= t < 2**31 for t in stop_ids)):
+            raise ValueError("'stop_token_ids' must be a list of at most "
+                             "64 token ids in [0, 2**31)")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     return SamplingParams(
         max_tokens=max_tokens,
@@ -121,6 +128,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         seed=seed,
         logprobs=n_logprobs,
         logit_bias=bias,
+        stop_token_ids=tuple(stop_ids),
     )
 
 
