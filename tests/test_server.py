@@ -337,3 +337,27 @@ def test_min_tokens_param_accepted(server):
     p = _sampling_from_request({"max_tokens": 4, "min_tokens": 99}, cap=100)
     assert p.min_tokens == 4
     assert _sampling_from_request({"min_tokens": -3}, cap=100).min_tokens == 0
+
+
+def test_stream_options_include_usage(server):
+    status, raw = _post(server + "/v1/completions",
+                        {"prompt": "hi", "max_tokens": 5, "temperature": 0,
+                         "ignore_eos": True, "stream": True,
+                         "stream_options": {"include_usage": True}},
+                        raw=True)
+    assert status == 200
+    lines = [ln for ln in raw.decode().splitlines()
+             if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+    final = json.loads(lines[-1][6:])
+    assert final["choices"] == []
+    assert final["usage"]["completion_tokens"] == 5
+    assert final["usage"]["prompt_tokens"] >= 1
+    assert final["usage"]["total_tokens"] == (
+        final["usage"]["prompt_tokens"] + 5)
+    # without the option, no usage chunk appears
+    status, raw2 = _post(server + "/v1/completions",
+                         {"prompt": "hi", "max_tokens": 3, "temperature": 0,
+                          "ignore_eos": True, "stream": True}, raw=True)
+    assert all("usage" not in json.loads(ln[6:])
+               for ln in raw2.decode().splitlines()
+               if ln.startswith("data: ") and not ln.endswith("[DONE]"))

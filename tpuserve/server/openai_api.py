@@ -335,6 +335,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, str(e))
             return
         stream = bool(body.get("stream", False))
+        if "stream_options" in body and not isinstance(
+                body.get("stream_options"), dict):
+            self._error(400, "'stream_options' must be an object")
+            return
         kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
                   else {"prompt": prompt})
         from tpuserve.server.tracing import get_tracer
@@ -624,6 +628,11 @@ class _Handler(BaseHTTPRequestHandler):
                                 "created": int(time.time()),
                                 "model": ctx.model_name,
                                 "choices": [choice]})
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage"))
+            prompt_toks = 0
+            completion_toks = 0
+            errored = False
             live = n
             while live:
                 try:
@@ -636,12 +645,14 @@ class _Handler(BaseHTTPRequestHandler):
                 except _queue.Empty:
                     abort_all()
                     send_chunk({"error": {"message": "request timed out"}})
+                    errored = True
                     break
                 if item is None:
                     live -= 1
                     continue
                 if isinstance(item, Exception):
                     send_chunk({"error": {"message": str(item)}})
+                    errored = True
                     live -= 1
                     continue
                 finish = item.finish_reason.value if item.finish_reason else None
@@ -656,8 +667,29 @@ class _Handler(BaseHTTPRequestHandler):
                     obj = "text_completion"
                 if ret_ids:
                     choice["token_ids"] = list(item.new_token_ids)
-                send_chunk({"id": oid, "object": obj, "created": int(time.time()),
-                            "model": ctx.model_name, "choices": [choice]})
+                completion_toks += len(item.new_token_ids)
+                # the prompt is shared across the n choices: count it once
+                prompt_toks = item.num_prompt_tokens
+                chunk = {"id": oid, "object": obj,
+                         "created": int(time.time()),
+                         "model": ctx.model_name, "choices": [choice]}
+                if include_usage:
+                    chunk["usage"] = None     # OpenAI: null until the final chunk
+                send_chunk(chunk)
+            if include_usage and not errored:
+                # OpenAI stream_options.include_usage: one final chunk with
+                # empty choices carrying the aggregate usage (skipped after
+                # an error chunk — a zero-prompt usage line would misreport)
+                send_chunk({"id": oid,
+                            "object": ("chat.completion.chunk" if chat
+                                       else "text_completion"),
+                            "created": int(time.time()),
+                            "model": ctx.model_name, "choices": [],
+                            "usage": {
+                                "prompt_tokens": prompt_toks,
+                                "completion_tokens": completion_toks,
+                                "total_tokens": prompt_toks + completion_toks,
+                            }})
             done = b"data: [DONE]\n\n"
             self.wfile.write(hex(len(done))[2:].encode() + b"\r\n" + done + b"\r\n")
             self.wfile.write(b"0\r\n\r\n")
