@@ -261,34 +261,44 @@ def _run_workload(engine, prompts, params):
             **deltas}
 
 
-def _best_tpu_result():
-    """Highest-throughput backend=tpu row from bench_sweep.jsonl (a
-    git-tracked measurement log), if any — real chip evidence recorded
-    earlier in the round.  Never raises: this runs on the degraded path,
-    whose one job is to always emit the JSON line."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "bench_sweep.jsonl")
-    best, n_rows = None, 0
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    row = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if (not isinstance(row, dict)
-                        or row.get("backend") != "tpu"
-                        or not isinstance(row.get("value"), (int, float))):
-                    continue
-                n_rows += 1
-                if best is None or row["value"] > best["value"]:
-                    best = {k: row.get(k) for k in
-                            ("value", "unit", "vs_baseline", "variant",
-                             "multi_step", "attn_impl", "ttft_ms")}
-    except Exception:
-        return None
+def _best_tpu_result(model):
+    """Highest-throughput backend=tpu row for THIS model, from the live
+    sweep log or the committed round snapshot (bench_r03_tpu.jsonl) —
+    prior chip evidence may not be passed off for a different model, and
+    the row carries its own batch/prompt_len/gen_len so the workload it
+    measured is explicit (a degraded run uses CPU-sized shapes, so shape
+    equality would never hold by design).  Never raises: this runs on the
+    degraded path, whose one job is to always emit the JSON line."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    best, n_rows, seen = None, 0, set()
+    for name in ("bench_sweep.jsonl", "bench_r03_tpu.jsonl"):
+        try:
+            with open(os.path.join(root, name)) as f:
+                lines = f.readlines()
+        except Exception:
+            continue
+        for line in lines:
+            if line in seen:            # live log is seeded from the snapshot
+                continue
+            seen.add(line)
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (not isinstance(row, dict)
+                    or row.get("backend") != "tpu"
+                    or not isinstance(row.get("value"), (int, float))
+                    or row.get("model") != model):
+                continue
+            n_rows += 1
+            if best is None or row["value"] > best["value"]:
+                best = {k: row.get(k) for k in
+                        ("value", "unit", "vs_baseline", "variant",
+                         "multi_step", "attn_impl", "ttft_ms", "model",
+                         "batch", "prompt_len", "gen_len", "ts")}
     if best is not None:
         best["tpu_rows_recorded"] = n_rows
+        best["from_log"] = "bench_sweep.jsonl/bench_r03_tpu.jsonl"
     return best
 
 
@@ -496,7 +506,7 @@ def main(argv=None):
         probe_err = os.environ.get("TPUSERVE_BENCH_PROBE_ERROR")
         if probe_err:
             out["probe_error"] = probe_err
-        best_tpu = _best_tpu_result()
+        best_tpu = _best_tpu_result(eng0.model_cfg.name)
         if best_tpu:
             # the chip was reachable earlier: carry the round's best REAL
             # measurement (from the git-tracked bench_sweep.jsonl; the full

@@ -374,3 +374,35 @@ def test_stop_token_ids():
                                       stop_token_ids=(stop_tok,)))[0]
     assert len(rm.output_token_ids) >= 7
     assert stop_tok not in rm.output_token_ids[:6]
+
+
+def test_mixed_feature_batch_composes():
+    """One batch mixing logit_bias, min_tokens, stop_token_ids, and a
+    plain request: batch-level gates route everyone through the sync path
+    and each request's feature must still apply independently."""
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3", multi_step=4, pipeline_decode=True,
+        cache=CacheConfig(block_size=4, num_blocks=96, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=8, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+    base = eng.generate(["p0"], SamplingParams(
+        max_tokens=8, temperature=0.0, ignore_eos=True))[0].output_token_ids
+    stop_tok = base[3]
+    outs = eng.generate(
+        ["p0", "p0", "p2", "p3"],    # req 1 shares p0's stream -> stop_tok occurs
+        [SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True,
+                        logit_bias={11: 100.0}),
+         SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True,
+                        stop_token_ids=(stop_tok,)),
+         SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True,
+                        min_tokens=8),
+         SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)])
+    assert outs[0].output_token_ids == [11] * 8            # bias forces
+    assert outs[1].output_token_ids[-1] == stop_tok        # stop id fires
+    assert len(outs[1].output_token_ids) <= 4
+    assert len(outs[2].output_token_ids) == 8              # floor reached
+    # the plain request must be unaffected by its batchmates
+    plain = eng.generate(["p3"], SamplingParams(
+        max_tokens=8, temperature=0.0, ignore_eos=True))[0]
+    assert outs[3].output_token_ids == plain.output_token_ids
+    assert eng.block_manager.num_seqs() == 0

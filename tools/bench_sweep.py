@@ -203,6 +203,7 @@ def main():
             shutil.rmtree(cache_override, ignore_errors=True)
         r = run_variant(name, vargs, args.timeout, env=env)
         if r is not None:
+            r["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
             print(json.dumps(r), flush=True)
             log.write(json.dumps(r) + "\n")
             log.flush()
