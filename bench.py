@@ -296,9 +296,9 @@ def _best_tpu_result(model):
                         ("value", "unit", "vs_baseline", "variant",
                          "multi_step", "attn_impl", "ttft_ms", "model",
                          "batch", "prompt_len", "gen_len", "ts")}
+                best["from_log"] = name        # actual source of the row
     if best is not None:
         best["tpu_rows_recorded"] = n_rows
-        best["from_log"] = "bench_sweep.jsonl/bench_r03_tpu.jsonl"
     return best
 
 
@@ -509,9 +509,10 @@ def main(argv=None):
         best_tpu = _best_tpu_result(eng0.model_cfg.name)
         if best_tpu:
             # the chip was reachable earlier: carry the round's best REAL
-            # measurement (from the git-tracked bench_sweep.jsonl; the full
-            # table with every variant is in BENCHMARKS.md) so a dead
-            # tunnel at report time doesn't erase the evidence
+            # measurement (from the committed bench_r03_tpu.jsonl snapshot
+            # or the live sweep log; the full table with every variant is
+            # in BENCHMARKS.md) so a dead tunnel at report time doesn't
+            # erase the evidence
             out["best_tpu_result"] = best_tpu
     if args.spec:
         # per-run deltas (the selected median run), NOT cumulative stats —

@@ -74,6 +74,13 @@ class ServerMetrics:
             "Tokens computed past a request's stop point by fused "
             "multi-step windows and dropped at emit (the cost knob for "
             "--multi-step; no vLLM analog)")
+        self.prefix_hits = counter(
+            "tpuserve_prefix_cache_hits",
+            "Prompt blocks served from the prefix cache (vLLM "
+            "gpu_prefix_cache_hit_rate analog: divide by queries)")
+        self.prefix_queries = counter(
+            "tpuserve_prefix_cache_queries",
+            "Prompt blocks looked up in the prefix cache")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -21,6 +21,14 @@ from tpuserve.runtime.request import RequestOutput, SamplingParams
 logger = logging.getLogger("tpuserve.server")
 
 
+def _advance_counter(ctr, cumulative) -> None:
+    """Advance a prometheus Counter to an engine-side cumulative value
+    (counters only go up; engines keep their own monotonic totals)."""
+    current = ctr._value.get()
+    if cumulative > current:
+        ctr.inc(cumulative - current)
+
+
 @dataclasses.dataclass
 class _Submit:
     prompt: Optional[str]
@@ -258,6 +266,9 @@ class AsyncEngineRunner:
             total = sum(bm.num_blocks for bm in bms)
             free = sum(bm.num_free_blocks for bm in bms)
             self.metrics.kv_usage.set((total - free) / max(total, 1))
+            for name in ("prefix_hits", "prefix_queries"):
+                _advance_counter(getattr(self.metrics, name),
+                                 sum(getattr(bm, name, 0) for bm in bms))
         # engine-level stats live on the inner engines for the disagg
         # wrappers (DisaggStats has neither counter) — same special-casing
         # as the scheduler/block-manager reads above
@@ -265,17 +276,14 @@ class AsyncEngineRunner:
                               getattr(eng, "decode", None)) if e is not None]
         stats_objs = [i.stats for i in (inners or [eng])
                       if hasattr(i, "stats")]
-        preempt = sum(getattr(s, "preemptions", 0) for s in stats_objs)
         if stats_objs:
-            # counter semantics: advance to the engines' cumulative count
-            current = self.metrics.preemptions._value.get()
-            if preempt > current:
-                self.metrics.preemptions.inc(preempt - current)
-            overrun = sum(getattr(s, "window_overrun_tokens", 0)
-                          for s in stats_objs)
-            current = self.metrics.window_overrun._value.get()
-            if overrun > current:
-                self.metrics.window_overrun.inc(overrun - current)
+            _advance_counter(
+                self.metrics.preemptions,
+                sum(getattr(s, "preemptions", 0) for s in stats_objs))
+            _advance_counter(
+                self.metrics.window_overrun,
+                sum(getattr(s, "window_overrun_tokens", 0)
+                    for s in stats_objs))
 
     def _loop(self) -> None:
         logger.info("engine loop started")
