@@ -361,3 +361,23 @@ def test_stream_options_include_usage(server):
     assert all("usage" not in json.loads(ln[6:])
                for ln in raw2.decode().splitlines()
                if ln.startswith("data: ") and not ln.endswith("[DONE]"))
+
+
+def test_tokenize_detokenize_roundtrip(server):
+    status, out = _post(server + "/tokenize", {"prompt": "hello world"})
+    assert status == 200
+    assert out["count"] == len(out["tokens"]) > 0
+    assert out["max_model_len"] > 0
+    status2, out2 = _post(server + "/detokenize", {"tokens": out["tokens"]})
+    assert status2 == 200
+    assert out2["prompt"] == "hello world"
+    # malformed inputs -> 400
+    import urllib.error
+    for url, payload in ((server + "/tokenize", {"prompt": 5}),
+                         (server + "/detokenize", {"tokens": ["x"]}),
+                         (server + "/detokenize", {"tokens": [True]})):
+        try:
+            _post(url, payload)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400

@@ -323,6 +323,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/internal/abort":
             self._handle_internal_abort()
             return
+        if self.path in ("/tokenize", "/detokenize"):
+            self._handle_tokenize(self.path == "/tokenize")
+            return
         chat = self.path == "/v1/chat/completions"
         if self.path not in ("/v1/completions", "/v1/chat/completions"):
             self._error(404, f"no route {self.path}")
@@ -428,6 +431,35 @@ class _Handler(BaseHTTPRequestHandler):
             ctx.runner.abort(rid)
         finally:
             getattr(ctx.engine, "requests", {}).pop(rid, None)
+
+    def _handle_tokenize(self, encode: bool):
+        """vLLM-compatible /tokenize and /detokenize: clients use these for
+        budget accounting against the SERVER's tokenizer (which may differ
+        from whatever they have locally)."""
+        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
+        try:
+            body = self._read_body()
+            if encode:
+                prompt = body.get("prompt")
+                if not isinstance(prompt, str):
+                    raise ValueError("'prompt' must be a string")
+                ids = eng.tokenizer.encode(prompt)
+                self._json(200, {"tokens": ids, "count": len(ids),
+                                 "max_model_len": eng.max_seq_len})
+            else:
+                tokens = body.get("tokens")
+                if (not isinstance(tokens, list)
+                        or not all(isinstance(t, int)
+                                   and not isinstance(t, bool)
+                                   and 0 <= t < 2**31 for t in tokens)):
+                    # same bound as stop_token_ids/logit_bias: oversized
+                    # ids overflow the HF tokenizer's u32 conversion with
+                    # an exception type this handler doesn't map to a 400
+                    raise ValueError("'tokens' must be a list of token ids "
+                                     "in [0, 2**31)")
+                self._json(200, {"prompt": eng.tokenizer.decode(tokens)})
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, str(e))
 
     def _handle_internal_abort(self):
         """Drop an adopted request (prefill pod's ambiguous-outcome cleanup:
