@@ -9,9 +9,13 @@ line.  The baseline is the driver-defined north-star target of 2,000
 tok/s/chip on v5e (BASELINE.md); the reference itself publishes no numbers
 (SURVEY.md §6).
 
-A dead TPU tunnel is retried with backoff; only after the retries fail does
-the bench fall back to CPU, and then the JSON line carries a ``degraded``
-field so a CPU number can never pass silently for a TPU result.
+A dead TPU tunnel is retried with capped backoff until a real deadline
+(default 4 h, env ``TPUSERVE_PROBE_DEADLINE_S``) — round-3 evidence shows
+the tunnel flaps for hours and then returns, so a short probe window turns
+a whole round of TPU work into a CPU number (VERDICT r3 weak #1).  Only
+after the deadline truly expires does the bench fall back to CPU, and then
+the JSON line carries a ``degraded`` field so a CPU number can never pass
+silently for a TPU result.
 
 Variants (all optional, main line unchanged without them):
   --spec K          speculative decoding (n-gram prompt lookup, k=K) on a
@@ -33,10 +37,28 @@ import time
 
 TARGET_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north-star target
 
-# retry schedule for the tunnel probe: worst case 3 x 120s probes + 60s of
-# backoff = 7 min before the degraded CPU fallback
+# Patient tunnel watcher: the capture window is the whole round, and the
+# axon tunnel's observed outages last hours, not minutes.  Probe with
+# capped backoff until the deadline; the old 7-minute courtesy check
+# produced three consecutive degraded BENCH captures while the chip was
+# reachable later the same day.
 PROBE_TIMEOUT_S = 120
-PROBE_BACKOFF_S = (20, 40)
+PROBE_DEADLINE_S = float(os.environ.get("TPUSERVE_PROBE_DEADLINE_S", 4 * 3600))
+PROBE_MAX_BACKOFF_S = 180.0
+
+
+def _git_commit() -> str:
+    """Short HEAD hash, stamped into every result row so carried evidence
+    is explicit about which code it measured (ADVICE r3: a best_tpu_result
+    predating the current engine must be distinguishable from HEAD)."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() if out.returncode == 0 else "unknown"
+    except Exception:
+        return "unknown"
 
 
 # Last failed probe's diagnostics (the actual jax/PJRT error text) — carried
@@ -103,27 +125,34 @@ def _degrade_to_cpu(reason: str) -> None:
 
 def _ensure_live_backend(retry: bool = True) -> None:
     """The axon TPU tunnel, when unhealthy, hangs ANY jax backend init —
-    even under JAX_PLATFORMS=cpu.  Probe it in a killable subprocess,
-    retrying with backoff (tunnels do come back); only then fall back to a
-    clean CPU re-exec, marked DEGRADED in the output, so the bench always
-    produces its JSON line instead of hanging the driver.  ``retry=False``
-    (smoke runs, which are CPU-by-definition) probes once and falls back
-    immediately instead of burning the ~7-minute retry schedule."""
-    import sys
+    even under JAX_PLATFORMS=cpu.  Probe it in a killable subprocess and
+    keep probing with capped backoff until ``TPUSERVE_PROBE_DEADLINE_S``
+    (default 4 h) expires — the tunnel's observed outages are hours long
+    and it DOES come back, so the watcher must outlast the flap rather
+    than fall back while the capture window is still open.  Only when the
+    deadline truly expires does the bench re-exec on CPU, marked DEGRADED
+    in the output, so it always produces its JSON line instead of hanging
+    the driver.  ``retry=False`` (smoke runs, which are CPU-by-definition)
+    probes once and falls back immediately."""
     if os.environ.get("TPUSERVE_BENCH_REEXEC"):
         return
-    backoffs = PROBE_BACKOFF_S if retry else ()
-    attempts = 1 + len(backoffs)
-    for i in range(attempts):
+    deadline = time.monotonic() + (PROBE_DEADLINE_S if retry else 0.0)
+    attempt = 0
+    while True:
+        attempt += 1
         if _probe_backend_once():
             return
-        if i < len(backoffs):
-            print(f"tpu backend probe {i + 1}/{attempts} failed; "
-                  f"retrying in {backoffs[i]}s", flush=True)
-            time.sleep(backoffs[i])
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        backoff = min(PROBE_MAX_BACKOFF_S, 15.0 * attempt, remaining)
+        print(f"tpu backend probe {attempt} failed; retrying in "
+              f"{backoff:.0f}s ({remaining / 60:.0f} min of probe budget "
+              f"left)", flush=True)
+        time.sleep(backoff)
     _degrade_to_cpu(
-        f"tpu backend unavailable after {attempts} probes; CPU fallback — "
-        f"NOT a TPU result")
+        f"tpu backend unavailable after {attempt} probes over "
+        f"{PROBE_DEADLINE_S / 3600:.1f}h; CPU fallback — NOT a TPU result")
 
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
@@ -166,6 +195,34 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     return Engine(cfg)
 
 
+def _warm_plan_arrivals(eng, batch, prompt_len):
+    """Warmup plan for staggered (Poisson) arrivals: prefill batches can be
+    any size from 1 up to the admission limit (arrivals trickle in), and
+    the decode batch grows/shrinks through every bucket, so warm the full
+    power-of-two ladder of both — up to and INCLUDING the padded bucket of
+    a full admission batch (the engine pads the picked count to a power of
+    two, which can exceed the admission limit itself).  A handful of extra
+    tiny compiles at startup beats a recompile landing inside a measured
+    TTFT."""
+    from tpuserve.utils import next_power_of_2
+    cfg = eng.scheduler.cfg
+    if prompt_len > cfg.prefill_chunk_size:
+        # chunked-prefill route: the burst plan already warms every chunk
+        # bucket and the full 1..batch decode ladder; no batched-prefill
+        # shape ever dispatches
+        return _warm_plan(eng, batch, prompt_len)
+    L = eng.scheduler.prefill_bucket(prompt_len)
+    per = min(batch, cfg.max_prefill_seqs,
+              max(1, cfg.max_prefill_tokens // L))
+    buckets, b = [], 1
+    while b <= next_power_of_2(per):
+        buckets.append((b, L))
+        b *= 2
+    decode = sorted({eng.scheduler.decode_bucket(n)
+                     for n in range(1, batch + 1)})
+    return dict(prefill_buckets=buckets, decode_buckets=decode)
+
+
 def _warm_plan(eng, batch, prompt_len):
     """Every executable shape the scheduler will actually dispatch for this
     uniform-prompt workload, derived with the scheduler's own admission
@@ -206,20 +263,28 @@ def _warm_plan(eng, batch, prompt_len):
                 decode_buckets=[eng.scheduler.decode_bucket(batch)])
 
 
-def _warm(engine, batch, prompt_len):
+def _warm(engine, batch, prompt_len, arrivals=False):
     """Pre-compile the exact bucket set the measured run will hit
     (SURVEY.md §7: TTFT budget requires AOT warmup)."""
+    plan = _warm_plan_arrivals if arrivals else _warm_plan
     eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
-    eng.warmup(sample_modes=("greedy",), **_warm_plan(eng, batch, prompt_len))
+    eng.warmup(sample_modes=("greedy",), **plan(eng, batch, prompt_len))
     if eng is not engine:
         engine.decode.warmup(sample_modes=("greedy",),
-                             **_warm_plan(engine.decode, batch, prompt_len))
+                             **plan(engine.decode, batch, prompt_len))
 
 
-def _run_workload(engine, prompts, params):
+def _run_workload(engine, prompts, params, arrival_offsets=None):
     """Feed all prompts, drain, and split wall time into prefill/decode.
     Token counts are deltas from the engine's counters at entry, so the
-    workload can be repeated on one engine (``--repeat``/median runs)."""
+    workload can be repeated on one engine (``--repeat``/median runs).
+
+    ``arrival_offsets`` (seconds from workload start, one per prompt,
+    ascending) switches from the all-at-once burst — the worst case for
+    p50 TTFT, since every request queues behind a full batch of prefill —
+    to a timed arrival process: each request is added when its offset
+    passes, so TTFT measures what a client arriving into a *busy* engine
+    sees rather than what the last member of a stampede sees."""
     stats = getattr(engine, "decode", engine).stats  # disagg: decode engine
     pstats = getattr(engine, "prefill", engine).stats
     gen0 = stats.generated_tokens + (pstats.generated_tokens
@@ -227,11 +292,38 @@ def _run_workload(engine, prompts, params):
     before = {k: getattr(stats, k) for k in
               ("num_decode_steps", "spec_steps", "spec_proposed",
                "spec_accepted")}
-    rids = [engine.add_request(prompt_token_ids=p, params=params)
-            for p in prompts]
+    rids = []
+    pending = None
+    # rid -> intended arrival on the monotonic clock.  Arrivals are only
+    # admitted between engine steps (a fused window blocks for its whole
+    # duration), so add_request can run a full window AFTER the offset
+    # passed — TTFT must count that queueing delay from the INTENDED
+    # arrival, or multi-step serving systematically understates it.
+    intended: dict = {}
+    if arrival_offsets is None:
+        rids = [engine.add_request(prompt_token_ids=p, params=params)
+                for p in prompts]
+    else:
+        pending = list(zip(arrival_offsets, prompts))
     t_start = time.perf_counter()
+    t_start_mono = time.monotonic()
     prefill_time = decode_time = 0.0
-    while engine.has_work():
+    while True:
+        if pending:
+            now = time.perf_counter() - t_start
+            while pending and pending[0][0] <= now:
+                off, p = pending.pop(0)
+                rid = engine.add_request(prompt_token_ids=p, params=params)
+                rids.append(rid)
+                intended[rid] = t_start_mono + off
+        if not engine.has_work():
+            if not pending:
+                break
+            # idle until the next arrival — wall time the engine spends
+            # waiting for offered load, not engine cost
+            time.sleep(max(0.0, pending[0][0]
+                           - (time.perf_counter() - t_start)))
+            continue
         d0 = stats.num_decode_steps
         t0 = time.perf_counter()
         outs = engine.step()
@@ -251,8 +343,9 @@ def _run_workload(engine, prompts, params):
                                     if pstats is not stats else 0) - gen0
     reqs = getattr(engine, "requests", {})
     ttfts_ms = sorted(
-        1000.0 * (rq.first_token_time - rq.arrival_time)
-        for rq in (reqs.get(rid) for rid in rids)
+        1000.0 * (rq.first_token_time
+                  - intended.get(rid, rq.arrival_time))
+        for rid, rq in ((rid, reqs.get(rid)) for rid in rids)
         if rq is not None and rq.first_token_time is not None)
     deltas = {k: getattr(stats, k) - v for k, v in before.items()}
     return {"total_s": total, "prefill_s": prefill_time,
@@ -271,7 +364,8 @@ def _best_tpu_result(model):
     degraded path, whose one job is to always emit the JSON line."""
     root = os.path.dirname(os.path.abspath(__file__))
     best, n_rows, seen = None, 0, set()
-    for name in ("bench_sweep.jsonl", "bench_r03_tpu.jsonl"):
+    for name in ("bench_r04_tpu.jsonl", "bench_sweep.jsonl",
+                 "bench_r03_tpu.jsonl"):
         try:
             with open(os.path.join(root, name)) as f:
                 lines = f.readlines()
@@ -295,7 +389,7 @@ def _best_tpu_result(model):
                 best = {k: row.get(k) for k in
                         ("value", "unit", "vs_baseline", "variant",
                          "multi_step", "attn_impl", "ttft_ms", "model",
-                         "batch", "prompt_len", "gen_len", "ts")}
+                         "batch", "prompt_len", "gen_len", "ts", "commit")}
                 best["from_log"] = name        # actual source of the row
     if best is not None:
         best["tpu_rows_recorded"] = n_rows
@@ -335,6 +429,15 @@ def main(argv=None):
     ap.add_argument("--prefill-split", type=int, default=1, metavar="N",
                     help="admit the arrival burst in N prefill batches "
                          "instead of one (p50-TTFT vs throughput trade)")
+    ap.add_argument("--arrival", default="burst",
+                    choices=["burst", "poisson"],
+                    help="request arrival process: 'burst' (all at once — "
+                         "worst-case p50 TTFT) or 'poisson' (timed "
+                         "exponential interarrivals — what a real client "
+                         "mix sees)")
+    ap.add_argument("--arrival-rate", type=float, default=16.0, metavar="R",
+                    help="mean request arrival rate for --arrival poisson, "
+                         "req/s (default 16)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -427,9 +530,18 @@ def main(argv=None):
                                 f"CPU fallback — NOT a TPU result")
             raise
 
+    poisson = args.arrival == "poisson"
+    arrival_offsets = None
+    if poisson:
+        # fixed seed: every repeat (and every variant comparison) sees the
+        # SAME arrival sample path, so differences are engine, not luck
+        inter = np.random.default_rng(7).exponential(
+            1.0 / args.arrival_rate, size=batch)
+        arrival_offsets = np.cumsum(inter).tolist()
+
     with tpu_guard("tpu run"):
         t_warm = time.perf_counter()
-        _warm(engine, batch, prompt_len)
+        _warm(engine, batch, prompt_len, arrivals=poisson)
         warmup_s = time.perf_counter() - t_warm
         # Host<->device round-trip floor: every decode window and every
         # TTFT pays at least one of these.  On the tunnelled axon backend
@@ -449,7 +561,8 @@ def main(argv=None):
         # throughput.  Warmup already compiled every bucket, so repeats cost
         # only the workload itself.
         n_rep = args.repeat or (3 if on_tpu else 1)
-        runs = [_run_workload(engine, prompts, params)
+        runs = [_run_workload(engine, prompts, params,
+                              arrival_offsets=arrival_offsets)
                 for _ in range(n_rep)]
 
     def _rate(x):
@@ -499,7 +612,11 @@ def main(argv=None):
         "host_rtt_ms": round(host_rtt_ms, 2),
         "runs_tok_s": runs_tok_s,
         "compile_cache": "warm" if cache_entries_before else "cold",
+        "commit": _git_commit(),
     }
+    if poisson:
+        out["arrival"] = {"process": "poisson",
+                          "rate_req_s": args.arrival_rate}
     degraded = os.environ.get("TPUSERVE_BENCH_DEGRADED")
     if degraded:
         out["degraded"] = degraded
@@ -535,8 +652,11 @@ def main(argv=None):
                                      disagg=True, multi_step=args.multi_step,
                                      quantization=args.quant,
                                      prefill_split=args.prefill_split)
-            _warm(d_engine, batch, prompt_len)
-            dr = _run_workload(d_engine, prompts, params)
+            # same arrival process as the main run, or vs_colocated would
+            # compare a poisson workload against a burst workload
+            _warm(d_engine, batch, prompt_len, arrivals=poisson)
+            dr = _run_workload(d_engine, prompts, params,
+                               arrival_offsets=arrival_offsets)
         d_decode = dr["gen_tokens"] - batch
         d_tok_s = d_decode / dr["decode_s"] if dr["decode_s"] else 0.0
         out["disagg"] = {

@@ -147,16 +147,26 @@ def main(argv=None):
                          "relay hop the K8s deployment has)")
     ap.add_argument("--no-md", action="store_true",
                     help="don't append the BENCHMARKS.md section (tests)")
+    ap.add_argument("--multi-step", type=int, default=None, metavar="S",
+                    help="fused decode window for the in-process engine "
+                         "(default: engine auto).  The S=32 throughput "
+                         "default delivers streamed tokens in ~S-token "
+                         "bursts; this flag exists to measure that ITL "
+                         "cost and pick the serving default from data")
     args = ap.parse_args(argv)
     if args.gateway and args.url:
         ap.error("--gateway only applies to the in-process server; an "
                  "external --url is measured as-is")
+    if args.multi_step is not None and args.url:
+        ap.error("--multi-step configures the in-process engine; an "
+                 "external --url serves with whatever it was started with")
 
     import numpy as np
 
     # one derivation of the workload shape, shared by both branches
     n = args.num_requests or args.clients
     srv = gw = None
+    multi_step_resolved = None
     if args.url:
         url = args.url
         backend = "external"
@@ -189,9 +199,11 @@ def main(argv=None):
             scheduler=SchedulerConfig(max_num_seqs=args.clients,
                                       max_prefill_seqs=args.clients,
                                       max_prefill_tokens=max(
-                                          8192, args.clients * plen))))
+                                          8192, args.clients * plen)),
+            multi_step=args.multi_step))
         srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
-        url = f"http://127.0.0.1:{srv.start()}"
+        multi_step_resolved = eng._multi_step   # record what actually ran,
+        url = f"http://127.0.0.1:{srv.start()}"  # not the flag (None=auto)
         vocab = eng.model_cfg.vocab_size
         concurrency_capped = True             # max_num_seqs == clients
         if args.gateway:
@@ -234,6 +246,7 @@ def main(argv=None):
         "num_requests": n,
         "prompt_len": plen,
         "gen_len": glen,
+        "multi_step": multi_step_resolved,
         "lost_streams": lost,
         "hung_streams": hung,
         "throughput_tok_s": round(total_tokens / wall, 1),

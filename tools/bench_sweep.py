@@ -55,6 +55,19 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # p50-TTFT lever: admit the 64-request burst in 2/4 prefill batches
     ("prefill-split2", ["--prefill-split", "2"], {}),
     ("prefill-split4", ["--prefill-split", "4"], {}),
+    # Realistic-arrival TTFT rows (VERDICT r3 weak #2: every recorded TTFT
+    # was the worst-case simultaneous 64-burst).  single-request = an
+    # unloaded engine's floor; poisson = clients arriving into a busy
+    # engine at a sustainable offered load.
+    ("single-request", ["--batch", "1", "--repeat", "5"], {}),
+    ("poisson16", ["--arrival", "poisson", "--arrival-rate", "16"], {}),
+    ("poisson32", ["--arrival", "poisson", "--arrival-rate", "32"], {}),
+    # HBM-roofline headroom probe (VERDICT r3 weak #4: 4,210 tok/s moves
+    # ~80 GB/s of an 819 GB/s pipe — int8 halves weight bytes and bigger
+    # batches amortize them; these rows answer how much of the 2x+ is real)
+    ("batch128", ["--batch", "128"], {}),
+    ("int8-batch128", ["--quant", "int8", "--batch", "128"], {}),
+    ("int8-batch256", ["--quant", "int8", "--batch", "256"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
     # Long-context path: prompts routed through chunked prefill (the
@@ -178,6 +191,12 @@ def main():
                     help="per-variant timeout (first compile through a "
                          "tunnel can take >30 min)")
     args = ap.parse_args()
+    # bench.py's patient probe (default 4 h) must stay SHORTER than the
+    # per-variant timeout here, or a dead tunnel kills every variant
+    # mid-probe with no JSON at all — not even the degraded CPU line.
+    # Sweep callers own the waiting; each variant degrades fast.
+    os.environ.setdefault("TPUSERVE_PROBE_DEADLINE_S",
+                          str(min(300, max(0, args.timeout - 600))))
     known = [n for n, _, _ in VARIANTS]
     if args.only:
         names = [n.strip() for n in args.only.split(",")]

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Round-4 TPU capture runner: drain the measurement backlog the moment the
+chip is reachable.
+
+Three consecutive rounds produced degraded CPU BENCH captures because the
+bench ran at a fixed time while the axon tunnel flaps for hours (VERDICT r3
+weak #1).  This runner inverts that: a background watcher (tools/
+tpu_watch.sh) probes the tunnel continuously and invokes this script the
+moment the backend answers.  The script runs the round's measurement list
+in PRIORITY order — headline + TTFT levers first (VERDICT r3 next #1/#2),
+then the int8/spec/disagg sweep that the round-3 outage cut (#3), then the
+serving-path rows (#4) — appending every completed TPU row to
+bench_r04_tpu.jsonl + bench_sweep.jsonl + BENCHMARKS.md immediately, so a
+mid-sweep flap loses nothing.  Already-recorded variants are skipped, so
+the watcher can re-invoke after every flap until the list is drained.
+
+Exit codes: 0 = every row captured; 2 = tunnel down / flapped mid-sweep
+(watcher should keep probing and retry); 1 = real error.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+sys.path.insert(0, ROOT)
+
+from bench_sweep import VARIANTS, append_markdown, run_variant  # noqa: E402
+
+LOG = os.path.join(ROOT, "bench_r04_tpu.jsonl")
+SWEEP_LOG = os.path.join(ROOT, "bench_sweep.jsonl")
+ATTEMPTS = "/tmp/round4_attempts.json"
+MAX_ATTEMPTS = 2          # per variant, across runner invocations
+
+# Engine-level rows (bench.py), highest-value first.
+PRIORITY = [
+    "base",                                   # the headline number
+    "prefill-split2", "prefill-split4",       # p50-TTFT levers (r3 cut)
+    "single-request", "poisson16", "poisson32",  # realistic-arrival TTFT
+    "int8", "int8-multistep32",               # cut by the r3 outage
+    "batch128", "int8-batch128", "int8-batch256",  # HBM roofline headroom
+    "spec4", "disagg",                        # cut by the r3 outage
+    "multistep16", "multistep64",
+    "long-prompt",
+    "int8-multistep16",
+    "pallas-spp16",                           # re-time with the VMEM clamp
+    "phi3-mini", "opt-1.3b", "llama3-8b-int8",
+    "cold-cache",
+]
+
+# Serving-path rows (tools/bench_serving.py): client-observed TTFT/ITL
+# through HTTP+SSE (VERDICT r3 next #4) and the S=32-vs-S=8 ITL decision
+# (ADVICE r3: the throughput default ships ~32-token bursts to streams).
+SERVING = [
+    ("serving-closed32", ["--clients", "32"]),
+    ("serving-closed32-S8", ["--clients", "32", "--multi-step", "8"]),
+    ("serving-closed32-S4", ["--clients", "32", "--multi-step", "4"]),
+    ("serving-poisson16", ["--clients", "32", "--rate", "16",
+                           "--num-requests", "64"]),
+    ("serving-gateway", ["--clients", "32", "--gateway"]),
+]
+
+
+def probe(timeout_s: int = 90) -> bool:
+    """Quick killable tunnel probe (a dead tunnel HANGS jax init)."""
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s,
+            env=os.environ.copy()).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def recorded() -> set[str]:
+    done = set()
+    try:
+        with open(LOG) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                backend = str(row.get("backend", ""))
+                if backend.startswith("tpu") and not row.get("degraded"):
+                    done.add(row.get("variant"))
+    except FileNotFoundError:
+        pass
+    return done
+
+
+def load_attempts() -> dict:
+    try:
+        with open(ATTEMPTS) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def save_attempts(a: dict) -> None:
+    with open(ATTEMPTS, "w") as f:
+        json.dump(a, f)
+
+
+def record(row: dict) -> None:
+    row["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
+    line = json.dumps(row)
+    print(line, flush=True)
+    for path in (LOG, SWEEP_LOG):
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    if row.get("metric") == "decode_throughput":
+        append_markdown(row)
+
+
+def main() -> int:
+    attempts = load_attempts()
+    variant_table = {n: (a, e) for n, a, e in VARIANTS}
+    done = recorded()
+    # Mid-sweep flaps should degrade FAST inside bench.py (the runner +
+    # watcher own the waiting), not burn the 4 h patient-probe budget per
+    # variant.
+    env_base = dict(os.environ)
+    env_base["TPUSERVE_PROBE_DEADLINE_S"] = "300"
+
+    for name in PRIORITY:
+        if name in done:
+            continue
+        if attempts.get(name, 0) >= MAX_ATTEMPTS:
+            print(f"=== {name}: skipped ({MAX_ATTEMPTS} failed attempts)",
+                  flush=True)
+            continue
+        if not probe():
+            print("tunnel down — yielding to the watcher", flush=True)
+            return 2
+        attempts[name] = attempts.get(name, 0) + 1
+        save_attempts(attempts)
+        vargs, venv = variant_table[name]
+        env = dict(env_base)
+        env.update(venv)
+        cache_override = venv.get("JAX_COMPILATION_CACHE_DIR", "")
+        if cache_override.startswith("/tmp/"):
+            import shutil
+            shutil.rmtree(cache_override, ignore_errors=True)
+        r = run_variant(name, vargs, timeout=5400, env=env)
+        if r is None:
+            continue                      # timeout/no JSON: try next variant
+        if r.get("degraded") or r.get("backend") != "tpu":
+            print(f"--- {name}: degraded/non-tpu ({r.get('degraded')}) — "
+                  "discarding; yielding to the watcher", flush=True)
+            return 2
+        attempts[name] = 0                # success resets the budget
+        save_attempts(attempts)
+        record(r)
+        done.add(name)
+
+    for name, sargs in SERVING:
+        if name in done:
+            continue
+        if attempts.get(name, 0) >= MAX_ATTEMPTS:
+            print(f"=== {name}: skipped ({MAX_ATTEMPTS} failed attempts)",
+                  flush=True)
+            continue
+        if not probe():
+            print("tunnel down — yielding to the watcher", flush=True)
+            return 2
+        attempts[name] = attempts.get(name, 0) + 1
+        save_attempts(attempts)
+        r = run_variant(name, sargs, timeout=5400, env=dict(env_base),
+                        bench_path=os.path.join(ROOT, "tools",
+                                                "bench_serving.py"))
+        if r is None:
+            continue
+        if not str(r.get("backend", "")).startswith("tpu"):
+            print(f"--- {name}: backend={r.get('backend')} — discarding; "
+                  "yielding to the watcher", flush=True)
+            return 2
+        attempts[name] = 0
+        save_attempts(attempts)
+        record(r)
+        done.add(name)
+
+    missing = ([n for n in PRIORITY if n not in done]
+               + [n for n, _ in SERVING if n not in done])
+    if missing:
+        print(f"capture finished with permanently-skipped rows: {missing}",
+              flush=True)
+    else:
+        print("round-4 TPU capture COMPLETE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Background tunnel watcher for the round-4 TPU capture (VERDICT r3 weak
+# #1: the capture window is the round — probe until the chip answers, run
+# the moment it does).  Loops: quick killable probe; on success, run
+# tools/tpu_round4.py (which drains the priority measurement list and is
+# resumable across flaps); exit when the runner reports the list complete
+# or the wall-clock budget expires.
+#
+# Usage: nohup bash tools/tpu_watch.sh >> tpu_round4.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+DONE_MARKER=/tmp/round4_tpu_done
+BUDGET_S=${TPUSERVE_WATCH_BUDGET_S:-39600}   # 11 h default
+START=$(date +%s)
+
+while true; do
+    [ -f "$DONE_MARKER" ] && exit 0
+    NOW=$(date +%s)
+    if [ $((NOW - START)) -gt "$BUDGET_S" ]; then
+        echo "[watch] budget expired after $((NOW - START))s"
+        exit 1
+    fi
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "[watch] tunnel UP at $(date -Is) — running capture"
+        python tools/tpu_round4.py
+        rc=$?
+        if [ $rc -eq 0 ]; then
+            touch "$DONE_MARKER"
+            echo "[watch] capture complete at $(date -Is)"
+            exit 0
+        fi
+        echo "[watch] runner yielded rc=$rc at $(date -Is); resuming probe"
+    fi
+    sleep 120
+done

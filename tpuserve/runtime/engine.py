@@ -208,6 +208,10 @@ class Engine:
         self.scheduler = Scheduler(config.scheduler, self.block_manager,
                                    max_model_len=self.cache_cfg.max_model_len)
         self.stats = EngineStats()
+        # device outputs of warmup-only executables (samplers, token
+        # select) whose producer chains the end-of-warmup sync must drain
+        # individually — see warmup()
+        self._warm_tails: list = []
         self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
         self._detok: dict[str, IncrementalDetokenizer] = {}
         self._greedy_cache: dict[int, tuple] = {}
@@ -1160,10 +1164,11 @@ class Engine:
                     # the first chained dispatch mid-serving.  Both call
                     # sites pass (B,) int32 tokens (the windowed one via
                     # p.toks[:, -1]), so one shape covers them.
-                    _select_tokens(jnp.zeros((B,), jnp.int32),
-                                   jnp.zeros((B,), jnp.int32),
-                                   jnp.zeros((B,), jnp.int32),
-                                   jnp.zeros((B,), bool))
+                    self._warm_tails.append(_select_tokens(
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), jnp.int32),
+                        jnp.zeros((B,), bool)))
                 if self._spec is not None:
                     # the speculative verify pass is its own executable;
                     # left cold, the first spec step stalls on its compile
@@ -1194,8 +1199,17 @@ class Engine:
         # block_until_ready is a no-op and the first real request's host
         # transfer would pay for the entire queued warmup backlog (measured
         #: 53 s of "TTFT" that was actually deferred warmup execution).
-        if logits is not None:
-            hard_sync(logits)
+        # hard_sync drains ONE producer chain (it fetches one element of
+        # the first leaf), so sync each independent chain: the KV cache —
+        # every model executable donates it through, so its chain covers
+        # all queued model work on a dependency-ordered backend (the last
+        # logits only cover their own executable) — plus every sampler /
+        # token-select warmup output, which consume logits but never touch
+        # the cache, so each queued execution sits on a chain of its own.
+        hard_sync(self.kv_cache)
+        for tail in self._warm_tails:
+            hard_sync(tail)
+        self._warm_tails.clear()
         logger.info("warmup complete: prefill buckets %s, decode buckets %s",
                     prefill_buckets, decode_buckets)
 
@@ -1208,4 +1222,5 @@ class Engine:
         B = logits.shape[0]
         keys, temp, top_k, top_p = self._greedy_dummies(B)
         for mode in modes:
-            self._exec_sample(logits, keys, temp, top_k, top_p, mode=mode)
+            self._warm_tails.append(self._exec_sample(
+                logits, keys, temp, top_k, top_p, mode=mode))
