@@ -136,7 +136,8 @@ def _ensure_live_backend(retry: bool = True) -> None:
     probes once and falls back immediately."""
     if os.environ.get("TPUSERVE_BENCH_REEXEC"):
         return
-    deadline = time.monotonic() + (PROBE_DEADLINE_S if retry else 0.0)
+    t0 = time.monotonic()
+    deadline = t0 + (PROBE_DEADLINE_S if retry else 0.0)
     attempt = 0
     while True:
         attempt += 1
@@ -150,9 +151,10 @@ def _ensure_live_backend(retry: bool = True) -> None:
               f"{backoff:.0f}s ({remaining / 60:.0f} min of probe budget "
               f"left)", flush=True)
         time.sleep(backoff)
+    elapsed_min = (time.monotonic() - t0) / 60
     _degrade_to_cpu(
-        f"tpu backend unavailable after {attempt} probes over "
-        f"{PROBE_DEADLINE_S / 3600:.1f}h; CPU fallback — NOT a TPU result")
+        f"tpu backend unavailable after {attempt} probe(s) over "
+        f"{elapsed_min:.0f} min; CPU fallback — NOT a TPU result")
 
 
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,

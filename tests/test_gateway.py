@@ -78,13 +78,60 @@ def test_gateway_prefix_affinity(stack):
         b = gw.pick_backend(body)
         gw.release(b, ok=True)
         assert b.url == b1.url          # same prefix -> same replica
-    other = json.dumps({"prompt": "different", "max_tokens": 1}).encode()
-    # least-loaded balancing still applies for new prefixes
-    b1.outstanding = 5
-    b2 = gw.pick_backend(other)
+    # the load-slack guard diverts once the hash target is overloaded
+    b1.outstanding = gw.config.affinity_load_slack + 1
+    b2 = gw.pick_backend(body)
     assert b2.url != b1.url
     gw.release(b2, ok=True)
     b1.outstanding = 0
+
+
+def test_gateway_affinity_agrees_across_replicas(stack):
+    """HA property (VERDICT r3 next #7): two INDEPENDENT gateway replicas
+    — no shared state — map every prefix to the same backend, so prefix-
+    cache hit rate survives running >1 gateway.  Also pins the spread:
+    rendezvous must not collapse onto one backend."""
+    from tpuserve.server.gateway import Gateway, GatewayConfig
+    gw1 = stack["gw"]
+    gw2 = Gateway(stack["urls"], GatewayConfig(host="127.0.0.1", port=0))
+    picks = set()
+    for i in range(32):
+        body = json.dumps({"prompt": f"tenant-{i} shared context",
+                           "max_tokens": 1}).encode()
+        a = gw1.pick_backend(body)
+        b = gw2.pick_backend(body)
+        gw1.release(a, ok=True)
+        gw2.release(b, ok=True)
+        assert a.url == b.url
+        picks.add(a.url)
+    assert len(picks) == 2              # both backends get traffic
+
+
+def test_gateway_two_replica_prefix_cache_hit_rate(stack):
+    """End to end: the same prompt routed through DIFFERENT gateway
+    replicas lands on the same engine, so the second request is a
+    prefix-cache hit there (the llm-d topology runs HA gateways in front
+    of shared engine pools)."""
+    from tpuserve.server.gateway import Gateway, GatewayConfig
+    gw2 = Gateway(stack["urls"], GatewayConfig(host="127.0.0.1", port=0,
+                                               health_interval_s=0.5))
+    g2port = gw2.start()
+    try:
+        # ByteTokenizer: 1 token/char; keep prompt+gen inside the tiny
+        # fixture's 32-token budget
+        payload = {"prompt": "shared sys prefix abc",
+                   "max_tokens": 2, "temperature": 0, "ignore_eos": True}
+        before = [s.engine.block_manager.prefix_hits
+                  for s in stack["servers"]]
+        _post(stack["url"] + "/v1/completions", payload)
+        _post(f"http://127.0.0.1:{g2port}/v1/completions", payload)
+        after = [s.engine.block_manager.prefix_hits
+                 for s in stack["servers"]]
+        # the second request (via the OTHER gateway) hit the prefix cache
+        # populated by the first — affinity agreed across replicas
+        assert sum(after) > sum(before)
+    finally:
+        gw2.shutdown()
 
 
 def test_gateway_ejects_dead_backend(stack):

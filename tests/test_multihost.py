@@ -132,6 +132,46 @@ def test_multiprocess_gates(monkeypatch):
                         params=SamplingParams(logprobs=5))
 
 
+def test_multihost_http_rejects_unsupported_params(monkeypatch):
+    """The API edge returns a documented OpenAI-style 400 for params the
+    lockstep protocol can't serve — not the 500 the engine-side
+    ValueError used to surface as (VERDICT r3 next #8)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    srv = OpenAIServer(_tiny_engine(), ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    try:
+        for payload in ({"presence_penalty": 0.5}, {"logit_bias": {"3": 2}},
+                        {"min_tokens": 2}, {"logprobs": 3}):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions",
+                data=_json.dumps({"prompt": "hi", "max_tokens": 2,
+                                  **payload}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            body = _json.loads(ei.value.read())
+            assert body["error"]["type"] == "invalid_request_error"
+            assert "multi-host" in body["error"]["message"]
+        # the supported surface still serves
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=_json.dumps({"prompt": "hi", "max_tokens": 2,
+                              "temperature": 0,
+                              "ignore_eos": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert _json.loads(r.read())["usage"]["completion_tokens"] == 2
+    finally:
+        srv.shutdown()
+
+
 def test_coordinator_requires_mesh(monkeypatch):
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     eng = _tiny_engine()

@@ -15,11 +15,14 @@ import argparse
 import datetime
 import json
 import os
+import sys
 import threading
 import time
 import urllib.request
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
 
 def _mk_engine(model: str):
@@ -74,11 +77,18 @@ def _stream_client(url: str, prompt, gen: int, counts, i):
     counts[i] = total
 
 
-def http_tok_s(url: str, prompts, gen: int) -> float:
+def http_tok_s(url: str | list, prompts, gen: int) -> float:
+    """Aggregate streaming tok/s for one burst of concurrent clients.
+    ``url`` may be a list (HA gateway pool): clients round-robin across
+    the entries, the two-replica topology the K8s gateway Deployment
+    runs."""
+    urls = [url] if isinstance(url, str) else list(url)
+
     def burst(key_base: int) -> float:
         counts: dict = {}
         threads = [threading.Thread(target=_stream_client,
-                                    args=(url, p, gen, counts, key_base + i))
+                                    args=(urls[i % len(urls)], p, gen,
+                                          counts, key_base + i))
                    for i, p in enumerate(prompts)]
         t0 = time.perf_counter()
         for t in threads:
@@ -103,31 +113,45 @@ def main():
     ap.add_argument("--clients", type=int, default=32)
     ap.add_argument("--gen", type=int, default=32)
     ap.add_argument("--model", default="tiny-qwen3")
+    ap.add_argument("--ha", action="store_true",
+                    help="HA topology: 2 engines behind 2 stateless gateway "
+                         "replicas, clients split across the gateways "
+                         "(rendezvous affinity keeps prefix routing "
+                         "consistent with no shared gateway state)")
     args = ap.parse_args()
 
     import jax
     from tpuserve.server.gateway import Gateway, GatewayConfig
     from tpuserve.server.openai_api import OpenAIServer, ServerConfig
 
-    eng_rate = None
-    srv = OpenAIServer(_mk_engine(args.model),
-                       ServerConfig(host="127.0.0.1", port=0))
-    port = srv.start()
-    url = f"http://127.0.0.1:{port}"
-    gw = Gateway([url], GatewayConfig(host="127.0.0.1", port=0,
-                                      health_interval_s=0.5))
-    gurl = f"http://127.0.0.1:{gw.start()}"
+    n_pool = 2 if args.ha else 1
+    servers = [OpenAIServer(_mk_engine(args.model),
+                            ServerConfig(host="127.0.0.1", port=0))
+               for _ in range(n_pool)]
+    urls = [f"http://127.0.0.1:{s.start()}" for s in servers]
+    srv, url = servers[0], urls[0]
+    gateways = [Gateway(urls, GatewayConfig(host="127.0.0.1", port=0,
+                                            health_interval_s=0.5))
+                for _ in range(n_pool)]
+    gurls = [f"http://127.0.0.1:{g.start()}" for g in gateways]
 
     prompts = _prompts(args.clients, srv.engine.model_cfg.vocab_size)
     eng_rate = engine_only_tok_s(args.model, prompts, args.gen)
     http_rate = http_tok_s(url, prompts, args.gen)
-    gw_rate = http_tok_s(gurl, prompts, args.gen)
-    gw.shutdown()
-    srv.shutdown()
+    gw_rate = http_tok_s(gurls, prompts, args.gen)
+    for g in gateways:
+        g.shutdown()
+    for s in servers:
+        s.shutdown()
 
+    # The gateway burst fans across n_pool engines; normalize its overhead
+    # against the POOL's capacity (engine rate x pool size), or the HA
+    # numbers would compare a 2-engine aggregate to a 1-engine baseline.
+    pool_capacity = eng_rate * n_pool
     result = {
         "metric": "serving_overhead",
         "backend": jax.default_backend(),
+        "topology": f"{n_pool} engine(s), {n_pool} gateway replica(s)",
         "model": args.model,
         "clients": args.clients,
         "gen": args.gen,
@@ -135,21 +159,23 @@ def main():
         "http_tok_s": round(http_rate, 1),
         "gateway_tok_s": round(gw_rate, 1),
         "http_overhead_pct": round(100 * (1 - http_rate / eng_rate), 1),
-        "gateway_overhead_pct": round(100 * (1 - gw_rate / eng_rate), 1),
+        "gateway_overhead_pct": round(100 * (1 - gw_rate / pool_capacity), 1),
     }
     print(json.dumps(result))
     stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M")
     with open(os.path.join(ROOT, "BENCHMARKS.md"), "a") as f:
+        gw_label = ("through 2 HA gateways (vs 2-engine pool capacity)"
+                    if args.ha else "through gateway")
         f.write(
             f"\n## Serving-stack HTTP overhead @ {stamp}\n\n"
             f"{args.clients} concurrent streaming clients, {args.gen} tokens "
-            f"each, {args.model}, backend={result['backend']} "
-            f"(tools/load_test.py):\n\n"
-            f"| path | aggregate tok/s | overhead vs engine |\n|---|---|---|\n"
-            f"| engine only (in-process) | {result['engine_tok_s']} | — |\n"
+            f"each, {args.model}, backend={result['backend']}, "
+            f"topology: {result['topology']} (tools/load_test.py):\n\n"
+            f"| path | aggregate tok/s | overhead vs capacity |\n|---|---|---|\n"
+            f"| engine only (in-process, x1) | {result['engine_tok_s']} | — |\n"
             f"| engine server (SSE) | {result['http_tok_s']} | "
             f"{result['http_overhead_pct']}% |\n"
-            f"| through gateway | {result['gateway_tok_s']} | "
+            f"| {gw_label} | {result['gateway_tok_s']} | "
             f"{result['gateway_overhead_pct']}% |\n")
 
 

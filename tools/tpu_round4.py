@@ -150,8 +150,22 @@ def main() -> int:
             shutil.rmtree(cache_override, ignore_errors=True)
         r = run_variant(name, vargs, timeout=5400, env=env)
         if r is None:
-            continue                      # timeout/no JSON: try next variant
+            # timeout / no JSON: a mid-compile tunnel death looks exactly
+            # like a genuinely slow variant.  Re-probe to tell them apart —
+            # a flap must NOT burn the attempt budget (the watcher exists
+            # to retry through flaps), only a failure on a live tunnel may.
+            if not probe():
+                attempts[name] -= 1
+                save_attempts(attempts)
+                print(f"--- {name}: died with the tunnel down — refunding "
+                      "the attempt; yielding to the watcher", flush=True)
+                return 2
+            continue                      # failed on a live tunnel: move on
         if r.get("degraded") or r.get("backend") != "tpu":
+            # the flap happened inside bench.py: refund — this is the
+            # watcher's problem, not the variant's
+            attempts[name] -= 1
+            save_attempts(attempts)
             print(f"--- {name}: degraded/non-tpu ({r.get('degraded')}) — "
                   "discarding; yielding to the watcher", flush=True)
             return 2
@@ -176,8 +190,16 @@ def main() -> int:
                         bench_path=os.path.join(ROOT, "tools",
                                                 "bench_serving.py"))
         if r is None:
+            if not probe():               # flap, not failure: refund
+                attempts[name] -= 1
+                save_attempts(attempts)
+                print(f"--- {name}: died with the tunnel down — refunding "
+                      "the attempt; yielding to the watcher", flush=True)
+                return 2
             continue
         if not str(r.get("backend", "")).startswith("tpu"):
+            attempts[name] -= 1           # flap inside the bench: refund
+            save_attempts(attempts)
             print(f"--- {name}: backend={r.get('backend')} — discarding; "
                   "yielding to the watcher", flush=True)
             return 2

@@ -59,6 +59,10 @@ class DeployConfig:
     chat_template: Optional[str] = None    # name of a bundled template (phi/opt)
     engine_port: int = 8000                # vLLM-compatible metrics port (otel-observability-setup.yaml:379)
     gateway_port: int = 8080
+    # HA gateway pool (llm-d's gateway is HA by platform, llm-d-test.yaml:
+    # 14-18).  Safe >1 since affinity is stateless rendezvous hashing —
+    # every replica computes the same prefix->backend mapping.
+    gateway_replicas: int = 2
 
     # --- observability (otel-observability-setup.yaml:7-12 analog) --------
     monitoring_namespace: str = "monitoring"
@@ -84,6 +88,8 @@ class DeployConfig:
         if self.prefill_replicas < 1 or self.decode_replicas < 1:
             raise ValueError("prefill_replicas and decode_replicas must "
                              "be >= 1")
+        if self.gateway_replicas < 1:
+            raise ValueError("gateway_replicas must be >= 1")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.

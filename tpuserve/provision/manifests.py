@@ -331,7 +331,7 @@ def gateway_deployment(cfg: DeployConfig, backends: list[str]) -> dict:
         "metadata": {"name": "tpuserve-gateway", "namespace": cfg.namespace,
                      "labels": labels},
         "spec": {
-            "replicas": 1,
+            "replicas": cfg.gateway_replicas,
             "selector": {"matchLabels": labels},
             "template": {
                 "metadata": {"labels": labels, "annotations": {

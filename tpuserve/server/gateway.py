@@ -8,8 +8,14 @@ is the in-repo equivalent: a threaded HTTP proxy with
 
 - health-checked backend pools (``/healthz`` probing, auto-eject/readmit),
 - least-outstanding-requests load balancing,
-- KV-aware session affinity: requests whose prompt shares a prefix hash
-  prefer the replica that served it before (prefix-cache hits stay local),
+- KV-aware session affinity via RENDEZVOUS (highest-random-weight)
+  hashing on the prompt prefix: every gateway replica computes the same
+  prefix->backend mapping from nothing but the backend list, so affinity
+  (and therefore engine prefix-cache hit rate) survives running N gateway
+  replicas with no shared state (VERDICT r3 next #7 — the llm-d gateway
+  is HA by platform, llm-d-test.yaml:14-18).  A load-slack guard diverts
+  to the least-loaded backend when the hash target is overloaded,
+  trading a cache hit for tail latency under skew,
 - pass-through streaming (SSE chunks relayed as they arrive).
 
 DP replicas = multiple backends here + K8s replica count, matching the
@@ -27,7 +33,6 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -66,7 +71,11 @@ class GatewayConfig:
     health_interval_s: float = 5.0
     health_timeout_s: float = 2.0
     affinity_prefix_chars: int = 256     # prompt prefix hashed for affinity
-    affinity_cache_size: int = 4096
+    # Divert from the rendezvous target to the least-loaded backend when
+    # the target has this many more outstanding requests than the idlest
+    # backend — an overloaded replica's queueing delay quickly exceeds
+    # what a prefix-cache hit saves.
+    affinity_load_slack: int = 8
     upstream_timeout_s: float = 600.0
 
 
@@ -77,7 +86,6 @@ class Gateway:
         self.config = config or GatewayConfig()
         self.backends = [Backend(url=u.rstrip("/")) for u in backend_urls]
         self._lock = threading.Lock()
-        self._affinity: OrderedDict[str, str] = OrderedDict()  # prefix hash -> url
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -99,11 +107,20 @@ class Gateway:
         except Exception:
             return None
 
+    @staticmethod
+    def _rendezvous_target(key: str, pool: list[Backend]) -> Backend:
+        """Highest-random-weight choice: every gateway replica, given the
+        same backend list, maps ``key`` to the same backend — no shared
+        state, and removing a backend only remaps that backend's keys."""
+        return max(pool, key=lambda b: hashlib.sha256(
+            f"{key}|{b.url}".encode()).digest())
+
     def pick_backend(self, body: bytes | None = None,
                      exclude: set[str] | None = None) -> Backend:
-        """Pick the least-loaded healthy backend (prefix affinity first).
-        ``exclude``: URLs already tried this request (connect-failure
-        failover) — skipped unless nothing else remains."""
+        """Pick a backend: rendezvous prefix affinity (with a load-slack
+        escape to least-loaded), else least-loaded.  ``exclude``: URLs
+        already tried this request (connect-failure failover) — skipped
+        unless nothing else remains."""
         with self._lock:
             ex = exclude or set()
             # preference order: healthy+untried > any untried (a backend
@@ -115,19 +132,13 @@ class Gateway:
                     or [b for b in self.backends if b.url not in ex]
                     or self.backends)
             key = self._prefix_key(body) if body else None
+            least = min(pool, key=lambda b: b.outstanding)
+            chosen = least
             if key is not None:
-                url = self._affinity.get(key)
-                if url is not None:
-                    self._affinity.move_to_end(key)
-                    for b in pool:
-                        if b.url == url:
-                            b.outstanding += 1
-                            return b
-            chosen = min(pool, key=lambda b: b.outstanding)
-            if key is not None:
-                self._affinity[key] = chosen.url
-                while len(self._affinity) > self.config.affinity_cache_size:
-                    self._affinity.popitem(last=False)
+                target = self._rendezvous_target(key, pool)
+                if (target.outstanding - least.outstanding
+                        <= self.config.affinity_load_slack):
+                    chosen = target
             chosen.outstanding += 1
             return chosen
 
@@ -192,7 +203,7 @@ class Gateway:
     def status(self) -> dict:
         with self._lock:
             return {"backends": [dataclasses.asdict(b) for b in self.backends],
-                    "affinity_entries": len(self._affinity)}
+                    "affinity": "rendezvous"}
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):

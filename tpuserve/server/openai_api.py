@@ -209,6 +209,29 @@ class OpenAIServer:
             raise ValueError(f"'n' must be an integer in 1..{self.MAX_CHOICES}")
         return n
 
+    def _reject_multihost_unsupported(self, params) -> None:
+        """Multi-host lockstep mirrors prefill/decode/sample only; the
+        penalty/bias/min-tokens/logprob jits are out of protocol
+        (parallel/multihost.py "Limitations").  Reject HERE, before
+        submission, as a documented OpenAI-style 400 — the engine-side
+        ValueError would surface through the generic handler as a 500
+        (VERDICT r3 next #8)."""
+        import jax
+        if jax.process_count() <= 1:
+            return
+        offending = [name for name, used in (
+            ("presence_penalty/frequency_penalty/repetition_penalty",
+             params.needs_penalties),
+            ("logit_bias", params.needs_logit_bias),
+            ("min_tokens", params.needs_min_tokens),
+            ("logprobs", params.logprobs is not None),
+        ) if used]
+        if offending:
+            raise ValueError(
+                f"{', '.join(offending)} not supported by this multi-host "
+                "deployment; remove the parameter(s) or route to a "
+                "single-host replica")
+
     def handle_completion(self, body: dict, chat: bool):
         if chat:
             messages = body.get("messages")
@@ -227,15 +250,19 @@ class OpenAIServer:
             prompt = body.get("prompt")
             if isinstance(prompt, list):
                 if prompt and isinstance(prompt[0], int):
-                    return prompt, _sampling_from_request(
+                    params = _sampling_from_request(
                         body, self.config.max_tokens_cap)
+                    self._reject_multihost_unsupported(params)
+                    return prompt, params
                 if len(prompt) != 1:
                     raise ValueError("batched prompt lists are not supported; "
                                      "send one request per prompt")
                 prompt = prompt[0]
             if not isinstance(prompt, str) or not prompt:
                 raise ValueError("'prompt' must be a non-empty string")
-        return prompt, _sampling_from_request(body, self.config.max_tokens_cap)
+        params = _sampling_from_request(body, self.config.max_tokens_cap)
+        self._reject_multihost_unsupported(params)
+        return prompt, params
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -448,15 +475,17 @@ class _Handler(BaseHTTPRequestHandler):
                                  "max_model_len": eng.max_seq_len})
             else:
                 tokens = body.get("tokens")
+                vocab = eng.model_cfg.vocab_size
                 if (not isinstance(tokens, list)
                         or not all(isinstance(t, int)
                                    and not isinstance(t, bool)
-                                   and 0 <= t < 2**31 for t in tokens)):
-                    # same bound as stop_token_ids/logit_bias: oversized
-                    # ids overflow the HF tokenizer's u32 conversion with
-                    # an exception type this handler doesn't map to a 400
+                                   and 0 <= t < vocab for t in tokens)):
+                    # bounded by the model's vocab, not just 2**31: an
+                    # out-of-vocab id can make HF decode raise a
+                    # non-ValueError (OverflowError / rust panic) that
+                    # this handler would surface as a 500
                     raise ValueError("'tokens' must be a list of token ids "
-                                     "in [0, 2**31)")
+                                     f"in [0, {vocab})")
                 self._json(200, {"prompt": eng.tokenizer.decode(tokens)})
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, str(e))
@@ -638,13 +667,22 @@ class _Handler(BaseHTTPRequestHandler):
 
         deadline = time.monotonic() + ctx.config.request_timeout_s
         try:
+            # computed BEFORE any chunk goes out: with include_usage,
+            # OpenAI sends "usage": null on EVERY non-final chunk — role
+            # and echo chunks included; strict clients index
+            # chunk["usage"] unconditionally
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage"))
             if chat:
                 for i in range(n):
-                    send_chunk({"id": oid, "object": "chat.completion.chunk",
-                                "model": ctx.model_name,
-                                "choices": [{"index": i,
-                                             "delta": {"role": "assistant"},
-                                             "finish_reason": None}]})
+                    chunk = {"id": oid, "object": "chat.completion.chunk",
+                             "model": ctx.model_name,
+                             "choices": [{"index": i,
+                                          "delta": {"role": "assistant"},
+                                          "finish_reason": None}]}
+                    if include_usage:
+                        chunk["usage"] = None
+                    send_chunk(chunk)
             echo_text = self._echo_text(body, chat, kwargs)
             if echo_text is not None:
                 # OpenAI echo semantics: the prompt text leads the stream.
@@ -656,12 +694,13 @@ class _Handler(BaseHTTPRequestHandler):
                               "finish_reason": None}
                     if ret_ids:
                         choice["token_ids"] = []
-                    send_chunk({"id": oid, "object": "text_completion",
-                                "created": int(time.time()),
-                                "model": ctx.model_name,
-                                "choices": [choice]})
-            include_usage = bool(
-                (body.get("stream_options") or {}).get("include_usage"))
+                    chunk = {"id": oid, "object": "text_completion",
+                             "created": int(time.time()),
+                             "model": ctx.model_name,
+                             "choices": [choice]}
+                    if include_usage:
+                        chunk["usage"] = None
+                    send_chunk(chunk)
             prompt_toks = 0
             completion_toks = 0
             errored = False
