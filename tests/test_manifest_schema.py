@@ -1,0 +1,104 @@
+"""Every manifest this repo can emit is schema-checked against the vendored
+strict K8s schemas (tpuserve/provision/validate.py) — the stand-in for
+applying against a real API server on a host with no docker/kubectl
+(reference's convergence evidence: deploy-k8s-cluster.sh:19-44; VERDICT r3
+next #6c).  Covers every preset x every manifest producer, plus negative
+cases proving the validator actually rejects what a strict API server
+would."""
+
+import copy
+
+import pytest
+
+from tpuserve.provision import manifests, observability
+from tpuserve.provision.cluster import (storage_class_manifest,
+                                        tpu_servicemonitor_manifest)
+from tpuserve.provision.config import PRESETS, load_config
+from tpuserve.provision.validate import (ManifestError, validate_all,
+                                         validate_manifest)
+
+
+def _all_manifests(cfg):
+    objs = list(manifests.serving_manifests(cfg))
+    objs += observability.tpu_metrics_exporter_manifests(cfg)
+    objs += observability.collector_rbac_manifests(cfg)
+    objs += observability.otel_prometheus_manifests(cfg)
+    objs += observability.collector_manifests(cfg)
+    objs.append(tpu_servicemonitor_manifest(cfg))
+    if cfg.provider == "local":
+        objs.append(storage_class_manifest(cfg))
+    return objs
+
+
+@pytest.mark.parametrize("preset", sorted(PRESETS))
+def test_every_preset_manifest_validates(preset):
+    cfg = load_config(preset=preset)
+    n = validate_all(_all_manifests(cfg))
+    assert n >= 8           # namespace, pvc, templates, workloads, ...
+
+
+def test_gateway_replicas_parameterized():
+    cfg = load_config(preset="qwen3-0.6b-v5e4", gateway_replicas=3)
+    objs = manifests.serving_manifests(cfg)
+    gw = [o for o in objs if o["kind"] == "Deployment"
+          and o["metadata"]["name"] == "tpuserve-gateway"]
+    assert gw and gw[0]["spec"]["replicas"] == 3
+    validate_all(objs)
+
+
+def _find(objs, kind):
+    return next(o for o in objs if o["kind"] == kind)
+
+
+@pytest.fixture(scope="module")
+def base_objs():
+    return _all_manifests(load_config(preset="cpu-smoke"))
+
+
+def test_validator_rejects_misspelled_field(base_objs):
+    dep = copy.deepcopy(_find(base_objs, "Deployment"))
+    dep["spec"]["template"]["spec"]["containers"][0]["comand"] = ["x"]
+    with pytest.raises(ManifestError, match="comand"):
+        validate_manifest(dep)
+
+
+def test_validator_rejects_selector_mismatch(base_objs):
+    dep = copy.deepcopy(_find(base_objs, "Deployment"))
+    # the producers alias one labels dict into selector AND template (so
+    # they can never disagree); replace the selector wholesale to simulate
+    # a future producer that builds them separately and typos one
+    dep["spec"]["selector"] = {"matchLabels": {
+        **dep["spec"]["selector"]["matchLabels"], "app": "other"}}
+    with pytest.raises(ManifestError, match="selector"):
+        validate_manifest(dep)
+
+
+def test_validator_rejects_unknown_volume_mount(base_objs):
+    dep = copy.deepcopy(_find(base_objs, "Deployment"))
+    pod = dep["spec"]["template"]["spec"]
+    pod["containers"][0].setdefault("volumeMounts", []).append(
+        {"name": "ghost", "mountPath": "/g"})
+    with pytest.raises(ManifestError, match="ghost"):
+        validate_manifest(dep)
+
+
+def test_validator_rejects_bad_quantity(base_objs):
+    pvc = copy.deepcopy(_find(base_objs, "PersistentVolumeClaim"))
+    pvc["spec"]["resources"]["requests"]["storage"] = "100 gigs"
+    with pytest.raises(ManifestError, match="storage"):
+        validate_manifest(pvc)
+
+
+def test_validator_rejects_unvendored_kind():
+    with pytest.raises(ManifestError, match="no vendored schema"):
+        validate_manifest({"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": "x", "namespace": "y"},
+                           "spec": {}})
+
+
+def test_validator_rejects_bad_probe_port(base_objs):
+    dep = copy.deepcopy(_find(base_objs, "Deployment"))
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    c["readinessProbe"] = {"httpGet": {"path": "/healthz", "port": "nope"}}
+    with pytest.raises(ManifestError, match="nope"):
+        validate_manifest(dep)

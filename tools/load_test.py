@@ -164,16 +164,18 @@ def main():
     print(json.dumps(result))
     stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M")
     with open(os.path.join(ROOT, "BENCHMARKS.md"), "a") as f:
-        gw_label = ("through 2 HA gateways (vs 2-engine pool capacity)"
-                    if args.ha else "through gateway")
+        gw_label = (f"through {n_pool} HA gateways (vs {n_pool}-engine "
+                    "pool capacity)" if args.ha
+                    else "through gateway (vs 1 engine)")
         f.write(
             f"\n## Serving-stack HTTP overhead @ {stamp}\n\n"
             f"{args.clients} concurrent streaming clients, {args.gen} tokens "
             f"each, {args.model}, backend={result['backend']}, "
-            f"topology: {result['topology']} (tools/load_test.py):\n\n"
-            f"| path | aggregate tok/s | overhead vs capacity |\n|---|---|---|\n"
+            f"topology: {result['topology']} (tools/load_test.py; each "
+            "row's overhead is against the baseline named in that row):\n\n"
+            f"| path | aggregate tok/s | overhead |\n|---|---|---|\n"
             f"| engine only (in-process, x1) | {result['engine_tok_s']} | — |\n"
-            f"| engine server (SSE) | {result['http_tok_s']} | "
+            f"| engine server (SSE, vs 1 engine) | {result['http_tok_s']} | "
             f"{result['http_overhead_pct']}% |\n"
             f"| {gw_label} | {result['gateway_tok_s']} | "
             f"{result['gateway_overhead_pct']}% |\n")

@@ -162,13 +162,20 @@ def main() -> int:
                 return 2
             continue                      # failed on a live tunnel: move on
         if r.get("degraded") or r.get("backend") != "tpu":
-            # the flap happened inside bench.py: refund — this is the
-            # watcher's problem, not the variant's
-            attempts[name] -= 1
-            save_attempts(attempts)
-            print(f"--- {name}: degraded/non-tpu ({r.get('degraded')}) — "
-                  "discarding; yielding to the watcher", flush=True)
-            return 2
+            # Degraded on a DOWN tunnel = flap: refund the attempt (the
+            # watcher owns retrying through outages).  Degraded on a LIVE
+            # tunnel = the variant itself fails (OOM, kernel bug, ...):
+            # the attempt stands, so MAX_ATTEMPTS still ends the loop
+            # instead of re-running a deterministic crash forever.
+            if not probe():
+                attempts[name] -= 1
+                save_attempts(attempts)
+                print(f"--- {name}: degraded with the tunnel down — "
+                      "refunding; yielding to the watcher", flush=True)
+                return 2
+            print(f"--- {name}: degraded on a live tunnel "
+                  f"({r.get('degraded')}) — attempt stands", flush=True)
+            continue
         attempts[name] = 0                # success resets the budget
         save_attempts(attempts)
         record(r)
@@ -198,11 +205,16 @@ def main() -> int:
                 return 2
             continue
         if not str(r.get("backend", "")).startswith("tpu"):
-            attempts[name] -= 1           # flap inside the bench: refund
-            save_attempts(attempts)
-            print(f"--- {name}: backend={r.get('backend')} — discarding; "
-                  "yielding to the watcher", flush=True)
-            return 2
+            if not probe():               # flap, not failure: refund
+                attempts[name] -= 1
+                save_attempts(attempts)
+                print(f"--- {name}: backend={r.get('backend')} with the "
+                      "tunnel down — refunding; yielding to the watcher",
+                      flush=True)
+                return 2
+            print(f"--- {name}: backend={r.get('backend')} on a live "
+                  "tunnel — attempt stands", flush=True)
+            continue
         attempts[name] = 0
         save_attempts(attempts)
         record(r)

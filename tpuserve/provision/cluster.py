@@ -38,6 +38,36 @@ def _namespaces(cfg: DeployConfig, kube: KubeCtl) -> None:
         kube.apply_manifest(manifests.render(manifests.namespace(ns)))
 
 
+def storage_class_manifest(cfg: DeployConfig) -> dict:
+    """Default StorageClass for provider=local (kubernetes-single-node.
+    yaml:364-373 installs rancher local-path by hand; kind/minikube bundle
+    the same provisioner)."""
+    return {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": {"name": cfg.storage_class, "annotations": {
+            "storageclass.kubernetes.io/is-default-class": "true"}},
+        "provisioner": "rancher.io/local-path",
+        "volumeBindingMode": "WaitForFirstConsumer",
+    }
+
+
+def tpu_servicemonitor_manifest(cfg: DeployConfig) -> dict:
+    """ServiceMonitor for the TPU metrics exporter at the reference's 5s
+    DCGM cadence (kubernetes-single-node.yaml:447-504)."""
+    return {
+        "apiVersion": "monitoring.coreos.com/v1", "kind": "ServiceMonitor",
+        "metadata": {"name": "tpu-metrics",
+                     "namespace": cfg.monitoring_namespace,
+                     "labels": {"release": "prometheus"}},
+        "spec": {
+            "namespaceSelector": {"matchNames": [cfg.namespace]},
+            "selector": {"matchLabels": {"app": "tpu-metrics-exporter"}},
+            "endpoints": [{"port": "metrics",
+                           "interval": f"{cfg.tpu_metrics_interval_s}s"}],
+        },
+    }
+
+
 def _storage(cfg: DeployConfig, kube: KubeCtl) -> None:
     """Default StorageClass + PVCs (kubernetes-single-node.yaml:360-401).
     GKE ships standard-rwo; for provider=local install a hostPath-style
@@ -46,16 +76,7 @@ def _storage(cfg: DeployConfig, kube: KubeCtl) -> None:
         res = kube.kubectl("get", "storageclass", "-o",
                            "jsonpath={.items[*].metadata.name}", check=False)
         if cfg.storage_class not in (res.stdout or "").split():
-            sc = {
-                "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
-                "metadata": {"name": cfg.storage_class, "annotations": {
-                    "storageclass.kubernetes.io/is-default-class": "true"}},
-                # kind/minikube bundle the rancher local-path provisioner the
-                # reference installs by hand (kubernetes-single-node.yaml:364-373)
-                "provisioner": "rancher.io/local-path",
-                "volumeBindingMode": "WaitForFirstConsumer",
-            }
-            kube.apply_manifest(yaml.safe_dump(sc))
+            kube.apply_manifest(manifests.render(storage_class_manifest(cfg)))
     kube.apply_manifest(manifests.render(manifests.namespace(cfg.namespace),
                                          *manifests.storage_pvcs(cfg)))
 
@@ -88,18 +109,8 @@ def _tpu_metrics_monitor(cfg: DeployConfig, kube: KubeCtl) -> None:
     """ServiceMonitor for the TPU metrics exporter at the reference's 5s
     DCGM cadence (kubernetes-single-node.yaml:447-504), plus the RBAC the
     reference grants alongside it."""
-    sm = {
-        "apiVersion": "monitoring.coreos.com/v1", "kind": "ServiceMonitor",
-        "metadata": {"name": "tpu-metrics", "namespace": cfg.monitoring_namespace,
-                     "labels": {"release": "prometheus"}},
-        "spec": {
-            "namespaceSelector": {"matchNames": [cfg.namespace]},
-            "selector": {"matchLabels": {"app": "tpu-metrics-exporter"}},
-            "endpoints": [{"port": "metrics",
-                           "interval": f"{cfg.tpu_metrics_interval_s}s"}],
-        },
-    }
-    res = kube.apply_manifest(yaml.safe_dump(sm), check=False)
+    res = kube.apply_manifest(
+        manifests.render(tpu_servicemonitor_manifest(cfg)), check=False)
     if not res.ok:
         # CRD may be absent on a bare local cluster without the stack —
         # a soft assertion, like the reference's ignore_errors waits

@@ -19,7 +19,15 @@ TPU_RESOURCE = "google.com/tpu"
 
 
 def render(*objs: dict) -> str:
-    return yaml.safe_dump_all([o for o in objs if o], sort_keys=False)
+    """Serialize manifests for kubectl apply — after pushing each through
+    the vendored strict schemas (provision/validate.py), so an invalid
+    manifest fails HERE with a readable error instead of at the API
+    server (or worse, passes a lenient server and misbehaves)."""
+    from tpuserve.provision.validate import validate_manifest
+    objs = [o for o in objs if o]
+    for o in objs:
+        validate_manifest(o)
+    return yaml.safe_dump_all(objs, sort_keys=False)
 
 
 def namespace(name: str) -> dict:

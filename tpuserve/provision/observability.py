@@ -15,6 +15,7 @@ import logging
 
 import yaml
 
+from tpuserve.provision import manifests
 from tpuserve.provision.config import DeployConfig
 from tpuserve.provision.infra import KubeCtl
 
@@ -36,7 +37,7 @@ def _namespaces(cfg: DeployConfig, kube: KubeCtl) -> None:
     # --dry-run=client -o yaml | kubectl apply idempotent creation
     # (otel-observability-setup.yaml:15-37).
     for ns in (cfg.observability_namespace, cfg.otel_namespace):
-        kube.apply_manifest(yaml.safe_dump(
+        kube.apply_manifest(manifests.render(
             {"apiVersion": "v1", "kind": "Namespace",
              "metadata": {"name": ns}}))
 
@@ -130,8 +131,8 @@ def tpu_metrics_exporter_manifests(cfg: DeployConfig) -> list[dict]:
 
 
 def _tpu_metrics_exporter(cfg: DeployConfig, kube: KubeCtl) -> None:
-    kube.apply_manifest(yaml.safe_dump_all(
-        tpu_metrics_exporter_manifests(cfg)))
+    kube.apply_manifest(manifests.render(
+        *tpu_metrics_exporter_manifests(cfg)))
 
 
 # --- collector RBAC (:107-168) --------------------------------------------
@@ -170,7 +171,7 @@ def collector_rbac_manifests(cfg: DeployConfig) -> list[dict]:
 
 
 def _collector_rbac(cfg: DeployConfig, kube: KubeCtl) -> None:
-    kube.apply_manifest(yaml.safe_dump_all(collector_rbac_manifests(cfg)))
+    kube.apply_manifest(manifests.render(*collector_rbac_manifests(cfg)))
 
 
 # --- dedicated Prometheus with remote-write receiver (:179-283) -----------
@@ -240,7 +241,7 @@ def otel_prometheus_manifests(cfg: DeployConfig) -> list[dict]:
 
 
 def _otel_prometheus(cfg: DeployConfig, kube: KubeCtl) -> None:
-    kube.apply_manifest(yaml.safe_dump_all(otel_prometheus_manifests(cfg)))
+    kube.apply_manifest(manifests.render(*otel_prometheus_manifests(cfg)))
 
 
 # --- OTEL collector (:297-642) --------------------------------------------
@@ -408,7 +409,7 @@ def collector_manifests(cfg: DeployConfig) -> list[dict]:
 
 
 def _collector(cfg: DeployConfig, kube: KubeCtl) -> None:
-    kube.apply_manifest(yaml.safe_dump_all(collector_manifests(cfg)))
+    kube.apply_manifest(manifests.render(*collector_manifests(cfg)))
 
 
 def _wait_ready(cfg: DeployConfig, kube: KubeCtl) -> None:

@@ -255,17 +255,16 @@ class Engine:
         prompt_token_ids = list(prompt_token_ids)
         if not prompt_token_ids:
             raise ValueError("empty prompt")
-        if jax.process_count() > 1 and (params.needs_penalties
-                                        or params.needs_logit_bias
-                                        or params.needs_min_tokens
-                                        or params.logprobs is not None):
+        if jax.process_count() > 1 and params.multihost_unsupported():
             # Penalty/bias/logprob ops are separate jits over the
             # mesh-global logits; the lockstep protocol mirrors
             # prefill/decode/sample only.  Rejected at intake rather than
-            # deadlocking in SPMD.  See parallel/multihost.py "Limitations".
+            # deadlocking in SPMD (the API edge already 400s these; this
+            # guards direct engine users).  See parallel/multihost.py
+            # "Limitations".
             raise ValueError(
-                "sampling penalties, logit_bias, min_tokens, and logprobs "
-                "are not supported in multi-host serving mode")
+                f"{', '.join(params.multihost_unsupported())} not "
+                "supported in multi-host serving mode")
         if len(prompt_token_ids) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds max sequence "

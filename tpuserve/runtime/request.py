@@ -76,6 +76,21 @@ class SamplingParams:
         return self.min_tokens > 0 and (not self.ignore_eos
                                         or bool(self.stop_token_ids))
 
+    def multihost_unsupported(self) -> list[str]:
+        """Parameter families the multi-host lockstep protocol cannot
+        serve (it mirrors prefill/decode/sample only; penalty/bias/
+        min-tokens/logprob jits are out of protocol — parallel/multihost.py
+        "Limitations").  ONE source of truth for both rejection sites: the
+        engine's intake guard and the API edge's 400
+        (tpuserve/server/openai_api.py) — keep them from drifting."""
+        return [name for name, used in (
+            ("presence_penalty/frequency_penalty/repetition_penalty",
+             self.needs_penalties),
+            ("logit_bias", self.needs_logit_bias),
+            ("min_tokens", self.needs_min_tokens),
+            ("logprobs", self.logprobs is not None),
+        ) if used]
+
     def min_tokens_active(self, n_generated: int, slack: int = 0) -> bool:
         """True while the min_tokens floor is still in force after
         ``n_generated`` tokens.  ``slack`` widens the window for callers

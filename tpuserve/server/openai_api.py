@@ -219,13 +219,7 @@ class OpenAIServer:
         import jax
         if jax.process_count() <= 1:
             return
-        offending = [name for name, used in (
-            ("presence_penalty/frequency_penalty/repetition_penalty",
-             params.needs_penalties),
-            ("logit_bias", params.needs_logit_bias),
-            ("min_tokens", params.needs_min_tokens),
-            ("logprobs", params.logprobs is not None),
-        ) if used]
+        offending = params.multihost_unsupported()
         if offending:
             raise ValueError(
                 f"{', '.join(offending)} not supported by this multi-host "
