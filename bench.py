@@ -160,7 +160,7 @@ def _ensure_live_backend(retry: bool = True) -> None:
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
                   prefix_caching=False, multi_step=None, quantization=None,
-                  prefill_split=1):
+                  prefill_split=1, kv_quant=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -170,7 +170,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     blocks_per_seq = -(-max_len // block_size) + 1
     cache = CacheConfig(block_size=block_size,
                         num_blocks=batch * blocks_per_seq + 2 * batch,
-                        max_blocks_per_seq=blocks_per_seq)
+                        max_blocks_per_seq=blocks_per_seq,
+                        dtype=kv_quant or "bfloat16")
     # Admit the whole batch in ONE prefill step by default: queueing behind
     # 8-seq prefill batches is what dominates mean TTFT when all requests
     # arrive at once (and one big batch keeps the MXU busier than eight
@@ -412,6 +413,10 @@ def main(argv=None):
                          "TPU, off on CPU); 1 disables")
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="weight-only quantization variant")
+    ap.add_argument("--kv-quant", default=None, choices=["int8"],
+                    help="KV-cache quantization: int8 halves KV bytes per "
+                         "decode step and doubles cache capacity "
+                         "(per-token-per-head scales)")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K draft tokens on a "
                          "repetitive-prompt workload")
@@ -496,7 +501,8 @@ def main(argv=None):
                            attn_impl=attn_impl, pipeline=pipeline,
                            spec_k=args.spec, multi_step=args.multi_step,
                            quantization=args.quant,
-                           prefill_split=args.prefill_split)
+                           prefill_split=args.prefill_split,
+                           kv_quant=args.kv_quant)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -599,6 +605,7 @@ def main(argv=None):
         "attn_impl": eng0.attn_impl,
         "multi_step": eng0._multi_step,
         "quantization": eng0.config.quantization,
+        "kv_quant": args.kv_quant,
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -653,7 +660,8 @@ def main(argv=None):
                                      attn_impl=attn_impl, pipeline=pipeline,
                                      disagg=True, multi_step=args.multi_step,
                                      quantization=args.quant,
-                                     prefill_split=args.prefill_split)
+                                     prefill_split=args.prefill_split,
+                                     kv_quant=args.kv_quant)
             # same arrival process as the main run, or vs_colocated would
             # compare a poisson workload against a burst workload
             _warm(d_engine, batch, prompt_len, arrivals=poisson)

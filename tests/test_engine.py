@@ -406,3 +406,67 @@ def test_mixed_feature_batch_composes():
         max_tokens=8, temperature=0.0, ignore_eos=True))[0]
     assert outs[3].output_token_ids == plain.output_token_ids
     assert eng.block_manager.num_seqs() == 0
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache (CacheConfig dtype="int8"): quantize-on-write, dequantize
+# in the attention reads — halves KV bandwidth on the bandwidth-bound
+# decode path (BENCHMARKS.md roofline; VERDICT r3 weak #4)
+# ---------------------------------------------------------------------------
+
+def _int8_engine(attn_impl):
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16,
+                          dtype="int8"),
+        scheduler=SchedulerConfig(max_num_seqs=8, max_prefill_tokens=256,
+                                  min_prefill_bucket=8, min_decode_bucket=2),
+        attn_impl=attn_impl))
+
+
+def test_int8_kv_reference_pallas_parity():
+    """Both attention impls read the SAME quantized cache, so greedy
+    streams must agree token for token (the dequantized values are
+    bit-identical; only the attention arithmetic differs)."""
+    prompts = ["Hello world", "The quick brown fox", "zq"]
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    ref = _int8_engine("reference").generate(prompts, p)
+    pal = _int8_engine("pallas").generate(prompts, p)
+    for a, b in zip(ref, pal):
+        assert a.output_token_ids == b.output_token_ids
+
+
+def test_int8_kv_deterministic_and_close_to_fp(engine):
+    """int8 KV generation is deterministic, and quantization noise leaves
+    the greedy stream mostly unchanged vs the fp cache."""
+    prompts = ["Hello world", "determinism check"]
+    p = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    e = _int8_engine("reference")
+    a = e.generate(prompts, p)
+    b = e.generate(prompts, p)
+    for x, y in zip(a, b):
+        assert x.output_token_ids == y.output_token_ids
+    fp = engine.generate(prompts, p)
+    matches = sum(t1 == t2
+                  for x, y in zip(a, fp)
+                  for t1, t2 in zip(x.output_token_ids, y.output_token_ids))
+    total = sum(len(x.output_token_ids) for x in a)
+    assert matches / total >= 0.75, f"int8 KV diverged: {matches}/{total}"
+
+
+def test_int8_kv_long_prompt_chunked():
+    """Long prompts route through chunked prefill; the int8 window path
+    must serve them (reference impl on CPU; the Pallas window kernel has
+    its own interpret-mode parity test)."""
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=32, dtype="int8"),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=256,
+                                  min_prefill_bucket=8, min_decode_bucket=2,
+                                  prefill_chunk_size=16)))
+    long_prompt = "x" * 50            # > chunk size -> chunked path
+    out = eng.generate([long_prompt],
+                       SamplingParams(max_tokens=6, temperature=0.0,
+                                      ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 6

@@ -68,6 +68,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("batch128", ["--batch", "128"], {}),
     ("int8-batch128", ["--quant", "int8", "--batch", "128"], {}),
     ("int8-batch256", ["--quant", "int8", "--batch", "256"], {}),
+    # int8 KV cache: halves the OTHER half of decode's HBM traffic (KV
+    # reads rival weight reads at the headline shape — roofline in
+    # BENCHMARKS.md); with int8 weights too, decode moves ~1/2 the bytes
+    ("kv-int8", ["--kv-quant", "int8"], {}),
+    ("int8-kv-int8", ["--quant", "int8", "--kv-quant", "int8"], {}),
+    ("int8-kv-int8-batch256", ["--quant", "int8", "--kv-quant", "int8",
+                               "--batch", "256"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
     # Long-context path: prompts routed through chunked prefill (the

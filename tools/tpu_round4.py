@@ -45,6 +45,7 @@ PRIORITY = [
     "single-request", "poisson16", "poisson32",  # realistic-arrival TTFT
     "int8", "int8-multistep32",               # cut by the r3 outage
     "batch128", "int8-batch128", "int8-batch256",  # HBM roofline headroom
+    "kv-int8", "int8-kv-int8", "int8-kv-int8-batch256",  # int8 KV cache
     "spec4", "disagg",                        # cut by the r3 outage
     "multistep16", "multistep64",
     "long-prompt",

@@ -204,9 +204,10 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
-        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
-        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
-        new_cache.append({"k": ck, "v": cv})
+        # batched prefill attends over the FRESH k/v (full precision even
+        # when the cache stores int8 — only cache READS see quantization)
+        new_cache.append(attn_ops.write_kv_entry(kv_cache[li], k, v,
+                                                 slot_ids))
         if attn_impl == "pallas" and mesh is not None:
             from tpuserve.ops.pallas_tp import flash_prefill_attention_tp
             out = flash_prefill_attention_tp(q, k, v, prompt_lens, scale, mesh)
@@ -281,20 +282,24 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
-        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
-        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
-        new_cache.append({"k": ck, "v": cv})
+        entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
+        new_cache.append(entry)
+        ck, cv = entry["k"], entry["v"]
+        ks, vs = entry.get("ks"), entry.get("vs")
         if attn_impl == "pallas" and mesh is not None:
             from tpuserve.ops.pallas_tp import paged_window_attention_tp
             out = paged_window_attention_tp(
-                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale, mesh)
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale, mesh,
+                k_scale=ks, v_scale=vs)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
             out = paged_window_attention(
-                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
+                k_scale=ks, v_scale=vs)
         else:
             out = attn_ops.chunked_prefill_attention(
-                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale)
+                q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
+                k_scale=ks, v_scale=vs)
         out = out.reshape(B, C, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
@@ -346,18 +351,23 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)                 # (B, Hq/Hkv, D)
-        ck = attn_ops.write_kv_cache(kv_cache[li]["k"], k, slot_ids)
-        cv = attn_ops.write_kv_cache(kv_cache[li]["v"], v, slot_ids)
-        new_cache.append({"k": ck, "v": cv})
+        entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
+        new_cache.append(entry)
+        ck, cv = entry["k"], entry["v"]
+        ks, vs = entry.get("ks"), entry.get("vs")
         if attn_impl == "pallas" and mesh is not None:
             from tpuserve.ops.pallas_tp import paged_decode_attention_tp
             out = paged_decode_attention_tp(q, ck, cv, block_tables, seq_lens,
-                                            scale, mesh)
+                                            scale, mesh, k_scale=ks,
+                                            v_scale=vs)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_paged_attention import paged_decode_attention as impl
-            out = impl(q, ck, cv, block_tables, seq_lens, scale)
+            out = impl(q, ck, cv, block_tables, seq_lens, scale,
+                       k_scale=ks, v_scale=vs)
         else:
-            out = attn_ops.paged_decode_attention(q, ck, cv, block_tables, seq_lens, scale)
+            out = attn_ops.paged_decode_attention(q, ck, cv, block_tables,
+                                                  seq_lens, scale,
+                                                  k_scale=ks, v_scale=vs)
         out = out.reshape(B, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)

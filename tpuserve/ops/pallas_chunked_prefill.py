@@ -34,7 +34,12 @@ TARGET_GROUP_ROWS = 512
 
 def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
                    k_scr, v_scr, sems, *, scale, page_size, pages_g,
-                   num_kv_heads, group, head_dim, blk_q):
+                   num_kv_heads, group, head_dim, blk_q,
+                   ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None):
+    """``ks_hbm``/``vs_hbm`` present = int8 cache: pages DMA as int8 with
+    per-page scale blocks and dequantize in VMEM (same scheme as the paged
+    decode kernel)."""
+    quantized = ks_hbm is not None
     b = pl.program_id(0)
     qi = pl.program_id(1)
     ctx = ctx_ref[b]
@@ -46,15 +51,29 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
     num_pages = pl.cdiv(kv_limit, page_size)
     num_groups = pl.cdiv(num_pages, pages_g)
 
+    def _copies(g, slot, j):
+        page = bt_ref[b, g * pages_g + j]
+        copies = [
+            pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot, j],
+                                  sems.at[0, slot, j]),
+            pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot, j],
+                                  sems.at[1, slot, j]),
+        ]
+        if quantized:
+            copies += [
+                pltpu.make_async_copy(ks_hbm.at[page], ks_scr.at[slot, j],
+                                      sems.at[2, slot, j]),
+                pltpu.make_async_copy(vs_hbm.at[page], vs_scr.at[slot, j],
+                                      sems.at[3, slot, j]),
+            ]
+        return copies
+
     def start_group(g, slot):
         def copy_one(j, _):
             @pl.when(g * pages_g + j < num_pages)
             def _():
-                page = bt_ref[b, g * pages_g + j]
-                pltpu.make_async_copy(
-                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).start()
-                pltpu.make_async_copy(
-                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).start()
+                for c in _copies(g, slot, j):
+                    c.start()
             return 0
         jax.lax.fori_loop(0, pages_g, copy_one, 0)
 
@@ -62,11 +81,8 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
         def wait_one(j, _):
             @pl.when(g * pages_g + j < num_pages)
             def _():
-                page = bt_ref[b, g * pages_g + j]
-                pltpu.make_async_copy(
-                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).wait()
-                pltpu.make_async_copy(
-                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).wait()
+                for c in _copies(g, slot, j):
+                    c.wait()
             return 0
         jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
@@ -102,6 +118,14 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
                          0, 1)
         v = jnp.swapaxes(v_scr[slot].reshape(rows_g, num_kv_heads, head_dim),
                          0, 1)
+        if quantized:
+            from tpuserve.ops.attention import dequantize_kv
+            k = dequantize_kv(k, jnp.swapaxes(
+                ks_scr[slot].reshape(rows_g, num_kv_heads), 0, 1),
+                q_ref.dtype)
+            v = dequantize_kv(v, jnp.swapaxes(
+                vs_scr[slot].reshape(rows_g, num_kv_heads), 0, 1),
+                q_ref.dtype)
         # Zero V rows past THIS PROGRAM'S loaded range: pages beyond
         # kv_limit are never DMA'd (even when within the written keys —
         # early q blocks stop at their causal limit), so their scratch is
@@ -143,7 +167,9 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                            scale: float, interpret: bool | None = None,
                            blk_q: int = 128,
-                           pages_per_group: int | None = None) -> jnp.ndarray:
+                           pages_per_group: int | None = None,
+                           k_scale: jnp.ndarray | None = None,
+                           v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
     """q: (B, C, Hq, D) window queries; k_cache/v_cache: (num_blocks, page,
     Hkv, D) with the window's KV already written; block_tables: (B,
     max_pages) int32; ctx_lens/chunk_lens: (B,). -> (B, C, Hq, D).
@@ -166,25 +192,43 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     pages_g = pages_per_group or max(1, -(-TARGET_GROUP_ROWS // page_size))
     pages_g = min(pages_g, max_pages)
 
+    quantized = k_scale is not None
     kernel = functools.partial(
         _window_kernel, scale=scale, page_size=page_size, pages_g=pages_g,
         num_kv_heads=Hkv, group=group, head_dim=D, blk_q=blk_q)
+    if quantized:
+        base_kernel = kernel
+
+        def kernel(bt, cx, ck, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+                   k_scr, v_scr, ks_scr, vs_scr, sems):
+            return base_kernel(bt, cx, ck, q_ref, k_hbm, v_hbm, o_ref,
+                               k_scr, v_scr, sems, ks_hbm=ks_hbm,
+                               vs_hbm=vs_hbm, ks_scr=ks_scr, vs_scr=vs_scr)
+
+    in_specs = [
+        pl.BlockSpec((1, blk_q, Hq, D),
+                     lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),   # k_cache stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),   # v_cache stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
+        pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
+    ]
+    scales = ()
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        scratch += [pltpu.VMEM((2, pages_g, page_size, Hkv), jnp.float32)] * 2
+        scales = (k_scale, v_scale)
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quantized else 2,
+                                            2, pages_g)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, pl.cdiv(C, blk_q)),
-        in_specs=[
-            pl.BlockSpec((1, blk_q, Hq, D),
-                         lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),   # k_cache stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),   # v_cache stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, blk_q, Hq, D),
                                lambda b, qi, bt, cx, ck: (b, qi, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
-            pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, pages_g)),
-        ],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
@@ -194,4 +238,4 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_tables, ctx_lens, chunk_lens, q, k_cache, v_cache)
+    )(block_tables, ctx_lens, chunk_lens, q, k_cache, v_cache, *scales)

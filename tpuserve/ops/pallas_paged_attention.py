@@ -68,18 +68,23 @@ VMEM_BUDGET_BYTES = int(os.environ.get("TPUSERVE_VMEM_BUDGET_MB", "12")) * 2**20
 def _clamp_to_vmem_budget(pages_g: int, seqs_pp: int, page_size: int,
                           num_kv_heads: int, head_dim: int,
                           kv_itemsize: int, num_q_heads: int,
-                          q_itemsize: int) -> tuple[int, int]:
+                          q_itemsize: int,
+                          scale_itemsize: int = 0) -> tuple[int, int]:
     """Shrink (pages_g, seqs_pp) until the kernel's VMEM footprint fits.
 
     Footprint model (what the kernel actually allocates):
       - KV scratch: 2 slots (double buffer) x {K,V} x pages_g x page x
         Hkv x D at the cache dtype;
+      - int8 caches add per-(token, head) scale scratch — D-free, so it
+        is ~3% of the KV bytes, NOT folded into kv_itemsize (which the
+        model multiplies by D);
       - q/out pipeline blocks: 2 buffers each (Pallas double-buffers
         grid-indexed blocks) x seqs_pp x Hq x D at the activation dtype.
     pages_g halves first (it dominates and shrinking it only shortens the
     DMA pipeline), then seqs_pp."""
     def footprint(pg: int, sp: int) -> int:
-        kv = 2 * 2 * pg * page_size * num_kv_heads * head_dim * kv_itemsize
+        rows = 2 * 2 * pg * page_size * num_kv_heads
+        kv = rows * (head_dim * kv_itemsize + scale_itemsize)
         qo = 2 * 2 * sp * num_q_heads * head_dim * q_itemsize
         return kv + qo
 
@@ -119,7 +124,12 @@ def _env_int(name: str) -> int | None:
 
 def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                          k_scr, v_scr, sems, *, scale, page_size, pages_g,
-                         num_kv_heads, group, head_dim, seqs_pp):
+                         num_kv_heads, group, head_dim, seqs_pp,
+                         ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None):
+    """``ks_hbm``/``vs_hbm`` present = int8 cache: value pages DMA as int8
+    (half the HBM bytes — the whole point) alongside tiny per-page scale
+    blocks, and dequantize on the VPU after landing in VMEM."""
+    quantized = ks_hbm is not None
     p = pl.program_id(0)
     base = p * seqs_pp
     rows_g = pages_g * page_size
@@ -132,17 +142,31 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
         # (their zero pages mean no DMAs start and no waits happen).
         return jnp.maximum(pl.cdiv(sl_ref[base + s], rows_g), 1)
 
+    def _copies(s, g, slot, j):
+        page = bt_ref[base + s, g * pages_g + j]
+        copies = [
+            pltpu.make_async_copy(k_hbm.at[page], k_scr.at[slot, j],
+                                  sems.at[0, slot, j]),
+            pltpu.make_async_copy(v_hbm.at[page], v_scr.at[slot, j],
+                                  sems.at[1, slot, j]),
+        ]
+        if quantized:
+            copies += [
+                pltpu.make_async_copy(ks_hbm.at[page], ks_scr.at[slot, j],
+                                      sems.at[2, slot, j]),
+                pltpu.make_async_copy(vs_hbm.at[page], vs_scr.at[slot, j],
+                                      sems.at[3, slot, j]),
+            ]
+        return copies
+
     def start_chunk(s, g, slot):
         np_s = num_pages(s)
 
         def copy_one(j, _):
             @pl.when(g * pages_g + j < np_s)
             def _():
-                page = bt_ref[base + s, g * pages_g + j]
-                pltpu.make_async_copy(
-                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).start()
-                pltpu.make_async_copy(
-                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).start()
+                for c in _copies(s, g, slot, j):
+                    c.start()
             return 0
         jax.lax.fori_loop(0, pages_g, copy_one, 0)
 
@@ -152,11 +176,8 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
         def wait_one(j, _):
             @pl.when(g * pages_g + j < np_s)
             def _():
-                page = bt_ref[base + s, g * pages_g + j]
-                pltpu.make_async_copy(
-                    k_hbm.at[page], k_scr.at[slot, j], sems.at[0, slot, j]).wait()
-                pltpu.make_async_copy(
-                    v_hbm.at[page], v_scr.at[slot, j], sems.at[1, slot, j]).wait()
+                for c in _copies(s, g, slot, j):
+                    c.wait()
             return 0
         jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
@@ -191,6 +212,17 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                 k_scr[slot].reshape(rows_g, num_kv_heads, head_dim), 0, 1)
             v = jnp.swapaxes(
                 v_scr[slot].reshape(rows_g, num_kv_heads, head_dim), 0, 1)
+            if quantized:
+                # dequantize in VMEM: one VPU multiply per element, paid
+                # AFTER the halved DMA — results in q's dtype (bf16 on
+                # TPU) keep the dots on the fast MXU path
+                from tpuserve.ops.attention import dequantize_kv
+                k = dequantize_kv(k, jnp.swapaxes(
+                    ks_scr[slot].reshape(rows_g, num_kv_heads), 0, 1),
+                    q_ref.dtype)
+                v = dequantize_kv(v, jnp.swapaxes(
+                    vs_scr[slot].reshape(rows_g, num_kv_heads), 0, 1),
+                    q_ref.dtype)
             # Zero V rows past the sequence: pages of the group that were
             # never DMA'd hold unspecified scratch (possibly NaN), and
             # 0 * NaN would poison the accumulator even though those
@@ -234,9 +266,14 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
                            interpret: bool | None = None,
                            pages_per_group: int | None = None,
-                           seqs_per_program: int | None = None) -> jnp.ndarray:
+                           seqs_per_program: int | None = None,
+                           k_scale: jnp.ndarray | None = None,
+                           v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
     """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
     block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D).
+    ``k_scale``/``v_scale``: (num_blocks, page, Hkv) f32 when the cache
+    stores int8 (ops/attention.py quantize_kv) — pages then move over HBM
+    at half the bytes and dequantize on the VPU inside the kernel.
 
     The env knobs are resolved HERE, outside jit, and passed as static
     args — reading them inside the traced function would capture them at
@@ -254,21 +291,24 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     seqs_pp = min(seqs_pp, q.shape[0])
     pages_g, seqs_pp = _clamp_to_vmem_budget(
         pages_g, seqs_pp, page_size, k_cache.shape[2], k_cache.shape[3],
-        k_cache.dtype.itemsize, q.shape[1], q.dtype.itemsize)
+        k_cache.dtype.itemsize, q.shape[1], q.dtype.itemsize,
+        scale_itemsize=4 if k_scale is not None else 0)
+    scales = () if k_scale is None else (k_scale, v_scale)
     return _paged_decode_attention(q, k_cache, v_cache, block_tables,
-                                   seq_lens, scale=scale,
+                                   seq_lens, scales, scale=scale,
                                    interpret=interpret, pages_g=pages_g,
                                    seqs_pp=seqs_pp)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret",
                                              "pages_g", "seqs_pp"))
-def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
-                            scale: float, interpret: bool, pages_g: int,
-                            seqs_pp: int) -> jnp.ndarray:
+def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
+                            scales, *, scale: float, interpret: bool,
+                            pages_g: int, seqs_pp: int) -> jnp.ndarray:
     B, Hq, D = q.shape
     num_blocks, page_size, Hkv, _ = k_cache.shape
     group = Hq // Hkv
+    quantized = bool(scales)
 
     # Pad the batch to a whole number of programs; padded rows have
     # seq_len 0 (no DMAs, masked scores) and are sliced off below.
@@ -283,20 +323,36 @@ def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
         _paged_decode_kernel, scale=scale, page_size=page_size,
         pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D,
         seqs_pp=seqs_pp)
+    if quantized:
+        # operand order must mirror the extra in_specs/scratch below
+        base_kernel = kernel
+
+        def kernel(bt, sl, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+                   k_scr, v_scr, ks_scr, vs_scr, sems):
+            return base_kernel(bt, sl, q_ref, k_hbm, v_hbm, o_ref,
+                               k_scr, v_scr, sems, ks_hbm=ks_hbm,
+                               vs_hbm=vs_hbm, ks_scr=ks_scr, vs_scr=vs_scr)
+
+    in_specs = [
+        pl.BlockSpec((seqs_pp, Hq, D), lambda p, bt, sl: (p, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),      # k_cache stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),      # v_cache stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
+        pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
+    ]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2   # scale pages
+        scratch += [pltpu.VMEM((2, pages_g, page_size, Hkv), jnp.float32)] * 2
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quantized else 2,
+                                            2, pages_g)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(Bp // seqs_pp,),
-        in_specs=[
-            pl.BlockSpec((seqs_pp, Hq, D), lambda p, bt, sl: (p, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),      # k_cache stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),      # v_cache stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((seqs_pp, Hq, D), lambda p, bt, sl: (p, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, pages_g, page_size, Hkv, D), k_cache.dtype),
-            pltpu.VMEM((2, pages_g, page_size, Hkv, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, pages_g)),
-        ],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         kernel,
@@ -306,5 +362,5 @@ def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(block_tables, seq_lens, q, k_cache, v_cache)
+    )(block_tables, seq_lens, q, k_cache, v_cache, *scales)
     return out[:B]

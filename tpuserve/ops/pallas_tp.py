@@ -36,40 +36,63 @@ def tp_partitionable(cfg_kv_heads: int, mesh: Mesh | None) -> bool:
 
 
 def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
-                              scale: float, mesh: Mesh):
+                              scale: float, mesh: Mesh,
+                              k_scale=None, v_scale=None):
     """Head-parallel paged decode attention over the tp axis.
 
     q: (B, Hq, D) head-sharded; k/v_cache: (blocks, page, Hkv, D)
-    kv-head-sharded; block_tables/seq_lens replicated.  Output keeps q's
-    head sharding, feeding straight into the row-parallel o_proj.
+    kv-head-sharded; block_tables/seq_lens replicated.  ``k_scale``/
+    ``v_scale``: (blocks, page, Hkv) int8-cache scales, kv-head-sharded
+    like their pages.  Output keeps q's head sharding, feeding straight
+    into the row-parallel o_proj.
     """
     from tpuserve.ops.pallas_paged_attention import paged_decode_attention
     head_spec = P(None, AXIS_TP, None)
     kv_spec = P(None, None, AXIS_TP, None)
-    fn = shard_map(
-        partial(paged_decode_attention, scale=scale),
-        mesh=mesh,
-        in_specs=(head_spec, kv_spec, kv_spec, P(None, None), P(None)),
-        out_specs=head_spec, **_CHECK_KWARG)
-    return fn(q, k_cache, v_cache, block_tables, seq_lens)
+    scale_spec = P(None, None, AXIS_TP)
+    in_specs = [head_spec, kv_spec, kv_spec, P(None, None), P(None)]
+    args = [q, k_cache, v_cache, block_tables, seq_lens]
+    if k_scale is not None:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+
+        def impl(q_, kc, vc, bt, sl, ks, vs):
+            return paged_decode_attention(q_, kc, vc, bt, sl, scale,
+                                          k_scale=ks, v_scale=vs)
+    else:
+        impl = partial(paged_decode_attention, scale=scale)
+    fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=head_spec, **_CHECK_KWARG)
+    return fn(*args)
 
 
 def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
-                              chunk_lens, scale: float, mesh: Mesh):
+                              chunk_lens, scale: float, mesh: Mesh,
+                              k_scale=None, v_scale=None):
     """Head-parallel paged window attention (chunked prefill) over tp.
 
     q: (B, C, Hq, D) head-sharded; k/v_cache kv-head-sharded;
-    block_tables/ctx_lens/chunk_lens replicated.
+    block_tables/ctx_lens/chunk_lens replicated; int8-cache scales
+    kv-head-sharded like their pages.
     """
     from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
     q_spec = P(None, None, AXIS_TP, None)
     kv_spec = P(None, None, AXIS_TP, None)
-    fn = shard_map(
-        partial(paged_window_attention, scale=scale),
-        mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, P(None, None), P(None), P(None)),
-        out_specs=q_spec, **_CHECK_KWARG)
-    return fn(q, k_cache, v_cache, block_tables, ctx_lens, chunk_lens)
+    scale_spec = P(None, None, AXIS_TP)
+    in_specs = [q_spec, kv_spec, kv_spec, P(None, None), P(None), P(None)]
+    args = [q, k_cache, v_cache, block_tables, ctx_lens, chunk_lens]
+    if k_scale is not None:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+
+        def impl(q_, kc, vc, bt, cx, ck, ks, vs):
+            return paged_window_attention(q_, kc, vc, bt, cx, ck, scale,
+                                          k_scale=ks, v_scale=vs)
+    else:
+        impl = partial(paged_window_attention, scale=scale)
+    fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=q_spec, **_CHECK_KWARG)
+    return fn(*args)
 
 
 def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,

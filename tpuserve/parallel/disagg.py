@@ -33,16 +33,18 @@ from tpuserve.utils import next_power_of_2
 
 @partial(jax.jit, donate_argnames=("cache",))
 def _gather_pages(cache: list[dict], idx: jnp.ndarray):
-    # donate so XLA needn't keep a second copy of the source cache alive
-    gathered = [{"k": layer["k"][idx], "v": layer["v"][idx]} for layer in cache]
+    # donate so XLA needn't keep a second copy of the source cache alive;
+    # generic over entry keys so int8 caches move their ks/vs scale pages
+    # along with the values
+    gathered = [{key: layer[key][idx] for key in layer} for layer in cache]
     return gathered, cache
 
 
 @partial(jax.jit, donate_argnames=("cache",))
 def _scatter_pages(cache: list[dict], seq_kv: list[dict], idx: jnp.ndarray):
     return [
-        {"k": layer["k"].at[idx].set(moved["k"].astype(layer["k"].dtype)),
-         "v": layer["v"].at[idx].set(moved["v"].astype(layer["v"].dtype))}
+        {key: layer[key].at[idx].set(moved[key].astype(layer[key].dtype))
+         for key in layer}
         for layer, moved in zip(cache, seq_kv)
     ]
 

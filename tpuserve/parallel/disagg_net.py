@@ -75,7 +75,7 @@ def migration_payload(meta: dict, seq_kv: list[dict],
     specs, arrays, off = [], [], 0
     for layer in seq_kv:
         spec = {}
-        for kk in ("k", "v"):
+        for kk in sorted(layer):       # k/v (+ ks/vs scales on int8 caches)
             arr = np.asarray(layer[kk])
             dtype = str(arr.dtype)
             if dtype == "bfloat16":
@@ -113,8 +113,7 @@ def deserialize_migration(blob: bytes) -> tuple[dict, list[dict]]:
     (hlen,) = struct.unpack("<I", blob[4:8])
     header = json.loads(blob[8:8 + hlen])
     view = memoryview(blob)[8 + hlen:]
-    seq_kv = [{"k": _unpack_array(view, spec["k"]),
-               "v": _unpack_array(view, spec["v"])}
+    seq_kv = [{kk: _unpack_array(view, s) for kk, s in spec.items()}
               for spec in header["layers"]]
     return header["meta"], seq_kv
 

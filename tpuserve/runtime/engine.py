@@ -311,7 +311,7 @@ class Engine:
         req = Request(request_id=request_id,
                       prompt_token_ids=prompt_token_ids, params=params)
         alloc = self.block_manager.allocate(request_id, prompt_token_ids)
-        seq_kv = [{"k": jnp.asarray(l["k"]), "v": jnp.asarray(l["v"])}
+        seq_kv = [{kk: jnp.asarray(a) for kk, a in l.items()}
                   for l in seq_kv]
         self.kv_cache = insert_seq_kv(self.kv_cache, seq_kv, alloc.blocks)
         req.output_token_ids.append(first_token)

@@ -23,7 +23,16 @@ class CacheConfig:
     block_size: int = 32
     num_blocks: int = 1024
     max_blocks_per_seq: int = 64
+    # "bfloat16"/"float32" store raw; "int8" stores symmetric-absmax
+    # quantized values plus one f32 scale per (token, kv head) in parallel
+    # ``ks``/``vs`` paged arrays — halves KV bytes per decode step and
+    # doubles cache capacity per HBM byte (decode is bandwidth-bound;
+    # BENCHMARKS.md roofline).
     dtype: str = "bfloat16"
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
 
     @property
     def max_model_len(self) -> int:
@@ -32,8 +41,11 @@ class CacheConfig:
 
 def bytes_per_block(model_cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
     itemsize = jnp.dtype(cache_cfg.dtype).itemsize
+    per_vector = model_cfg.head_dim * itemsize
+    if cache_cfg.quantized:
+        per_vector += 4                 # one f32 scale per (token, head)
     return (2 * model_cfg.num_layers * cache_cfg.block_size
-            * model_cfg.num_kv_heads * model_cfg.head_dim * itemsize)
+            * model_cfg.num_kv_heads * per_vector)
 
 
 def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
@@ -56,11 +68,20 @@ def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
     shape = (cache_cfg.num_blocks, cache_cfg.block_size,
              model_cfg.num_kv_heads, model_cfg.head_dim)
     dtype = jnp.dtype(cache_cfg.dtype)
+    scale_shape = shape[:3]             # one scale per (block, pos, head)
 
-    def zeros(sh):
+    def zeros(sh, shape=shape, dtype=dtype):
         if sh is not None:
             return jnp.zeros(shape, dtype, device=sh)
         return jnp.zeros(shape, dtype)
+
+    def scale_sharding(sh):
+        """Scale arrays drop the head_dim axis; reuse the KV sharding's
+        first three axes so scales co-locate with their pages under tp."""
+        if sh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(sh.mesh, PartitionSpec(*sh.spec[:3]))
 
     cache = []
     for li in range(model_cfg.num_layers):
@@ -70,5 +91,11 @@ def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
             k_sh, v_sh = shardings[li]["k"], shardings[li]["v"]
         else:
             k_sh = v_sh = shardings
-        cache.append({"k": zeros(k_sh), "v": zeros(v_sh)})
+        entry = {"k": zeros(k_sh), "v": zeros(v_sh)}
+        if cache_cfg.quantized:
+            entry["ks"] = zeros(scale_sharding(k_sh), scale_shape,
+                                jnp.float32)
+            entry["vs"] = zeros(scale_sharding(v_sh), scale_shape,
+                                jnp.float32)
+        cache.append(entry)
     return cache
