@@ -144,3 +144,27 @@ def test_disagg_zombie_only_window_drains():
         "disagg engine failed to drain a zombie-only pending window")
     assert disagg.decode._pending_window is None
     assert disagg.decode.block_manager.num_seqs() == 0
+
+
+def test_insert_rejects_kv_format_mismatch():
+    """An int8 pool's pages must not scatter into a bf16 pool (raw codes
+    would masquerade as values, scales silently dropped) — the mismatch is
+    a loud ValueError instead."""
+    import dataclasses
+
+    import pytest
+
+    from tpuserve.models.config import get_model_config
+    from tpuserve.parallel.disagg import extract_seq_kv, insert_seq_kv
+    from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
+
+    cfg = dataclasses.replace(get_model_config("tiny-qwen3"),
+                              dtype="float32")
+    ccfg = CacheConfig(block_size=4, num_blocks=16, max_blocks_per_seq=8)
+    int8_cache = create_kv_cache(cfg, dataclasses.replace(ccfg, dtype="int8"))
+    fp_cache = create_kv_cache(cfg, ccfg)
+    pages, int8_cache = extract_seq_kv(int8_cache, [1, 2])
+    with pytest.raises(ValueError, match="mismatch"):
+        insert_seq_kv(fp_cache, pages, [3, 4])
+    # matching formats round-trip fine
+    int8_cache = insert_seq_kv(int8_cache, pages, [5, 6])

@@ -102,3 +102,21 @@ def test_validator_rejects_bad_probe_port(base_objs):
     c["readinessProbe"] = {"httpGet": {"path": "/healthz", "port": "nope"}}
     with pytest.raises(ManifestError, match="nope"):
         validate_manifest(dep)
+
+
+def test_engine_perf_knobs_reach_container_args():
+    """DeployConfig's engine performance knobs must land in the engine
+    container command line — a cluster that can't express them ships the
+    slow defaults."""
+    cfg = load_config(preset="qwen3-0.6b-v5e4", quantization="int8",
+                      kv_cache_dtype="int8", speculative_k=4, multi_step=16)
+    objs = manifests.serving_manifests(cfg)
+    eng = next(o for o in objs if o["kind"] == "Deployment"
+               and o["metadata"]["name"] == "tpuserve-engine")
+    cmd = eng["spec"]["template"]["spec"]["containers"][0]["command"]
+    joined = " ".join(cmd)
+    assert "--quantization int8" in joined
+    assert "--kv-cache-dtype int8" in joined
+    assert "--speculative-k 4" in joined
+    assert "--multi-step 16" in joined
+    validate_all(objs)

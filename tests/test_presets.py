@@ -77,3 +77,16 @@ def test_multihost_protocol_degenerates_single_process():
     assert outs and outs[0].output_token_ids
     coord.stop_followers()          # no-op single-process
     multihost.follower_loop(eng)    # returns immediately
+
+
+def test_engine_knob_validation():
+    """Values the server's argparse would reject must fail at config load,
+    not as an in-cluster CrashLoopBackOff."""
+    import pytest
+
+    from tpuserve.provision.config import load_config
+
+    for bad in ({"kv_cache_dtype": "fp8"}, {"quantization": "int4"},
+                {"speculative_k": -1}, {"multi_step": 0}):
+        with pytest.raises(ValueError):
+            load_config(preset="cpu-smoke", **bad)

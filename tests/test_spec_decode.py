@@ -121,3 +121,39 @@ def test_spec_composed_with_pipelined_windows(cfg):
         assert a.output_token_ids == b.output_token_ids
     assert eng._pending_window is None
     assert eng.block_manager.num_seqs() == 0
+
+
+def test_adaptive_governor_pauses_on_low_acceptance(cfg):
+    """A workload whose drafts never verify pauses the spec path after the
+    rolling window fills, and resumes probing after the pause expires
+    (SpecConfig.adaptive — the acceptance rate decides, not the config)."""
+    spec = SpecConfig(num_draft_tokens=4, min_batch_coverage=0.0,
+                      min_acceptance=0.9,       # force: random text loses
+                      adaptive_window_proposed=8, adaptive_pause_steps=6)
+    eng = _engine(cfg, spec)
+    # repetitive PROMPTS make the proposer fire; with random weights the
+    # model's continuation rarely matches, so acceptance stays low and the
+    # 0.9 bar guarantees a pause
+    prompts = [[1, 2, 3, 4] * 6, [7, 8] * 10]
+    p = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    eng.generate(prompts, p)
+    assert eng.stats.spec_pauses >= 1
+    # while paused, decode steps advanced without spec steps
+    assert eng.stats.num_decode_steps > eng.stats.spec_steps
+    # outputs stay correct: identical to the plain engine
+    plain = _engine(cfg, None).generate(prompts, p)
+    again = _engine(cfg, spec).generate(prompts, p)
+    for a, b in zip(plain, again):
+        assert a.output_token_ids == b.output_token_ids
+
+
+def test_adaptive_governor_keeps_winning_spec_active(cfg):
+    """High-acceptance workloads never pause (governor is not a tax)."""
+    spec = SpecConfig(num_draft_tokens=2, min_acceptance=0.01,
+                      adaptive_window_proposed=4, adaptive_pause_steps=1000)
+    eng = _engine(cfg, spec)
+    prompts = [[1, 2, 3, 4] * 6]
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    eng.generate(prompts, p)
+    assert eng.stats.spec_steps > 0
+    assert eng.stats.spec_pauses == 0

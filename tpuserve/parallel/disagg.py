@@ -68,7 +68,27 @@ def insert_seq_kv(cache: list[dict], seq_kv: list[dict],
                   blocks: Sequence[int], device=None) -> list[dict]:
     """Scatter transferred pages into the target cache's allocated blocks —
     an in-place donated update.  ``device``: target device/sharding for the
-    transfer hop (rides ICI on TPU; no host round-trip)."""
+    transfer hop (rides ICI on TPU; no host round-trip).
+
+    Raises ``ValueError`` on a cache-format mismatch between pools: an
+    int8 prefill pool handing pages to a bf16 decode pool (or vice versa)
+    would otherwise scatter raw quantization codes as values and silently
+    drop the scales — corrupted KV with no error anywhere."""
+    if seq_kv and cache:
+        src_keys, dst_keys = set(seq_kv[0]), set(cache[0])
+        if src_keys != dst_keys:
+            raise ValueError(
+                f"KV cache format mismatch between pools: transferred pages "
+                f"carry {sorted(src_keys)} but this pool stores "
+                f"{sorted(dst_keys)} — both pools must use the same "
+                "--kv-cache-dtype")
+        src_dt = jnp.asarray(seq_kv[0]["k"]).dtype
+        dst_dt = cache[0]["k"].dtype
+        if (src_dt == jnp.int8) != (dst_dt == jnp.int8):
+            raise ValueError(
+                f"KV cache dtype mismatch between pools: transferred pages "
+                f"are {src_dt}, this pool stores {dst_dt} — both pools "
+                "must use the same --kv-cache-dtype")
     idx = jnp.asarray(_pad_blocks(blocks), jnp.int32)
     if device is not None:
         seq_kv = jax.device_put(seq_kv, device)

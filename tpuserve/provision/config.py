@@ -43,6 +43,13 @@ class DeployConfig:
     disagg_cross_pod: bool = False
     prefill_replicas: int = 1              # cross-pod: prefill pool size
     decode_replicas: int = 1               # cross-pod: decode pool size
+    # Engine performance knobs, forwarded to `python -m tpuserve.server`:
+    # the deploy layer must be able to express every serving-perf feature
+    # the engine has, or clusters ship with the slow defaults.
+    quantization: Optional[str] = None     # "int8" weight-only quant
+    kv_cache_dtype: str = "bfloat16"       # "int8" = quantized KV cache
+    speculative_k: int = 0                 # n-gram speculative decoding
+    multi_step: Optional[int] = None       # fused decode window override
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
@@ -90,6 +97,20 @@ class DeployConfig:
                              "be >= 1")
         if self.gateway_replicas < 1:
             raise ValueError("gateway_replicas must be >= 1")
+        # Engine knobs are forwarded verbatim to the server's argparse:
+        # reject HERE what it would reject, or an invalid value passes the
+        # build-time manifest validation and only surfaces as an
+        # in-cluster CrashLoopBackOff.
+        if self.quantization not in (None, "int8"):
+            raise ValueError(f"quantization must be int8 or unset, "
+                             f"got {self.quantization!r}")
+        if self.kv_cache_dtype not in ("bfloat16", "float32", "int8"):
+            raise ValueError(f"kv_cache_dtype must be bfloat16/float32/"
+                             f"int8, got {self.kv_cache_dtype!r}")
+        if self.speculative_k < 0:
+            raise ValueError("speculative_k must be >= 0")
+        if self.multi_step is not None and self.multi_step < 1:
+            raise ValueError("multi_step must be >= 1 when set")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.

@@ -152,6 +152,14 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
             "--checkpoint-dir", f"/models/{cfg.model}",
             "--port", str(cfg.engine_port),
             "--tp", str(cfg.tensor_parallel)]
+    if cfg.quantization:
+        args += ["--quantization", cfg.quantization]
+    if cfg.kv_cache_dtype != "bfloat16":
+        args += ["--kv-cache-dtype", cfg.kv_cache_dtype]
+    if cfg.speculative_k:
+        args += ["--speculative-k", str(cfg.speculative_k)]
+    if cfg.multi_step is not None:
+        args += ["--multi-step", str(cfg.multi_step)]
     args += extra_args or []
     tpu_req = {TPU_RESOURCE: str(cfg.tensor_parallel)} \
         if cfg.provider == "gke" else {}

@@ -116,6 +116,7 @@ class EngineStats:
     spec_steps: int = 0
     spec_proposed: int = 0           # draft tokens offered to the verifier
     spec_accepted: int = 0           # draft tokens accepted
+    spec_pauses: int = 0             # adaptive governor pauses (spec.py)
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -224,6 +225,11 @@ class Engine:
         # fixed-shape step kinds only).
         self._spec = (config.speculative
                       if jax.process_count() == 1 else None)
+        # adaptive-speculation governor state (SpecConfig.adaptive): a
+        # rolling (proposed, accepted) window and the decode-step number
+        # at which a paused spec path may probe again
+        self._spec_window = [0, 0]
+        self._spec_resume_step = 0
         self._req_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._eos_ids = set(self.tokenizer.eos_token_ids)
@@ -360,6 +366,7 @@ class Engine:
         elif batch.kind == "prefill_chunk":
             outputs = self._run_prefill_chunk(batch)
         elif (self._spec is not None
+              and self.stats.num_decode_steps >= self._spec_resume_step
               and all(r.params.greedy and not r.params.needs_penalties
                       and not r.params.needs_logit_bias
                       and not (r.params.needs_min_tokens
@@ -844,17 +851,45 @@ class Engine:
         pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
+        step_proposed = step_accepted = 0
         for i, r in enumerate(reqs):
             emitted = spec_mod.accept_greedy(drafts[i], pred_h[i])
-            self.stats.spec_proposed += len(drafts[i])
-            self.stats.spec_accepted += len(emitted) - 1
+            step_proposed += len(drafts[i])
+            step_accepted += len(emitted) - 1
             self.block_manager.advance(r.request_id, len(emitted))
             for tok in emitted:
                 out = self._emit_one(r, tok)
                 outputs.append(out)
                 if out.finished:
                     break
+        self.stats.spec_proposed += step_proposed
+        self.stats.spec_accepted += step_accepted
+        self._spec_govern(step_proposed, step_accepted)
         return outputs
+
+    def _spec_govern(self, proposed: int, accepted: int) -> None:
+        """Adaptive speculation (SpecConfig.adaptive): accumulate a rolling
+        acceptance window; once it holds enough evidence, pause the spec
+        path when acceptance is below break-even and re-probe after
+        ``adaptive_pause_steps`` decode steps.  The acceptance rate — not a
+        config guess — decides whether speculation runs on this workload."""
+        cfg = self._spec
+        if cfg is None or not cfg.adaptive:
+            return
+        self._spec_window[0] += proposed
+        self._spec_window[1] += accepted
+        if self._spec_window[0] < cfg.adaptive_window_proposed:
+            return
+        acc = self._spec_window[1] / self._spec_window[0]
+        self._spec_window = [0, 0]
+        if acc < cfg.min_acceptance:
+            self._spec_resume_step = (self.stats.num_decode_steps
+                                      + cfg.adaptive_pause_steps)
+            self.stats.spec_pauses += 1
+            logger.info(
+                "speculation paused: rolling acceptance %.3f < %.3f; "
+                "re-probing after %d decode steps", acc, cfg.min_acceptance,
+                cfg.adaptive_pause_steps)
 
     def _flush_pending(self) -> list[RequestOutput]:
         """Read the in-flight decode step's tokens and run the host-side

@@ -33,6 +33,24 @@ class SpecConfig:
     # batch has a proposal — draft-less rows pay the full window cost to
     # emit one token
     min_batch_coverage: float = 0.5
+    # ---- adaptive governor (VERDICT r3 next #9: the acceptance rate, not
+    # a config default, should decide whether speculation runs) ----------
+    # Speculation is workload-dependent: prompt lookup shines on
+    # extractive/repetitive text and loses on free generation.  The
+    # governor measures acceptance ONLINE and pauses the spec path when it
+    # is a loss, re-probing later — so `speculative` can be enabled
+    # without knowing the workload in advance.
+    adaptive: bool = True
+    # pause when rolling acceptance drops below this.  Break-even is
+    # roughly (verify_cost/decode_cost - 1) / k ~= 0.08 at k=4; 0.15 adds
+    # margin for the host-side proposer cost.
+    min_acceptance: float = 0.15
+    # judge only after this many proposed tokens (a handful of cold steps
+    # must not condemn the workload)
+    adaptive_window_proposed: int = 256
+    # how long a pause lasts, in decode steps, before re-probing; the
+    # probe overhead is bounded by window/pause (~6% at defaults)
+    adaptive_pause_steps: int = 4096
 
 
 def ngram_propose(ids: list[int], k: int, max_ngram: int = 3,

@@ -81,6 +81,18 @@ class ServerMetrics:
         self.prefix_queries = counter(
             "tpuserve_prefix_cache_queries",
             "Prompt blocks looked up in the prefix cache")
+        self.spec_proposed = counter(
+            "tpuserve_spec_draft_tokens_proposed",
+            "Draft tokens offered to the speculative verifier (vLLM "
+            "spec_decode_num_draft_tokens analog)")
+        self.spec_accepted = counter(
+            "tpuserve_spec_draft_tokens_accepted",
+            "Draft tokens accepted by the verifier; divide by proposed "
+            "for the live acceptance rate")
+        self.spec_pauses = counter(
+            "tpuserve_spec_adaptive_pauses",
+            "Times the adaptive governor paused speculation for "
+            "below-break-even acceptance (runtime/spec.py)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -809,6 +809,11 @@ def main(argv=None):
                     help="fused decode window size — S decode+sample steps "
                          "per dispatch (default: auto — 32 on TPU, off on "
                          "CPU; 1 disables).  Tokens stream in bursts of S")
+    ap.add_argument("--kv-cache-dtype", default="bfloat16",
+                    choices=["bfloat16", "float32", "int8"],
+                    help="KV cache storage dtype; int8 quantizes on write "
+                         "(per-token, per-kv-head scales), halving KV read "
+                         "bandwidth and doubling cache capacity")
     ap.add_argument("--quantization", default=None, choices=["int8"],
                     help="weight-only quantization (int8 halves decode's "
                          "HBM weight traffic)")
@@ -838,7 +843,8 @@ def main(argv=None):
         model=args.model, checkpoint_dir=args.checkpoint_dir,
         cache=CacheConfig(block_size=args.block_size,
                           num_blocks=args.num_blocks,
-                          max_blocks_per_seq=args.max_blocks_per_seq),
+                          max_blocks_per_seq=args.max_blocks_per_seq,
+                          dtype=args.kv_cache_dtype),
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
         attn_impl=args.attn_impl, speculative=spec,
         multi_step=args.multi_step, pipeline_decode=args.pipeline,

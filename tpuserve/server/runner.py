@@ -284,6 +284,11 @@ class AsyncEngineRunner:
                 self.metrics.window_overrun,
                 sum(getattr(s, "window_overrun_tokens", 0)
                     for s in stats_objs))
+            for attr, metric in (("spec_proposed", self.metrics.spec_proposed),
+                                 ("spec_accepted", self.metrics.spec_accepted),
+                                 ("spec_pauses", self.metrics.spec_pauses)):
+                _advance_counter(
+                    metric, sum(getattr(s, attr, 0) for s in stats_objs))
 
     def _loop(self) -> None:
         logger.info("engine loop started")
