@@ -470,3 +470,35 @@ def test_int8_kv_long_prompt_chunked():
                        SamplingParams(max_tokens=6, temperature=0.0,
                                       ignore_eos=True))[0]
     assert len(out.output_token_ids) == 6
+
+
+def test_auto_num_blocks(monkeypatch):
+    """CacheConfig.num_blocks == 0 sizes the cache from device memory
+    minus actual weight bytes (vLLM gpu_memory_utilization analog);
+    int8-quantized weights buy a larger cache.  A small injected budget
+    (TPUSERVE_HBM_BYTES) keeps both sides below the block cap so the
+    quantized-vs-fp comparison actually discriminates."""
+    # tiny-qwen3 fp32 params ~= 430KB; 2 MiB leaves real but tight room
+    monkeypatch.setenv("TPUSERVE_HBM_BYTES", str(2 << 20))
+
+    def mk(quant=None, share=1.0):
+        return Engine(EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=0,
+                              max_blocks_per_seq=16),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2),
+            quantization=quant, hbm_share=share))
+    eng = mk()
+    n = eng.cache_cfg.num_blocks
+    assert 16 <= n < 1 << 17
+    assert eng.block_manager.num_blocks == n
+    # the auto-sized engine actually serves
+    out = eng.generate(["auto"], SamplingParams(max_tokens=4,
+                                                temperature=0.0,
+                                                ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 4
+    # quantized weights leave strictly more room below the cap
+    assert mk("int8").cache_cfg.num_blocks > n
+    # an engine sharing the chip budgets proportionally less
+    assert mk(share=0.5).cache_cfg.num_blocks < n

@@ -113,6 +113,17 @@ class DisaggregatedEngine:
 
     def __init__(self, prefill_config: EngineConfig, decode_config: EngineConfig,
                  decode_device=None, mesh=None):
+        import dataclasses as _dc
+        if decode_device is None:
+            # colocated: both engines live on the same chip — split the
+            # auto-sizing budget or each would claim ~all of HBM and the
+            # second cache allocation OOMs (cache.num_blocks == 0 path)
+            def _halved(cfg: EngineConfig) -> EngineConfig:
+                if cfg.cache.num_blocks == 0 and cfg.hbm_share == 1.0:
+                    return _dc.replace(cfg, hbm_share=0.5)
+                return cfg
+            prefill_config = _halved(prefill_config)
+            decode_config = _halved(decode_config)
         self.prefill = Engine(prefill_config, mesh=mesh)
         self.decode = Engine(decode_config, mesh=mesh)
         self.decode_device = decode_device

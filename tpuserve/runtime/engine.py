@@ -51,6 +51,11 @@ class EngineConfig:
     # quantize_params_int8).  None = full precision.
     quantization: Optional[str] = None
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    # Fraction of device memory this engine may budget when auto-sizing
+    # its cache (cache.num_blocks == 0).  The colocated disagg topology
+    # runs TWO engines on one chip — each gets 0.5 so they don't
+    # double-book the HBM.
+    hbm_share: float = 1.0
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     attn_impl: str = "auto"                   # "auto" | "reference" | "pallas"
     enable_prefix_caching: bool = True
@@ -178,6 +183,14 @@ class Engine:
             if "scale" not in params["embed"]:    # not already quantized
                 params = quantize_params_int8(params)
         self.params = params
+        if self.cache_cfg.num_blocks == 0:
+            # vLLM gpu_memory_utilization analog: size the KV cache to
+            # what the HBM budget leaves after the (possibly quantized)
+            # weights actually loaded
+            self.cache_cfg = dataclasses.replace(
+                self.cache_cfg, num_blocks=self._auto_num_blocks(mesh))
+            logger.info("auto-sized KV cache: %d blocks of %d tokens",
+                        self.cache_cfg.num_blocks, self.cache_cfg.block_size)
         if mesh is not None:
             # Tensor-parallel placement: GSPMD inserts the ICI collectives.
             from tpuserve.parallel.sharding import cache_shardings, shard_params
@@ -244,6 +257,54 @@ class Engine:
             self.cache_cfg.max_model_len,
             self.model_cfg.max_position_embeddings,
             (self.cache_cfg.num_blocks - 1) * self.cache_cfg.block_size)
+
+    def _auto_num_blocks(self, mesh) -> int:
+        """Size the paged KV cache to the device memory the weights left
+        free (CacheConfig.num_blocks == 0) — the vLLM
+        ``gpu_memory_utilization`` analog; the reference's deployed vLLM
+        sizes its cache the same way rather than taking a block count.
+
+        Uses the ACTUAL loaded parameter bytes (so int8-quantized weights
+        buy a proportionally larger cache).  Under a mesh, params and
+        cache both shard over the tp axis (replicated over dp), so the
+        per-device budget arithmetic cancels to: total blocks =
+        (limit*util - params/tp) * tp / bytes_per_block.
+
+        ``TPUSERVE_HBM_BYTES`` overrides the detected per-device memory —
+        for engines sharing a chip (the colocated disagg topology passes
+        a halved value via hbm_share) and for tests."""
+        import os
+
+        from tpuserve.runtime.kv_cache import num_blocks_for_budget
+        limit = None
+        env = os.environ.get("TPUSERVE_HBM_BYTES")
+        if env:
+            limit = int(env)
+        if not limit:
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+                limit = (stats.get("bytes_limit")
+                         or stats.get("bytes_reservable_limit"))
+            except Exception:
+                pass
+        if not limit:
+            # backends without memory stats (CPU tests, some PJRT
+            # plugins): assume a v5e-sized 16 GiB HBM on TPU, stay small
+            # elsewhere
+            limit = (16 << 30) if jax.default_backend() == "tpu" else (1 << 30)
+        limit = int(limit * self.config.hbm_share)
+        tp = 1
+        if mesh is not None:
+            from tpuserve.parallel.mesh import AXIS_TP
+            tp = mesh.shape.get(AXIS_TP, 1)
+        param_bytes = sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(self.params))
+        blocks = num_blocks_for_budget(
+            self.model_cfg, self.cache_cfg, limit * tp,
+            weight_bytes=param_bytes)
+        # cap bounds host-side block-manager state on huge-HBM backends
+        return min(blocks, 1 << 17)
 
     # ------------------------------------------------------------------
     # Request intake

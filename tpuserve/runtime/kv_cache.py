@@ -49,10 +49,16 @@ def bytes_per_block(model_cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
 
 
 def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
-                          hbm_bytes: int, utilization: float = 0.9) -> int:
+                          hbm_bytes: int, utilization: float = 0.9,
+                          weight_bytes: int | None = None) -> int:
     """How many KV blocks fit in ``hbm_bytes`` after weights, at the given
-    utilization fraction."""
-    weight_bytes = model_cfg.num_params * jnp.dtype(model_cfg.dtype).itemsize
+    utilization fraction.  ``weight_bytes``: the ACTUAL loaded parameter
+    bytes when known (int8-quantized weights buy a larger cache); defaults
+    to the config-derived estimate.  The single source of the cache-budget
+    formula (Engine._auto_num_blocks is the caller)."""
+    if weight_bytes is None:
+        weight_bytes = (model_cfg.num_params
+                        * jnp.dtype(model_cfg.dtype).itemsize)
     budget = int(hbm_bytes * utilization) - weight_bytes
     return max(budget // bytes_per_block(model_cfg, cache_cfg), 16)
 

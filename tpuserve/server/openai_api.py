@@ -782,7 +782,10 @@ def main(argv=None):
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--block-size", type=int, default=32)
-    ap.add_argument("--num-blocks", type=int, default=2048)
+    ap.add_argument("--num-blocks", type=int, default=2048,
+                    help="KV cache blocks; 0 auto-sizes to the device "
+                         "memory the weights leave free (vLLM "
+                         "gpu_memory_utilization analog)")
     ap.add_argument("--max-blocks-per-seq", type=int, default=64)
     ap.add_argument("--max-num-seqs", type=int, default=64)
     ap.add_argument("--attn-impl", default="auto")
