@@ -160,7 +160,7 @@ def _ensure_live_backend(retry: bool = True) -> None:
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
                   prefix_caching=False, multi_step=None, quantization=None,
-                  prefill_split=1, kv_quant=None):
+                  prefill_split=1, kv_quant=None, interleave=False):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -183,7 +183,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                             max_prefill_seqs=seqs_per_batch,
                             max_prefill_tokens=max(
                                 8192 // max(1, prefill_split),
-                                seqs_per_batch * prompt_len))
+                                seqs_per_batch * prompt_len),
+                            interleave_batched_prefill=interleave)
     spec = None
     if spec_k:
         from tpuserve.runtime.spec import SpecConfig
@@ -262,8 +263,17 @@ def _warm_plan(eng, batch, prompt_len):
     buckets = {next_power_of_2(per)}
     if batch % per:
         buckets.add(next_power_of_2(batch % per))
+    if cfg.interleave_batched_prefill:
+        # decode steps run BETWEEN admission batches at partial running
+        # sizes — warm the whole ladder or those shapes compile inside
+        # the timed region
+        decode = sorted({eng.scheduler.decode_bucket(n)
+                         for n in range(1, batch + 1)})
+    else:
+        # prefill-priority admits the whole burst before decode starts
+        decode = [eng.scheduler.decode_bucket(batch)]
     return dict(prefill_buckets=[(b, L) for b in sorted(buckets)],
-                decode_buckets=[eng.scheduler.decode_bucket(batch)])
+                decode_buckets=decode)
 
 
 def _warm(engine, batch, prompt_len, arrivals=False):
@@ -369,12 +379,11 @@ def _roofline(eng0, batch, prompt_len, gen_len, steps_s):
     how many tokens it emits (speculative verify emits several), and its
     queries share one read of each sequence's live context (mean over the
     run ~= prompt + gen/2)."""
-    import jax
+    from tpuserve.models.weights import param_nbytes
     from tpuserve.runtime.kv_cache import bytes_per_block
     mc = eng0.model_cfg
     cc = eng0.cache_cfg
-    weight_bytes = sum(getattr(l, "nbytes", 0)
-                       for l in jax.tree_util.tree_leaves(eng0.params))
+    weight_bytes = param_nbytes(eng0.params)
     kv_per_token = bytes_per_block(mc, cc) / cc.block_size
     avg_ctx = prompt_len + gen_len / 2
     weight_gbs = weight_bytes * steps_s / 1e9
@@ -474,6 +483,10 @@ def main(argv=None):
     ap.add_argument("--arrival-rate", type=float, default=16.0, metavar="R",
                     help="mean request arrival rate for --arrival poisson, "
                          "req/s (default 16)")
+    ap.add_argument("--interleave-prefill", action="store_true",
+                    help="run one decode step between prefill admission "
+                         "batches (bounds running streams' ITL during "
+                         "arrival bursts; trades tail-of-burst TTFT)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -531,7 +544,8 @@ def main(argv=None):
                            spec_k=args.spec, multi_step=args.multi_step,
                            quantization=args.quant,
                            prefill_split=args.prefill_split,
-                           kv_quant=args.kv_quant)
+                           kv_quant=args.kv_quant,
+                           interleave=args.interleave_prefill)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)

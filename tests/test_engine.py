@@ -478,15 +478,17 @@ def test_auto_num_blocks(monkeypatch):
     int8-quantized weights buy a larger cache.  A small injected budget
     (TPUSERVE_HBM_BYTES) keeps both sides below the block cap so the
     quantized-vs-fp comparison actually discriminates."""
-    # tiny-qwen3 fp32 params ~= 430KB; 2 MiB leaves real but tight room
-    monkeypatch.setenv("TPUSERVE_HBM_BYTES", str(2 << 20))
+    # A budget small enough that BOTH sizes land below the scheduler-
+    # addressable cap (32 x 17 blocks) — at the cap the quantized-vs-fp
+    # comparison would be vacuous.
+    monkeypatch.setenv("TPUSERVE_HBM_BYTES", str(512 << 10))
 
     def mk(quant=None, share=1.0):
         return Engine(EngineConfig(
             model="tiny-qwen3",
             cache=CacheConfig(block_size=4, num_blocks=0,
                               max_blocks_per_seq=16),
-            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+            scheduler=SchedulerConfig(max_num_seqs=32, min_prefill_bucket=8,
                                       min_decode_bucket=2),
             quantization=quant, hbm_share=share))
     eng = mk()
@@ -502,3 +504,40 @@ def test_auto_num_blocks(monkeypatch):
     assert mk("int8").cache_cfg.num_blocks > n
     # an engine sharing the chip budgets proportionally less
     assert mk(share=0.5).cache_cfg.num_blocks < n
+
+
+def test_int8_kv_composes_with_multistep_and_spec():
+    """The TPU capture runs kv-int8 under fused multi-step windows (and
+    spec4 may compose too): the scanned decode body must quantize-write and
+    dequantize-read the int8 cache identically to single-step decode."""
+    def mk(multi_step=None, spec=None):
+        from tpuserve.runtime.spec import SpecConfig
+        return Engine(EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=64,
+                              max_blocks_per_seq=16, dtype="int8"),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2),
+            multi_step=multi_step, pipeline_decode=False,
+            speculative=SpecConfig(num_draft_tokens=spec) if spec else None))
+    prompts = [[1, 2, 3, 4] * 4, [9, 8, 7, 6, 5]]
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    base = mk().generate(prompts, p)
+    multi = mk(multi_step=4).generate(prompts, p)
+    spec = mk(spec=3).generate(prompts, p)
+    for a, b, c in zip(base, multi, spec):
+        assert a.output_token_ids == b.output_token_ids
+        assert a.output_token_ids == c.output_token_ids
+
+
+def test_auto_num_blocks_rejects_overcommitted_weights(monkeypatch):
+    """Weights that don't fit the budget fail LOUDLY at boot, not as a
+    mysterious 480-token max_seq_len with constant preemption."""
+    monkeypatch.setenv("TPUSERVE_HBM_BYTES", str(64 << 10))   # 64 KiB
+    with pytest.raises(ValueError, match="exceed the memory budget"):
+        Engine(EngineConfig(
+            model="tiny-qwen3",
+            cache=CacheConfig(block_size=4, num_blocks=0,
+                              max_blocks_per_seq=16),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2)))

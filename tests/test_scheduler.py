@@ -115,3 +115,42 @@ def test_finish_frees_blocks():
     s.finish(r)
     assert bm.num_free_blocks == free_before + 2
     assert s.num_running == 0
+
+
+def test_interleave_batched_prefill():
+    """With interleave_batched_prefill, running streams get a decode step
+    between prefill admission batches (bounded ITL under arrival bursts);
+    without it, prefill-priority drains the whole queue first."""
+    from tpuserve.runtime.block_manager import BlockManager
+    from tpuserve.runtime.request import Request, SamplingParams
+    from tpuserve.runtime.scheduler import Scheduler, SchedulerConfig
+
+    def mk(interleave):
+        bm = BlockManager(num_blocks=64, block_size=4,
+                          enable_prefix_caching=False)
+        sched = Scheduler(SchedulerConfig(
+            max_num_seqs=8, max_prefill_seqs=1, min_prefill_bucket=4,
+            min_decode_bucket=2,
+            interleave_batched_prefill=interleave), bm, max_model_len=64)
+        return sched
+
+    def run_kinds(sched):
+        # one running stream + two waiting prompts
+        running = Request(request_id="r0", prompt_token_ids=[1, 2, 3],
+                          params=SamplingParams())
+        sched.mark_running([running])
+        for i in range(2):
+            sched.add(Request(request_id=f"w{i}",
+                              prompt_token_ids=[1, 2, 3],
+                              params=SamplingParams()))
+        kinds = []
+        for _ in range(4):
+            b = sched.schedule()
+            assert b is not None
+            kinds.append(b.kind)
+            if b.kind.startswith("prefill"):
+                sched.mark_running(b.requests)
+        return kinds
+
+    assert run_kinds(mk(False)) == ["prefill", "prefill", "decode", "decode"]
+    assert run_kinds(mk(True)) == ["prefill", "decode", "prefill", "decode"]

@@ -43,7 +43,11 @@ def test_models_endpoint(server):
     status, body = _get(server + "/v1/models")
     assert status == 200
     assert body["object"] == "list"
-    assert body["data"][0]["id"] == "tiny-qwen3"
+    m = body["data"][0]
+    assert m["id"] == "tiny-qwen3"
+    # vLLM-style metadata: clients budget prompts against max_model_len
+    assert m["max_model_len"] > 0
+    assert m["kv_cache_dtype"] in ("bfloat16", "float32", "int8")
 
 
 def test_health_ready(server):

@@ -62,6 +62,8 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("single-request", ["--batch", "1", "--repeat", "5"], {}),
     ("poisson16", ["--arrival", "poisson", "--arrival-rate", "16"], {}),
     ("poisson32", ["--arrival", "poisson", "--arrival-rate", "32"], {}),
+    ("poisson16-interleave", ["--arrival", "poisson", "--arrival-rate", "16",
+                              "--interleave-prefill"], {}),
     # HBM-roofline headroom probe (VERDICT r3 weak #4: 4,210 tok/s moves
     # ~80 GB/s of an 819 GB/s pipe — int8 halves weight bytes and bigger
     # batches amortize them; these rows answer how much of the 2x+ is real)

@@ -26,6 +26,15 @@ from tpuserve.models.config import ModelConfig
 Params = Any
 
 
+def param_nbytes(params) -> int:
+    """Total bytes of a parameter pytree as actually materialized —
+    quantized trees count their int8 values + scales, not the fp
+    estimate.  The one byte-count used by both the KV-cache auto-sizer
+    (Engine._auto_num_blocks) and the bench roofline (bench.py)."""
+    return sum(getattr(leaf, "nbytes", 0)
+               for leaf in jax.tree_util.tree_leaves(params))
+
+
 def param_dtype(cfg: ModelConfig):
     return jnp.dtype(cfg.dtype)
 

@@ -297,14 +297,18 @@ class Engine:
         if mesh is not None:
             from tpuserve.parallel.mesh import AXIS_TP
             tp = mesh.shape.get(AXIS_TP, 1)
-        param_bytes = sum(
-            getattr(leaf, "nbytes", 0)
-            for leaf in jax.tree_util.tree_leaves(self.params))
+        from tpuserve.models.weights import param_nbytes
+        param_bytes = param_nbytes(self.params)
         blocks = num_blocks_for_budget(
             self.model_cfg, self.cache_cfg, limit * tp,
             weight_bytes=param_bytes)
-        # cap bounds host-side block-manager state on huge-HBM backends
-        return min(blocks, 1 << 17)
+        # cap at what the scheduler can ever address (+1 decode-headroom
+        # block per sequence) — HBM past that is pure waste — and bound
+        # host-side block-manager state on huge-HBM backends
+        sched = self.config.scheduler
+        addressable = sched.max_num_seqs * (self.cache_cfg.max_blocks_per_seq
+                                            + 1)
+        return min(blocks, addressable, 1 << 17)
 
     # ------------------------------------------------------------------
     # Request intake

@@ -60,6 +60,17 @@ def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
         weight_bytes = (model_cfg.num_params
                         * jnp.dtype(model_cfg.dtype).itemsize)
     budget = int(hbm_bytes * utilization) - weight_bytes
+    if budget <= 0:
+        # silently clamping to the 16-block floor here would boot an
+        # engine whose real problem is "the model does not fit" but whose
+        # visible symptom is a ~500-token max_seq_len and constant
+        # preemption — fail loudly instead
+        raise ValueError(
+            f"model weights ({weight_bytes / 2**30:.2f} GiB) exceed the "
+            f"memory budget ({hbm_bytes / 2**30:.2f} GiB x {utilization} "
+            "utilization) — no room for a KV cache; use a bigger "
+            "device/share, quantize the weights, or set num_blocks "
+            "explicitly")
     return max(budget // bytes_per_block(model_cfg, cache_cfg), 16)
 
 

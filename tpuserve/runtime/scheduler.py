@@ -31,6 +31,14 @@ class SchedulerConfig:
     # against the cache (ONE compiled shape instead of a giant per-length
     # bucket; bounds prefill activation memory for long contexts).
     prefill_chunk_size: int = 2048
+    # Also run one decode step after every BATCHED prefill (not just
+    # chunked ones): under sustained arrivals, strict prefill-priority
+    # stalls every running stream for the whole admission burst — this
+    # bounds their inter-token latency at the cost of slightly later
+    # admission for the tail of the burst.  Off by default: the
+    # worst-case-burst benchmark favors draining admissions first; flip
+    # on for latency-sensitive serving.
+    interleave_batched_prefill: bool = False
 
 
 @dataclasses.dataclass
@@ -120,7 +128,9 @@ class Scheduler:
                 padded_batch=self.decode_bucket(len(self.running)))
         batch = self._schedule_prefill()
         if batch is not None:
-            self._interleave_decode = batch.kind == "prefill_chunk"
+            self._interleave_decode = (
+                batch.kind == "prefill_chunk"
+                or self.cfg.interleave_batched_prefill)
             return batch
         if self.running:
             return ScheduledBatch(

@@ -304,9 +304,22 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         ctx = self.ctx
         if self.path == "/v1/models":
+            # max_model_len like vLLM's /v1/models, so clients can budget
+            # prompts without a /tokenize round-trip; engine config
+            # metadata for operators diagnosing a pod.  Disagg wrappers
+            # report the MIN over both pools — intake enforces the decode
+            # pool's limit, and advertising the larger prefill budget
+            # would 4xx prompts the endpoint called fine.
+            engines = [e for e in (getattr(ctx.engine, "prefill", None),
+                                   getattr(ctx.engine, "decode", None))
+                       if e is not None] or [ctx.engine]
+            eng = engines[0]
             self._json(200, {"object": "list", "data": [{
                 "id": ctx.model_name, "object": "model",
-                "created": int(time.time()), "owned_by": "tpuserve"}]})
+                "created": int(time.time()), "owned_by": "tpuserve",
+                "max_model_len": min(e.max_seq_len for e in engines),
+                "quantization": eng.config.quantization,
+                "kv_cache_dtype": eng.cache_cfg.dtype}]})
         elif self.path == "/metrics":
             data = ctx.metrics.render()
             self.send_response(200)
