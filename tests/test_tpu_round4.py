@@ -1,0 +1,121 @@
+"""The round-4 capture runner's state machine (tools/tpu_round4.py): the
+single most important artifact of the round is the TPU capture, and its
+resume/refund logic must survive tunnel flaps without losing variants or
+looping forever.  All device work is mocked; this tests ONLY the control
+flow."""
+
+import importlib
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    import tpu_round4
+    mod = importlib.reload(tpu_round4)
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "r04.jsonl"))
+    monkeypatch.setattr(mod, "SWEEP_LOG", str(tmp_path / "sweep.jsonl"))
+    monkeypatch.setattr(mod, "ATTEMPTS", str(tmp_path / "attempts.json"))
+    # keep the test small: two engine variants, one serving row
+    monkeypatch.setattr(mod, "PRIORITY", ["base", "int8"])
+    monkeypatch.setattr(mod, "SERVING", [("serving-closed32", ["--clients", "32"])])
+    monkeypatch.setattr(mod, "append_markdown", lambda r: None)
+    return mod
+
+
+def _ok_row(name, backend="tpu"):
+    return {"metric": "decode_throughput", "value": 1000.0,
+            "backend": backend, "variant": name}
+
+
+def test_happy_path_records_everything(runner, monkeypatch):
+    monkeypatch.setattr(runner, "probe", lambda timeout_s=90: True)
+    calls = []
+
+    def fake_run(name, args, timeout, env=None, bench_path=None):
+        calls.append(name)
+        r = _ok_row(name)
+        if bench_path:
+            r["metric"] = "serving_latency"
+        return r
+
+    monkeypatch.setattr(runner, "run_variant", fake_run)
+    assert runner.main() == 0
+    assert calls == ["base", "int8", "serving-closed32"]
+    rows = [json.loads(l) for l in open(runner.LOG)]
+    assert {r["variant"] for r in rows} == {"base", "int8",
+                                            "serving-closed32"}
+    # every row also feeds the sweep log (bench.py best_tpu_result carry)
+    assert len(open(runner.SWEEP_LOG).readlines()) == 3
+
+
+def test_flap_refunds_attempt_and_resumes(runner, monkeypatch):
+    """A degraded result with the tunnel DOWN yields rc=2 without burning
+    the attempt; the next invocation (tunnel back) captures everything."""
+    state = {"up": True, "first": True}
+    monkeypatch.setattr(runner, "probe",
+                        lambda timeout_s=90: state["up"])
+
+    def flaky_run(name, args, timeout, env=None, bench_path=None):
+        if state["first"]:
+            state["first"] = False
+            state["up"] = False          # tunnel died mid-variant
+            return {**_ok_row(name, backend="cpu"), "degraded": "flap"}
+        r = _ok_row(name)
+        if bench_path:
+            r["metric"] = "serving_latency"
+        return r
+
+    monkeypatch.setattr(runner, "run_variant", flaky_run)
+    assert runner.main() == 2            # yielded to the watcher
+    assert runner.load_attempts().get("base", 0) == 0   # refunded
+    state["up"] = True
+    assert runner.main() == 0
+    rows = [json.loads(l) for l in open(runner.LOG)]
+    assert {r["variant"] for r in rows} == {"base", "int8",
+                                            "serving-closed32"}
+
+
+def test_deterministic_failure_exhausts_attempts(runner, monkeypatch):
+    """A variant that fails on a LIVE tunnel burns attempts and is skipped
+    after MAX_ATTEMPTS — no infinite loop — while other variants record."""
+    monkeypatch.setattr(runner, "probe", lambda timeout_s=90: True)
+
+    def crashy_run(name, args, timeout, env=None, bench_path=None):
+        if name == "base":
+            return {**_ok_row(name, backend="cpu"),
+                    "degraded": "OOM mid-flight"}
+        r = _ok_row(name)
+        if bench_path:
+            r["metric"] = "serving_latency"
+        return r
+
+    monkeypatch.setattr(runner, "run_variant", crashy_run)
+    rcs = [runner.main() for _ in range(3)]
+    assert rcs[-1] == 0
+    assert runner.load_attempts()["base"] >= runner.MAX_ATTEMPTS
+    rows = [json.loads(l) for l in open(runner.LOG)]
+    names = {r["variant"] for r in rows}
+    assert "base" not in names and "int8" in names
+
+
+def test_already_recorded_variants_skipped(runner, monkeypatch):
+    monkeypatch.setattr(runner, "probe", lambda timeout_s=90: True)
+    with open(runner.LOG, "w") as f:
+        f.write(json.dumps(_ok_row("base")) + "\n")
+    calls = []
+
+    def fake_run(name, args, timeout, env=None, bench_path=None):
+        calls.append(name)
+        r = _ok_row(name)
+        if bench_path:
+            r["metric"] = "serving_latency"
+        return r
+
+    monkeypatch.setattr(runner, "run_variant", fake_run)
+    assert runner.main() == 0
+    assert "base" not in calls

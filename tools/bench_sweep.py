@@ -100,7 +100,7 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("cold-cache", [], {"JAX_COMPILATION_CACHE_DIR": "/tmp/tpuserve-coldcache"}),
 ]
 
-QUICK = ["base", "multistep1", "int8", "disagg"]
+QUICK = ["base", "multistep1", "int8", "kv-int8", "poisson16", "disagg"]
 
 
 def cpu_env() -> dict[str, str]:
