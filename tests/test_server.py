@@ -113,6 +113,37 @@ def test_logprobs_in_response(server):
     assert all(len(t) == 2 for t in lp["top_logprobs"])
 
 
+def test_logprobs_in_stream(server):
+    """Streaming completions carry each chunk's incremental logprobs —
+    they were previously computed but silently dropped on this path."""
+    status, raw = _post(server + "/v1/completions", {
+        "prompt": "slp", "max_tokens": 3, "temperature": 0, "logprobs": 2,
+        "stream": True, "ignore_eos": True}, raw=True)
+    assert status == 200
+    chunks = [json.loads(l[6:]) for l in raw.decode().splitlines()
+              if l.startswith("data: ") and not l.endswith("[DONE]")]
+    entries = [lp for c in chunks
+               for lp in c["choices"][0].get("logprobs", {})
+               .get("token_logprobs", [])]
+    assert len(entries) == 3
+    assert all(e <= 0.0 for e in entries)
+
+
+def test_logprobs_in_chat(server):
+    """Chat logprobs use the OpenAI chat shape (content entries with
+    decoded token strings + top alternatives)."""
+    status, body = _post(server + "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}], "max_tokens": 3,
+        "temperature": 0, "logprobs": True, "top_logprobs": 2,
+        "ignore_eos": True})
+    assert status == 200
+    content = body["choices"][0]["logprobs"]["content"]
+    assert len(content) == 3
+    for e in content:
+        assert e["logprob"] <= 0.0
+        assert len(e["top_logprobs"]) == 2
+
+
 def test_metrics_exposition(server):
     with urllib.request.urlopen(server + "/metrics", timeout=30) as r:
         text = r.read().decode()

@@ -6,11 +6,12 @@ flow."""
 
 import importlib
 import json
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "tools")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 
 @pytest.fixture()

@@ -43,6 +43,15 @@ class ByteTokenizer:
                      if self._OFFSET <= i < self._OFFSET + 256)
         return data.decode("utf-8", errors="replace")
 
+    def id_to_token(self, token_id: int) -> str:
+        """Vocabulary-level token string (logprob reporting): preserves
+        special tokens / markers that plain decode() strips."""
+        if token_id == self.bos_id:
+            return "<bos>"
+        if token_id == self.eos_id:
+            return "<eos>"
+        return self.decode([token_id])
+
 
 class HFTokenizer:
     """Thin wrapper over a local transformers tokenizer."""
@@ -70,6 +79,13 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
+
+    def id_to_token(self, token_id: int) -> str:
+        """Vocabulary-level token string (logprob reporting): keeps
+        special tokens and SentencePiece space markers that per-id
+        decode() would strip — clients align these to text offsets."""
+        tok = self._tok.convert_ids_to_tokens(int(token_id))
+        return tok if tok is not None else ""
 
     def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
         try:

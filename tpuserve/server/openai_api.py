@@ -546,6 +546,28 @@ class _Handler(BaseHTTPRequestHandler):
             raise
         return submits
 
+    @staticmethod
+    def _completions_logprobs(entries) -> dict:
+        """OpenAI completions logprobs shape (parallel lists)."""
+        return {
+            "token_logprobs": [e["logprob"] for e in entries],
+            "tokens": [e["token_id"] for e in entries],
+            "top_logprobs": [dict(e["top"]) for e in entries],
+        }
+
+    def _chat_logprobs(self, entries) -> dict:
+        """OpenAI chat logprobs shape: per-token content entries with
+        vocabulary-level token strings (id_to_token keeps special tokens
+        and SentencePiece markers that plain decode strips) and top
+        alternatives."""
+        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
+        tok = eng.tokenizer.id_to_token
+        return {"content": [
+            {"token": tok(e["token_id"]), "logprob": e["logprob"],
+             "top_logprobs": [{"token": tok(t), "logprob": lp}
+                              for t, lp in e["top"]]}
+            for e in entries]}
+
     def _echo_text(self, body, chat, kwargs):
         """OpenAI completions `echo`: the prompt text to prepend, or None."""
         if chat or not body.get("echo"):
@@ -608,15 +630,14 @@ class _Handler(BaseHTTPRequestHandler):
                 choice = {"index": idx,
                           "message": {"role": "assistant", "content": text},
                           "finish_reason": finish_reason}
+                if logprob_entries:
+                    choice["logprobs"] = self._chat_logprobs(logprob_entries)
             else:
                 choice = {"index": idx, "text": text,
                           "finish_reason": finish_reason}
                 if logprob_entries:
-                    choice["logprobs"] = {
-                        "token_logprobs": [e["logprob"] for e in logprob_entries],
-                        "tokens": [e["token_id"] for e in logprob_entries],
-                        "top_logprobs": [dict(e["top"]) for e in logprob_entries],
-                    }
+                    choice["logprobs"] = self._completions_logprobs(
+                        logprob_entries)
             choices.append(choice)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         usage = {
@@ -711,6 +732,7 @@ class _Handler(BaseHTTPRequestHandler):
             prompt_toks = 0
             completion_toks = 0
             errored = False
+            lp_cursor = [0] * n        # per-choice logprob emission offset
             live = n
             while live:
                 try:
@@ -743,6 +765,19 @@ class _Handler(BaseHTTPRequestHandler):
                     choice = {"index": idx, "text": item.new_text,
                               "finish_reason": finish}
                     obj = "text_completion"
+                if params.logprobs is not None and item.new_token_ids:
+                    # incremental logprobs: this chunk's slice of the
+                    # request's accumulated entries (append-only, so the
+                    # cross-thread read is safe)
+                    req = ctx.engine.requests.get(submits[idx][0])
+                    if req is not None:
+                        lo = lp_cursor[idx]
+                        entries = req.logprobs[lo:lo + len(item.new_token_ids)]
+                        lp_cursor[idx] = lo + len(entries)
+                        if entries:
+                            choice["logprobs"] = (
+                                self._chat_logprobs(entries) if chat
+                                else self._completions_logprobs(entries))
                 if ret_ids:
                     choice["token_ids"] = list(item.new_token_ids)
                 completion_toks += len(item.new_token_ids)
