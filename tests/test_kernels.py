@@ -179,6 +179,32 @@ def test_paged_decode_vmem_clamp_end_to_end(caplog):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_paged_window_vmem_clamp(caplog):
+    """The window kernel clamps oversized knob/shape combinations against
+    the same VMEM budget as the decode kernel (wide-Hkv models blow the
+    default group size), and the clamped kernel stays correct."""
+    import logging
+
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    B, C, Hq, Hkv, D, page, nb, mp = 1, 8, 4, 2, 128, 16, 256, 256
+    rng = np.random.default_rng(31)
+    q = jnp.asarray(rng.standard_normal((B, C, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(0, nb, (B, mp)), jnp.int32)
+    ctx = jnp.asarray([40], jnp.int32)
+    chunk = jnp.asarray([C], jnp.int32)
+    ref = ref_ops.chunked_prefill_attention(q, kc, vc, bt, ctx, chunk,
+                                            D ** -0.5)
+    # 256-page groups of fp32 KV = ~16.8 MiB of double-buffered scratch:
+    # over the 12 MiB budget, must clamp
+    with caplog.at_level(logging.WARNING, "tpuserve.ops.paged_attention"):
+        out = paged_window_attention(q, kc, vc, bt, ctx, chunk, D ** -0.5,
+                                     interpret=True, pages_per_group=256)
+    assert any("clamped" in r.message for r in caplog.records)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_paged_decode_single_token_sequence():
     # seq_len == 1: only the freshly written token is attended to.
     D = 16

@@ -191,6 +191,16 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     blk_q = min(blk_q, C)
     pages_g = pages_per_group or max(1, -(-TARGET_GROUP_ROWS // page_size))
     pages_g = min(pages_g, max_pages)
+    # Same VMEM-budget clamp as the decode kernel: wide-Hkv models (phi3:
+    # 32 kv heads) push the double-buffered KV scratch past the budget at
+    # the default group size — clamp with a log line instead of handing
+    # the compiler an oversized allocation.  blk_q plays seqs_pp's role
+    # in the q/out-block term (it IS the q rows per program).
+    from tpuserve.ops.pallas_paged_attention import _clamp_to_vmem_budget
+    pages_g, blk_q = _clamp_to_vmem_budget(
+        pages_g, blk_q, page_size, Hkv, D, k_cache.dtype.itemsize,
+        Hq, q.dtype.itemsize,
+        scale_itemsize=4 if k_scale is not None else 0)
 
     quantized = k_scale is not None
     kernel = functools.partial(
