@@ -274,10 +274,77 @@ PyTypeObject CoreType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// ---------------------------------------------------------------------------
+// ngram_propose: n-gram prompt-lookup draft proposal for speculative
+// decoding.  Exact port of tpuserve/runtime/spec.py:ngram_propose — the
+// proposer runs on the synchronous host hot path once per sequence per
+// spec step (a batch of 64 scans up to 64 x 1024 tokens between device
+// dispatches), which is worth native speed.
+// ---------------------------------------------------------------------------
+
+PyObject* py_ngram_propose(PyObject* /*self*/, PyObject* args,
+                           PyObject* kwds) {
+  PyObject* ids_list;
+  int k, max_ngram = 3, min_ngram = 1, max_lookback = 1024;
+  static const char* kwlist[] = {"ids", "k", "max_ngram", "min_ngram",
+                                 "max_lookback", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "Oi|iii",
+                                   const_cast<char**>(kwlist), &ids_list,
+                                   &k, &max_ngram, &min_ngram,
+                                   &max_lookback))
+    return nullptr;
+  if (!PyList_Check(ids_list)) {
+    PyErr_SetString(PyExc_TypeError, "expected a list of ints");
+    return nullptr;
+  }
+  // Convert only the trailing lookback window: the caller passes the FULL
+  // sequence (possibly tens of thousands of tokens) and converting it all
+  // would put the O(context) cost right back on the host hot path.
+  Py_ssize_t total = PyList_GET_SIZE(ids_list);
+  Py_ssize_t start_i = 0;
+  if (max_lookback > 0 && total > static_cast<Py_ssize_t>(max_lookback))
+    start_i = total - static_cast<Py_ssize_t>(max_lookback);
+  std::vector<int32_t> ids(static_cast<size_t>(total - start_i));
+  for (Py_ssize_t i = start_i; i < total; ++i) {
+    long val = PyLong_AsLong(PyList_GET_ITEM(ids_list, i));
+    if (val == -1 && PyErr_Occurred()) return nullptr;
+    ids[static_cast<size_t>(i - start_i)] = static_cast<int32_t>(val);
+  }
+  const int32_t* v = ids.data();
+  const int64_t L = static_cast<int64_t>(ids.size());
+  for (int n = max_ngram; n >= min_ngram; --n) {
+    if (L < n + 1) continue;
+    const int32_t* tail = v + (L - n);
+    // most recent occurrence strictly before the trailing one, with at
+    // least one continuation token available
+    for (int64_t j = L - n - 1; j >= 0; --j) {
+      bool match = true;
+      for (int t = 0; t < n; ++t) {
+        if (v[j + t] != tail[t]) { match = false; break; }
+      }
+      if (!match) continue;
+      int64_t cstart = j + n;
+      int64_t clen = L - cstart;
+      if (clen > k) clen = k;
+      if (clen <= 0) continue;
+      return list_from_blocks(v + cstart, clen);
+    }
+  }
+  return PyList_New(0);
+}
+
+PyMethodDef module_methods[] = {
+    {"ngram_propose", (PyCFunction)py_ngram_propose,
+     METH_VARARGS | METH_KEYWORDS,
+     "n-gram prompt-lookup draft proposal (native port of "
+     "runtime/spec.py:ngram_propose)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
 PyModuleDef module_def = {
     PyModuleDef_HEAD_INIT, "_tpuserve_native",
     "Native runtime components for tpuserve", -1,
-    nullptr, nullptr, nullptr, nullptr, nullptr,
+    module_methods, nullptr, nullptr, nullptr, nullptr,
 };
 
 }  // namespace

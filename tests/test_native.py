@@ -286,3 +286,41 @@ def test_reserve_advance_parity():
     assert py.append_slot("s") == cc.append_slot("s")
     py.free("s"); cc.free("s")
     assert py.num_free_blocks == cc.num_free_blocks == 16
+
+
+def test_native_ngram_propose_parity():
+    """The C++ proposer must match the pure-Python reference on a large
+    randomized corpus (it runs the spec hot path when available)."""
+    import numpy as np
+    import pytest
+
+    from tpuserve import native
+    from tpuserve.runtime.spec import _ngram_propose_py
+
+    if not native.native_available():
+        pytest.skip("native extension unavailable")
+    ext = native._load()
+    rng = np.random.default_rng(0)
+    for trial in range(300):
+        # small alphabets force n-gram repeats; vary every knob
+        vocab = int(rng.integers(2, 12))
+        n_tok = int(rng.integers(0, 200))
+        ids = rng.integers(0, vocab, size=n_tok).tolist()
+        k = int(rng.integers(1, 8))
+        max_n = int(rng.integers(1, 5))
+        min_n = int(rng.integers(1, max_n + 1))
+        lookback = int(rng.integers(1, 64))
+        expect = _ngram_propose_py(ids, k, max_n, min_n, lookback)
+        got = ext.ngram_propose(ids, k, max_n, min_n, lookback)
+        assert got == expect, (ids, k, max_n, min_n, lookback)
+
+
+def test_engine_spec_uses_native_proposer_when_available():
+    from tpuserve import native
+    from tpuserve.runtime import spec
+
+    spec._propose_impl = None                      # re-resolve
+    out = spec.ngram_propose([1, 2, 3, 9, 9, 1, 2, 3], 3)
+    assert out == [9, 9, 1]
+    if native.native_available():
+        assert spec._propose_impl is not spec._ngram_propose_py

@@ -53,14 +53,11 @@ class SpecConfig:
     adaptive_pause_steps: int = 4096
 
 
-def ngram_propose(ids: list[int], k: int, max_ngram: int = 3,
-                  min_ngram: int = 1, max_lookback: int = 1024) -> list[int]:
-    """Propose up to ``k`` draft tokens from the sequence's own history.
-
-    Finds the most recent occurrence of the trailing n-gram within the last
-    ``max_lookback`` tokens (longest n first) and returns the tokens that
-    followed it.
-    """
+def _ngram_propose_py(ids: list[int], k: int, max_ngram: int = 3,
+                      min_ngram: int = 1,
+                      max_lookback: int = 1024) -> list[int]:
+    """Pure-Python reference for :func:`ngram_propose` (the native port in
+    native/block_manager_ext.cc must match this exactly; parity-tested)."""
     if len(ids) > max_lookback:
         ids = ids[-max_lookback:]
     L = len(ids)
@@ -76,6 +73,39 @@ def ngram_propose(ids: list[int], k: int, max_ngram: int = 3,
                 if cont:
                     return cont
     return []
+
+
+def _resolve_propose():
+    """Prefer the C++ proposer: this scan runs on the synchronous host hot
+    path once per sequence per spec step, BETWEEN device dispatches —
+    batch 64 x 1024-token lookbacks in Python is real milliseconds that
+    the chip spends idle."""
+    try:
+        from tpuserve import native
+        if native.native_available():
+            ext = native._load()
+            if hasattr(ext, "ngram_propose"):
+                return ext.ngram_propose
+    except Exception:                            # pragma: no cover
+        pass
+    return _ngram_propose_py
+
+
+_propose_impl = None
+
+
+def ngram_propose(ids: list[int], k: int, max_ngram: int = 3,
+                  min_ngram: int = 1, max_lookback: int = 1024) -> list[int]:
+    """Propose up to ``k`` draft tokens from the sequence's own history.
+
+    Finds the most recent occurrence of the trailing n-gram within the last
+    ``max_lookback`` tokens (longest n first) and returns the tokens that
+    followed it.  Dispatches to the native (C++) scanner when the
+    extension is available; falls back to pure Python."""
+    global _propose_impl
+    if _propose_impl is None:
+        _propose_impl = _resolve_propose()
+    return _propose_impl(ids, k, max_ngram, min_ngram, max_lookback)
 
 
 def accept_greedy(draft: list[int], pred) -> list[int]:
