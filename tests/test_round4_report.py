@@ -1,0 +1,64 @@
+"""The post-capture analysis (tools/round4_report.py) must turn captured
+rows into the VERDICT-requested decisions even when the capture lands
+unattended."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import round4_report as rr
+
+
+def _rows():
+    return {
+        "base": {"variant": "base", "backend": "tpu", "value": 4100.0,
+                 "ttft_p50_ms": 180.0,
+                 "roofline": {"total_gb_s": 150.0, "v5e_hbm_fraction": 0.18}},
+        "poisson16": {"variant": "poisson16", "backend": "tpu",
+                      "value": 3900.0, "ttft_p50_ms": 95.0},
+        "spec4": {"variant": "spec4", "backend": "tpu", "value": 5000.0,
+                  "spec": {"acceptance": 0.55, "tokens_per_step": 2.4}},
+        "disagg": {"variant": "disagg", "backend": "tpu", "value": 4000.0,
+                   "disagg": {"decode_tok_s": 3300.0, "vs_colocated": 0.82,
+                              "kv_mb_transferred": 120.0,
+                              "transfer_s": 0.9}},
+        "serving-closed32": {"variant": "serving-closed32", "backend": "tpu",
+                             "throughput_tok_s": 3800.0,
+                             "ttft_ms": {"p50": 190.0},
+                             "itl_ms": {"p50": 8.0, "p99": 520.0}},
+        "serving-closed32-S8": {"variant": "serving-closed32-S8",
+                                "backend": "tpu",
+                                "throughput_tok_s": 3600.0,
+                                "ttft_ms": {"p50": 185.0},
+                                "itl_ms": {"p50": 7.0, "p99": 140.0}},
+    }
+
+
+def test_decisions_cover_every_verdict_question():
+    report, decisions = rr.build_report(_rows())
+    text = " ".join(decisions)
+    assert "TTFT: TARGET MET" in text           # poisson row meets 150ms
+    assert "Speculation" in text
+    assert "Disagg" in text and "0.82x" in text
+    assert "multi_step default: 8" in text      # S8 wins the ITL trade
+    assert "### Decisions" in report
+
+
+def test_ttft_not_met_branch():
+    rows = _rows()
+    rows["poisson16"]["ttft_p50_ms"] = 200.0
+    rows["base"]["ttft_p50_ms"] = 180.0
+    _, decisions = rr.build_report(rows)
+    assert any("NOT met" in d for d in decisions)
+
+
+def test_load_rows_filters_non_tpu(tmp_path):
+    p = tmp_path / "log.jsonl"
+    p.write_text(json.dumps({"variant": "base", "backend": "cpu",
+                             "value": 1.0}) + "\n"
+                 + json.dumps({"variant": "base", "backend": "tpu",
+                               "value": 2.0}) + "\n")
+    rows = rr.load_rows(str(p))
+    assert rows["base"]["value"] == 2.0
