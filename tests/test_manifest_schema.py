@@ -120,3 +120,17 @@ def test_engine_perf_knobs_reach_container_args():
     assert "--speculative-k 4" in joined
     assert "--multi-step 16" in joined
     validate_all(objs)
+
+
+def test_gateway_api_manifests_validate():
+    """The optional Gateway/HTTPRoute front (llm-d's discovered-first
+    topology) passes the vendored Gateway API schemas and routes to the
+    gateway Service."""
+    cfg = load_config(preset="qwen3-0.6b-v5e4")
+    objs = manifests.gateway_api_manifests(cfg)
+    assert [o["kind"] for o in objs] == ["Gateway", "HTTPRoute"]
+    validate_all(objs)
+    route = objs[1]
+    ref = route["spec"]["rules"][0]["backendRefs"][0]
+    assert ref["name"] == "tpuserve-gateway" and ref["port"] == 80
+    assert objs[0]["spec"]["gatewayClassName"] == cfg.gateway_class

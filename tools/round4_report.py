@@ -35,8 +35,9 @@ def load_rows(path):
                     r = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if str(r.get("backend", "")).startswith("tpu"):
-                    rows[r.get("variant")] = r   # last row per variant wins
+                if (str(r.get("backend", "")).startswith("tpu")
+                        and isinstance(r.get("variant"), str)):
+                    rows[r["variant"]] = r       # last row per variant wins
     except FileNotFoundError:
         pass
     return rows
@@ -106,7 +107,8 @@ def build_report(rows):
                   if n.startswith(("int8", "kv-int8", "batch"))
                   and isinstance(r.get("value"), (int, float))),
                  key=lambda r: r["value"], default=None)
-    if best_q is not None and base is not None:
+    if (best_q is not None and base is not None
+            and isinstance(base.get("value"), (int, float))):
         decisions.append(
             f"Quantization: best variant {best_q['variant']} = "
             f"{best_q['value']} tok/s "
@@ -122,7 +124,12 @@ def build_report(rows):
         s = spec["spec"]
         say(f"- spec4: {spec.get('value')} tok/s, acceptance "
             f"{s.get('acceptance')}, {s.get('tokens_per_step')} tok/step")
-        vs = (spec.get("value") / base["value"]) if base else None
+        vs = None
+        if (base is not None
+                and isinstance(base.get("value"), (int, float))
+                and isinstance(spec.get("value"), (int, float))
+                and base["value"] > 0):
+            vs = spec["value"] / base["value"]
         if s.get("acceptance", 0) >= 0.3 and vs and vs > 1.05:
             decisions.append(
                 f"Speculation: acceptance {s['acceptance']} and "
@@ -175,13 +182,16 @@ def build_report(rows):
                 f"{(r.get('itl_ms') or {}).get('p99')} ms")
     if s32 is not None:
         best_alt = None
+        s32_p99 = (s32.get("itl_ms") or {}).get("p99")
         for n, r in alts:
             if r is None:
                 continue
+            alt_p99 = (r.get("itl_ms") or {}).get("p99")
+            if s32_p99 is None or alt_p99 is None:
+                continue     # partial rows must not fabricate an ITL gain
             thr_cost = 1 - (r.get("throughput_tok_s", 0)
                             / max(s32.get("throughput_tok_s", 1), 1))
-            itl_gain = ((s32.get("itl_ms") or {}).get("p99", 0)
-                        - (r.get("itl_ms") or {}).get("p99", 0))
+            itl_gain = s32_p99 - alt_p99
             if thr_cost < 0.1 and itl_gain > 0:
                 best_alt = (n, r, thr_cost, itl_gain)
                 break

@@ -70,6 +70,9 @@ class DeployConfig:
     # 14-18).  Safe >1 since affinity is stateless rendezvous hashing —
     # every replica computes the same prefix->backend mapping.
     gateway_replicas: int = 2
+    # Gateway API class for the optional Gateway/HTTPRoute front (applied
+    # only when the cluster has the CRDs; GKE ships this class built in).
+    gateway_class: str = "gke-l7-regional-external-managed"
 
     # --- observability (otel-observability-setup.yaml:7-12 analog) --------
     monitoring_namespace: str = "monitoring"

@@ -369,6 +369,43 @@ def gateway_deployment(cfg: DeployConfig, backends: list[str]) -> dict:
     }
 
 
+def gateway_api_manifests(cfg: DeployConfig) -> list[dict]:
+    """Optional Gateway API front (gateway.networking.k8s.io/v1): the
+    llm-d stack fronts serving with a Gateway the smoke tests discover
+    FIRST (reference: llm-d-test.yaml:14-18).  Applied only when the
+    cluster has the Gateway API CRDs (provision/serving.py soft-applies,
+    like the ServiceMonitor); traffic routes to the tpuserve-gateway
+    Service, which load-balances the HA gateway replicas."""
+    return [
+        {
+            "apiVersion": "gateway.networking.k8s.io/v1", "kind": "Gateway",
+            "metadata": {"name": "tpuserve", "namespace": cfg.namespace,
+                         "labels": {"app": "tpuserve"}},
+            "spec": {
+                "gatewayClassName": cfg.gateway_class,
+                "listeners": [{"name": "http", "port": 80,
+                               "protocol": "HTTP"}],
+            },
+        },
+        {
+            "apiVersion": "gateway.networking.k8s.io/v1",
+            "kind": "HTTPRoute",
+            "metadata": {"name": "tpuserve-routes",
+                         "namespace": cfg.namespace,
+                         "labels": {"app": "tpuserve"}},
+            "spec": {
+                "parentRefs": [{"name": "tpuserve"}],
+                "rules": [{
+                    "matches": [{"path": {"type": "PathPrefix",
+                                          "value": "/"}}],
+                    "backendRefs": [{"name": "tpuserve-gateway",
+                                     "port": 80}],
+                }],
+            },
+        },
+    ]
+
+
 def gateway_service(cfg: DeployConfig) -> dict:
     return {
         "apiVersion": "v1", "kind": "Service",

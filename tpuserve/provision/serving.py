@@ -53,10 +53,36 @@ def deploy(cfg: DeployConfig, kube: KubeCtl) -> None:
                  "--ignore-not-found", check=False)
     objs = manifests.serving_manifests(cfg)
     kube.apply_manifest(manifests.render(*objs))
+    _apply_gateway_api(cfg, kube)
 
     _wait_download_job(cfg, kube)
     _wait_pods_ready(cfg, kube)
     _print_services(cfg, kube)
+
+
+def _apply_gateway_api(cfg: DeployConfig, kube: KubeCtl) -> None:
+    """Front the serving Service with a Gateway API Gateway + HTTPRoute
+    when the cluster has the CRDs (the llm-d topology the reference's
+    smoke test discovers FIRST, llm-d-test.yaml:14-18).  Soft like the
+    ServiceMonitor apply: a cluster without the Gateway API still serves
+    through the Service."""
+    if cfg.provider != "gke":
+        # the default gateway_class is GKE's; on local/kind a Gateway
+        # referencing a nonexistent class would sit unprogrammed forever
+        # as a dead first discovery hop
+        return
+    crd = kube.kubectl("get", "crd", "gateways.gateway.networking.k8s.io",
+                       check=False)
+    if not crd.ok:
+        logger.info("Gateway API CRDs absent; serving through the "
+                    "Service only")
+        return
+    res = kube.apply_manifest(
+        manifests.render(*manifests.gateway_api_manifests(cfg)),
+        check=False)
+    if not res.ok:
+        logger.warning("Gateway API apply failed (class %r?): %s",
+                       cfg.gateway_class, res.stderr.strip()[:500])
 
 
 def _wait_download_job(cfg: DeployConfig, kube: KubeCtl) -> None:
@@ -133,9 +159,21 @@ def _print_services(cfg: DeployConfig, kube: KubeCtl) -> None:
 
 
 def discover_gateway(cfg: DeployConfig, kube: KubeCtl) -> str:
-    """Gateway address discovery with the reference's three fallbacks
-    (llm-d-test.yaml:14-26): LoadBalancer ingress → Service clusterIP →
-    cluster-DNS name."""
+    """Gateway address discovery with the reference's fallback chain
+    (llm-d-test.yaml:14-26): Gateway CRD status address → LoadBalancer
+    ingress → Service clusterIP → cluster-DNS name."""
+    programmed = kube.kubectl(
+        "get", "gateway", "tpuserve", "-n", cfg.namespace, "-o",
+        "jsonpath={.status.conditions[?(@.type==\"Programmed\")].status}",
+        check=False)
+    if programmed.ok and programmed.stdout.strip() == "True":
+        # only a PROGRAMMED Gateway's address is routable — the status
+        # address can populate minutes before the LB actually forwards
+        res = kube.kubectl(
+            "get", "gateway", "tpuserve", "-n", cfg.namespace, "-o",
+            "jsonpath={.status.addresses[0].value}", check=False)
+        if res.ok and res.stdout.strip():
+            return res.stdout.strip()
     res = kube.kubectl(
         "get", "svc", "tpuserve-gateway", "-n", cfg.namespace, "-o",
         "jsonpath={.status.loadBalancer.ingress[0].ip}", check=False)

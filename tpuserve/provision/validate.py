@@ -419,6 +419,78 @@ SCHEMAS: dict[tuple[str, str], dict] = {
                                               "WaitForFirstConsumer"]},
                "reclaimPolicy": {"enum": ["Delete", "Retain"]},
                "parameters": _str_map}),
+    ("gateway.networking.k8s.io/v1", "Gateway"): _top(
+        "gateway.networking.k8s.io/v1", {
+            "type": "object",
+            "properties": {
+                "gatewayClassName": {"type": "string", "minLength": 1},
+                "listeners": {"type": "array", "minItems": 1, "items": {
+                    "type": "object",
+                    "properties": {
+                        "name": {"type": "string", "pattern": DNS1123},
+                        "port": {"type": "integer", "minimum": 1,
+                                 "maximum": 65535},
+                        "protocol": {"enum": ["HTTP", "HTTPS", "TCP",
+                                              "TLS", "UDP"]},
+                        "hostname": {"type": "string"},
+                        "allowedRoutes": {"type": "object"},
+                        "tls": {"type": "object"},
+                    },
+                    "required": ["name", "port", "protocol"],
+                    "additionalProperties": False}},
+                "addresses": {"type": "array"},
+            },
+            "required": ["gatewayClassName", "listeners"],
+            "additionalProperties": False}),
+    ("gateway.networking.k8s.io/v1", "HTTPRoute"): _top(
+        "gateway.networking.k8s.io/v1", {
+            "type": "object",
+            "properties": {
+                "parentRefs": {"type": "array", "minItems": 1, "items": {
+                    "type": "object",
+                    "properties": {"name": {"type": "string"},
+                                   "namespace": {"type": "string"},
+                                   "sectionName": {"type": "string"},
+                                   "kind": {"type": "string"},
+                                   "group": {"type": "string"}},
+                    "required": ["name"],
+                    "additionalProperties": False}},
+                "hostnames": {"type": "array", "items": {"type": "string"}},
+                "rules": {"type": "array", "items": {
+                    "type": "object",
+                    "properties": {
+                        "matches": {"type": "array", "items": {
+                            "type": "object",
+                            "properties": {
+                                "path": {"type": "object",
+                                         "properties": {
+                                             "type": {"enum": [
+                                                 "Exact", "PathPrefix",
+                                                 "RegularExpression"]},
+                                             "value": {"type": "string"}},
+                                         "additionalProperties": False},
+                                "headers": {"type": "array"},
+                                "method": {"type": "string"},
+                            },
+                            "additionalProperties": False}},
+                        "backendRefs": {"type": "array", "items": {
+                            "type": "object",
+                            "properties": {"name": {"type": "string"},
+                                           "namespace": {"type": "string"},
+                                           "port": {"type": "integer",
+                                                    "minimum": 1,
+                                                    "maximum": 65535},
+                                           "weight": {"type": "integer"},
+                                           "kind": {"type": "string"},
+                                           "group": {"type": "string"}},
+                            "required": ["name"],
+                            "additionalProperties": False}},
+                        "filters": {"type": "array"},
+                    },
+                    "additionalProperties": False}},
+            },
+            "required": ["parentRefs", "rules"],
+            "additionalProperties": False}),
     ("monitoring.coreos.com/v1", "ServiceMonitor"): _top(
         "monitoring.coreos.com/v1", {
             "type": "object",
