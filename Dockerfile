@@ -9,6 +9,14 @@
 # pipeline (provision/image.py).
 FROM python:3.12-slim
 
+# g++ builds the native runtime extension (block manager + ngram
+# proposer, native/*.cc) during the image build — the slim base has no
+# toolchain, and without this step pods silently fall back to the pure-
+# Python block manager.
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
 # jax with the TPU runtime (libtpu) from Google's release index, plus the
 # optional extras the engine uses when present (HF tokenizers/downloads).
 RUN pip install --no-cache-dir "jax[tpu]" \
@@ -17,7 +25,11 @@ RUN pip install --no-cache-dir "jax[tpu]" \
       transformers huggingface_hub safetensors pyyaml prometheus-client
 
 COPY . /opt/tpuserve
-RUN pip install --no-cache-dir /opt/tpuserve && rm -rf /root/.cache
+# Build the native extension against the source tree (it lands in
+# tpuserve/native/*.so and ships as package data), then install.
+RUN cd /opt/tpuserve \
+    && python -c "from tpuserve import native; assert native.native_available(), 'native build failed'" \
+    && pip install --no-cache-dir /opt/tpuserve && rm -rf /root/.cache
 
 # engine API/metrics port + gateway port (DeployConfig.engine_port/gateway_port)
 EXPOSE 8000 8080
