@@ -122,8 +122,19 @@ def _qwen2():
         bos_token_id=0, eos_token_id=1))
 
 
+def _gemma():
+    # Gemma traits: RMSNorm(1 + w), sqrt(hidden) embedding scale,
+    # tanh-GELU, tied embeddings, head_dim independent of hidden/heads
+    return transformers.GemmaForCausalLM(transformers.GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=512,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        bos_token_id=0, eos_token_id=1))
+
+
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
-             "qwen3_moe": _qwen3_moe, "qwen2": _qwen2}
+             "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -146,6 +157,10 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.num_experts == 4 and cfg.qk_norm
     if family == "qwen2":
         assert cfg.attention_bias and not cfg.qk_norm
+    if family == "gemma":
+        assert cfg.norm_weight_offset == 1.0
+        assert cfg.embed_scale_by_sqrt_dim
+        assert cfg.head_dim == 24 and cfg.tie_word_embeddings
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

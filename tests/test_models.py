@@ -233,3 +233,24 @@ def test_orbax_roundtrip(tmp_path):
     assert qr["layers"][0]["q_proj"]["kernel"].dtype == jnp.int8
     np.testing.assert_array_equal(
         np.asarray(qp["embed"]["scale"]), np.asarray(qr["embed"]["scale"]))
+
+
+def test_tiny_gemma_serves():
+    """Gemma family traits (RMSNorm(1+w), sqrt(hidden) embed scale,
+    tanh-GELU, head_dim independent of hidden/heads) through the full
+    engine path."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+    eng = Engine(EngineConfig(
+        model="tiny-gemma",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+    out = eng.generate(["hello gemma"],
+                       SamplingParams(max_tokens=6, temperature=0.0,
+                                      ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 6
+    a = eng.generate(["hello gemma"],
+                     SamplingParams(max_tokens=6, temperature=0.0,
+                                    ignore_eos=True))[0]
+    assert a.output_token_ids == out.output_token_ids

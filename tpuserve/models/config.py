@@ -39,6 +39,10 @@ class ModelConfig:
     qk_norm: bool = False            # Qwen3 per-head RMSNorm on q/k
     attention_bias: bool = False     # Qwen2-style bias on q/k/v projections
     mlp_bias: bool = False
+    # Gemma traits: RMSNorm computes (1 + weight) — checkpoints store the
+    # residual around 0 — and embeddings scale by sqrt(hidden_size).
+    norm_weight_offset: float = 0.0
+    embed_scale_by_sqrt_dim: bool = False
     tie_word_embeddings: bool = True
     learned_pos_offset: int = 0      # OPT stores positions shifted by 2
     final_layernorm: bool = True
@@ -138,6 +142,33 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             mlp_bias=True,
             **common,
         )
+    if "gemma" in family and mt != "gemma":
+        # gemma2/gemma3 add pre/post-feedforward norms, soft-capping and
+        # sliding windows — falling through to the llama path would load
+        # and SILENTLY mis-serve
+        raise ValueError(f"model_type {mt!r} is not supported yet "
+                         "(only first-generation gemma)")
+    if mt == "gemma":
+        # Gemma: llama-shaped weights, but RMSNorm(1 + w), sqrt(hidden)
+        # embedding scale, tanh-GELU MLP, tied embeddings, head_dim from
+        # config (not hidden/heads)
+        nh = hf["num_attention_heads"]
+        common["tie_word_embeddings"] = hf.get("tie_word_embeddings", True)
+        return ModelConfig(
+            intermediate_size=hf["intermediate_size"],
+            num_kv_heads=hf.get("num_key_value_heads", nh),
+            head_dim=hf.get("head_dim") or hf["hidden_size"] // nh,
+            norm="rmsnorm",
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_weight_offset=1.0,
+            embed_scale_by_sqrt_dim=True,
+            act=hf.get("hidden_activation",
+                       hf.get("hidden_act", "gelu_pytorch_tanh")),
+            mlp_style="gated",
+            pos="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            **common,
+        )
     # Llama / Qwen2 / Qwen3 / Phi-3 all share the rotary+gated-MLP skeleton;
     # the Qwen3-MoE variant swaps the MLP for routed experts.
     nh = hf["num_attention_heads"]
@@ -233,6 +264,16 @@ register_model_config(ModelConfig(
     bos_token_id=2, eos_token_id=2,
 ), "opt-1.3b")
 
+register_model_config(ModelConfig(
+    name="google/gemma-2b",
+    vocab_size=256000, hidden_size=2048, intermediate_size=16384,
+    num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
+    max_position_embeddings=8192, rope_theta=10000.0, norm_eps=1e-6,
+    norm_weight_offset=1.0, embed_scale_by_sqrt_dim=True,
+    act="gelu_pytorch_tanh", tie_word_embeddings=True,
+    bos_token_id=2, eos_token_id=1,
+), "gemma-2b")
+
 # Mixture-of-experts family (Qwen3-MoE): routed experts replace the dense
 # MLP; serves with expert-parallel sharding over the mesh 'ep' axis.
 register_model_config(ModelConfig(
@@ -261,6 +302,15 @@ register_model_config(ModelConfig(
     max_position_embeddings=512, rope_theta=1e6,
     qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
     num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+))
+
+register_model_config(ModelConfig(
+    name="tiny-gemma",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=24,
+    max_position_embeddings=512, norm_weight_offset=1.0,
+    embed_scale_by_sqrt_dim=True, act="gelu_pytorch_tanh",
+    tie_word_embeddings=True, eos_token_id=1,
 ))
 
 register_model_config(ModelConfig(

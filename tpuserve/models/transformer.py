@@ -27,12 +27,15 @@ Params = Any  # nested dict/list pytree of jnp arrays
 # Normalisation
 # --------------------------------------------------------------------------
 
-def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rmsnorm(x: jnp.ndarray, scale: jnp.ndarray, eps: float,
+            offset: float = 0.0) -> jnp.ndarray:
+    """``offset``: Gemma stores RMSNorm weights as residuals around zero
+    and computes (1 + w) * normed — pass 1.0 for that family."""
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     out = x * jax.lax.rsqrt(var + eps)
-    return (out * scale.astype(jnp.float32)).astype(dtype)
+    return (out * (scale.astype(jnp.float32) + offset)).astype(dtype)
 
 
 def layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -46,7 +49,7 @@ def layernorm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray, eps: float)
 
 def _norm(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     if cfg.norm == "rmsnorm":
-        return rmsnorm(x, p["scale"], cfg.norm_eps)
+        return rmsnorm(x, p["scale"], cfg.norm_eps, cfg.norm_weight_offset)
     return layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
 
 
@@ -141,8 +144,10 @@ def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray):
     k = _linear(h, lp["k_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
     v = _linear(h, lp["v_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
-        q = rmsnorm(q, lp["q_norm"]["scale"], cfg.norm_eps)
-        k = rmsnorm(k, lp["k_norm"]["scale"], cfg.norm_eps)
+        q = rmsnorm(q, lp["q_norm"]["scale"], cfg.norm_eps,
+                    cfg.norm_weight_offset)
+        k = rmsnorm(k, lp["k_norm"]["scale"], cfg.norm_eps,
+                    cfg.norm_weight_offset)
     if cfg.pos == "rope":
         rotary_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
         cos, sin = rope_ops.rope_freqs(positions, cfg.head_dim, cfg.rope_theta, rotary_dim)
@@ -158,6 +163,8 @@ def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         dtype = jnp.dtype(cfg.dtype)
         h = (h.astype(dtype)
              * params["embed"]["scale"][tokens][..., None].astype(dtype))
+    if cfg.embed_scale_by_sqrt_dim:       # Gemma: normalizer in h's dtype,
+        h = h * jnp.asarray(cfg.hidden_size ** 0.5, h.dtype)  # like HF
     if cfg.pos == "learned":
         h = h + params["pos_embed"]["weight"][positions + cfg.learned_pos_offset]
     return h
