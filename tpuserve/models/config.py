@@ -142,13 +142,15 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             mlp_bias=True,
             **common,
         )
-    if "gemma" in family and mt != "gemma":
-        # gemma2/gemma3 add pre/post-feedforward norms, soft-capping and
-        # sliding windows — falling through to the llama path would load
-        # and SILENTLY mis-serve
-        raise ValueError(f"model_type {mt!r} is not supported yet "
+    # first-generation gemma by model_type OR architectures (some configs
+    # omit model_type); gemma2/gemma3 add pre/post-feedforward norms,
+    # soft-capping and sliding windows — falling through to the llama
+    # path would load and SILENTLY mis-serve, so reject those loudly
+    gemma1 = mt == "gemma" or arch.startswith("gemmafor")
+    if "gemma" in family and not gemma1:
+        raise ValueError(f"model family {family!r} is not supported yet "
                          "(only first-generation gemma)")
-    if mt == "gemma":
+    if gemma1:
         # Gemma: llama-shaped weights, but RMSNorm(1 + w), sqrt(hidden)
         # embedding scale, tanh-GELU MLP, tied embeddings, head_dim from
         # config (not hidden/heads)
@@ -162,8 +164,10 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             norm_eps=hf.get("rms_norm_eps", 1e-6),
             norm_weight_offset=1.0,
             embed_scale_by_sqrt_dim=True,
-            act=hf.get("hidden_activation",
-                       hf.get("hidden_act", "gelu_pytorch_tanh")),
+            # hidden_activation can be PRESENT as null (GemmaConfig's
+            # nullable default) — `or` through to the real fallbacks
+            act=(hf.get("hidden_activation") or hf.get("hidden_act")
+                 or "gelu_pytorch_tanh"),
             mlp_style="gated",
             pos="rope",
             rope_theta=hf.get("rope_theta", 10000.0),

@@ -56,8 +56,13 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
             p["bias"] = jnp.zeros((n_out,), dtype)
         return p
 
+    # Families with a norm-weight offset (Gemma: effective scale = 1 + w)
+    # init the stored weight so the EFFECTIVE gain is 1 — plain ones would
+    # compound a 2x gain per norm through every layer on random-init paths.
+    norm_init = 1.0 - cfg.norm_weight_offset
+
     def norm(n):
-        p = {"scale": jnp.ones((n,), dtype)}
+        p = {"scale": jnp.full((n,), norm_init, dtype)}
         if cfg.norm == "layernorm":
             p["bias"] = jnp.zeros((n,), dtype)
         return p
@@ -74,8 +79,8 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
             "mlp_norm": norm(h),
         }
         if cfg.qk_norm:
-            lp["q_norm"] = {"scale": jnp.ones((d,), dtype)}
-            lp["k_norm"] = {"scale": jnp.ones((d,), dtype)}
+            lp["q_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
+            lp["k_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
         if cfg.num_experts:
             ei = cfg.expert_intermediate_size
             E = cfg.num_experts
