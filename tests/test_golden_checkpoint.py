@@ -133,8 +133,21 @@ def _gemma():
         bos_token_id=0, eos_token_id=1))
 
 
+def _mistral():
+    # sliding_window smaller than the test sequence so windowed attention
+    # actually changes the logits (full-context parity would pass even if
+    # the window were ignored)
+    return transformers.MistralForCausalLM(transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, sliding_window=6,
+        tie_word_embeddings=False, bos_token_id=0, eos_token_id=1,
+        attn_implementation="eager"))
+
+
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
-             "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma}
+             "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
+             "mistral": _mistral}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -161,6 +174,10 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.norm_weight_offset == 1.0
         assert cfg.embed_scale_by_sqrt_dim
         assert cfg.head_dim == 24 and cfg.tie_word_embeddings
+    if family == "mistral":
+        # the 12-token test sequence exceeds the 6-token window, so parity
+        # proves the window is actually applied
+        assert cfg.sliding_window == 6
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

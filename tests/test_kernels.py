@@ -291,3 +291,96 @@ def test_paged_window_multi_group():
                                  interpret=True, blk_q=8, pages_per_group=3)
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
                                atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window attention (Mistral): every kernel must match the windowed
+# reference, including the page-skip paths that never DMA out-of-window KV
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("W", [4, 16, 40])
+def test_flash_prefill_sliding_window(W):
+    B, T, Hq, Hkv, D = 2, 48, 4, 2, 128
+    rng = np.random.default_rng(41 + W)
+    q = jnp.asarray(rng.standard_normal((B, T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, Hkv, D)), jnp.float32)
+    lens = jnp.asarray([T, T - 5], jnp.int32)
+    ref = ref_ops.prefill_attention(q, k, v, lens, D ** -0.5,
+                                    sliding_window=W)
+    out = flash_prefill_attention(q, k, v, lens, D ** -0.5, blk_q=16,
+                                  blk_k=16, interpret=True,
+                                  sliding_window=W)
+    for b in range(B):
+        n = int(lens[b])
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(ref)[b, :n], atol=2e-5)
+
+
+@pytest.mark.parametrize("W,spp", [(8, 1), (24, 2), (100, 2)])
+def test_paged_decode_sliding_window(W, spp):
+    """Windowed decode: out-of-window pages are skipped entirely (the
+    perf point) and results still match the windowed reference across
+    mixed lengths, incl. sequences shorter than the window."""
+    B, Hq, Hkv, D, page, nb, mp = 5, 4, 2, 128, 4, 128, 24
+    rng = np.random.default_rng(W + spp)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(0, nb, (B, mp)), jnp.int32)
+    sl = np.asarray(rng.integers(1, page * mp + 1, (B,)), np.int32)
+    sl[0] = 3                          # shorter than any window
+    sl[-1] = page * mp                 # full context, deep page skip
+    sl = jnp.asarray(sl)
+    ref = ref_ops.paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5,
+                                         sliding_window=W)
+    out = paged_decode_attention(q, kc, vc, bt, sl, D ** -0.5,
+                                 interpret=True, pages_per_group=2,
+                                 seqs_per_program=spp, sliding_window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_sliding_window_int8():
+    """Window + int8 cache compose (both alter the DMA schedule)."""
+    from tpuserve.ops.attention import quantize_kv
+    B, Hq, Hkv, D, page, nb, mp = 3, 4, 2, 128, 4, 64, 16
+    rng = np.random.default_rng(53)
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    kq, ks = quantize_kv(kc)
+    vq, vs = quantize_kv(vc)
+    bt = jnp.asarray(rng.integers(0, nb, (B, mp)), jnp.int32)
+    sl = jnp.asarray([3, 30, page * mp], jnp.int32)
+    ref = ref_ops.paged_decode_attention(q, kq, vq, bt, sl, D ** -0.5,
+                                         k_scale=ks, v_scale=vs,
+                                         sliding_window=12)
+    out = paged_decode_attention(q, kq, vq, bt, sl, D ** -0.5,
+                                 interpret=True, pages_per_group=2,
+                                 seqs_per_program=2, k_scale=ks, v_scale=vs,
+                                 sliding_window=12)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("W", [6, 20])
+def test_paged_window_sliding_window(W):
+    """Chunked-prefill window kernel under a sliding window: deep context
+    beyond the window exercises the group-skip start."""
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    B, C, Hq, Hkv, D, page, nb, mp = 2, 8, 4, 2, 128, 4, 128, 24
+    rng = np.random.default_rng(W)
+    q = jnp.asarray(rng.standard_normal((B, C, Hq, D)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(0, nb, (B, mp)), jnp.int32)
+    ctx = jnp.asarray([60, 0], jnp.int32)   # deep context + fresh prompt
+    chunk = jnp.asarray([C, C - 3], jnp.int32)
+    ref = ref_ops.chunked_prefill_attention(q, kc, vc, bt, ctx, chunk,
+                                            D ** -0.5, sliding_window=W)
+    out = paged_window_attention(q, kc, vc, bt, ctx, chunk, D ** -0.5,
+                                 interpret=True, blk_q=4, pages_per_group=2,
+                                 sliding_window=W)
+    o, r = np.asarray(out), np.asarray(ref)
+    for b in range(B):
+        n = int(chunk[b])
+        np.testing.assert_allclose(o[b, :n], r[b, :n], atol=2e-5)

@@ -254,3 +254,58 @@ def test_tiny_gemma_serves():
                      SamplingParams(max_tokens=6, temperature=0.0,
                                     ignore_eos=True))[0]
     assert a.output_token_ids == out.output_token_ids
+
+
+def test_tiny_mistral_sliding_window_serves():
+    """Sliding-window family end to end: prompts longer than the window
+    route through batched AND chunked prefill, and decode crosses the
+    window boundary; pallas (interpret) and reference impls agree."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+
+    def mk(attn, chunk=64):
+        return Engine(EngineConfig(
+            model="tiny-mistral", attn_impl=attn,
+            cache=CacheConfig(block_size=4, num_blocks=128,
+                              max_blocks_per_seq=32),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2,
+                                      prefill_chunk_size=chunk)))
+    prompts = [list(range(2, 32)), [5, 6, 7]]    # 30 tokens >> window 8
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    ref = mk("reference").generate(prompts, p)
+    pal = mk("pallas").generate(prompts, p)
+    for a, b in zip(ref, pal):
+        assert len(a.output_token_ids) == 10
+        assert a.output_token_ids == b.output_token_ids
+    # chunked prefill route (chunk 16 < prompt 30) agrees too
+    chunked = mk("reference", chunk=16).generate(prompts, p)
+    for a, b in zip(ref, chunked):
+        assert a.output_token_ids == b.output_token_ids
+
+
+def test_qwen_style_sliding_window_gating():
+    """Qwen2-style configs: the window applies only when use_sliding_window
+    is on AND no leading layers are full-attention (HF: the FIRST
+    max_window_layers layers use full attention)."""
+    import pytest
+
+    from tpuserve.models.config import _sliding_window
+
+    base = {"sliding_window": 4096, "num_hidden_layers": 28}
+    # qwen default: field present but disabled
+    assert _sliding_window({**base, "use_sliding_window": False},
+                           "qwen2") is None
+    # enabled but every layer full-attention (mwl == num_layers): no window
+    assert _sliding_window({**base, "use_sliding_window": True,
+                            "max_window_layers": 28}, "qwen2") is None
+    # uniform SWA (mwl == 0): supported
+    assert _sliding_window({**base, "use_sliding_window": True,
+                            "max_window_layers": 0}, "qwen2") == 4096
+    # mixed per-layer: loud rejection
+    with pytest.raises(ValueError, match="per-layer"):
+        _sliding_window({**base, "use_sliding_window": True,
+                         "max_window_layers": 14}, "qwen2")
+    # mistral applies whenever set
+    assert _sliding_window({"sliding_window": 4096}, "mistral") == 4096
+    assert _sliding_window({"sliding_window": None}, "mistral") is None

@@ -43,6 +43,11 @@ class ModelConfig:
     # residual around 0 — and embeddings scale by sqrt(hidden_size).
     norm_weight_offset: float = 0.0
     embed_scale_by_sqrt_dim: bool = False
+    # Sliding-window attention (Mistral): each position attends to at most
+    # the previous `sliding_window` tokens.  None = full context.  Besides
+    # correctness for the family, decode skips whole KV pages outside the
+    # window — at 32k context with a 4k window that is 8x fewer KV reads.
+    sliding_window: Optional[int] = None
     tie_word_embeddings: bool = True
     learned_pos_offset: int = 0      # OPT stores positions shifted by 2
     final_layernorm: bool = True
@@ -209,9 +214,34 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
         qk_norm="qwen3" in family,
         attention_bias="qwen2" in family or hf.get("attention_bias", False),
+        sliding_window=_sliding_window(hf, family),
         **moe,
         **common,
     )
+
+
+def _sliding_window(hf: dict, family: str):
+    """Mistral applies its sliding_window whenever set; Qwen2/Qwen3 carry
+    the field but gate it behind use_sliding_window (default off) and
+    max_window_layers.  Honoring a disabled window would corrupt long-
+    context serving for every Qwen checkpoint."""
+    sw = hf.get("sliding_window")
+    if sw is None:
+        return None
+    if not hf.get("use_sliding_window", "mistral" in family):
+        return None
+    # HF semantics: the FIRST max_window_layers layers use full attention;
+    # layers at or after it use the window.
+    mwl = hf.get("max_window_layers")
+    nl = hf.get("num_hidden_layers", 0)
+    if mwl is not None:
+        if mwl >= nl:
+            return None                   # window never applies
+        if mwl > 0:
+            raise ValueError(
+                f"per-layer sliding windows (max_window_layers={mwl} of "
+                f"{nl} layers full-attention) are not supported yet")
+    return int(sw)
 
 
 def _first(x):
@@ -269,6 +299,15 @@ register_model_config(ModelConfig(
 ), "opt-1.3b")
 
 register_model_config(ModelConfig(
+    name="mistralai/Mistral-7B-Instruct-v0.1",
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=32768, rope_theta=10000.0, norm_eps=1e-5,
+    sliding_window=4096, tie_word_embeddings=False,
+    bos_token_id=1, eos_token_id=2,
+), "mistral-7b")
+
+register_model_config(ModelConfig(
     name="google/gemma-2b",
     vocab_size=256000, hidden_size=2048, intermediate_size=16384,
     num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
@@ -306,6 +345,14 @@ register_model_config(ModelConfig(
     max_position_embeddings=512, rope_theta=1e6,
     qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
     num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+))
+
+register_model_config(ModelConfig(
+    name="tiny-mistral",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+    max_position_embeddings=512, sliding_window=8,
+    tie_word_embeddings=False, eos_token_id=1,
 ))
 
 register_model_config(ModelConfig(

@@ -207,6 +207,7 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = _embed(params, cfg, tokens, positions)
     scale = cfg.head_dim ** -0.5
+    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
@@ -217,12 +218,15 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                                  slot_ids))
         if attn_impl == "pallas" and mesh is not None:
             from tpuserve.ops.pallas_tp import flash_prefill_attention_tp
-            out = flash_prefill_attention_tp(q, k, v, prompt_lens, scale, mesh)
+            out = flash_prefill_attention_tp(q, k, v, prompt_lens, scale,
+                                             mesh, sliding_window=sw)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
-            out = flash_prefill_attention(q, k, v, prompt_lens, scale)
+            out = flash_prefill_attention(q, k, v, prompt_lens, scale,
+                                          sliding_window=sw)
         else:
-            out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale)
+            out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
+                                             sliding_window=sw)
         out = out.reshape(B, T, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
@@ -285,6 +289,7 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = ctx_lens[:, None] + jnp.arange(C)[None, :]
     h = _embed(params, cfg, tokens, positions)
     scale = cfg.head_dim ** -0.5
+    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
@@ -297,16 +302,16 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             from tpuserve.ops.pallas_tp import paged_window_attention_tp
             out = paged_window_attention_tp(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale, mesh,
-                k_scale=ks, v_scale=vs)
+                k_scale=ks, v_scale=vs, sliding_window=sw)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
             out = paged_window_attention(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
-                k_scale=ks, v_scale=vs)
+                k_scale=ks, v_scale=vs, sliding_window=sw)
         else:
             out = attn_ops.chunked_prefill_attention(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
-                k_scale=ks, v_scale=vs)
+                k_scale=ks, v_scale=vs, sliding_window=sw)
         out = out.reshape(B, C, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
@@ -354,6 +359,7 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     B = tokens.shape[0]
     h = _embed(params, cfg, tokens, positions)                 # (B, H)
     scale = cfg.head_dim ** -0.5
+    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
@@ -366,15 +372,16 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             from tpuserve.ops.pallas_tp import paged_decode_attention_tp
             out = paged_decode_attention_tp(q, ck, cv, block_tables, seq_lens,
                                             scale, mesh, k_scale=ks,
-                                            v_scale=vs)
+                                            v_scale=vs, sliding_window=sw)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_paged_attention import paged_decode_attention as impl
             out = impl(q, ck, cv, block_tables, seq_lens, scale,
-                       k_scale=ks, v_scale=vs)
+                       k_scale=ks, v_scale=vs, sliding_window=sw)
         else:
             out = attn_ops.paged_decode_attention(q, ck, cv, block_tables,
                                                   seq_lens, scale,
-                                                  k_scale=ks, v_scale=vs)
+                                                  k_scale=ks, v_scale=vs,
+                                                  sliding_window=sw)
         out = out.reshape(B, cfg.q_size)
         h = h + _linear(out, lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
@@ -484,7 +491,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for lp in params["layers"]:
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
-        out = attn_ops.prefill_attention(q, k, v, seq_lens, scale)
+        out = attn_ops.prefill_attention(q, k, v, seq_lens, scale,
+                                         sliding_window=cfg.sliding_window)
         h = h + _linear(out.reshape(B, T, cfg.q_size), lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
         h = h + _mlp(hn, lp, cfg)

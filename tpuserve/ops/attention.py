@@ -26,10 +26,12 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 
 def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      prompt_lens: jnp.ndarray, scale: float) -> jnp.ndarray:
+                      prompt_lens: jnp.ndarray, scale: float,
+                      sliding_window: int | None = None) -> jnp.ndarray:
     """Causal self-attention over the prompt being prefetched.
 
     q: (B, T, Hq, D); k, v: (B, T, Hkv, D); prompt_lens: (B,) valid lengths.
+    ``sliding_window``: Mistral-style — row p attends keys in (p - W, p].
     Returns (B, T, Hq, D) in q.dtype.  Softmax in float32.
     """
     B, T, Hq, D = q.shape
@@ -39,6 +41,8 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(T)
     causal = pos[None, :] <= pos[:, None]                      # (Tq, Tk)
+    if sliding_window is not None:
+        causal &= pos[None, :] > pos[:, None] - sliding_window
     valid = pos[None, :] < prompt_lens[:, None]                # (B, Tk)
     mask = causal[None, None, :, :] & valid[:, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
@@ -51,14 +55,16 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
                            k_scale: jnp.ndarray | None = None,
-                           v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
+                           v_scale: jnp.ndarray | None = None,
+                           sliding_window: int | None = None) -> jnp.ndarray:
     """Single-token decode attention against a paged KV cache.
 
     q: (B, Hq, D); k_cache/v_cache: (num_blocks, block_size, Hkv, D);
     block_tables: (B, max_blocks) int32 physical block ids;
     seq_lens: (B,) total tokens in cache per sequence (including current).
     ``k_scale``/``v_scale``: (num_blocks, block_size, Hkv) dequantization
-    scales when the cache stores int8.  Returns (B, Hq, D).
+    scales when the cache stores int8.  ``sliding_window``: attend only
+    the last W cached positions.  Returns (B, Hq, D).
     """
     B, Hq, D = q.shape
     _, block_size, Hkv, _ = k_cache.shape
@@ -75,6 +81,9 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     v = repeat_kv(v, n_rep)
     scores = jnp.einsum("bhd,bkhd->bhk", q, k, preferred_element_type=jnp.float32) * scale
     valid = jnp.arange(S)[None, :] < seq_lens[:, None]         # (B, S)
+    if sliding_window is not None:
+        valid &= (jnp.arange(S)[None, :]
+                  >= seq_lens[:, None] - sliding_window)
     scores = jnp.where(valid[:, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhk,bkhd->bhd", probs.astype(v.dtype), v)
@@ -86,7 +95,8 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                               ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                               scale: float, *, seg_size: int = 512,
                               k_scale: jnp.ndarray | None = None,
-                              v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
+                              v_scale: jnp.ndarray | None = None,
+                              sliding_window: int | None = None) -> jnp.ndarray:
     """Attention for one prefill CHUNK against the paged cache.
 
     The chunk's K/V must already be written into the cache (so keys live at
@@ -140,6 +150,9 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         scores = scores.reshape(B, Hq, C, seg)
         j = s0 + jnp.arange(seg)[None, None, :]          # global key position
         mask = (j <= ctx_lens[:, None, None] + qi) & q_valid & (j < S)
+        if sliding_window is not None:
+            # query at global pos ctx+qi attends keys in (pos - W, pos]
+            mask &= j > ctx_lens[:, None, None] + qi - sliding_window
         mask = mask[:, None, :, :]                       # (B, 1, C, seg)
         scores = jnp.where(mask, scores, NEG_INF)
         m_cur = jnp.max(scores, axis=-1)

@@ -35,10 +35,13 @@ TARGET_GROUP_ROWS = 512
 def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
                    k_scr, v_scr, sems, *, scale, page_size, pages_g,
                    num_kv_heads, group, head_dim, blk_q,
-                   ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None):
+                   ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None,
+                   sliding_window=None):
     """``ks_hbm``/``vs_hbm`` present = int8 cache: pages DMA as int8 with
     per-page scale blocks and dequantize in VMEM (same scheme as the paged
-    decode kernel)."""
+    decode kernel).  ``sliding_window`` (static): each query attends only
+    the previous W positions; pages entirely before the q block's
+    earliest window are never DMA'd."""
     quantized = ks_hbm is not None
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -50,6 +53,22 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
     kv_limit = jnp.minimum(total, q_start + blk_q)
     num_pages = pl.cdiv(kv_limit, page_size)
     num_groups = pl.cdiv(num_pages, pages_g)
+    # Earliest key ANY row of this q block may attend (row 0's window
+    # start); per-row windows are enforced by the score mask.
+    if sliding_window is None:
+        blk_ws = jnp.int32(0)
+        g0 = jnp.int32(0)
+    else:
+        blk_ws = jnp.maximum(q_start - sliding_window + 1, 0)
+        g0 = blk_ws // (pages_g * page_size)
+
+    def _page_needed(g, j):
+        """MUST be identical for start and wait or semaphores desync."""
+        pi = g * pages_g + j
+        needed = pi < num_pages
+        if sliding_window is not None:
+            needed &= pi >= blk_ws // page_size
+        return needed
 
     def _copies(g, slot, j):
         page = bt_ref[b, g * pages_g + j]
@@ -70,7 +89,7 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
 
     def start_group(g, slot):
         def copy_one(j, _):
-            @pl.when(g * pages_g + j < num_pages)
+            @pl.when(_page_needed(g, j))
             def _():
                 for c in _copies(g, slot, j):
                     c.start()
@@ -79,14 +98,14 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
 
     def wait_group(g, slot):
         def wait_one(j, _):
-            @pl.when(g * pages_g + j < num_pages)
+            @pl.when(_page_needed(g, j))
             def _():
                 for c in _copies(g, slot, j):
                     c.wait()
             return 0
         jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
-    start_group(0, 0)
+    start_group(g0, 0)
 
     rows_g = pages_g * page_size
     rows_q = blk_q * group
@@ -105,9 +124,10 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
     l0 = jnp.zeros((num_kv_heads, rows_q, 1), jnp.float32)
     acc0 = jnp.zeros((num_kv_heads, rows_q, head_dim), jnp.float32)
 
-    def body(g, carry):
+    def body(i, carry):
+        g = g0 + i
         m_prev, l_prev, acc_prev = carry
-        slot = jax.lax.rem(g, 2)
+        slot = jax.lax.rem(i, 2)
 
         @pl.when(g + 1 < num_groups)
         def _prefetch():
@@ -133,12 +153,17 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
         # accumulator even though those probabilities are 0.
         row_pos = g * rows_g + jax.lax.broadcasted_iota(
             jnp.int32, (num_kv_heads, rows_g, 1), 1)
-        v = jnp.where(row_pos < kv_limit, v, jnp.zeros_like(v))
+        v_valid = row_pos < kv_limit
+        if sliding_window is not None:
+            v_valid &= row_pos >= blk_ws           # never-DMA'd pages
+        v = jnp.where(v_valid, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * scale
         kpos = g * rows_g + jax.lax.broadcasted_iota(
             jnp.int32, (num_kv_heads, rows_q, rows_g), 2)
         mask = kpos <= q_pos                       # causal + context
+        if sliding_window is not None:
+            mask &= kpos > q_pos - sliding_window  # per-row window
         s = jnp.where(mask, s, NEG_INF)
 
         m_cur = jnp.max(s, axis=2, keepdims=True)
@@ -152,7 +177,7 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
         acc_new = acc_prev * correction + pv
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_groups, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_groups - g0, body, (m0, l0, acc0))
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = acc / safe_l                            # (Hkv, blk_q*G, D)
     out = out.reshape(num_kv_heads, blk_q, group, head_dim)
@@ -161,7 +186,8 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret", "blk_q",
-                                             "pages_per_group"))
+                                             "pages_per_group",
+                                             "sliding_window"))
 def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
@@ -169,7 +195,8 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            blk_q: int = 128,
                            pages_per_group: int | None = None,
                            k_scale: jnp.ndarray | None = None,
-                           v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
+                           v_scale: jnp.ndarray | None = None,
+                           sliding_window: int | None = None) -> jnp.ndarray:
     """q: (B, C, Hq, D) window queries; k_cache/v_cache: (num_blocks, page,
     Hkv, D) with the window's KV already written; block_tables: (B,
     max_pages) int32; ctx_lens/chunk_lens: (B,). -> (B, C, Hq, D).
@@ -205,7 +232,8 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     quantized = k_scale is not None
     kernel = functools.partial(
         _window_kernel, scale=scale, page_size=page_size, pages_g=pages_g,
-        num_kv_heads=Hkv, group=group, head_dim=D, blk_q=blk_q)
+        num_kv_heads=Hkv, group=group, head_dim=D, blk_q=blk_q,
+        sliding_window=sliding_window)
     if quantized:
         base_kernel = kernel
 

@@ -24,7 +24,8 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, blk_q, blk_k):
+                  m_scr, l_scr, acc_scr, *, scale, blk_q, blk_k,
+                  sliding_window=None):
     b = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -41,8 +42,13 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
     prompt_len = len_ref[b]
 
     # Causal block skip: this k block only matters if it starts at or before
-    # the last query row of the q block, and inside the valid prompt.
-    @pl.when((k_start <= q_start + blk_q - 1) & (k_start < prompt_len))
+    # the last query row of the q block, and inside the valid prompt — and,
+    # under a sliding window, not entirely before the EARLIEST row's window.
+    relevant = (k_start <= q_start + blk_q - 1) & (k_start < prompt_len)
+    if sliding_window is not None:
+        relevant &= k_start + blk_k > q_start - sliding_window + 1
+
+    @pl.when(relevant)
     def _compute():
         # Stored-dtype (bf16) MXU inputs with fp32 accumulation: upcasting
         # before the dot would run the MXU at its slow fp32 rate for no
@@ -60,6 +66,8 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
         mask = (cols <= rows) & (cols < prompt_len)
+        if sliding_window is not None:
+            mask &= cols > rows - sliding_window
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]                                   # (blk_q, 1)
@@ -85,11 +93,13 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0, :, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k",
+                                             "interpret", "sliding_window"))
 def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             prompt_lens: jnp.ndarray, scale: float,
                             blk_q: int = 128, blk_k: int = 128,
-                            interpret: bool | None = None) -> jnp.ndarray:
+                            interpret: bool | None = None,
+                            sliding_window: int | None = None) -> jnp.ndarray:
     """q: (B, T, Hq, D); k/v: (B, T, Hkv, D); prompt_lens: (B,). -> (B, T, Hq, D).
 
     T is padded (bucketed) by the engine; query rows past prompt_lens still
@@ -112,7 +122,7 @@ def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     vt = jnp.swapaxes(v, 1, 2)
 
     kernel = functools.partial(_flash_kernel, scale=scale, blk_q=blk_q,
-                               blk_k=blk_k)
+                               blk_k=blk_k, sliding_window=sliding_window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,

@@ -125,10 +125,15 @@ def _env_int(name: str) -> int | None:
 def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                          k_scr, v_scr, sems, *, scale, page_size, pages_g,
                          num_kv_heads, group, head_dim, seqs_pp,
-                         ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None):
+                         ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None,
+                         sliding_window=None):
     """``ks_hbm``/``vs_hbm`` present = int8 cache: value pages DMA as int8
     (half the HBM bytes — the whole point) alongside tiny per-page scale
-    blocks, and dequantize on the VPU after landing in VMEM."""
+    blocks, and dequantize on the VPU after landing in VMEM.
+
+    ``sliding_window`` (static): attend only the last W cached positions —
+    groups and pages entirely BEFORE the window are never DMA'd, so a 32k
+    context with a 4k window moves ~1/8 the KV bytes."""
     quantized = ks_hbm is not None
     p = pl.program_id(0)
     base = p * seqs_pp
@@ -141,6 +146,17 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
         # >= 1 so padded/empty sequences keep the chunk pipeline uniform
         # (their zero pages mean no DMAs start and no waits happen).
         return jnp.maximum(pl.cdiv(sl_ref[base + s], rows_g), 1)
+
+    def win_start(s):
+        # first attended position (0 without a window)
+        if sliding_window is None:
+            return jnp.int32(0)
+        return jnp.maximum(sl_ref[base + s] - sliding_window, 0)
+
+    def first_group(s):
+        if sliding_window is None:
+            return jnp.int32(0)
+        return win_start(s) // rows_g
 
     def _copies(s, g, slot, j):
         page = bt_ref[base + s, g * pages_g + j]
@@ -159,11 +175,18 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
             ]
         return copies
 
-    def start_chunk(s, g, slot):
-        np_s = num_pages(s)
+    def _page_needed(s, g, j):
+        """Inside the valid range AND not entirely before the window.
+        MUST be identical for start and wait or semaphores desync."""
+        pi = g * pages_g + j
+        needed = pi < num_pages(s)
+        if sliding_window is not None:
+            needed &= pi >= win_start(s) // page_size
+        return needed
 
+    def start_chunk(s, g, slot):
         def copy_one(j, _):
-            @pl.when(g * pages_g + j < np_s)
+            @pl.when(_page_needed(s, g, j))
             def _():
                 for c in _copies(s, g, slot, j):
                     c.start()
@@ -171,40 +194,43 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
         jax.lax.fori_loop(0, pages_g, copy_one, 0)
 
     def wait_chunk(s, g, slot):
-        np_s = num_pages(s)
-
         def wait_one(j, _):
-            @pl.when(g * pages_g + j < np_s)
+            @pl.when(_page_needed(s, g, j))
             def _():
                 for c in _copies(s, g, slot, j):
                     c.wait()
             return 0
         jax.lax.fori_loop(0, pages_g, wait_one, 0)
 
-    start_chunk(0, 0, 0)
+    start_chunk(0, first_group(0), 0)
 
     def seq_body(s, parity0):
         seq_len = sl_ref[base + s]
         ng = num_groups(s)
+        g0 = first_group(s)
+        neff = ng - g0                  # groups this sequence processes
+        ws = win_start(s)
         q_r = q_ref[pl.ds(s, 1)].reshape(num_kv_heads, group, head_dim)
 
         m0 = jnp.full((num_kv_heads, group, 1), NEG_INF, jnp.float32)
         l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
         acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
 
-        def body(g, carry):
+        def body(i, carry):
+            g = g0 + i
             m_prev, l_prev, acc_prev = carry
-            slot = jax.lax.rem(parity0 + g, 2)
+            slot = jax.lax.rem(parity0 + i, 2)
 
             # Prefetch the pipeline's next chunk into the other slot:
-            # this sequence's next group, or the next sequence's first.
-            @pl.when(g + 1 < ng)
+            # this sequence's next group, or the next sequence's first
+            # IN-WINDOW group.
+            @pl.when(i + 1 < neff)
             def _prefetch_group():
                 start_chunk(s, g + 1, 1 - slot)
 
-            @pl.when((g + 1 == ng) & (s + 1 < seqs_pp))
+            @pl.when((i + 1 == neff) & (s + 1 < seqs_pp))
             def _prefetch_seq():
-                start_chunk(s + 1, 0, 1 - slot)
+                start_chunk(s + 1, first_group(s + 1), 1 - slot)
 
             wait_chunk(s, g, slot)
             # (pages_g, page, Hkv, D) -> (Hkv, rows_g, D), stored dtype
@@ -223,20 +249,26 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                 v = dequantize_kv(v, jnp.swapaxes(
                     vs_scr[slot].reshape(rows_g, num_kv_heads), 0, 1),
                     q_ref.dtype)
-            # Zero V rows past the sequence: pages of the group that were
+            # Zero V rows outside [win_start, seq_len): pages that were
             # never DMA'd hold unspecified scratch (possibly NaN), and
             # 0 * NaN would poison the accumulator even though those
             # probabilities are 0.
             row_pos = g * rows_g + jax.lax.broadcasted_iota(
                 jnp.int32, (num_kv_heads, rows_g, 1), 1)
-            v = jnp.where(row_pos < seq_len, v, jnp.zeros_like(v))
+            v_valid = row_pos < seq_len
+            if sliding_window is not None:
+                v_valid &= row_pos >= ws
+            v = jnp.where(v_valid, v, jnp.zeros_like(v))
             # (Hkv, group, D) x (Hkv, rows, D) -> (Hkv, group, rows); bf16
             # MXU inputs, fp32 accumulation; scale on the fp32 product.
             sc = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
                                      preferred_element_type=jnp.float32) * scale
             pos = g * rows_g + jax.lax.broadcasted_iota(
                 jnp.int32, (num_kv_heads, group, rows_g), 2)
-            sc = jnp.where(pos < seq_len, sc, NEG_INF)
+            s_valid = pos < seq_len
+            if sliding_window is not None:
+                s_valid &= pos >= ws
+            sc = jnp.where(s_valid, sc, NEG_INF)
 
             m_cur = jnp.max(sc, axis=2, keepdims=True)
             m_new = jnp.maximum(m_prev, m_cur)
@@ -252,11 +284,11 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
             acc_new = acc_prev * correction + pv
             return m_new, l_new, acc_new
 
-        m, l, acc = jax.lax.fori_loop(0, ng, body, (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(0, neff, body, (m0, l0, acc0))
         safe_l = jnp.where(l == 0.0, 1.0, l)
         out = (acc / safe_l).reshape(1, num_kv_heads * group, head_dim)
         o_ref[pl.ds(s, 1)] = out.astype(o_ref.dtype)
-        return parity0 + ng
+        return parity0 + neff
 
     jax.lax.fori_loop(0, seqs_pp, seq_body, 0)
 
@@ -268,12 +300,15 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            pages_per_group: int | None = None,
                            seqs_per_program: int | None = None,
                            k_scale: jnp.ndarray | None = None,
-                           v_scale: jnp.ndarray | None = None) -> jnp.ndarray:
+                           v_scale: jnp.ndarray | None = None,
+                           sliding_window: int | None = None) -> jnp.ndarray:
     """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
     block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D).
     ``k_scale``/``v_scale``: (num_blocks, page, Hkv) f32 when the cache
     stores int8 (ops/attention.py quantize_kv) — pages then move over HBM
     at half the bytes and dequantize on the VPU inside the kernel.
+    ``sliding_window``: attend only the last W positions; out-of-window
+    pages are never DMA'd.
 
     The env knobs are resolved HERE, outside jit, and passed as static
     args — reading them inside the traced function would capture them at
@@ -297,14 +332,17 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     return _paged_decode_attention(q, k_cache, v_cache, block_tables,
                                    seq_lens, scales, scale=scale,
                                    interpret=interpret, pages_g=pages_g,
-                                   seqs_pp=seqs_pp)
+                                   seqs_pp=seqs_pp,
+                                   sliding_window=sliding_window)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret",
-                                             "pages_g", "seqs_pp"))
+                                             "pages_g", "seqs_pp",
+                                             "sliding_window"))
 def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
                             scales, *, scale: float, interpret: bool,
-                            pages_g: int, seqs_pp: int) -> jnp.ndarray:
+                            pages_g: int, seqs_pp: int,
+                            sliding_window: int | None = None) -> jnp.ndarray:
     B, Hq, D = q.shape
     num_blocks, page_size, Hkv, _ = k_cache.shape
     group = Hq // Hkv
@@ -322,7 +360,7 @@ def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
     kernel = functools.partial(
         _paged_decode_kernel, scale=scale, page_size=page_size,
         pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D,
-        seqs_pp=seqs_pp)
+        seqs_pp=seqs_pp, sliding_window=sliding_window)
     if quantized:
         # operand order must mirror the extra in_specs/scratch below
         base_kernel = kernel

@@ -37,7 +37,8 @@ def tp_partitionable(cfg_kv_heads: int, mesh: Mesh | None) -> bool:
 
 def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
                               scale: float, mesh: Mesh,
-                              k_scale=None, v_scale=None):
+                              k_scale=None, v_scale=None,
+                              sliding_window=None):
     """Head-parallel paged decode attention over the tp axis.
 
     q: (B, Hq, D) head-sharded; k/v_cache: (blocks, page, Hkv, D)
@@ -58,9 +59,11 @@ def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
 
         def impl(q_, kc, vc, bt, sl, ks, vs):
             return paged_decode_attention(q_, kc, vc, bt, sl, scale,
-                                          k_scale=ks, v_scale=vs)
+                                          k_scale=ks, v_scale=vs,
+                                          sliding_window=sliding_window)
     else:
-        impl = partial(paged_decode_attention, scale=scale)
+        impl = partial(paged_decode_attention, scale=scale,
+                       sliding_window=sliding_window)
     fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=head_spec, **_CHECK_KWARG)
     return fn(*args)
@@ -68,7 +71,8 @@ def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
 
 def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
                               chunk_lens, scale: float, mesh: Mesh,
-                              k_scale=None, v_scale=None):
+                              k_scale=None, v_scale=None,
+                              sliding_window=None):
     """Head-parallel paged window attention (chunked prefill) over tp.
 
     q: (B, C, Hq, D) head-sharded; k/v_cache kv-head-sharded;
@@ -87,16 +91,18 @@ def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
 
         def impl(q_, kc, vc, bt, cx, ck, ks, vs):
             return paged_window_attention(q_, kc, vc, bt, cx, ck, scale,
-                                          k_scale=ks, v_scale=vs)
+                                          k_scale=ks, v_scale=vs,
+                                          sliding_window=sliding_window)
     else:
-        impl = partial(paged_window_attention, scale=scale)
+        impl = partial(paged_window_attention, scale=scale,
+                       sliding_window=sliding_window)
     fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=q_spec, **_CHECK_KWARG)
     return fn(*args)
 
 
 def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,
-                               mesh: Mesh):
+                               mesh: Mesh, sliding_window=None):
     """Head-parallel flash prefill attention over the tp axis.
 
     q: (B, T, Hq, D); k/v: (B, T, Hkv, D) — head axes sharded over tp,
@@ -105,7 +111,8 @@ def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,
     from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
     q_spec = P(None, None, AXIS_TP, None)
     fn = shard_map(
-        partial(flash_prefill_attention, scale=scale),
+        partial(flash_prefill_attention, scale=scale,
+                sliding_window=sliding_window),
         mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec, P(None)),
         out_specs=q_spec, **_CHECK_KWARG)
