@@ -53,6 +53,7 @@ PRIORITY = [
     "int8-multistep16",
     "pallas-spp16",                           # re-time with the VMEM clamp
     "phi3-mini", "opt-1.3b", "llama3-8b-int8",
+    "mistral7b-int8-sw8k",                    # windowed page-skip decode
     "cold-cache",
 ]
 
