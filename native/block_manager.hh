@@ -25,9 +25,14 @@ inline uint64_t chain_hash(uint64_t prev, const int32_t* tokens, int64_t n) {
   return h ? h : 1;
 }
 
+// Sentinel for a leading block returned by the sliding-window rolling
+// buffer (release_out_of_window); mirrors runtime/block_manager.py.
+constexpr int32_t kReleased = -1;
+
 struct SeqAlloc {
   std::vector<int32_t> blocks;
   int64_t num_tokens = 0;
+  int64_t released_upto = 0;
 };
 
 class BlockManager {
@@ -177,8 +182,9 @@ class BlockManager {
     const SeqAlloc& a = it->second;
     if (idx < 0 || idx / block_size_ >= static_cast<int64_t>(a.blocks.size()))
       return -3;
-    return static_cast<int64_t>(a.blocks[idx / block_size_]) * block_size_ +
-           idx % block_size_;
+    int32_t b = a.blocks[idx / block_size_];
+    if (b == kReleased) return -3;  // window-released: no writable slot
+    return static_cast<int64_t>(b) * block_size_ + idx % block_size_;
   }
 
   int64_t block_table(const std::string& seq_id, int32_t* out,
@@ -186,9 +192,36 @@ class BlockManager {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
     int64_t n = static_cast<int64_t>(it->second.blocks.size());
-    for (int64_t i = 0; i < n && i < max_out; ++i)
-      out[i] = it->second.blocks[i];
+    for (int64_t i = 0; i < n && i < max_out; ++i) {
+      int32_t b = it->second.blocks[i];
+      // released entries report block 0 (valid id; those positions are
+      // masked/skipped by every attention impl) — mirrors the Python side
+      out[i] = b == kReleased ? 0 : b;
+    }
     return n;
+  }
+
+  // Sliding-window rolling buffer: return blocks holding only positions
+  // before first_needed_token to the pool.  Returns blocks released, or
+  // -2 unknown seq.
+  int64_t release_out_of_window(const std::string& seq_id,
+                                int64_t first_needed_token) {
+    auto it = seqs_.find(seq_id);
+    if (it == seqs_.end()) return -2;
+    SeqAlloc& a = it->second;
+    int64_t first_block = first_needed_token / block_size_;
+    if (first_block > static_cast<int64_t>(a.blocks.size()))
+      first_block = static_cast<int64_t>(a.blocks.size());
+    int64_t released = 0;
+    for (int64_t i = a.released_upto; i < first_block; ++i) {
+      int32_t b = a.blocks[i];
+      if (b == kReleased) continue;
+      release_block(b, /*cache_blocks=*/true);
+      a.blocks[i] = kReleased;
+      ++released;
+    }
+    if (first_block > a.released_upto) a.released_upto = first_block;
+    return released;
   }
 
   // cache_blocks=false drops the blocks' prefix hashes instead of parking
@@ -198,27 +231,32 @@ class BlockManager {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return;
     for (int32_t b : it->second.blocks) {
-      auto rc = refcount_.find(b);
-      int32_t count = (rc == refcount_.end() ? 1 : rc->second) - 1;
-      if (count > 0) {
-        refcount_[b] = count;
-        continue;
-      }
-      if (rc != refcount_.end()) refcount_.erase(rc);
-      if (!cache_blocks) drop_hash(b);
-      if (block_hash_.count(b)) {  // keep KV for prefix reuse, LRU order
-        auto pos = cached_pos_.find(b);
-        if (pos != cached_pos_.end()) cached_lru_.erase(pos->second);
-        cached_lru_.push_back(b);
-        cached_pos_[b] = std::prev(cached_lru_.end());
-      } else {
-        free_.push_back(b);
-      }
+      if (b == kReleased) continue;  // already back in the pool
+      release_block(b, cache_blocks);
     }
     seqs_.erase(it);
   }
 
  private:
+  void release_block(int32_t b, bool cache_blocks) {
+    auto rc = refcount_.find(b);
+    int32_t count = (rc == refcount_.end() ? 1 : rc->second) - 1;
+    if (count > 0) {
+      refcount_[b] = count;
+      return;
+    }
+    if (rc != refcount_.end()) refcount_.erase(rc);
+    if (!cache_blocks) drop_hash(b);
+    if (block_hash_.count(b)) {  // keep KV for prefix reuse, LRU order
+      auto pos = cached_pos_.find(b);
+      if (pos != cached_pos_.end()) cached_lru_.erase(pos->second);
+      cached_lru_.push_back(b);
+      cached_pos_[b] = std::prev(cached_lru_.end());
+    } else {
+      free_.push_back(b);
+    }
+  }
+
   int32_t pop_free_block() {
     if (!free_.empty()) {
       int32_t b = free_.back();

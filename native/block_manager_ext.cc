@@ -250,6 +250,18 @@ PyObject* core_free(CoreObject* self, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+PyObject* core_release_out_of_window(CoreObject* self, PyObject* args) {
+  const char* seq_id;
+  long long first_needed;
+  if (!PyArg_ParseTuple(args, "sL", &seq_id, &first_needed)) return nullptr;
+  int64_t r = self->bm->release_out_of_window(seq_id, first_needed);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, seq_id);
+    return nullptr;
+  }
+  return PyLong_FromLongLong(r);
+}
+
 PyMethodDef core_methods[] = {
     {"num_free_blocks", (PyCFunction)core_num_free_blocks, METH_NOARGS, ""},
     {"num_seqs", (PyCFunction)core_num_seqs, METH_NOARGS, ""},
@@ -267,6 +279,8 @@ PyMethodDef core_methods[] = {
     {"slot_for_token", (PyCFunction)core_slot_for_token, METH_VARARGS, ""},
     {"block_table", (PyCFunction)core_block_table, METH_O, ""},
     {"free", (PyCFunction)core_free, METH_VARARGS, ""},
+    {"release_out_of_window", (PyCFunction)core_release_out_of_window,
+     METH_VARARGS, ""},
     {nullptr, nullptr, 0, nullptr},
 };
 

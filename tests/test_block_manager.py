@@ -71,3 +71,45 @@ def test_prefix_cache_disabled():
     bm = BlockManager(num_blocks=8, block_size=2, enable_prefix_caching=False)
     bm.allocate("a", [1, 2, 3, 4])
     assert bm.lookup_prefix([1, 2, 3, 4]) == ([], 0)
+
+
+def test_release_out_of_window_returns_blocks():
+    bm = BlockManager(num_blocks=16, block_size=4,
+                      enable_prefix_caching=False)
+    bm.allocate("s", list(range(20)))          # 5 blocks
+    free0 = bm.num_free_blocks
+    # window starts at token 13 -> blocks 0..2 hold only positions < 13? no:
+    # block 3 holds 12..15; first_needed 13 -> blocks 0..2 releasable
+    assert bm.release_out_of_window("s", 13) == 3
+    assert bm.num_free_blocks == free0 + 3
+    # idempotent; further progress releases more
+    assert bm.release_out_of_window("s", 13) == 0
+    assert bm.release_out_of_window("s", 17) == 1
+    # table keeps logical length; released entries report block 0
+    table = bm.block_table("s")
+    assert len(table) == 5 and table[:4] == [0, 0, 0, 0]
+    # tail slots still writable, released slots loudly not
+    bm.slot_for_token("s", 18)
+    with pytest.raises(IndexError):
+        bm.slot_for_token("s", 2)
+    # freeing a partially-released sequence returns exactly the remainder
+    bm.free("s")
+    assert bm.num_free_blocks == 16
+    assert bm.num_seqs() == 0
+
+
+def test_release_respects_shared_refcounts():
+    bm = BlockManager(num_blocks=16, block_size=4)
+    prompt = list(range(100, 116))              # 4 full blocks
+    bm.allocate("a", prompt)
+    shared, n = bm.lookup_prefix(prompt + [1])
+    assert len(shared) >= 2
+    bm.allocate("b", prompt + [1], shared_blocks=shared)
+    free0 = bm.num_free_blocks
+    # a releases its first two (shared) blocks: b still holds them, so
+    # they must NOT hit the pool yet
+    bm.release_out_of_window("a", 8)
+    assert bm.num_free_blocks == free0
+    bm.free("b")
+    bm.free("a")
+    assert bm.num_seqs() == 0

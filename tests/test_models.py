@@ -309,3 +309,34 @@ def test_qwen_style_sliding_window_gating():
     # mistral applies whenever set
     assert _sliding_window({"sliding_window": 4096}, "mistral") == 4096
     assert _sliding_window({"sliding_window": None}, "mistral") is None
+
+
+def test_sliding_window_rolling_buffer_capacity():
+    """The rolling buffer returns out-of-window blocks to the pool, so
+    windowed sequences fit a cache their full contexts would blow: four
+    32-token sequences (9 blocks each unreleased) serve concurrently from
+    a 24-block pool without a single preemption, and emit the same tokens
+    as an uncontended engine."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+
+    def mk(num_blocks):
+        return Engine(EngineConfig(
+            model="tiny-mistral",
+            cache=CacheConfig(block_size=4, num_blocks=num_blocks,
+                              max_blocks_per_seq=16),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2),
+            enable_prefix_caching=False))
+    prompts = [[i + 2, i + 3, i + 4] * 4 for i in range(4)]   # 12 tokens
+    p = SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
+    tight = mk(24)
+    outs = tight.generate(prompts, p)
+    assert all(len(r.output_token_ids) == 20 for r in outs)
+    assert tight.stats.preemptions == 0, (
+        "rolling buffer failed to hold 4 windowed seqs in 24 blocks")
+    assert tight.block_manager.num_seqs() == 0
+    assert tight.block_manager.num_free_blocks == 24
+    roomy = mk(64).generate(prompts, p)
+    for a, b in zip(outs, roomy):
+        assert a.output_token_ids == b.output_token_ids

@@ -324,3 +324,30 @@ def test_engine_spec_uses_native_proposer_when_available():
     assert out == [9, 9, 1]
     if native.native_available():
         assert spec._propose_impl is not spec._ngram_propose_py
+
+
+def test_native_release_out_of_window_parity():
+    """Rolling-buffer release must behave identically in C++ and Python."""
+    import pytest
+
+    from tpuserve import native
+    from tpuserve.runtime.block_manager import BlockManager
+
+    if not native.native_available():
+        pytest.skip("native extension unavailable")
+    impls = [BlockManager(16, 4, enable_prefix_caching=False),
+             native.NativeBlockManager(16, 4, enable_prefix_caching=False)]
+    for bm in impls:
+        bm.allocate("s", list(range(20)))
+    for step in (13, 13, 17, 5):
+        rel = [bm.release_out_of_window("s", step) for bm in impls]
+        assert rel[0] == rel[1], f"release({step}): {rel}"
+        frees = [bm.num_free_blocks for bm in impls]
+        assert frees[0] == frees[1]
+        tables = [bm.block_table("s") for bm in impls]
+        assert tables[0] == tables[1]
+    for bm in impls:
+        with pytest.raises(IndexError):
+            bm.slot_for_token("s", 2)
+        bm.free("s")
+    assert impls[0].num_free_blocks == impls[1].num_free_blocks == 16

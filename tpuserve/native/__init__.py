@@ -162,6 +162,10 @@ class NativeBlockManager:
     def block_table(self, seq_id: str) -> list[int]:
         return self._core.block_table(seq_id)
 
+    def release_out_of_window(self, seq_id: str,
+                              first_needed_token: int) -> int:
+        return self._core.release_out_of_window(seq_id, first_needed_token)
+
     def free(self, seq_id: str, cache_blocks: bool = True) -> None:
         self._core.free(seq_id, cache_blocks)
 

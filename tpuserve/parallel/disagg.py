@@ -124,6 +124,10 @@ class DisaggregatedEngine:
                 return cfg
             prefill_config = _halved(prefill_config)
             decode_config = _halved(decode_config)
+        # The prefill side must never window-release: migration ships its
+        # block_table() pages, and released entries would transfer block
+        # 0's unrelated KV and poison the decode pool's prefix cache.
+        prefill_config = _dc.replace(prefill_config, window_release=False)
         self.prefill = Engine(prefill_config, mesh=mesh)
         self.decode = Engine(decode_config, mesh=mesh)
         self.decode_device = decode_device

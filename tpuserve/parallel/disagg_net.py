@@ -154,7 +154,12 @@ class PrefillHandoffEngine:
     MIGRATE_RETRY_DELAY_S = 2.0
 
     def __init__(self, engine_config, decode_url: str, mesh=None):
+        import dataclasses as _dc
+
         from tpuserve.runtime.engine import Engine
+        # never window-release on the prefill side: migration ships
+        # block_table() pages (see parallel/disagg.py for the full story)
+        engine_config = _dc.replace(engine_config, window_release=False)
         self.prefill = Engine(engine_config, mesh=mesh)
         self.decode_url = decode_url.rstrip("/")
         self.tokenizer = self.prefill.tokenizer

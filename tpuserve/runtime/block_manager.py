@@ -23,10 +23,17 @@ from typing import Optional
 from tpuserve.utils import cdiv
 
 
+# Sentinel in a sequence's block table for a leading block returned to the
+# pool by the sliding-window rolling buffer (release_out_of_window): the
+# logical index keeps its place so tail slot arithmetic is unchanged.
+RELEASED = -1
+
+
 @dataclasses.dataclass
 class SeqAlloc:
     blocks: list[int]
     num_tokens: int                  # tokens written so far
+    released_upto: int = 0           # logical blocks returned to the pool
 
 
 class BlockManager:
@@ -203,11 +210,55 @@ class BlockManager:
         alloc = self._seqs[seq_id]
         if token_idx < 0:
             raise IndexError("token index out of range")
-        return (alloc.blocks[token_idx // self.block_size] * self.block_size
-                + token_idx % self.block_size)
+        b = alloc.blocks[token_idx // self.block_size]
+        if b == RELEASED:
+            raise IndexError(
+                f"token {token_idx} of {seq_id} is in a window-released "
+                "block — writes must stay at or after the window start")
+        return b * self.block_size + token_idx % self.block_size
 
     def block_table(self, seq_id: str) -> list[int]:
-        return list(self._seqs[seq_id].blocks)
+        """Physical block ids by logical index.  Window-released entries
+        are reported as block 0: the attention kernels never DMA (Pallas)
+        or un-mask (reference) positions before the window, so any valid
+        id is safe — and a valid id keeps gathers in bounds."""
+        return [0 if b == RELEASED else b
+                for b in self._seqs[seq_id].blocks]
+
+    def _release_block(self, b: int, cache_blocks: bool = True) -> None:
+        rc = self._refcount.get(b, 1) - 1
+        if rc > 0:
+            self._refcount[b] = rc
+            return
+        self._refcount.pop(b, None)
+        if not cache_blocks:
+            self._drop_hash(b)
+        if b in self._block_hash:       # keep KV around for prefix reuse
+            self._cached[b] = None
+            self._cached.move_to_end(b)
+        else:
+            self._free.append(b)
+
+    def release_out_of_window(self, seq_id: str,
+                              first_needed_token: int) -> int:
+        """Sliding-window rolling buffer: return the blocks holding only
+        positions before ``first_needed_token`` to the pool (the window
+        will never attend them again), keeping the logical table length so
+        tail slot arithmetic is unchanged.  Cache capacity for a windowed
+        model thus scales with the WINDOW, not the context.  Returns the
+        number of blocks released."""
+        alloc = self._seqs[seq_id]
+        first_block = min(first_needed_token // self.block_size,
+                          len(alloc.blocks))
+        released = 0
+        for i in range(alloc.released_upto, first_block):
+            b = alloc.blocks[i]
+            if b != RELEASED:
+                self._release_block(b)
+                alloc.blocks[i] = RELEASED
+                released += 1
+        alloc.released_upto = max(alloc.released_upto, first_block)
+        return released
 
     def free(self, seq_id: str, cache_blocks: bool = True) -> None:
         """Release a sequence's blocks.  ``cache_blocks=False`` drops their
@@ -219,18 +270,9 @@ class BlockManager:
         if alloc is None:
             return
         for b in alloc.blocks:
-            rc = self._refcount.get(b, 1) - 1
-            if rc > 0:
-                self._refcount[b] = rc
+            if b == RELEASED:               # already back in the pool
                 continue
-            self._refcount.pop(b, None)
-            if not cache_blocks:
-                self._drop_hash(b)
-            if b in self._block_hash:       # keep KV around for prefix reuse
-                self._cached[b] = None
-                self._cached.move_to_end(b)
-            else:
-                self._free.append(b)
+            self._release_block(b, cache_blocks)
 
     def num_seqs(self) -> int:
         return len(self._seqs)

@@ -69,6 +69,11 @@ class EngineConfig:
     # (synchronous backend — nothing overlaps, the extra dispatches only
     # cost; measured 2.6x slower on the CPU smoke bench).
     pipeline_decode: Optional[bool] = None
+    # Sliding-window rolling buffer (Engine._release_window_blocks).
+    # Disabled for disagg PREFILL engines: migration ships block_table()
+    # pages, and released entries would transfer block 0's unrelated KV
+    # and register garbage prefix hashes in the decode pool.
+    window_release: bool = True
     # Speculative decoding (n-gram prompt-lookup drafts + one verify pass,
     # runtime/spec.py).  None disables.  Greedy batches only; sampled /
     # penalty / logprob batches run the normal decode path.
@@ -447,7 +452,27 @@ class Engine:
             if outputs is None:
                 outputs = self._run_decode(batch)
         self.stats.last_step_time = time.monotonic() - t0
+        self._release_window_blocks()
         return outputs
+
+    def _release_window_blocks(self) -> None:
+        """Sliding-window rolling buffer: blocks whose every position fell
+        behind the attention window go back to the pool, so a windowed
+        model's cache footprint scales with the WINDOW, not the context
+        (vLLM's rolling-buffer cache for Mistral).  Safe against in-flight
+        device work: TPU executes dispatches in order, so any reuse of a
+        released block is ordered after the steps that attended it."""
+        W = self.model_cfg.sliding_window
+        if not W or not self.config.window_release:
+            return
+        bm = self.block_manager
+        for r in self.scheduler.running:
+            bm.release_out_of_window(r.request_id, max(0, r.num_tokens - W))
+        for r in self.scheduler.waiting:
+            # mid-chunk long prompts free their tail-window backlog too
+            if r.num_prefilled > 0:
+                bm.release_out_of_window(r.request_id,
+                                         max(0, r.num_prefilled - W))
 
     def _next_key(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)
