@@ -65,6 +65,9 @@ NEG_INF = -1e30
 VMEM_BUDGET_BYTES = int(os.environ.get("TPUSERVE_VMEM_BUDGET_MB", "12")) * 2**20
 
 
+MIN_SUBLANES = {1: 32, 2: 16, 4: 8}   # Mosaic min tile rows by itemsize
+
+
 def _clamp_to_vmem_budget(pages_g: int, seqs_pp: int, page_size: int,
                           num_kv_heads: int, head_dim: int,
                           kv_itemsize: int, num_q_heads: int,
@@ -72,21 +75,30 @@ def _clamp_to_vmem_budget(pages_g: int, seqs_pp: int, page_size: int,
                           scale_itemsize: int = 0) -> tuple[int, int]:
     """Shrink (pages_g, seqs_pp) until the kernel's VMEM footprint fits.
 
-    Footprint model (what the kernel actually allocates):
+    Footprint model (what Mosaic actually allocates — the trailing two
+    dims of every VMEM array are padded to the dtype's minimum tile, so
+    narrow-head caches cost far more than their dense byte count):
       - KV scratch: 2 slots (double buffer) x {K,V} x pages_g x page x
-        Hkv x D at the cache dtype;
-      - int8 caches add per-(token, head) scale scratch — D-free, so it
-        is ~3% of the KV bytes, NOT folded into kv_itemsize (which the
-        model multiplies by D);
+        padded(Hkv) x D at the cache dtype — Hkv pads to 32 rows for
+        int8, 16 for bf16, 8 for f32, which is why an 8-kv-head int8
+        cache does NOT shrink scratch 2x;
+      - int8 scale scratch (2 x {K,V} x pages_g x page x Hkv f32): the
+        trailing dim Hkv pads to the 128-lane width;
       - q/out pipeline blocks: 2 buffers each (Pallas double-buffers
-        grid-indexed blocks) x seqs_pp x Hq x D at the activation dtype.
+        grid-indexed blocks) x seqs_pp x padded(Hq) x D.
     pages_g halves first (it dominates and shrinking it only shortens the
     DMA pipeline), then seqs_pp."""
+    from tpuserve.utils import round_up
+    kv_rows = round_up(num_kv_heads, MIN_SUBLANES.get(kv_itemsize, 8))
+    q_rows = round_up(num_q_heads, MIN_SUBLANES.get(q_itemsize, 8))
+    lanes = round_up(head_dim, 128)   # lane dim pads to the 128 width too
+
     def footprint(pg: int, sp: int) -> int:
-        rows = 2 * 2 * pg * page_size * num_kv_heads
-        kv = rows * (head_dim * kv_itemsize + scale_itemsize)
-        qo = 2 * 2 * sp * num_q_heads * head_dim * q_itemsize
-        return kv + qo
+        kv = 2 * 2 * pg * page_size * kv_rows * lanes * kv_itemsize
+        scales = (2 * 2 * pg * round_up(page_size, 8)
+                  * round_up(num_kv_heads, 128) * scale_itemsize)
+        qo = 2 * 2 * sp * q_rows * lanes * q_itemsize
+        return kv + scales + qo
 
     orig = (pages_g, seqs_pp)
     while footprint(pages_g, seqs_pp) > VMEM_BUDGET_BYTES and pages_g > 1:

@@ -196,6 +196,15 @@ PRESETS: dict[str, dict] = {
         "machine_type": "ct5lp-hightpu-4t", "tensor_parallel": 4,
         "chat_template": "opt",
     },
+    # sliding-window long-context serving (beyond the reference's model
+    # set): rolling-buffer KV keeps cache footprint O(window), int8
+    # weights+KV halve decode's HBM bytes
+    "mistral-7b-v5e4": {
+        "model": "mistralai/Mistral-7B-Instruct-v0.1",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t", "tensor_parallel": 4,
+        "quantization": "int8", "kv_cache_dtype": "int8",
+    },
     # disaggregated prefill/decode pools on a v5e-8 (BASELINE "Llama-3-8B
     # disaggregated prefill/decode on v5e-8"): 4 chips prefill + 4 decode,
     # KV handoff over ICI within the slice
