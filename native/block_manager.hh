@@ -209,6 +209,10 @@ class BlockManager {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
     SeqAlloc& a = it->second;
+    // never release the newest written position's block (or beyond): the
+    // next append / spec-verify rewrite targets it (mirrors Python)
+    int64_t newest = a.num_tokens > 0 ? a.num_tokens - 1 : 0;
+    if (first_needed_token > newest) first_needed_token = newest;
     int64_t first_block = first_needed_token / block_size_;
     if (first_block > static_cast<int64_t>(a.blocks.size()))
       first_block = static_cast<int64_t>(a.blocks.size());

@@ -162,6 +162,14 @@ def test_randomized_differential():
             assert (err_py is None) == (err_cc is None), f"step {step}"
             if err_py is None:
                 assert s_py == s_cc, f"step {step}"
+        elif op < 0.85 and live:
+            # sliding-window rolling buffer: release a random leading span
+            sid = rng.choice(live)
+            first_needed = rng.randrange(0, 40)
+            r_py = py.release_out_of_window(sid, first_needed)
+            r_cc = cc.release_out_of_window(sid, first_needed)
+            assert r_py == r_cc, f"step {step}"
+            assert py.block_table(sid) == cc.block_table(sid), f"step {step}"
         elif live:
             sid = live.pop(rng.randrange(len(live)))
             py.free(sid); cc.free(sid)

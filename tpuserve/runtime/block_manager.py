@@ -248,6 +248,11 @@ class BlockManager:
         model thus scales with the WINDOW, not the context.  Returns the
         number of blocks released."""
         alloc = self._seqs[seq_id]
+        # never release the newest written position's block (or beyond):
+        # the next append / spec-verify rewrite targets it, and a write
+        # into a released block would corrupt whoever owns it now
+        first_needed_token = min(first_needed_token,
+                                 max(alloc.num_tokens - 1, 0))
         first_block = min(first_needed_token // self.block_size,
                           len(alloc.blocks))
         released = 0
