@@ -133,6 +133,17 @@ def _gemma():
         bos_token_id=0, eos_token_id=1))
 
 
+def _qwen2_swa():
+    # mixed per-layer attention: layer 0 full, layer 1 windowed (HF
+    # max_window_layers semantics) — the config gate used to reject this
+    return transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        use_sliding_window=True, sliding_window=6, max_window_layers=1,
+        bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
+
+
 def _mistral():
     # sliding_window smaller than the test sequence so windowed attention
     # actually changes the logits (full-context parity would pass even if
@@ -147,7 +158,7 @@ def _mistral():
 
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
              "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
-             "mistral": _mistral}
+             "mistral": _mistral, "qwen2_swa": _qwen2_swa}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -178,6 +189,9 @@ def test_family_logits_match_transformers(family, tmp_path):
         # the 12-token test sequence exceeds the 6-token window, so parity
         # proves the window is actually applied
         assert cfg.sliding_window == 6
+    if family == "qwen2_swa":
+        assert cfg.sliding_window == 6
+        assert cfg.full_attention_first_layers == 1
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

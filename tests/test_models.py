@@ -286,29 +286,29 @@ def test_tiny_mistral_sliding_window_serves():
 
 def test_qwen_style_sliding_window_gating():
     """Qwen2-style configs: the window applies only when use_sliding_window
-    is on AND no leading layers are full-attention (HF: the FIRST
-    max_window_layers layers use full attention)."""
-    import pytest
-
+    is on; HF's max_window_layers (the FIRST that-many layers use full
+    attention) maps onto full_attention_first_layers."""
     from tpuserve.models.config import _sliding_window
 
     base = {"sliding_window": 4096, "num_hidden_layers": 28}
     # qwen default: field present but disabled
     assert _sliding_window({**base, "use_sliding_window": False},
-                           "qwen2") is None
+                           "qwen2") == {}
     # enabled but every layer full-attention (mwl == num_layers): no window
     assert _sliding_window({**base, "use_sliding_window": True,
-                            "max_window_layers": 28}, "qwen2") is None
-    # uniform SWA (mwl == 0): supported
+                            "max_window_layers": 28}, "qwen2") == {}
+    # uniform SWA (mwl == 0)
     assert _sliding_window({**base, "use_sliding_window": True,
-                            "max_window_layers": 0}, "qwen2") == 4096
-    # mixed per-layer: loud rejection
-    with pytest.raises(ValueError, match="per-layer"):
-        _sliding_window({**base, "use_sliding_window": True,
-                         "max_window_layers": 14}, "qwen2")
+                            "max_window_layers": 0}, "qwen2") == {
+        "sliding_window": 4096, "full_attention_first_layers": 0}
+    # mixed per-layer: first 14 layers full attention, rest windowed
+    assert _sliding_window({**base, "use_sliding_window": True,
+                            "max_window_layers": 14}, "qwen2") == {
+        "sliding_window": 4096, "full_attention_first_layers": 14}
     # mistral applies whenever set
-    assert _sliding_window({"sliding_window": 4096}, "mistral") == 4096
-    assert _sliding_window({"sliding_window": None}, "mistral") is None
+    assert _sliding_window({"sliding_window": 4096}, "mistral") == {
+        "sliding_window": 4096, "full_attention_first_layers": 0}
+    assert _sliding_window({"sliding_window": None}, "mistral") == {}
 
 
 def test_sliding_window_rolling_buffer_capacity():

@@ -48,6 +48,11 @@ class ModelConfig:
     # correctness for the family, decode skips whole KV pages outside the
     # window — at 32k context with a 4k window that is 8x fewer KV reads.
     sliding_window: Optional[int] = None
+    # Qwen2-style mixed layers: the FIRST this-many layers use full
+    # attention, the rest the sliding window (HF max_window_layers).
+    # Non-zero disables the rolling-buffer block release — full-attention
+    # layers need every position's KV forever.
+    full_attention_first_layers: int = 0
     tie_word_embeddings: bool = True
     learned_pos_offset: int = 0      # OPT stores positions shifted by 2
     final_layernorm: bool = True
@@ -61,6 +66,16 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None
     norm_topk_prob: bool = True      # renormalise the top-k router weights
+
+    def layer_window(self, layer_idx: int) -> Optional[int]:
+        """Effective sliding window for one layer: the first
+        ``full_attention_first_layers`` layers run full attention (HF
+        max_window_layers semantics); ONE implementation for every
+        forward path."""
+        if (self.sliding_window is None
+                or layer_idx < self.full_attention_first_layers):
+            return None
+        return self.sliding_window
 
     @property
     def q_size(self) -> int:
@@ -214,34 +229,43 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
         qk_norm="qwen3" in family,
         attention_bias="qwen2" in family or hf.get("attention_bias", False),
-        sliding_window=_sliding_window(hf, family),
+        **_sliding_window(hf, family),
         **moe,
         **common,
     )
 
 
-def _sliding_window(hf: dict, family: str):
+def _sliding_window(hf: dict, family: str) -> dict:
     """Mistral applies its sliding_window whenever set; Qwen2/Qwen3 carry
     the field but gate it behind use_sliding_window (default off) and
     max_window_layers.  Honoring a disabled window would corrupt long-
-    context serving for every Qwen checkpoint."""
+    context serving for every Qwen checkpoint.
+
+    HF max_window_layers semantics: the FIRST that-many layers use full
+    attention, the rest the window — mapped onto
+    ``full_attention_first_layers``."""
     sw = hf.get("sliding_window")
     if sw is None:
-        return None
+        return {}
     if not hf.get("use_sliding_window", "mistral" in family):
-        return None
-    # HF semantics: the FIRST max_window_layers layers use full attention;
-    # layers at or after it use the window.
+        return {}
     mwl = hf.get("max_window_layers")
     nl = hf.get("num_hidden_layers", 0)
-    if mwl is not None:
-        if mwl >= nl:
-            return None                   # window never applies
-        if mwl > 0:
+    if mwl is None:
+        if "mistral" in family:
+            mwl = 0                       # mistral windows every layer
+        else:
+            # HF Qwen2Config defaults max_window_layers=28 INDEPENDENT of
+            # the layer count; guessing here risks silently windowing
+            # layers transformers runs full — demand the field instead
             raise ValueError(
-                f"per-layer sliding windows (max_window_layers={mwl} of "
-                f"{nl} layers full-attention) are not supported yet")
-    return int(sw)
+                "use_sliding_window is enabled but max_window_layers is "
+                "missing; add it to the config (HF defaults it per-class, "
+                "not per-model)")
+    if nl and mwl >= nl:
+        return {}                         # window never applies
+    return {"sliding_window": int(sw),
+            "full_attention_first_layers": int(mwl)}
 
 
 def _first(x):

@@ -207,9 +207,9 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = _embed(params, cfg, tokens, positions)
     scale = cfg.head_dim ** -0.5
-    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
         # batched prefill attends over the FRESH k/v (full precision even
@@ -289,9 +289,9 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = ctx_lens[:, None] + jnp.arange(C)[None, :]
     h = _embed(params, cfg, tokens, positions)
     scale = cfg.head_dim ** -0.5
-    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
@@ -359,9 +359,9 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     B = tokens.shape[0]
     h = _embed(params, cfg, tokens, positions)                 # (B, H)
     scale = cfg.head_dim ** -0.5
-    sw = cfg.sliding_window
     new_cache = []
     for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)                 # (B, Hq/Hkv, D)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
@@ -488,11 +488,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = _embed(params, cfg, tokens, positions)
     scale = cfg.head_dim ** -0.5
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
         out = attn_ops.prefill_attention(q, k, v, seq_lens, scale,
-                                         sliding_window=cfg.sliding_window)
+                                         sliding_window=cfg.layer_window(li))
         h = h + _linear(out.reshape(B, T, cfg.q_size), lp["o_proj"])
         hn = _norm(h, lp["mlp_norm"], cfg)
         h = h + _mlp(hn, lp, cfg)

@@ -465,6 +465,10 @@ class Engine:
         W = self.model_cfg.sliding_window
         if not W or not self.config.window_release:
             return
+        if self.model_cfg.full_attention_first_layers:
+            # mixed-layer models keep full-attention layers that need
+            # every position's KV forever — nothing is releasable
+            return
         bm = self.block_manager
         for r in self.scheduler.running:
             bm.release_out_of_window(r.request_id, max(0, r.num_tokens - W))
