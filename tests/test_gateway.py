@@ -36,6 +36,22 @@ def stack():
         s.shutdown()
 
 
+def _drain(gw, timeout=10.0):
+    """Wait for in-flight relay handlers to release their backends: the
+    handler thread's finally-release runs AFTER the client has read the
+    body, so outstanding counts linger briefly — affinity assertions that
+    depend on load state must not race them."""
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with gw._lock:
+            if all(b.outstanding == 0 for b in gw.backends):
+                return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"gateway backends never drained: {gw.status()['backends']}")
+
+
 def _post(url, payload, timeout=120):
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode(),
@@ -71,6 +87,7 @@ def test_gateway_streaming_passthrough(stack):
 
 def test_gateway_prefix_affinity(stack):
     gw = stack["gw"]
+    _drain(gw)
     body = json.dumps({"prompt": "affinity-prompt", "max_tokens": 1}).encode()
     b1 = gw.pick_backend(body)
     gw.release(b1, ok=True)
@@ -93,6 +110,7 @@ def test_gateway_affinity_agrees_across_replicas(stack):
     rendezvous must not collapse onto one backend."""
     from tpuserve.server.gateway import Gateway, GatewayConfig
     gw1 = stack["gw"]
+    _drain(gw1)
     gw2 = Gateway(stack["urls"], GatewayConfig(host="127.0.0.1", port=0))
     picks = set()
     for i in range(32):
@@ -117,6 +135,7 @@ def test_gateway_two_replica_prefix_cache_hit_rate(stack):
                                                health_interval_s=0.5))
     g2port = gw2.start()
     try:
+        _drain(stack["gw"])
         # ByteTokenizer: 1 token/char; keep prompt+gen inside the tiny
         # fixture's 32-token budget
         payload = {"prompt": "shared sys prefix abc",
