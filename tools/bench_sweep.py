@@ -48,6 +48,9 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
      {"TPUSERVE_PAGES_PER_GROUP": "4"}),
     ("pallas-ppg32", ["--attn", "pallas", "--multi-step", "1"],
      {"TPUSERVE_PAGES_PER_GROUP": "32"}),
+    # flash prefill block split (prefill bounds TTFT)
+    ("flash-q64", [], {"TPUSERVE_FLASH_BLK_Q": "64"}),
+    ("flash-k256", [], {"TPUSERVE_FLASH_BLK_K": "256"}),
     ("multistep64", ["--multi-step", "64"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),

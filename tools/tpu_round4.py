@@ -52,6 +52,7 @@ PRIORITY = [
     "long-prompt",
     "int8-multistep16",
     "pallas-spp16",                           # re-time with the VMEM clamp
+    "flash-q64", "flash-k256",                # prefill block split (TTFT)
     "phi3-mini", "opt-1.3b", "llama3-8b-int8",
     "mistral7b-int8-sw8k",                    # windowed page-skip decode
     "cold-cache",

@@ -93,8 +93,6 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0, :, :] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k",
-                                             "interpret", "sliding_window"))
 def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             prompt_lens: jnp.ndarray, scale: float,
                             blk_q: int = 128, blk_k: int = 128,
@@ -105,7 +103,30 @@ def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     T is padded (bucketed) by the engine; query rows past prompt_lens still
     attend to the valid keys (same as the reference impl) — the engine only
     reads the row at prompt_len - 1, so their values are never consumed.
-    """
+
+    ``TPUSERVE_FLASH_BLK_Q``/``_K`` override the block split (sweepable on
+    silicon — prefill bounds TTFT).  Resolved HERE, outside jit: an env
+    read inside the traced function would freeze at first trace (the jit
+    cache key only covers shapes and statics)."""
+    import os
+    env_q = os.environ.get("TPUSERVE_FLASH_BLK_Q")
+    env_k = os.environ.get("TPUSERVE_FLASH_BLK_K")
+    if env_q:
+        blk_q = int(env_q)
+    if env_k:
+        blk_k = int(env_k)
+    return _flash_prefill_attention(q, k, v, prompt_lens, scale=scale,
+                                    blk_q=blk_q, blk_k=blk_k,
+                                    interpret=interpret,
+                                    sliding_window=sliding_window)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k",
+                                             "interpret", "sliding_window"))
+def _flash_prefill_attention(q, k, v, prompt_lens, *, scale: float,
+                             blk_q: int, blk_k: int,
+                             interpret: bool | None,
+                             sliding_window: int | None) -> jnp.ndarray:
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
