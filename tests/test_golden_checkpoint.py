@@ -144,6 +144,21 @@ def _qwen2_swa():
         bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
 
 
+def _gemma2():
+    # Gemma2's full trait set: sandwich norms (post-attn + pre/post-ffn),
+    # tanh softcaps on attention scores AND final logits, attention scale
+    # from query_pre_attn_scalar, alternating sliding/full layers with a
+    # window smaller than the test sequence
+    return transformers.Gemma2ForCausalLM(transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=512,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        sliding_window=6, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, query_pre_attn_scalar=24,
+        bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
+
+
 def _mistral():
     # sliding_window smaller than the test sequence so windowed attention
     # actually changes the logits (full-context parity would pass even if
@@ -158,7 +173,8 @@ def _mistral():
 
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
              "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
-             "mistral": _mistral, "qwen2_swa": _qwen2_swa}
+             "mistral": _mistral, "qwen2_swa": _qwen2_swa,
+             "gemma2": _gemma2}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -192,6 +208,11 @@ def test_family_logits_match_transformers(family, tmp_path):
     if family == "qwen2_swa":
         assert cfg.sliding_window == 6
         assert cfg.full_attention_first_layers == 1
+    if family == "gemma2":
+        assert cfg.sandwich_norms and cfg.window_pattern == "alternate"
+        assert cfg.attn_logit_softcapping == 50.0
+        assert cfg.final_logit_softcapping == 30.0
+        assert cfg.layer_window(0) == 6 and cfg.layer_window(1) is None
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

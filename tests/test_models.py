@@ -340,3 +340,43 @@ def test_sliding_window_rolling_buffer_capacity():
     roomy = mk(64).generate(prompts, p)
     for a, b in zip(outs, roomy):
         assert a.output_token_ids == b.output_token_ids
+
+
+def test_tiny_gemma2_serves_all_impls():
+    """Gemma2's full trait set through the serving engine: sandwich norms,
+    attention/final softcaps, qpas scale, alternating sliding/full layers.
+    reference and pallas (interpret) agree token for token, and the
+    chunked-prefill route matches — covering softcap + alternation in
+    every kernel."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+
+    def mk(attn, chunk=64):
+        return Engine(EngineConfig(
+            model="tiny-gemma2", attn_impl=attn,
+            cache=CacheConfig(block_size=4, num_blocks=128,
+                              max_blocks_per_seq=32),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2,
+                                      prefill_chunk_size=chunk)))
+    prompts = [list(range(2, 30)), [5, 6, 7] * 4]   # 28 tokens >> window 8
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    ref = mk("reference").generate(prompts, p)
+    pal = mk("pallas").generate(prompts, p)
+    for a, b in zip(ref, pal):
+        assert len(a.output_token_ids) == 10
+        assert a.output_token_ids == b.output_token_ids
+    for impl in ("reference", "pallas"):   # pallas = the WINDOW KERNEL's
+        chunked = mk(impl, chunk=16).generate(prompts, p)   # softcap path
+        for a, b in zip(ref, chunked):
+            assert a.output_token_ids == b.output_token_ids
+    # mixed layers: the rolling buffer must NOT release (odd layers are
+    # full attention and need all KV) — fail loudly if any release fires
+    eng = mk("reference")
+
+    def _boom(*a, **kw):
+        raise AssertionError("release_out_of_window fired on a "
+                             "mixed-layer (non-uniform-window) model")
+    eng.block_manager.release_out_of_window = _boom
+    eng.generate(prompts, p)
+    assert not eng.model_cfg.uniform_window

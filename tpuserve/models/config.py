@@ -53,6 +53,16 @@ class ModelConfig:
     # Non-zero disables the rolling-buffer block release — full-attention
     # layers need every position's KV forever.
     full_attention_first_layers: int = 0
+    # "first_full" (Qwen2) or "alternate" (Gemma2: even layers sliding,
+    # odd layers full) — see layer_window()
+    window_pattern: str = "first_full"
+    # Gemma2 traits: tanh softcaps on attention scores / final logits,
+    # attention scale from query_pre_attn_scalar instead of head_dim, and
+    # sandwich norms (post-attention + pre/post-feedforward layernorms).
+    attn_logit_softcapping: Optional[float] = None
+    final_logit_softcapping: Optional[float] = None
+    query_pre_attn_scalar: Optional[int] = None
+    sandwich_norms: bool = False
     tie_word_embeddings: bool = True
     learned_pos_offset: int = 0      # OPT stores positions shifted by 2
     final_layernorm: bool = True
@@ -68,14 +78,33 @@ class ModelConfig:
     norm_topk_prob: bool = True      # renormalise the top-k router weights
 
     def layer_window(self, layer_idx: int) -> Optional[int]:
-        """Effective sliding window for one layer: the first
-        ``full_attention_first_layers`` layers run full attention (HF
-        max_window_layers semantics); ONE implementation for every
-        forward path."""
-        if (self.sliding_window is None
-                or layer_idx < self.full_attention_first_layers):
+        """Effective sliding window for one layer — ONE implementation for
+        every forward path.  "first_full": the first
+        ``full_attention_first_layers`` layers run full attention (Qwen2
+        max_window_layers).  "alternate": even layers sliding, odd full
+        (Gemma2 layer_types)."""
+        if self.sliding_window is None:
+            return None
+        if self.window_pattern == "alternate":
+            return self.sliding_window if layer_idx % 2 == 0 else None
+        if layer_idx < self.full_attention_first_layers:
             return None
         return self.sliding_window
+
+    @property
+    def uniform_window(self) -> bool:
+        """True when EVERY layer is windowed — the rolling-buffer block
+        release is only sound then (any full-attention layer needs every
+        position's KV forever)."""
+        return (self.sliding_window is not None
+                and all(self.layer_window(i) is not None
+                        for i in range(self.num_layers)))
+
+    @property
+    def attn_scale(self) -> float:
+        """Attention score scale: Gemma2 uses query_pre_attn_scalar**-0.5
+        instead of head_dim**-0.5."""
+        return (self.query_pre_attn_scalar or self.head_dim) ** -0.5
 
     @property
     def q_size(self) -> int:
@@ -162,14 +191,46 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             mlp_bias=True,
             **common,
         )
-    # first-generation gemma by model_type OR architectures (some configs
-    # omit model_type); gemma2/gemma3 add pre/post-feedforward norms,
-    # soft-capping and sliding windows — falling through to the llama
-    # path would load and SILENTLY mis-serve, so reject those loudly
+    # gemma generations by model_type OR architectures (some configs omit
+    # model_type); gemma3 adds per-layer rope scaling etc. — falling
+    # through to the llama path would load and SILENTLY mis-serve, so
+    # unsupported generations reject loudly
     gemma1 = mt == "gemma" or arch.startswith("gemmafor")
-    if "gemma" in family and not gemma1:
+    gemma2 = mt == "gemma2" or arch.startswith("gemma2for")
+    if "gemma" in family and not (gemma1 or gemma2):
         raise ValueError(f"model family {family!r} is not supported yet "
-                         "(only first-generation gemma)")
+                         "(gemma and gemma2 are)")
+    if gemma2:
+        nh = hf["num_attention_heads"]
+        lt = hf.get("layer_types")
+        if lt is not None and any(
+                (t == "sliding_attention") != (i % 2 == 0)
+                for i, t in enumerate(lt)):
+            raise ValueError(
+                "gemma2 checkpoints with a non-alternating layer_types "
+                f"pattern are not supported yet (got {lt[:6]}...)")
+        common["tie_word_embeddings"] = hf.get("tie_word_embeddings", True)
+        return ModelConfig(
+            intermediate_size=hf["intermediate_size"],
+            num_kv_heads=hf.get("num_key_value_heads", nh),
+            head_dim=hf.get("head_dim") or hf["hidden_size"] // nh,
+            norm="rmsnorm",
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_weight_offset=1.0,
+            embed_scale_by_sqrt_dim=True,
+            act=(hf.get("hidden_activation") or hf.get("hidden_act")
+                 or "gelu_pytorch_tanh"),
+            mlp_style="gated",
+            pos="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            sliding_window=hf.get("sliding_window"),
+            window_pattern="alternate",
+            attn_logit_softcapping=hf.get("attn_logit_softcapping"),
+            final_logit_softcapping=hf.get("final_logit_softcapping"),
+            query_pre_attn_scalar=hf.get("query_pre_attn_scalar"),
+            sandwich_norms=True,
+            **common,
+        )
     if gemma1:
         # Gemma: llama-shaped weights, but RMSNorm(1 + w), sqrt(hidden)
         # embedding scale, tanh-GELU MLP, tied embeddings, head_dim from
@@ -332,6 +393,19 @@ register_model_config(ModelConfig(
 ), "mistral-7b")
 
 register_model_config(ModelConfig(
+    name="google/gemma-2-2b",
+    vocab_size=256000, hidden_size=2304, intermediate_size=9216,
+    num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+    max_position_embeddings=8192, rope_theta=10000.0, norm_eps=1e-6,
+    norm_weight_offset=1.0, embed_scale_by_sqrt_dim=True,
+    act="gelu_pytorch_tanh", tie_word_embeddings=True,
+    sliding_window=4096, window_pattern="alternate",
+    attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+    query_pre_attn_scalar=256, sandwich_norms=True,
+    bos_token_id=2, eos_token_id=1,
+), "gemma2-2b")
+
+register_model_config(ModelConfig(
     name="google/gemma-2b",
     vocab_size=256000, hidden_size=2048, intermediate_size=16384,
     num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
@@ -380,6 +454,20 @@ register_model_config(ModelConfig(
     # float32: the windowed tests assert token equality ACROSS impls
     # (reference/pallas/chunked/spec/disagg), and random-init logit gaps
     # (~4e-3) sit below bf16 rounding — bf16 argmax is path-sensitive
+    dtype="float32",
+))
+
+register_model_config(ModelConfig(
+    name="tiny-gemma2",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=24,
+    max_position_embeddings=512, norm_weight_offset=1.0,
+    embed_scale_by_sqrt_dim=True, act="gelu_pytorch_tanh",
+    tie_word_embeddings=True, eos_token_id=1,
+    sliding_window=8, window_pattern="alternate",
+    attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+    query_pre_attn_scalar=24, sandwich_norms=True,
+    # float32 for the cross-impl token-equality tests (see tiny-mistral)
     dtype="float32",
 ))
 

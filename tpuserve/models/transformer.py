@@ -78,6 +78,26 @@ def _act(x: jnp.ndarray, name: str) -> jnp.ndarray:
     raise ValueError(f"unknown activation {name}")
 
 
+def _attn_residual(out: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+    """Attention output projection; Gemma2 sandwich norms apply a
+    post-attention layernorm to the projected output before the residual
+    add."""
+    att = _linear(out, lp["o_proj"])
+    if cfg.sandwich_norms:
+        att = _norm(att, lp["post_attn_norm"], cfg)
+    return att
+
+
+def _mlp_residual(h: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+    """Pre-norm MLP branch; under sandwich norms the pre-norm weights are
+    the checkpoint's pre_feedforward_layernorm (mapped onto ``mlp_norm``)
+    and a post-feedforward layernorm wraps the output before the add."""
+    m = _mlp(_norm(h, lp["mlp_norm"], cfg), lp, cfg)
+    if cfg.sandwich_norms:
+        m = _norm(m, lp["post_mlp_norm"], cfg)
+    return m
+
+
 def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     if cfg.num_experts:
         return _moe_mlp(x, p, cfg)
@@ -181,7 +201,11 @@ def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
             logits = h @ ew["weight"].T
     else:
         logits = _linear(h, params["lm_head"])
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if cfg.final_logit_softcapping:
+        cap = cfg.final_logit_softcapping
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 # --------------------------------------------------------------------------
@@ -206,7 +230,7 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     B, T = tokens.shape
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = _embed(params, cfg, tokens, positions)
-    scale = cfg.head_dim ** -0.5
+    scale = cfg.attn_scale
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
@@ -219,18 +243,20 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         if attn_impl == "pallas" and mesh is not None:
             from tpuserve.ops.pallas_tp import flash_prefill_attention_tp
             out = flash_prefill_attention_tp(q, k, v, prompt_lens, scale,
-                                             mesh, sliding_window=sw)
+                                             mesh, sliding_window=sw,
+                                             logit_softcap=cfg.attn_logit_softcapping)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_flash_attention import flash_prefill_attention
             out = flash_prefill_attention(q, k, v, prompt_lens, scale,
-                                          sliding_window=sw)
+                                          sliding_window=sw,
+                                          logit_softcap=cfg.attn_logit_softcapping)
         else:
             out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
-                                             sliding_window=sw)
+                                             sliding_window=sw,
+                                             logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, T, cfg.q_size)
-        h = h + _linear(out, lp["o_proj"])
-        hn = _norm(h, lp["mlp_norm"], cfg)
-        h = h + _mlp(hn, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
     last_idx = jnp.maximum(prompt_lens - 1, 0)
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # (B, H)
     return _unembed(params, cfg, h_last), new_cache
@@ -288,7 +314,7 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     B, C = tokens.shape
     positions = ctx_lens[:, None] + jnp.arange(C)[None, :]
     h = _embed(params, cfg, tokens, positions)
-    scale = cfg.head_dim ** -0.5
+    scale = cfg.attn_scale
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
@@ -302,20 +328,22 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             from tpuserve.ops.pallas_tp import paged_window_attention_tp
             out = paged_window_attention_tp(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale, mesh,
-                k_scale=ks, v_scale=vs, sliding_window=sw)
+                k_scale=ks, v_scale=vs, sliding_window=sw,
+                logit_softcap=cfg.attn_logit_softcapping)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
             out = paged_window_attention(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
-                k_scale=ks, v_scale=vs, sliding_window=sw)
+                k_scale=ks, v_scale=vs, sliding_window=sw,
+                logit_softcap=cfg.attn_logit_softcapping)
         else:
             out = attn_ops.chunked_prefill_attention(
                 q, ck, cv, block_tables, ctx_lens, chunk_lens, scale,
-                k_scale=ks, v_scale=vs, sliding_window=sw)
+                k_scale=ks, v_scale=vs, sliding_window=sw,
+                logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, C, cfg.q_size)
-        h = h + _linear(out, lp["o_proj"])
-        hn = _norm(h, lp["mlp_norm"], cfg)
-        h = h + _mlp(hn, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
     return h, new_cache
 
 
@@ -358,7 +386,7 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (scanned — one dispatch per window)."""
     B = tokens.shape[0]
     h = _embed(params, cfg, tokens, positions)                 # (B, H)
-    scale = cfg.head_dim ** -0.5
+    scale = cfg.attn_scale
     new_cache = []
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
@@ -372,20 +400,22 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             from tpuserve.ops.pallas_tp import paged_decode_attention_tp
             out = paged_decode_attention_tp(q, ck, cv, block_tables, seq_lens,
                                             scale, mesh, k_scale=ks,
-                                            v_scale=vs, sliding_window=sw)
+                                            v_scale=vs, sliding_window=sw,
+                                            logit_softcap=cfg.attn_logit_softcapping)
         elif attn_impl == "pallas":
             from tpuserve.ops.pallas_paged_attention import paged_decode_attention as impl
             out = impl(q, ck, cv, block_tables, seq_lens, scale,
-                       k_scale=ks, v_scale=vs, sliding_window=sw)
+                       k_scale=ks, v_scale=vs, sliding_window=sw,
+                       logit_softcap=cfg.attn_logit_softcapping)
         else:
             out = attn_ops.paged_decode_attention(q, ck, cv, block_tables,
                                                   seq_lens, scale,
                                                   k_scale=ks, v_scale=vs,
-                                                  sliding_window=sw)
+                                                  sliding_window=sw,
+                                                  logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, cfg.q_size)
-        h = h + _linear(out, lp["o_proj"])
-        hn = _norm(h, lp["mlp_norm"], cfg)
-        h = h + _mlp(hn, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
     return _unembed(params, cfg, h), new_cache
 
 
@@ -487,13 +517,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         seq_lens = jnp.full((B,), T, jnp.int32)
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
     h = _embed(params, cfg, tokens, positions)
-    scale = cfg.head_dim ** -0.5
+    scale = cfg.attn_scale
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
         q, k, v = _qkv(hn, lp, cfg, positions)
         out = attn_ops.prefill_attention(q, k, v, seq_lens, scale,
-                                         sliding_window=cfg.layer_window(li))
-        h = h + _linear(out.reshape(B, T, cfg.q_size), lp["o_proj"])
-        hn = _norm(h, lp["mlp_norm"], cfg)
-        h = h + _mlp(hn, lp, cfg)
+                                         sliding_window=cfg.layer_window(li),
+                                         logit_softcap=cfg.attn_logit_softcapping)
+        h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
     return _unembed(params, cfg, h)

@@ -81,6 +81,9 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
         if cfg.qk_norm:
             lp["q_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
             lp["k_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
+        if cfg.sandwich_norms:
+            lp["post_attn_norm"] = norm(h)
+            lp["post_mlp_norm"] = norm(h)
         if cfg.num_experts:
             ei = cfg.expert_intermediate_size
             E = cfg.num_experts
@@ -159,14 +162,28 @@ def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
             p["bias"] = jnp.asarray(raw[bias_name], dtype=dtype)
         return p
 
+    def norm_scale(name):
+        return {"scale": jnp.asarray(get(name), dtype=dtype)}
+
     layers = []
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
         lp = {
-            "attn_norm": {"scale": jnp.asarray(get(pre + "input_layernorm.weight"), dtype=dtype)},
-            "mlp_norm": {"scale": jnp.asarray(get(pre + "post_attention_layernorm.weight"), dtype=dtype)},
+            "attn_norm": norm_scale(pre + "input_layernorm.weight"),
             "o_proj": dense(pre + "self_attn.o_proj.weight"),
         }
+        if cfg.sandwich_norms:
+            # Gemma2: post_attention_layernorm wraps the ATTENTION OUTPUT;
+            # the MLP pre-norm is pre_feedforward_layernorm
+            lp["post_attn_norm"] = norm_scale(
+                pre + "post_attention_layernorm.weight")
+            lp["mlp_norm"] = norm_scale(
+                pre + "pre_feedforward_layernorm.weight")
+            lp["post_mlp_norm"] = norm_scale(
+                pre + "post_feedforward_layernorm.weight")
+        else:
+            lp["mlp_norm"] = norm_scale(
+                pre + "post_attention_layernorm.weight")
         if pre + "self_attn.qkv_proj.weight" in raw:            # Phi-3 fused qkv
             qkv = jnp.asarray(raw[pre + "self_attn.qkv_proj.weight"], dtype=dtype)
             q, k, v = jnp.split(qkv, [cfg.q_size, cfg.q_size + cfg.kv_size], axis=0)

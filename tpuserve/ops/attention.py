@@ -13,6 +13,14 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+
+def _softcap(scores, cap):
+    """Gemma2 attention-score softcap: cap * tanh(s / cap); None = off.
+    Applied after scaling, before masking (matches HF eager)."""
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
 # Sentinel slot id for padding tokens in write_kv_cache: far out of range for
 # any realistic cache, so scatter mode="drop" discards the write.
 PAD_SLOT = 2**30
@@ -27,7 +35,8 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       prompt_lens: jnp.ndarray, scale: float,
-                      sliding_window: int | None = None) -> jnp.ndarray:
+                      sliding_window: int | None = None,
+                      logit_softcap: float | None = None) -> jnp.ndarray:
     """Causal self-attention over the prompt being prefetched.
 
     q: (B, T, Hq, D); k, v: (B, T, Hkv, D); prompt_lens: (B,) valid lengths.
@@ -39,6 +48,7 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    scores = _softcap(scores, logit_softcap)
     pos = jnp.arange(T)
     causal = pos[None, :] <= pos[:, None]                      # (Tq, Tk)
     if sliding_window is not None:
@@ -56,7 +66,8 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
                            k_scale: jnp.ndarray | None = None,
                            v_scale: jnp.ndarray | None = None,
-                           sliding_window: int | None = None) -> jnp.ndarray:
+                           sliding_window: int | None = None,
+                           logit_softcap: float | None = None) -> jnp.ndarray:
     """Single-token decode attention against a paged KV cache.
 
     q: (B, Hq, D); k_cache/v_cache: (num_blocks, block_size, Hkv, D);
@@ -80,6 +91,7 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     scores = jnp.einsum("bhd,bkhd->bhk", q, k, preferred_element_type=jnp.float32) * scale
+    scores = _softcap(scores, logit_softcap)
     valid = jnp.arange(S)[None, :] < seq_lens[:, None]         # (B, S)
     if sliding_window is not None:
         valid &= (jnp.arange(S)[None, :]
@@ -96,7 +108,8 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                               scale: float, *, seg_size: int = 512,
                               k_scale: jnp.ndarray | None = None,
                               v_scale: jnp.ndarray | None = None,
-                              sliding_window: int | None = None) -> jnp.ndarray:
+                              sliding_window: int | None = None,
+                              logit_softcap: float | None = None) -> jnp.ndarray:
     """Attention for one prefill CHUNK against the paged cache.
 
     The chunk's K/V must already be written into the cache (so keys live at
@@ -148,6 +161,7 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", q_r, ks,
                             preferred_element_type=jnp.float32)
         scores = scores.reshape(B, Hq, C, seg)
+        scores = _softcap(scores, logit_softcap)
         j = s0 + jnp.arange(seg)[None, None, :]          # global key position
         mask = (j <= ctx_lens[:, None, None] + qi) & q_valid & (j < S)
         if sliding_window is not None:

@@ -36,7 +36,7 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
                    k_scr, v_scr, sems, *, scale, page_size, pages_g,
                    num_kv_heads, group, head_dim, blk_q,
                    ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None,
-                   sliding_window=None):
+                   sliding_window=None, logit_softcap=None):
     """``ks_hbm``/``vs_hbm`` present = int8 cache: pages DMA as int8 with
     per-page scale blocks and dequantize in VMEM (same scheme as the paged
     decode kernel).  ``sliding_window`` (static): each query attends only
@@ -159,6 +159,8 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
         v = jnp.where(v_valid, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
                                 preferred_element_type=jnp.float32) * scale
+        if logit_softcap is not None:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
         kpos = g * rows_g + jax.lax.broadcasted_iota(
             jnp.int32, (num_kv_heads, rows_q, rows_g), 2)
         mask = kpos <= q_pos                       # causal + context
@@ -187,7 +189,8 @@ def _window_kernel(bt_ref, ctx_ref, chunk_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret", "blk_q",
                                              "pages_per_group",
-                                             "sliding_window"))
+                                             "sliding_window",
+                                             "logit_softcap"))
 def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
@@ -196,7 +199,8 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            pages_per_group: int | None = None,
                            k_scale: jnp.ndarray | None = None,
                            v_scale: jnp.ndarray | None = None,
-                           sliding_window: int | None = None) -> jnp.ndarray:
+                           sliding_window: int | None = None,
+                           logit_softcap: float | None = None) -> jnp.ndarray:
     """q: (B, C, Hq, D) window queries; k_cache/v_cache: (num_blocks, page,
     Hkv, D) with the window's KV already written; block_tables: (B,
     max_pages) int32; ctx_lens/chunk_lens: (B,). -> (B, C, Hq, D).
@@ -233,7 +237,7 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     kernel = functools.partial(
         _window_kernel, scale=scale, page_size=page_size, pages_g=pages_g,
         num_kv_heads=Hkv, group=group, head_dim=D, blk_q=blk_q,
-        sliding_window=sliding_window)
+        sliding_window=sliding_window, logit_softcap=logit_softcap)
     if quantized:
         base_kernel = kernel
 

@@ -25,7 +25,7 @@ NEG_INF = -1e30
 
 def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale, blk_q, blk_k,
-                  sliding_window=None):
+                  sliding_window=None, logit_softcap=None):
     b = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -63,6 +63,8 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
         v = jnp.where(col_ids < prompt_len, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if logit_softcap is not None:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
         mask = (cols <= rows) & (cols < prompt_len)
@@ -95,38 +97,43 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def flash_prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                             prompt_lens: jnp.ndarray, scale: float,
-                            blk_q: int = 128, blk_k: int = 128,
+                            blk_q: int | None = None,
+                            blk_k: int | None = None,
                             interpret: bool | None = None,
-                            sliding_window: int | None = None) -> jnp.ndarray:
+                            sliding_window: int | None = None,
+                            logit_softcap: float | None = None) -> jnp.ndarray:
     """q: (B, T, Hq, D); k/v: (B, T, Hkv, D); prompt_lens: (B,). -> (B, T, Hq, D).
 
     T is padded (bucketed) by the engine; query rows past prompt_lens still
     attend to the valid keys (same as the reference impl) — the engine only
     reads the row at prompt_len - 1, so their values are never consumed.
 
-    ``TPUSERVE_FLASH_BLK_Q``/``_K`` override the block split (sweepable on
-    silicon — prefill bounds TTFT).  Resolved HERE, outside jit: an env
-    read inside the traced function would freeze at first trace (the jit
-    cache key only covers shapes and statics)."""
+    ``TPUSERVE_FLASH_BLK_Q``/``_K`` fill the block split when the caller
+    leaves the default (sweepable on silicon — prefill bounds TTFT); an
+    explicit argument always wins so tests pin their shapes.  The env is
+    read per PROCESS: serving jits this inside the engine's prefill
+    executable, so changing it mid-process is ignored — fresh-process
+    sweeps (tools/bench_sweep.py) pick it up."""
     import os
-    env_q = os.environ.get("TPUSERVE_FLASH_BLK_Q")
-    env_k = os.environ.get("TPUSERVE_FLASH_BLK_K")
-    if env_q:
-        blk_q = int(env_q)
-    if env_k:
-        blk_k = int(env_k)
+    if blk_q is None:
+        blk_q = int(os.environ.get("TPUSERVE_FLASH_BLK_Q") or 128)
+    if blk_k is None:
+        blk_k = int(os.environ.get("TPUSERVE_FLASH_BLK_K") or 128)
     return _flash_prefill_attention(q, k, v, prompt_lens, scale=scale,
                                     blk_q=blk_q, blk_k=blk_k,
                                     interpret=interpret,
-                                    sliding_window=sliding_window)
+                                    sliding_window=sliding_window,
+                                    logit_softcap=logit_softcap)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "blk_q", "blk_k",
-                                             "interpret", "sliding_window"))
+                                             "interpret", "sliding_window",
+                                             "logit_softcap"))
 def _flash_prefill_attention(q, k, v, prompt_lens, *, scale: float,
                              blk_q: int, blk_k: int,
                              interpret: bool | None,
-                             sliding_window: int | None) -> jnp.ndarray:
+                             sliding_window: int | None,
+                             logit_softcap: float | None) -> jnp.ndarray:
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
     group = Hq // Hkv
@@ -143,7 +150,8 @@ def _flash_prefill_attention(q, k, v, prompt_lens, *, scale: float,
     vt = jnp.swapaxes(v, 1, 2)
 
     kernel = functools.partial(_flash_kernel, scale=scale, blk_q=blk_q,
-                               blk_k=blk_k, sliding_window=sliding_window)
+                               blk_k=blk_k, sliding_window=sliding_window,
+                               logit_softcap=logit_softcap)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,

@@ -138,7 +138,7 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
                          k_scr, v_scr, sems, *, scale, page_size, pages_g,
                          num_kv_heads, group, head_dim, seqs_pp,
                          ks_hbm=None, vs_hbm=None, ks_scr=None, vs_scr=None,
-                         sliding_window=None):
+                         sliding_window=None, logit_softcap=None):
     """``ks_hbm``/``vs_hbm`` present = int8 cache: value pages DMA as int8
     (half the HBM bytes — the whole point) alongside tiny per-page scale
     blocks, and dequantize on the VPU after landing in VMEM.
@@ -275,6 +275,8 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
             # MXU inputs, fp32 accumulation; scale on the fp32 product.
             sc = jax.lax.dot_general(q_r, k, (((2,), (2,)), ((0,), (0,))),
                                      preferred_element_type=jnp.float32) * scale
+            if logit_softcap is not None:
+                sc = logit_softcap * jnp.tanh(sc / logit_softcap)
             pos = g * rows_g + jax.lax.broadcasted_iota(
                 jnp.int32, (num_kv_heads, group, rows_g), 2)
             s_valid = pos < seq_len
@@ -313,7 +315,8 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            seqs_per_program: int | None = None,
                            k_scale: jnp.ndarray | None = None,
                            v_scale: jnp.ndarray | None = None,
-                           sliding_window: int | None = None) -> jnp.ndarray:
+                           sliding_window: int | None = None,
+                           logit_softcap: float | None = None) -> jnp.ndarray:
     """q: (B, Hq, D); k_cache/v_cache: (num_blocks, page, Hkv, D);
     block_tables: (B, max_pages) int32; seq_lens: (B,). -> (B, Hq, D).
     ``k_scale``/``v_scale``: (num_blocks, page, Hkv) f32 when the cache
@@ -345,16 +348,19 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                                    seq_lens, scales, scale=scale,
                                    interpret=interpret, pages_g=pages_g,
                                    seqs_pp=seqs_pp,
-                                   sliding_window=sliding_window)
+                                   sliding_window=sliding_window,
+                                   logit_softcap=logit_softcap)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret",
                                              "pages_g", "seqs_pp",
-                                             "sliding_window"))
+                                             "sliding_window",
+                                             "logit_softcap"))
 def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
                             scales, *, scale: float, interpret: bool,
                             pages_g: int, seqs_pp: int,
-                            sliding_window: int | None = None) -> jnp.ndarray:
+                            sliding_window: int | None = None,
+                            logit_softcap: float | None = None) -> jnp.ndarray:
     B, Hq, D = q.shape
     num_blocks, page_size, Hkv, _ = k_cache.shape
     group = Hq // Hkv
@@ -372,7 +378,8 @@ def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
     kernel = functools.partial(
         _paged_decode_kernel, scale=scale, page_size=page_size,
         pages_g=pages_g, num_kv_heads=Hkv, group=group, head_dim=D,
-        seqs_pp=seqs_pp, sliding_window=sliding_window)
+        seqs_pp=seqs_pp, sliding_window=sliding_window,
+        logit_softcap=logit_softcap)
     if quantized:
         # operand order must mirror the extra in_specs/scratch below
         base_kernel = kernel

@@ -38,7 +38,7 @@ def tp_partitionable(cfg_kv_heads: int, mesh: Mesh | None) -> bool:
 def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
                               scale: float, mesh: Mesh,
                               k_scale=None, v_scale=None,
-                              sliding_window=None):
+                              sliding_window=None, logit_softcap=None):
     """Head-parallel paged decode attention over the tp axis.
 
     q: (B, Hq, D) head-sharded; k/v_cache: (blocks, page, Hkv, D)
@@ -60,10 +60,12 @@ def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
         def impl(q_, kc, vc, bt, sl, ks, vs):
             return paged_decode_attention(q_, kc, vc, bt, sl, scale,
                                           k_scale=ks, v_scale=vs,
-                                          sliding_window=sliding_window)
+                                          sliding_window=sliding_window,
+                                          logit_softcap=logit_softcap)
     else:
         impl = partial(paged_decode_attention, scale=scale,
-                       sliding_window=sliding_window)
+                       sliding_window=sliding_window,
+                       logit_softcap=logit_softcap)
     fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=head_spec, **_CHECK_KWARG)
     return fn(*args)
@@ -72,7 +74,7 @@ def paged_decode_attention_tp(q, k_cache, v_cache, block_tables, seq_lens,
 def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
                               chunk_lens, scale: float, mesh: Mesh,
                               k_scale=None, v_scale=None,
-                              sliding_window=None):
+                              sliding_window=None, logit_softcap=None):
     """Head-parallel paged window attention (chunked prefill) over tp.
 
     q: (B, C, Hq, D) head-sharded; k/v_cache kv-head-sharded;
@@ -92,17 +94,20 @@ def paged_window_attention_tp(q, k_cache, v_cache, block_tables, ctx_lens,
         def impl(q_, kc, vc, bt, cx, ck, ks, vs):
             return paged_window_attention(q_, kc, vc, bt, cx, ck, scale,
                                           k_scale=ks, v_scale=vs,
-                                          sliding_window=sliding_window)
+                                          sliding_window=sliding_window,
+                                          logit_softcap=logit_softcap)
     else:
         impl = partial(paged_window_attention, scale=scale,
-                       sliding_window=sliding_window)
+                       sliding_window=sliding_window,
+                       logit_softcap=logit_softcap)
     fn = shard_map(impl, mesh=mesh, in_specs=tuple(in_specs),
                    out_specs=q_spec, **_CHECK_KWARG)
     return fn(*args)
 
 
 def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,
-                               mesh: Mesh, sliding_window=None):
+                               mesh: Mesh, sliding_window=None,
+                               logit_softcap=None):
     """Head-parallel flash prefill attention over the tp axis.
 
     q: (B, T, Hq, D); k/v: (B, T, Hkv, D) — head axes sharded over tp,
@@ -112,7 +117,8 @@ def flash_prefill_attention_tp(q, k, v, prompt_lens, scale: float,
     q_spec = P(None, None, AXIS_TP, None)
     fn = shard_map(
         partial(flash_prefill_attention, scale=scale,
-                sliding_window=sliding_window),
+                sliding_window=sliding_window,
+                logit_softcap=logit_softcap),
         mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec, P(None)),
         out_specs=q_spec, **_CHECK_KWARG)

@@ -465,9 +465,10 @@ class Engine:
         W = self.model_cfg.sliding_window
         if not W or not self.config.window_release:
             return
-        if self.model_cfg.full_attention_first_layers:
-            # mixed-layer models keep full-attention layers that need
-            # every position's KV forever — nothing is releasable
+        if not self.model_cfg.uniform_window:
+            # mixed-layer models (Qwen2 max_window_layers, Gemma2
+            # alternating) keep full-attention layers that need every
+            # position's KV forever — nothing is releasable
             return
         bm = self.block_manager
         for r in self.scheduler.running:
