@@ -103,6 +103,10 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("mistral7b-int8-sw8k", ["--model", "mistral-7b", "--quant", "int8",
                              "--kv-quant", "int8", "--batch", "4",
                              "--prompt-len", "8192", "--gen-len", "64"], {}),
+    # Gemma2 traits on silicon (softcaps in all kernels, sandwich norms,
+    # alternating windows, 256k-vocab unembed/sampling)
+    ("gemma2-2b-int8", ["--model", "gemma2-2b", "--quant", "int8",
+                        "--batch", "16", "--gen-len", "64"], {}),
     # Startup-cost story (BASELINE TTFT budget): identical run against an
     # EMPTY persistent compile cache — warmup_s cold vs the warm rows
     # above is the pod-restart cost the manifests' cache PVC removes.
