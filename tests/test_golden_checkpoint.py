@@ -159,6 +159,23 @@ def _gemma2():
         bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
 
 
+def _gemma3():
+    # Gemma3 text: 5-local:1-global layer pattern with PER-LAYER rope
+    # (local 10k unscaled, global 1M with linear position scaling), qk
+    # norms, sandwich norms, no softcaps; 8 layers + T=12 > window 5
+    # exercise both layer kinds and both rope configurations
+    return transformers.Gemma3ForCausalLM(transformers.Gemma3TextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=8, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=512,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        sliding_window=5, rope_theta=1_000_000.0,
+        rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        query_pre_attn_scalar=24, bos_token_id=0, eos_token_id=1,
+        attn_implementation="eager"))
+
+
 def _mistral():
     # sliding_window smaller than the test sequence so windowed attention
     # actually changes the logits (full-context parity would pass even if
@@ -174,7 +191,7 @@ def _mistral():
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
              "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
              "mistral": _mistral, "qwen2_swa": _qwen2_swa,
-             "gemma2": _gemma2}
+             "gemma2": _gemma2, "gemma3": _gemma3}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -213,6 +230,12 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.attn_logit_softcapping == 50.0
         assert cfg.final_logit_softcapping == 30.0
         assert cfg.layer_window(0) == 6 and cfg.layer_window(1) is None
+    if family == "gemma3":
+        assert cfg.qk_norm and cfg.sandwich_norms
+        assert cfg.window_layers is not None
+        assert cfg.layer_window(0) == 5 and cfg.layer_window(5) is None
+        assert cfg.layer_rope(0) == (10000.0, 1.0)          # local layer
+        assert cfg.layer_rope(5) == (1_000_000.0, 8.0)      # global layer
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

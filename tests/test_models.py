@@ -380,3 +380,46 @@ def test_tiny_gemma2_serves_all_impls():
     eng.block_manager.release_out_of_window = _boom
     eng.generate(prompts, p)
     assert not eng.model_cfg.uniform_window
+
+
+def test_tiny_gemma3_serves_all_impls():
+    """Gemma3 text end to end: 5-local:1-global layers with PER-LAYER rope
+    (local 10k unscaled / global 1M with linear scaling), qk norms,
+    sandwich norms; reference == pallas == chunked token equality."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SamplingParams, SchedulerConfig)
+
+    def mk(attn, chunk=64):
+        return Engine(EngineConfig(
+            model="tiny-gemma3", attn_impl=attn,
+            cache=CacheConfig(block_size=4, num_blocks=192,
+                              max_blocks_per_seq=32),
+            scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                      min_decode_bucket=2,
+                                      prefill_chunk_size=chunk)))
+    prompts = [list(range(2, 30)), [5, 6, 7] * 4]   # 28 tokens >> window 8
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    ref = mk("reference").generate(prompts, p)
+    for impl, chunk in (("pallas", 64), ("reference", 16), ("pallas", 16)):
+        outs = mk(impl, chunk).generate(prompts, p)
+        for a, b in zip(ref, outs):
+            assert len(a.output_token_ids) == 10
+            assert a.output_token_ids == b.output_token_ids
+
+
+def test_gemma3_sliding_window_pattern_fallback():
+    """Original-release gemma3 configs carry sliding_window_pattern
+    instead of layer_types — both must parse to the same layer map."""
+    base = dict(model_type="gemma3_text", vocab_size=256, hidden_size=64,
+                intermediate_size=128, num_hidden_layers=6,
+                num_attention_heads=4, num_key_value_heads=2, head_dim=24,
+                max_position_embeddings=512, sliding_window=8,
+                query_pre_attn_scalar=24, eos_token_id=1)
+    via_types = config_from_hf_json("a", {
+        **base, "layer_types": ["sliding_attention"] * 5
+        + ["full_attention"]})
+    via_pattern = config_from_hf_json("b", {
+        **base, "sliding_window_pattern": 6})
+    assert via_types.window_layers == via_pattern.window_layers
+    assert via_pattern.layer_window(4) == 8
+    assert via_pattern.layer_window(5) is None

@@ -56,6 +56,15 @@ class ModelConfig:
     # "first_full" (Qwen2) or "alternate" (Gemma2: even layers sliding,
     # odd layers full) — see layer_window()
     window_pattern: str = "first_full"
+    # Explicit per-layer windowed flags (True = sliding), from HF
+    # layer_types (Gemma3's 5-local:1-global pattern); overrides
+    # window_pattern when set.
+    window_layers: Optional[tuple] = None
+    # Per-layer rope (Gemma3): WINDOWED layers use this base frequency
+    # unscaled; full layers use rope_theta with rope_scaling_factor
+    # (linear: positions divided by the factor).
+    rope_local_base_freq: Optional[float] = None
+    rope_scaling_factor: float = 1.0
     # Gemma2 traits: tanh softcaps on attention scores / final logits,
     # attention scale from query_pre_attn_scalar instead of head_dim, and
     # sandwich norms (post-attention + pre/post-feedforward layernorms).
@@ -85,11 +94,24 @@ class ModelConfig:
         (Gemma2 layer_types)."""
         if self.sliding_window is None:
             return None
+        if self.window_layers is not None:
+            return (self.sliding_window if self.window_layers[layer_idx]
+                    else None)
         if self.window_pattern == "alternate":
             return self.sliding_window if layer_idx % 2 == 0 else None
         if layer_idx < self.full_attention_first_layers:
             return None
         return self.sliding_window
+
+    def layer_rope(self, layer_idx: int) -> tuple[float, float]:
+        """(theta, linear position scaling) for one layer.  Gemma3:
+        windowed layers rotate at rope_local_base_freq unscaled; full
+        layers at rope_theta with the linear factor.  Families without
+        per-layer rope get (rope_theta, rope_scaling_factor) everywhere."""
+        if (self.rope_local_base_freq is not None
+                and self.layer_window(layer_idx) is not None):
+            return self.rope_local_base_freq, 1.0
+        return self.rope_theta, self.rope_scaling_factor
 
     @property
     def uniform_window(self) -> bool:
@@ -197,9 +219,57 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
     # unsupported generations reject loudly
     gemma1 = mt == "gemma" or arch.startswith("gemmafor")
     gemma2 = mt == "gemma2" or arch.startswith("gemma2for")
-    if "gemma" in family and not (gemma1 or gemma2):
+    # gemma3 TEXT only; the multimodal wrapper (model_type "gemma3", a
+    # vision tower + text_config) is rejected loudly below
+    gemma3 = mt == "gemma3_text" or arch.startswith("gemma3forcausallm")
+    if "gemma" in family and not (gemma1 or gemma2 or gemma3):
         raise ValueError(f"model family {family!r} is not supported yet "
-                         "(gemma and gemma2 are)")
+                         "(gemma, gemma2 and gemma3 text are)")
+    if gemma3:
+        nh = hf["num_attention_heads"]
+        lt = hf.get("layer_types")
+        if lt:
+            window_layers = tuple(t == "sliding_attention" for t in lt)
+        else:
+            # original-release configs encode the pattern as
+            # sliding_window_pattern=p: every p-th layer is global
+            pat = hf.get("sliding_window_pattern")
+            if not pat:
+                raise ValueError("gemma3 configs must carry layer_types "
+                                 "or sliding_window_pattern")
+            window_layers = tuple(
+                (i + 1) % int(pat) != 0
+                for i in range(hf["num_hidden_layers"]))
+        rs = hf.get("rope_scaling")
+        factor = 1.0
+        if rs:
+            if rs.get("rope_type", rs.get("type", "linear")) != "linear":
+                raise ValueError(f"unsupported rope_scaling {rs!r} "
+                                 "(linear only)")
+            factor = float(rs.get("factor", 1.0))
+        common["tie_word_embeddings"] = hf.get("tie_word_embeddings", True)
+        return ModelConfig(
+            intermediate_size=hf["intermediate_size"],
+            num_kv_heads=hf.get("num_key_value_heads", nh),
+            head_dim=hf.get("head_dim") or hf["hidden_size"] // nh,
+            norm="rmsnorm",
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            norm_weight_offset=1.0,
+            embed_scale_by_sqrt_dim=True,
+            act=(hf.get("hidden_activation") or hf.get("hidden_act")
+                 or "gelu_pytorch_tanh"),
+            mlp_style="gated",
+            pos="rope",
+            rope_theta=hf.get("rope_theta", 1e6),
+            rope_local_base_freq=hf.get("rope_local_base_freq", 10000.0),
+            rope_scaling_factor=factor,
+            qk_norm=True,
+            sliding_window=hf.get("sliding_window"),
+            window_layers=window_layers,
+            query_pre_attn_scalar=hf.get("query_pre_attn_scalar"),
+            sandwich_norms=True,
+            **common,
+        )
     if gemma2:
         nh = hf["num_attention_heads"]
         lt = hf.get("layer_types")
@@ -393,6 +463,20 @@ register_model_config(ModelConfig(
 ), "mistral-7b")
 
 register_model_config(ModelConfig(
+    name="google/gemma-3-4b-text",
+    vocab_size=262208, hidden_size=2560, intermediate_size=10240,
+    num_layers=34, num_heads=8, num_kv_heads=4, head_dim=256,
+    max_position_embeddings=131072, rope_theta=1_000_000.0,
+    rope_local_base_freq=10000.0, rope_scaling_factor=8.0,
+    norm_eps=1e-6, norm_weight_offset=1.0, embed_scale_by_sqrt_dim=True,
+    act="gelu_pytorch_tanh", tie_word_embeddings=True, qk_norm=True,
+    sliding_window=1024,
+    window_layers=tuple(i % 6 != 5 for i in range(34)),   # 5 local : 1 global
+    query_pre_attn_scalar=256, sandwich_norms=True,
+    bos_token_id=2, eos_token_id=1,
+), "gemma3-4b")
+
+register_model_config(ModelConfig(
     name="google/gemma-2-2b",
     vocab_size=256000, hidden_size=2304, intermediate_size=9216,
     num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
@@ -454,6 +538,21 @@ register_model_config(ModelConfig(
     # float32: the windowed tests assert token equality ACROSS impls
     # (reference/pallas/chunked/spec/disagg), and random-init logit gaps
     # (~4e-3) sit below bf16 rounding — bf16 argmax is path-sensitive
+    dtype="float32",
+))
+
+register_model_config(ModelConfig(
+    name="tiny-gemma3",
+    vocab_size=256, hidden_size=64, intermediate_size=128,
+    num_layers=6, num_heads=4, num_kv_heads=2, head_dim=24,
+    max_position_embeddings=512, norm_weight_offset=1.0,
+    embed_scale_by_sqrt_dim=True, act="gelu_pytorch_tanh",
+    tie_word_embeddings=True, qk_norm=True, eos_token_id=1,
+    sliding_window=8, window_layers=tuple(i % 6 != 5 for i in range(6)),
+    rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+    rope_scaling_factor=8.0, query_pre_attn_scalar=24,
+    sandwich_norms=True,
+    # float32 for cross-impl token-equality tests (see tiny-mistral)
     dtype="float32",
 ))
 

@@ -158,8 +158,12 @@ def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
 # Attention projections (shared by prefill and decode)
 # --------------------------------------------------------------------------
 
-def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray):
-    """h: (..., H) -> q (..., Hq, D), k/v (..., Hkv, D), with qk-norm and RoPE."""
+def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray,
+         layer_idx: int):
+    """h: (..., H) -> q (..., Hq, D), k/v (..., Hkv, D), with qk-norm and
+    RoPE.  ``layer_idx`` selects per-layer rope (Gemma3: windowed layers
+    rotate at the local base frequency unscaled; full layers at
+    rope_theta with the linear position scaling)."""
     q = _linear(h, lp["q_proj"]).reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
     k = _linear(h, lp["k_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
     v = _linear(h, lp["v_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
@@ -170,7 +174,11 @@ def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray):
                     cfg.norm_weight_offset)
     if cfg.pos == "rope":
         rotary_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
-        cos, sin = rope_ops.rope_freqs(positions, cfg.head_dim, cfg.rope_theta, rotary_dim)
+        theta, scaling = cfg.layer_rope(layer_idx)
+        pos = positions
+        if scaling != 1.0:
+            pos = positions.astype(jnp.float32) / scaling
+        cos, sin = rope_ops.rope_freqs(pos, cfg.head_dim, theta, rotary_dim)
         q = rope_ops.apply_rope(q, cos, sin)
         k = rope_ops.apply_rope(k, cos, sin)
     return q, k, v
@@ -235,7 +243,7 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)
         # batched prefill attends over the FRESH k/v (full precision even
         # when the cache stores int8 — only cache READS see quantization)
         new_cache.append(attn_ops.write_kv_entry(kv_cache[li], k, v,
@@ -319,7 +327,7 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
         ck, cv = entry["k"], entry["v"]
@@ -391,7 +399,7 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions)                 # (B, Hq/Hkv, D)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)  # (B, Hq/Hkv, D)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
         ck, cv = entry["k"], entry["v"]
@@ -520,7 +528,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     scale = cfg.attn_scale
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)
         out = attn_ops.prefill_attention(q, k, v, seq_lens, scale,
                                          sliding_window=cfg.layer_window(li),
                                          logit_softcap=cfg.attn_logit_softcapping)
