@@ -144,6 +144,21 @@ def _qwen2_swa():
         bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
 
 
+def _llama31():
+    # Llama-3.1 rope_scaling: piecewise frequency transform with a smooth
+    # interpolation band; original_max_position_embeddings SMALLER than
+    # the test sequence makes all three wavelength bands matter
+    return transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, tie_word_embeddings=False,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 8},
+        bos_token_id=0, eos_token_id=1))
+
+
 def _gemma2():
     # Gemma2's full trait set: sandwich norms (post-attn + pre/post-ffn),
     # tanh softcaps on attention scores AND final logits, attention scale
@@ -191,7 +206,7 @@ def _mistral():
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
              "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
              "mistral": _mistral, "qwen2_swa": _qwen2_swa,
-             "gemma2": _gemma2, "gemma3": _gemma3}
+             "gemma2": _gemma2, "gemma3": _gemma3, "llama31": _llama31}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -230,6 +245,8 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.attn_logit_softcapping == 50.0
         assert cfg.final_logit_softcapping == 30.0
         assert cfg.layer_window(0) == 6 and cfg.layer_window(1) is None
+    if family == "llama31":
+        assert cfg.rope_llama3_scaling == (8.0, 1.0, 4.0, 8.0)
     if family == "gemma3":
         assert cfg.qk_norm and cfg.sandwich_norms
         assert cfg.window_layers is not None

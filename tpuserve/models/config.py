@@ -65,6 +65,10 @@ class ModelConfig:
     # (linear: positions divided by the factor).
     rope_local_base_freq: Optional[float] = None
     rope_scaling_factor: float = 1.0
+    # Llama-3.1 frequency transform: (factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings) — see
+    # ops/rope.py rope_freqs.
+    rope_llama3_scaling: Optional[tuple] = None
     # Gemma2 traits: tanh softcaps on attention scores / final logits,
     # attention scale from query_pre_attn_scalar instead of head_dim, and
     # sandwich norms (post-attention + pre/post-feedforward layernorms).
@@ -360,10 +364,29 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0),
         qk_norm="qwen3" in family,
         attention_bias="qwen2" in family or hf.get("attention_bias", False),
+        rope_llama3_scaling=_rope_scaling(hf),
         **_sliding_window(hf, family),
         **moe,
         **common,
     )
+
+
+def _rope_scaling(hf: dict):
+    """Llama-3.1-style rope_scaling for the llama-family path.  Ignoring
+    an unknown scheme would SILENTLY mis-rotate long contexts, so
+    anything unrecognized rejects loudly."""
+    rs = hf.get("rope_scaling")
+    if not rs:
+        return None
+    rt = rs.get("rope_type", rs.get("type"))
+    if rt == "llama3":
+        return (float(rs["factor"]), float(rs["low_freq_factor"]),
+                float(rs["high_freq_factor"]),
+                float(rs["original_max_position_embeddings"]))
+    if rt == "default":
+        return None
+    raise ValueError(f"unsupported rope_scaling {rs!r} for this family "
+                     "(llama3 and default are)")
 
 
 def _sliding_window(hf: dict, family: str) -> dict:
@@ -433,6 +456,16 @@ register_model_config(ModelConfig(
     tie_word_embeddings=False,
     bos_token_id=128000, eos_token_id=128009,
 ), "llama3-8b")
+
+register_model_config(ModelConfig(
+    name="meta-llama/Llama-3.1-8B-Instruct",
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+    max_position_embeddings=131072, rope_theta=500000.0, norm_eps=1e-5,
+    rope_llama3_scaling=(8.0, 1.0, 4.0, 8192.0),
+    tie_word_embeddings=False,
+    bos_token_id=128000, eos_token_id=128009,
+), "llama31-8b")
 
 register_model_config(ModelConfig(
     name="microsoft/Phi-3-mini-4k-instruct",

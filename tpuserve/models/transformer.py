@@ -178,7 +178,8 @@ def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray,
         pos = positions
         if scaling != 1.0:
             pos = positions.astype(jnp.float32) / scaling
-        cos, sin = rope_ops.rope_freqs(pos, cfg.head_dim, theta, rotary_dim)
+        cos, sin = rope_ops.rope_freqs(pos, cfg.head_dim, theta, rotary_dim,
+                                       llama3_scaling=cfg.rope_llama3_scaling)
         q = rope_ops.apply_rope(q, cos, sin)
         k = rope_ops.apply_rope(k, cos, sin)
     return q, k, v

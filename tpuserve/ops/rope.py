@@ -7,14 +7,31 @@ import jax.numpy as jnp
 
 
 def rope_freqs(positions: jnp.ndarray, head_dim: int, theta: float,
-               rotary_dim: int | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+               rotary_dim: int | None = None,
+               llama3_scaling: tuple | None = None
+               ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for ``positions``.
 
     positions: int array (...,) — returns cos/sin of shape (..., rotary_dim//2),
     computed in float32.
+
+    ``llama3_scaling``: (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) — the Llama-3.1 frequency transform:
+    wavelengths longer than original_ctx/low_factor are slowed by
+    ``factor``, shorter than original_ctx/high_factor are untouched, and
+    the band between interpolates smoothly (matches HF's
+    _compute_llama3_parameters).
     """
     rotary_dim = rotary_dim or head_dim
     inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    if llama3_scaling is not None:
+        factor, low_f, high_f, orig_ctx = llama3_scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (orig_ctx / wavelen - low_f) / (high_f - low_f)
+        interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > orig_ctx / low_f, inv_freq / factor,
+            jnp.where(wavelen < orig_ctx / high_f, inv_freq, interp))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
 
