@@ -153,9 +153,13 @@ def _llama31():
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=512, tie_word_embeddings=False,
         rope_theta=10000.0,
+        # original_max_position_embeddings=64 puts the band boundaries at
+        # wavelengths 16 and 64, straddling this head_dim's wavelengths
+        # (6.3 / 19.9 / 62.8 / 198...) so all THREE branches of the
+        # transform — untouched, interpolated, scaled — are exercised
         rope_scaling={"rope_type": "llama3", "factor": 8.0,
                       "low_freq_factor": 1.0, "high_freq_factor": 4.0,
-                      "original_max_position_embeddings": 8},
+                      "original_max_position_embeddings": 64},
         bos_token_id=0, eos_token_id=1))
 
 
@@ -246,7 +250,7 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.final_logit_softcapping == 30.0
         assert cfg.layer_window(0) == 6 and cfg.layer_window(1) is None
     if family == "llama31":
-        assert cfg.rope_llama3_scaling == (8.0, 1.0, 4.0, 8.0)
+        assert cfg.rope_llama3_scaling == (8.0, 1.0, 4.0, 64.0)
     if family == "gemma3":
         assert cfg.qk_norm and cfg.sandwich_norms
         assert cfg.window_layers is not None
