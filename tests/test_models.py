@@ -423,3 +423,27 @@ def test_gemma3_sliding_window_pattern_fallback():
     assert via_types.window_layers == via_pattern.window_layers
     assert via_pattern.layer_window(4) == 8
     assert via_pattern.layer_window(5) is None
+
+
+def test_every_registered_config_is_structurally_sound():
+    """Hand-entered registry entries (gemma3-4b, llama31-8b, ...) must be
+    internally consistent — a typo here serves garbage at checkpoint-load
+    time, far from its cause."""
+    from tpuserve.models.config import ModelConfig
+    for name in list_model_configs():
+        cfg = get_model_config(name)
+        assert cfg.num_heads % cfg.num_kv_heads == 0, name
+        assert cfg.q_size == cfg.num_heads * cfg.head_dim, name
+        if cfg.window_layers is not None:
+            assert len(cfg.window_layers) == cfg.num_layers, name
+            assert cfg.sliding_window, name
+        if cfg.full_attention_first_layers:
+            assert cfg.sliding_window, name
+            assert cfg.full_attention_first_layers < cfg.num_layers, name
+        if cfg.rope_llama3_scaling is not None:
+            assert len(cfg.rope_llama3_scaling) == 4, name
+        # every layer resolves a window + rope without raising
+        for li in range(cfg.num_layers):
+            cfg.layer_window(li)
+            cfg.layer_rope(li)
+        assert cfg.num_params > 0, name
