@@ -67,7 +67,7 @@ def build_report(rows):
     ttfts = {}
     for name in ("base", "prefill-split2", "prefill-split4",
                  "single-request", "poisson16", "poisson32",
-                 "poisson16-interleave"):
+                 "poisson16-interleave", "flash-q64", "flash-k256"):
         r = rows.get(name)
         if r is not None:
             ttfts[name] = (r.get("ttft_p50_ms"), r.get("value"))

@@ -127,6 +127,7 @@ class EngineStats:
     spec_proposed: int = 0           # draft tokens offered to the verifier
     spec_accepted: int = 0           # draft tokens accepted
     spec_pauses: int = 0             # adaptive governor pauses (spec.py)
+    released_blocks: int = 0         # rolling-buffer KV blocks recycled
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -472,12 +473,13 @@ class Engine:
             return
         bm = self.block_manager
         for r in self.scheduler.running:
-            bm.release_out_of_window(r.request_id, max(0, r.num_tokens - W))
+            self.stats.released_blocks += bm.release_out_of_window(
+                r.request_id, max(0, r.num_tokens - W))
         for r in self.scheduler.waiting:
             # mid-chunk long prompts free their tail-window backlog too
             if r.num_prefilled > 0:
-                bm.release_out_of_window(r.request_id,
-                                         max(0, r.num_prefilled - W))
+                self.stats.released_blocks += bm.release_out_of_window(
+                    r.request_id, max(0, r.num_prefilled - W))
 
     def _next_key(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)

@@ -93,6 +93,10 @@ class ServerMetrics:
             "tpuserve_spec_adaptive_pauses",
             "Times the adaptive governor paused speculation for "
             "below-break-even acceptance (runtime/spec.py)")
+        self.released_blocks = counter(
+            "tpuserve_window_released_blocks",
+            "KV blocks recycled by the sliding-window rolling buffer "
+            "(runtime/block_manager.py release_out_of_window)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

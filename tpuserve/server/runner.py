@@ -286,7 +286,9 @@ class AsyncEngineRunner:
                     for s in stats_objs))
             for attr, metric in (("spec_proposed", self.metrics.spec_proposed),
                                  ("spec_accepted", self.metrics.spec_accepted),
-                                 ("spec_pauses", self.metrics.spec_pauses)):
+                                 ("spec_pauses", self.metrics.spec_pauses),
+                                 ("released_blocks",
+                                  self.metrics.released_blocks)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
 
