@@ -87,30 +87,60 @@ class HFTokenizer:
         tok = self._tok.convert_ids_to_tokens(int(token_id))
         return tok if tok is not None else ""
 
-    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+    def apply_chat_template(self, messages: list[dict],
+                            add_generation_prompt: bool = True,
+                            tools: list[dict] | None = None) -> str:
         try:
+            kwargs = {"tools": tools} if tools else {}
             return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=add_generation_prompt)
+                messages, tokenize=False,
+                add_generation_prompt=add_generation_prompt, **kwargs)
         except Exception:
-            return default_chat_template(messages, add_generation_prompt)
+            return default_chat_template(messages, add_generation_prompt, tools)
 
 
-def default_chat_template(messages: list[dict], add_generation_prompt: bool = True) -> str:
+def default_chat_template(messages: list[dict], add_generation_prompt: bool = True,
+                          tools: list[dict] | None = None) -> str:
     """Plain-text chat template for template-less models.
 
     Same shape as the reference's ConfigMap templates
     (templates/opt-chat-template.yaml): leading system message becomes a
     preamble, then ``User:``/``Assistant:`` turns, then an open
-    ``Assistant:`` when a generation prompt is requested.
+    ``Assistant:`` when a generation prompt is requested.  When ``tools``
+    are supplied, a Hermes-style system block advertises them — matching
+    the server's default tool-call parser (server/tool_calls.py).
     """
+    import json as _json
     out = []
     msgs = list(messages)
     if msgs and msgs[0].get("role") == "system":
         out.append(msgs.pop(0)["content"].strip() + "\n")
+    if tools:
+        out.append(
+            "You may call tools. To call one, reply with "
+            '<tool_call>{"name": <name>, "arguments": <args-object>}'
+            "</tool_call>.\nAvailable tools: " + _json.dumps(tools) + "\n")
     for m in msgs:
         role = "User" if m.get("role") in ("user", "human") else \
                "Assistant" if m.get("role") == "assistant" else m.get("role", "User").title()
-        out.append(f"{role}: {m['content'].strip()}")
+        body = (m.get("content") or "").strip()
+        if m.get("tool_calls"):
+            blocks = []
+            for tc in m["tool_calls"]:
+                if not (isinstance(tc, dict)
+                        and isinstance(tc.get("function"), dict)):
+                    continue
+                args = tc["function"].get("arguments", {})
+                if isinstance(args, str):     # OpenAI wire shape: JSON text —
+                    try:                      # decode so the few-shot example
+                        args = _json.loads(args)   # matches the args-object
+                    except _json.JSONDecodeError:  # format the system block
+                        pass                       # instructs
+                blocks.append('<tool_call>' + _json.dumps(
+                    {"name": tc["function"]["name"], "arguments": args})
+                    + '</tool_call>')
+            body = "\n".join(x for x in [body] + blocks if x)
+        out.append(f"{role}: {body}")
     if add_generation_prompt:
         out.append("Assistant:")
     return "\n".join(out)

@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tpuserve.models.tokenizer import default_chat_template
+from tpuserve.server.tool_calls import ToolContext, normalize_messages
 from tpuserve.runtime.request import SamplingParams
 from tpuserve.server.metrics import ServerMetrics
 from tpuserve.server.runner import AsyncEngineRunner
@@ -46,6 +47,9 @@ class ServerConfig:
     # Jinja chat-template text overriding the tokenizer's (the reference
     # mounts these from ConfigMaps for template-less models, templates/*.yaml)
     chat_template: Optional[str] = None
+    # Tool-call parser override (hermes/mistral/llama3_json); None = infer
+    # from the model family (server/tool_calls.py).
+    tool_call_parser: Optional[str] = None
     # Export tpu_* device metrics alongside vllm_* on /metrics — the engine
     # owns the chips, so it is the authoritative DCGM-analog source.
     tpu_metrics: bool = True
@@ -227,19 +231,29 @@ class OpenAIServer:
                 "single-host replica")
 
     def handle_completion(self, body: dict, chat: bool):
+        toolctx = None
         if chat:
             messages = body.get("messages")
             if not isinstance(messages, list) or not messages:
                 raise ValueError("'messages' must be a non-empty list")
+            messages = normalize_messages(messages)
+            toolctx = ToolContext.from_body(
+                body, self.model_name, self.config.tool_call_parser)
+            tools = toolctx.raw_tools if toolctx else None
             tok = getattr(self.engine, "tokenizer", None) or \
                 self.engine.prefill.tokenizer
             if self._chat_template is not None:
                 prompt = self._chat_template.render(
-                    messages=messages, add_generation_prompt=True)
+                    messages=messages, add_generation_prompt=True,
+                    tools=tools)
             elif hasattr(tok, "apply_chat_template"):
-                prompt = tok.apply_chat_template(messages)
+                prompt = tok.apply_chat_template(messages, tools=tools)
             else:
-                prompt = default_chat_template(messages)
+                prompt = default_chat_template(messages, tools=tools)
+            if toolctx is not None and toolctx.forced:
+                # commit the model to a call (tool_choice required/named):
+                # the same prefix is prepended to the output before parsing
+                prompt += toolctx.forced
         else:
             prompt = body.get("prompt")
             if isinstance(prompt, list):
@@ -247,7 +261,7 @@ class OpenAIServer:
                     params = _sampling_from_request(
                         body, self.config.max_tokens_cap)
                     self._reject_multihost_unsupported(params)
-                    return prompt, params
+                    return prompt, params, None
                 if len(prompt) != 1:
                     raise ValueError("batched prompt lists are not supported; "
                                      "send one request per prompt")
@@ -256,7 +270,7 @@ class OpenAIServer:
                 raise ValueError("'prompt' must be a non-empty string")
         params = _sampling_from_request(body, self.config.max_tokens_cap)
         self._reject_multihost_unsupported(params)
-        return prompt, params
+        return prompt, params, toolctx
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -366,7 +380,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             body = self._read_body()
-            prompt, params = self.ctx.handle_completion(body, chat)
+            prompt, params, toolctx = self.ctx.handle_completion(body, chat)
             n = self.ctx.parse_n(body)
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, str(e))
@@ -388,9 +402,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # _stream_response owns its error handling: once SSE
                     # headers are out, a second status line would corrupt
                     # the stream.
-                    self._stream_response(body, params, chat, kwargs, n)
+                    self._stream_response(body, params, chat, kwargs, n,
+                                          toolctx=toolctx)
                 else:
-                    self._full_response(body, params, chat, kwargs, n)
+                    self._full_response(body, params, chat, kwargs, n,
+                                        toolctx=toolctx)
         except BrokenPipeError:
             pass
         except Exception as e:               # engine-side failure, pre-headers
@@ -577,7 +593,7 @@ class _Handler(BaseHTTPRequestHandler):
         eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
         return eng.tokenizer.decode(kwargs["prompt_token_ids"])
 
-    def _full_response(self, body, params, chat, kwargs, n=1):
+    def _full_response(self, body, params, chat, kwargs, n=1, toolctx=None):
         ctx = self.ctx
         t0 = time.monotonic()
         submits = self._submit_choices(params, kwargs, n)
@@ -627,8 +643,15 @@ class _Handler(BaseHTTPRequestHandler):
                 prompt_tokens = req.num_prompt_tokens
             completion_tokens += len(token_ids)
             if chat:
-                choice = {"index": idx,
-                          "message": {"role": "assistant", "content": text},
+                message = {"role": "assistant", "content": text}
+                if toolctx is not None:
+                    content, tool_calls = toolctx.postprocess(text)
+                    if tool_calls:
+                        message = {"role": "assistant", "content": content,
+                                   "tool_calls": tool_calls}
+                        if finish_reason == "stop":
+                            finish_reason = "tool_calls"
+                choice = {"index": idx, "message": message,
                           "finish_reason": finish_reason}
                 if logprob_entries:
                     choice["logprobs"] = self._chat_logprobs(logprob_entries)
@@ -650,7 +673,7 @@ class _Handler(BaseHTTPRequestHandler):
                          "model": ctx.model_name, "choices": choices,
                          "usage": usage})
 
-    def _stream_response(self, body, params, chat, kwargs, n=1):
+    def _stream_response(self, body, params, chat, kwargs, n=1, toolctx=None):
         ctx = self.ctx
         # vLLM-compatible extension: carry each chunk's token ids so
         # clients (and the load harness) can count tokens exactly — chunk
@@ -733,6 +756,10 @@ class _Handler(BaseHTTPRequestHandler):
             completion_toks = 0
             errored = False
             lp_cursor = [0] * n        # per-choice logprob emission offset
+            # tools: hold marker text out of content deltas per choice;
+            # parsed calls are emitted as a trailing tool_calls delta
+            filters = ([toolctx.stream_filter() for _ in range(n)]
+                       if chat and toolctx is not None else None)
             live = n
             while live:
                 try:
@@ -756,10 +783,22 @@ class _Handler(BaseHTTPRequestHandler):
                     live -= 1
                     continue
                 finish = item.finish_reason.value if item.finish_reason else None
+                tc_deltas = None
                 if chat:
-                    delta = {"content": item.new_text} if item.new_text else {}
+                    text_out = item.new_text
+                    if filters is not None:
+                        text_out = filters[idx].feed(item.new_text)
+                        if finish is not None:
+                            tail, calls = filters[idx].finish()
+                            text_out += tail
+                            if calls:
+                                tc_deltas = [dict(c.as_openai(), index=ci)
+                                             for ci, c in enumerate(calls)]
+                                if finish == "stop":
+                                    finish = "tool_calls"
+                    delta = {"content": text_out} if text_out else {}
                     choice = {"index": idx, "delta": delta,
-                              "finish_reason": finish}
+                              "finish_reason": None if tc_deltas else finish}
                     obj = "chat.completion.chunk"
                 else:
                     choice = {"index": idx, "text": item.new_text,
@@ -789,6 +828,18 @@ class _Handler(BaseHTTPRequestHandler):
                 if include_usage:
                     chunk["usage"] = None     # OpenAI: null until the final chunk
                 send_chunk(chunk)
+                if tc_deltas:
+                    # trailing delta carrying the parsed calls + the real
+                    # finish_reason (the content chunk above sent None)
+                    tchunk = {"id": oid, "object": obj,
+                              "created": int(time.time()),
+                              "model": ctx.model_name,
+                              "choices": [{"index": idx,
+                                           "delta": {"tool_calls": tc_deltas},
+                                           "finish_reason": finish}]}
+                    if include_usage:
+                        tchunk["usage"] = None
+                    send_chunk(tchunk)
             if include_usage and not errored:
                 # OpenAI stream_options.include_usage: one final chunk with
                 # empty choices carrying the aggregate usage (skipped after
@@ -853,6 +904,10 @@ def main(argv=None):
     ap.add_argument("--chat-template", default=None,
                     help="path to a Jinja chat template overriding the "
                          "tokenizer's (ConfigMap-mounted in K8s)")
+    ap.add_argument("--tool-call-parser", default=None,
+                    choices=["hermes", "mistral", "llama3_json"],
+                    help="tool-call output format for /v1/chat/completions "
+                         "tools (default: inferred from the model family)")
     ap.add_argument("--speculative-k", type=int, default=0,
                     help="n-gram speculative decoding with k draft tokens "
                          "(0 disables; greedy requests only)")
@@ -939,6 +994,7 @@ def main(argv=None):
         chat_template = open(args.chat_template).read()
     server = OpenAIServer(engine, ServerConfig(
         host=args.host, port=args.port, chat_template=chat_template,
+        tool_call_parser=args.tool_call_parser,
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)
