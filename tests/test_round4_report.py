@@ -62,3 +62,15 @@ def test_load_rows_filters_non_tpu(tmp_path):
                                "value": 2.0}) + "\n")
     rows = rr.load_rows(str(p))
     assert rows["base"]["value"] == 2.0
+
+
+def test_write_section_replaces_previous(tmp_path):
+    md = tmp_path / "b.md"
+    md.write_text("# Measured\n\n## Sweep @ x\n\n| base | 1 |\n")
+    rr.write_section("### Headline\n- base: 1", str(md))
+    rr.write_section("### Headline\n- base: 2", str(md))
+    rr.write_section("### Headline\n- base: 3", str(md))
+    text = md.read_text()
+    assert text.count(rr.SECTION_HEAD) == 1       # replaced, not stacked
+    assert "- base: 3" in text and "- base: 1\n" not in text
+    assert "## Sweep @ x" in text                 # other sections untouched

@@ -21,6 +21,9 @@ def runner(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "LOG", str(tmp_path / "r04.jsonl"))
     monkeypatch.setattr(mod, "SWEEP_LOG", str(tmp_path / "sweep.jsonl"))
     monkeypatch.setattr(mod, "ATTEMPTS", str(tmp_path / "attempts.json"))
+    # the end-of-capture report runs as a SUBPROCESS: it must be pointed
+    # at tmp files explicitly or it writes the real BENCHMARKS.md
+    monkeypatch.setattr(mod, "REPORT_MD", str(tmp_path / "bench.md"))
     # keep the test small: two engine variants, one serving row
     monkeypatch.setattr(mod, "PRIORITY", ["base", "int8"])
     monkeypatch.setattr(mod, "SERVING", [("serving-closed32", ["--clients", "32"])])

@@ -215,9 +215,33 @@ def build_report(rows):
     return "\n".join(lines), decisions
 
 
+SECTION_HEAD = "## Round-4 TPU capture analysis @ "
+
+
+def write_section(report: str, md_path: str) -> None:
+    """Append the analysis as ONE section, REPLACING any previous capture
+    analysis: the runner re-invokes after every tunnel flap, and a plain
+    append stacked identical blocks (observed 6x on 2026-07-31)."""
+    import datetime
+    import re
+    try:
+        with open(md_path) as f:
+            text = f.read()
+    except FileNotFoundError:
+        text = ""
+    text = re.sub(r"\n" + re.escape(SECTION_HEAD)
+                  + r"[^\n]*\n(?:(?!\n## ).)*", "", text, flags=re.DOTALL)
+    stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M")
+    with open(md_path, "w") as f:
+        f.write(text.rstrip("\n") + "\n")
+        f.write(f"\n{SECTION_HEAD}{stamp}\n\n")
+        f.write(report + "\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--log", default=os.path.join(ROOT, "bench_r04_tpu.jsonl"))
+    ap.add_argument("--md", default=os.path.join(ROOT, "BENCHMARKS.md"))
     ap.add_argument("--no-md", action="store_true")
     args = ap.parse_args(argv)
     rows = load_rows(args.log)
@@ -227,11 +251,7 @@ def main(argv=None):
     report, decisions = build_report(rows)
     print(report)
     if not args.no_md:
-        import datetime
-        stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M")
-        with open(os.path.join(ROOT, "BENCHMARKS.md"), "a") as f:
-            f.write(f"\n## Round-4 TPU capture analysis @ {stamp}\n\n")
-            f.write(report + "\n")
+        write_section(report, args.md)
     return 0
 
 
