@@ -1,0 +1,195 @@
+"""/v1/embeddings + Engine.embed: pooling correctness (padding invariance,
+masking), wire formats (float/base64/dimensions), and validation.
+
+Reference parity: the reference deploys vLLM's OpenAI surface
+(llm-d-test.yaml), which includes the embeddings route."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+
+@pytest.fixture(scope="module")
+def eng():
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=8, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+@pytest.fixture(scope="module")
+def server(eng):
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ------------------------------------------------------------ engine level
+
+def test_embed_shapes_and_norm(eng):
+    vecs, counts = eng.embed(["hello world", "hi"])
+    assert vecs.shape == (2, eng.model_cfg.hidden_size)
+    assert vecs.dtype == np.float32
+    assert counts == [len(eng.tokenizer.encode("hello world")),
+                      len(eng.tokenizer.encode("hi"))]
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, atol=1e-5)
+
+
+def test_embed_padding_invariance(eng):
+    # batching next to a longer text (more padding, padded batch rows)
+    # must not change a text's embedding: masking correctness
+    alone, _ = eng.embed(["short text"])
+    batched, _ = eng.embed(["short text", "a considerably longer text that "
+                            "forces the bucket up", "third entry"])
+    np.testing.assert_allclose(alone[0], batched[0], atol=2e-5)
+
+
+def test_embed_deterministic_and_distinct(eng):
+    a, _ = eng.embed(["same input"])
+    b, _ = eng.embed(["same input"])
+    np.testing.assert_allclose(a, b, atol=1e-6)
+    c, _ = eng.embed(["a different input entirely"])
+    assert np.linalg.norm(a[0] - c[0]) > 1e-3
+
+
+def test_embed_pooling_modes(eng):
+    mean, _ = eng.embed(["the quick brown fox"], pooling="mean")
+    last, _ = eng.embed(["the quick brown fox"], pooling="last")
+    assert np.linalg.norm(mean[0] - last[0]) > 1e-4
+
+
+def test_embed_token_ids_match_text(eng):
+    ids = eng.tokenizer.encode("round trip")
+    via_text, _ = eng.embed(["round trip"])
+    via_ids, _ = eng.embed([ids])
+    np.testing.assert_allclose(via_text, via_ids, atol=1e-6)
+
+
+def test_embed_validation(eng):
+    with pytest.raises(ValueError):
+        eng.embed([])
+    with pytest.raises(ValueError):
+        eng.embed([""])
+    with pytest.raises(ValueError):
+        eng.embed(["x"], pooling="max")
+    with pytest.raises(ValueError):
+        eng.embed(["x"] * (eng.MAX_EMBED_BATCH + 1))
+    with pytest.raises(ValueError):
+        eng.embed([[1] * (eng.model_cfg.max_position_embeddings + 1)])
+
+
+def test_embed_budget_chunking_matches_unchunked(eng, monkeypatch):
+    # tiny score budget forces multi-chunk execution; results must be
+    # identical to the one-shot path (OOM guard must not change outputs)
+    full, _ = eng.embed(["alpha", "beta text", "gamma", "delta four"])
+    per_row = eng.model_cfg.num_heads * 16 * 16 * 4      # T pads to 16 here
+    monkeypatch.setattr(type(eng), "EMBED_SCORE_BUDGET_BYTES", per_row)
+    chunked, _ = eng.embed(["alpha", "beta text", "gamma", "delta four"])
+    np.testing.assert_allclose(full, chunked, atol=2e-5)
+
+
+def test_embed_single_input_over_budget_rejected(eng, monkeypatch):
+    monkeypatch.setattr(type(eng), "EMBED_SCORE_BUDGET_BYTES", 1024)
+    with pytest.raises(ValueError, match="attention budget"):
+        eng.embed(["this input is far too long for a 1KB score budget"])
+
+
+def test_warmup_embed_buckets(eng):
+    eng.warmup(prefill_buckets=[], decode_buckets=[2],
+               embed_buckets=[(2, 8)])        # smoke: compiles + syncs
+
+
+# -------------------------------------------------------------- HTTP level
+
+def test_embeddings_endpoint_single(server):
+    status, body = _post(server + "/v1/embeddings",
+                         {"input": "hello", "model": "tiny-qwen3"})
+    assert status == 200
+    assert body["object"] == "list"
+    assert body["data"][0]["object"] == "embedding"
+    assert body["data"][0]["index"] == 0
+    assert isinstance(body["data"][0]["embedding"], list)
+    assert body["usage"]["prompt_tokens"] == body["usage"]["total_tokens"] > 0
+
+
+def test_embeddings_endpoint_batch_and_ids(server):
+    status, body = _post(server + "/v1/embeddings",
+                         {"input": ["a", "b", "c"]})
+    assert status == 200 and len(body["data"]) == 3
+    assert [d["index"] for d in body["data"]] == [0, 1, 2]
+    status, body = _post(server + "/v1/embeddings", {"input": [5, 6, 7]})
+    assert status == 200 and len(body["data"]) == 1
+    assert body["usage"]["prompt_tokens"] == 3
+
+
+def test_embeddings_base64_matches_float(server):
+    status, f = _post(server + "/v1/embeddings", {"input": "same text"})
+    status2, b = _post(server + "/v1/embeddings",
+                       {"input": "same text", "encoding_format": "base64"})
+    assert status == status2 == 200
+    decoded = np.frombuffer(
+        base64.b64decode(b["data"][0]["embedding"]), dtype="<f4")
+    np.testing.assert_allclose(decoded, np.array(f["data"][0]["embedding"],
+                                                 dtype=np.float32), atol=1e-6)
+
+
+def test_embeddings_dimensions_truncates_and_renorms(server):
+    status, body = _post(server + "/v1/embeddings",
+                         {"input": "truncate me", "dimensions": 8})
+    assert status == 200
+    v = np.array(body["data"][0]["embedding"])
+    assert v.shape == (8,)
+    np.testing.assert_allclose(np.linalg.norm(v), 1.0, atol=1e-5)
+
+
+def test_embeddings_validation_400s(server):
+    for bad in ({"input": []}, {"input": 7}, {},
+                {"input": "x", "encoding_format": "hex"},
+                {"input": "x", "dimensions": 0},
+                {"input": "x", "dimensions": 10**6},
+                {"input": [["a", "b"]]},
+                {"input": [[-1, 5]]}):
+        status, body = _post(server + "/v1/embeddings", bad)
+        assert status == 400, (bad, body)
+        assert body["error"]["type"] == "invalid_request_error"
+
+
+def test_embeddings_dimensions_bool_rejected(server):
+    status, body = _post(server + "/v1/embeddings",
+                         {"input": "x", "dimensions": True})
+    assert status == 400
+
+
+def test_embed_concurrent_requests_serialized(eng):
+    # the score budget is per-request; parallel embeds must serialize
+    # (and produce correct results) rather than multiply the budget
+    import threading
+    results = {}
+    def work(key, text):
+        results[key] = eng.embed([text])[0]
+    ts = [threading.Thread(target=work, args=(i, f"text number {i}"))
+          for i in range(4)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    for i in range(4):
+        solo, _ = eng.embed([f"text number {i}"])
+        np.testing.assert_allclose(results[i], solo, atol=2e-5)

@@ -393,3 +393,19 @@ def test_chat_template_carries_tools(srv):
         {"role": "tool", "content": "42"},
     ]))
     assert "f" in rendered and "42" in rendered
+
+
+def test_prompt_instruction_matches_parser():
+    # the fallback template must teach the ACTIVE parser's format
+    from tpuserve.models.tokenizer import default_chat_template
+    from tpuserve.server.tool_calls import get_tool_parser
+    tools_json = json.dumps(_tools())
+    msgs = [{"role": "user", "content": "hi"}]
+    for name, marker in (("mistral", "[TOOL_CALLS]"),
+                         ("llama3_json", '{"name": <name>, "parameters"'),
+                         ("hermes", "<tool_call>")):
+        p = get_tool_parser("m", override=name)
+        rendered = default_chat_template(
+            msgs, tools=_tools(),
+            tool_instruction=p.prompt_instruction(tools_json))
+        assert marker in rendered, (name, rendered)

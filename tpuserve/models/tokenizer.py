@@ -100,7 +100,8 @@ class HFTokenizer:
 
 
 def default_chat_template(messages: list[dict], add_generation_prompt: bool = True,
-                          tools: list[dict] | None = None) -> str:
+                          tools: list[dict] | None = None,
+                          tool_instruction: str | None = None) -> str:
     """Plain-text chat template for template-less models.
 
     Same shape as the reference's ConfigMap templates
@@ -116,10 +117,15 @@ def default_chat_template(messages: list[dict], add_generation_prompt: bool = Tr
     if msgs and msgs[0].get("role") == "system":
         out.append(msgs.pop(0)["content"].strip() + "\n")
     if tools:
-        out.append(
+        # tool_instruction comes from the ACTIVE parser (server/
+        # tool_calls.py prompt_instruction) so the format the prompt
+        # teaches is the format the server parses; the hermes text is
+        # only the no-context fallback
+        out.append((tool_instruction or (
             "You may call tools. To call one, reply with "
             '<tool_call>{"name": <name>, "arguments": <args-object>}'
-            "</tool_call>.\nAvailable tools: " + _json.dumps(tools) + "\n")
+            "</tool_call>.\nAvailable tools: " + _json.dumps(tools)))
+            + "\n")
     for m in msgs:
         role = "User" if m.get("role") in ("user", "human") else \
                "Assistant" if m.get("role") == "assistant" else m.get("role", "User").title()

@@ -310,6 +310,50 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 # --------------------------------------------------------------------------
+# Embeddings: trunk without KV cache, pooled final hidden states
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "pooling"))
+def embed_forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  prompt_lens: jnp.ndarray, *, pooling: str = "mean"):
+    """Pooled sentence embeddings for /v1/embeddings (the reference's
+    serving stack is vLLM, whose OpenAI surface includes embeddings).
+
+    tokens: (B, T) right-padded; prompt_lens: (B,).  Runs the decoder trunk
+    with plain (non-paged) causal attention — no KV cache is written, so
+    embedding traffic never touches the serving cache pool — applies the
+    final norm, pools over valid positions ("mean" or "last"), and returns
+    L2-normalised float32 (B, H).
+    """
+    B, T = tokens.shape
+    positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = _embed(params, cfg, tokens, positions)
+    scale = cfg.attn_scale
+    for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
+                                         sliding_window=sw,
+                                         logit_softcap=cfg.attn_logit_softcapping)
+        out = out.reshape(B, T, cfg.q_size)
+        h = h + _attn_residual(out, lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
+    if cfg.final_layernorm:
+        h = _norm(h, params["final_norm"], cfg)
+    h = h.astype(jnp.float32)
+    if pooling == "last":
+        last_idx = jnp.maximum(prompt_lens - 1, 0)
+        pooled = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+    else:                                  # masked mean over valid positions
+        mask = (jnp.arange(T)[None, :] < prompt_lens[:, None])[..., None]
+        pooled = jnp.sum(h * mask, axis=1) / \
+            jnp.maximum(prompt_lens[:, None], 1).astype(jnp.float32)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+
+
+# --------------------------------------------------------------------------
 # Speculative verify: score a draft window, return per-row greedy argmax
 # --------------------------------------------------------------------------
 

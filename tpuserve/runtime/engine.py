@@ -232,6 +232,10 @@ class Engine:
         # select) whose producer chains the end-of-warmup sync must drain
         # individually — see warmup()
         self._warm_tails: list = []
+        # serialises Engine.embed dispatches: the score budget is
+        # per-request; concurrent HTTP handler threads must not multiply it
+        import threading
+        self._embed_lock = threading.Lock()
         self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
         self._detok: dict[str, IncrementalDetokenizer] = {}
         self._greedy_cache: dict[int, tuple] = {}
@@ -1222,6 +1226,92 @@ class Engine:
         return [self.requests.pop(rid) for rid in rids]
 
     # ------------------------------------------------------------------
+    # Embeddings: pooled hidden states, no KV cache involvement
+    # ------------------------------------------------------------------
+
+    MAX_EMBED_BATCH = 128
+    # embed_forward materialises a (B, H, T, T) f32 score tensor (it runs
+    # the reference prefill attention, cache-less).  Bound that to ~1 GiB
+    # so one embeddings request can't OOM a device that is also serving
+    # decode traffic: the batch is auto-chunked down, and a single input
+    # too long for the budget alone is rejected with a 400-able error.
+    EMBED_SCORE_BUDGET_BYTES = 1 << 30
+
+    def _embed_max_rows(self, T: int) -> int:
+        per_row = self.model_cfg.num_heads * T * T * 4
+        return max(int(self.EMBED_SCORE_BUDGET_BYTES // max(per_row, 1)), 0)
+
+    def embed(self, inputs: Sequence[str] | Sequence[Sequence[int]],
+              pooling: str = "mean"):
+        """Sentence embeddings for /v1/embeddings (vLLM-surface parity).
+
+        Tokenises, pads to power-of-2 (B, T) buckets to bound recompiles,
+        and runs the cache-less trunk (models/transformer.py
+        embed_forward) in batch chunks sized to the attention-score memory
+        budget.  Returns (float32 ndarray (n, H), token counts).
+        Multi-host lockstep mirrors prefill/decode only, so embeddings are
+        rejected there like the other out-of-protocol ops."""
+        import jax
+        if jax.process_count() > 1:
+            raise ValueError("embeddings not supported by this multi-host "
+                             "deployment; route to a single-host replica")
+        if pooling not in ("mean", "last"):
+            raise ValueError("pooling must be 'mean' or 'last'")
+        if not inputs:
+            raise ValueError("input must be non-empty")
+        if len(inputs) > self.MAX_EMBED_BATCH:
+            raise ValueError(f"at most {self.MAX_EMBED_BATCH} inputs per "
+                             "request")
+        ids_list = []
+        for x in inputs:
+            ids = self.tokenizer.encode(x) if isinstance(x, str) else \
+                [int(t) for t in x]
+            if not ids:
+                raise ValueError("input texts must be non-empty")
+            limit = self.model_cfg.max_position_embeddings
+            if len(ids) > limit:
+                raise ValueError(f"input length {len(ids)} exceeds model "
+                                 f"position range {limit}")
+            T1 = max(next_power_of_2(len(ids)), 8)
+            if self._embed_max_rows(T1) < 1:
+                raise ValueError(
+                    f"input length {len(ids)} exceeds the embeddings "
+                    "attention budget for this model; shorten the input")
+            ids_list.append(ids)
+        with self._embed_lock:
+            return self._embed_locked(ids_list, pooling)
+
+    def _embed_locked(self, ids_list, pooling):
+        from tpuserve.models.transformer import embed_forward
+        outs = []
+        i = 0
+        while i < len(ids_list):
+            # greedy chunk: largest prefix whose padded (B, T) fits budget
+            T = max(next_power_of_2(len(ids_list[i])), 8)
+            j = i + 1
+            while j < len(ids_list):
+                T2 = max(T, next_power_of_2(len(ids_list[j])), 8)
+                if j + 1 - i > min(self._embed_max_rows(T2),
+                                   self.MAX_EMBED_BATCH):
+                    break
+                T = T2
+                j += 1
+            group = ids_list[i:j]
+            B = next_power_of_2(len(group))
+            if B > self._embed_max_rows(T):     # padding rows count too
+                B = max(len(group), 1)
+            tokens = np.zeros((B, T), dtype=np.int32)
+            lens = np.ones((B,), dtype=np.int32)   # pad rows: avoid 0-len
+            for k, ids in enumerate(group):
+                tokens[k, :len(ids)] = ids
+                lens[k] = len(ids)
+            out = embed_forward(self.params, self.model_cfg, tokens, lens,
+                                pooling=pooling)
+            outs.append(np.asarray(out)[:len(group)])
+            i = j
+        return np.concatenate(outs, axis=0), [len(x) for x in ids_list]
+
+    # ------------------------------------------------------------------
     # Warmup: pre-compile the bucketed executables (TTFT depends on this —
     # SURVEY.md §7 "TTFT ≤150 ms requires compile-cache warmup at startup")
     # ------------------------------------------------------------------
@@ -1231,6 +1321,7 @@ class Engine:
                decode_buckets: Sequence[int] = (),
                sample_modes: Sequence[str] = ("greedy", "temperature", "full"),
                chunk_buckets: Sequence[int] = (),
+               embed_buckets: Sequence[tuple[int, int]] = (),
                ) -> None:
         """Pre-compile executables.  ``prefill_buckets`` entries are either a
         padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
@@ -1326,6 +1417,14 @@ class Engine:
                     tokens, jnp.zeros((1,), jnp.int32),
                     jnp.ones((1,), jnp.int32), slots, bt)
                 self._warm_sampling(logits, sample_modes)
+        if embed_buckets:
+            # embeddings executables are independent of the KV cache —
+            # one pass suffices (no layout round-trip to settle)
+            from tpuserve.models.transformer import embed_forward
+            for B, T in embed_buckets:
+                self._warm_tails.append(embed_forward(
+                    self.params, self.model_cfg,
+                    jnp.zeros((B, T), jnp.int32), jnp.ones((B,), jnp.int32)))
         # hard_sync, not block_until_ready: on the tunnelled axon platform
         # block_until_ready is a no-op and the first real request's host
         # transfer would pay for the entire queued warmup backlog (measured

@@ -50,6 +50,10 @@ class ServerConfig:
     # Tool-call parser override (hermes/mistral/llama3_json); None = infer
     # from the model family (server/tool_calls.py).
     tool_call_parser: Optional[str] = None
+    # (B, T) embed_forward buckets to pre-compile at startup so the first
+    # /v1/embeddings request doesn't stall on a trunk compile.  Empty =
+    # compile lazily (deployments that never embed pay nothing).
+    warmup_embed: tuple = ()
     # Export tpu_* device metrics alongside vllm_* on /metrics — the engine
     # owns the chips, so it is the authoritative DCGM-analog source.
     tpu_metrics: bool = True
@@ -176,7 +180,9 @@ class OpenAIServer:
         if self.tpu_exporter is not None:
             self.tpu_exporter.start()
         if warmup and hasattr(self.engine, "warmup"):
-            self.engine.warmup()
+            # embed buckets opt-in: each costs a full trunk compile at
+            # startup, wasted on deployments that never call /v1/embeddings
+            self.engine.warmup(embed_buckets=self.config.warmup_embed)
         server = self
 
         class Handler(_Handler):
@@ -249,7 +255,10 @@ class OpenAIServer:
             elif hasattr(tok, "apply_chat_template"):
                 prompt = tok.apply_chat_template(messages, tools=tools)
             else:
-                prompt = default_chat_template(messages, tools=tools)
+                instr = (toolctx.parser.prompt_instruction(json.dumps(tools))
+                         if toolctx else None)
+                prompt = default_chat_template(messages, tools=tools,
+                                               tool_instruction=instr)
             if toolctx is not None and toolctx.forced:
                 # commit the model to a call (tool_choice required/named):
                 # the same prefix is prepended to the output before parsing
@@ -373,6 +382,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path in ("/tokenize", "/detokenize"):
             self._handle_tokenize(self.path == "/tokenize")
+            return
+        if self.path == "/v1/embeddings":
+            self._handle_embeddings()
             return
         chat = self.path == "/v1/chat/completions"
         if self.path not in ("/v1/completions", "/v1/chat/completions"):
@@ -512,6 +524,78 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"prompt": eng.tokenizer.decode(tokens)})
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, str(e))
+
+    def _handle_embeddings(self):
+        """OpenAI /v1/embeddings: input str | [str] | [ids] | [[ids]];
+        encoding_format float (default) or base64; optional `dimensions`
+        truncation with re-normalisation (OpenAI semantics).  Pooled from
+        the causal trunk's final hidden states (Engine.embed) — the
+        reference's serving stack (vLLM) exposes the same route."""
+        ctx = self.ctx
+        eng = getattr(ctx.engine, "prefill", None) or ctx.engine
+        try:
+            body = self._read_body()
+            raw = body.get("input")
+            if isinstance(raw, str):
+                inputs = [raw]
+            elif isinstance(raw, list) and raw and \
+                    all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in raw):
+                inputs = [raw]                       # one token-id prompt
+            elif isinstance(raw, list) and raw:
+                inputs = raw
+            else:
+                raise ValueError("'input' must be a string, list of "
+                                 "strings, or list(s) of token ids")
+            vocab = eng.model_cfg.vocab_size
+            for x in inputs:
+                if isinstance(x, list) and not all(
+                        isinstance(t, int) and not isinstance(t, bool)
+                        and 0 <= t < vocab for t in x):
+                    raise ValueError("token ids must be ints in "
+                                     f"[0, {vocab})")
+                elif not isinstance(x, (str, list)):
+                    raise ValueError("'input' items must be strings or "
+                                     "token-id lists")
+            fmt = body.get("encoding_format", "float")
+            if fmt not in ("float", "base64"):
+                raise ValueError("encoding_format must be 'float' or "
+                                 "'base64'")
+            dims = body.get("dimensions")
+            if dims is not None and (not isinstance(dims, int)
+                                     or isinstance(dims, bool)
+                                     or dims < 1):
+                raise ValueError("'dimensions' must be a positive integer")
+            vecs, counts = eng.embed(inputs)
+            if dims is not None:
+                if dims > vecs.shape[1]:
+                    raise ValueError(f"'dimensions' {dims} exceeds model "
+                                     f"embedding width {vecs.shape[1]}")
+                import numpy as _np
+                vecs = vecs[:, :dims]
+                vecs = vecs / _np.maximum(
+                    _np.linalg.norm(vecs, axis=-1, keepdims=True), 1e-12)
+            data = []
+            for i, v in enumerate(vecs):
+                if fmt == "base64":
+                    import base64
+                    emb = base64.b64encode(
+                        v.astype("<f4").tobytes()).decode()
+                else:
+                    emb = [float(x) for x in v]
+                data.append({"object": "embedding", "index": i,
+                             "embedding": emb})
+            total = sum(counts)
+            self._json(200, {
+                "object": "list", "data": data, "model": ctx.model_name,
+                "usage": {"prompt_tokens": total, "total_tokens": total}})
+        except (ValueError, json.JSONDecodeError) as e:
+            self._error(400, str(e))
+        except Exception as e:
+            # engine-side failure (XLA OOM, compile error): a JSON 500
+            # beats the dropped connection BaseHTTPRequestHandler gives
+            logger.exception("embeddings failed")
+            self._error(500, str(e), "server_error")
 
     def _handle_internal_abort(self):
         """Drop an adopted request (prefill pod's ambiguous-outcome cleanup:
@@ -908,6 +992,10 @@ def main(argv=None):
                     choices=["hermes", "mistral", "llama3_json"],
                     help="tool-call output format for /v1/chat/completions "
                          "tools (default: inferred from the model family)")
+    ap.add_argument("--warmup-embed", default=None,
+                    help="comma-separated BxT embed buckets to pre-compile "
+                         "(e.g. '8x128,1x512') so first /v1/embeddings "
+                         "requests don't stall on a trunk compile")
     ap.add_argument("--speculative-k", type=int, default=0,
                     help="n-gram speculative decoding with k draft tokens "
                          "(0 disables; greedy requests only)")
@@ -992,9 +1080,18 @@ def main(argv=None):
     chat_template = None
     if args.chat_template:
         chat_template = open(args.chat_template).read()
+    warmup_embed = ()
+    if args.warmup_embed:
+        try:
+            warmup_embed = tuple(
+                (int(b.lower().split("x")[0]), int(b.lower().split("x")[1]))
+                for b in args.warmup_embed.split(","))
+        except (ValueError, IndexError):
+            ap.error("--warmup-embed must be comma-separated BxT pairs, "
+                     "e.g. '8x128,1x512'")
     server = OpenAIServer(engine, ServerConfig(
         host=args.host, port=args.port, chat_template=chat_template,
-        tool_call_parser=args.tool_call_parser,
+        tool_call_parser=args.tool_call_parser, warmup_embed=warmup_embed,
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)

@@ -81,6 +81,13 @@ class ToolParser:
         extract()."""
         raise NotImplementedError
 
+    def prompt_instruction(self, tools_json: str) -> str:
+        """System-block text advertising the tools in THIS parser's output
+        format — used by the fallback chat template for template-less
+        models, so the format the prompt teaches is the format extract()
+        parses."""
+        raise NotImplementedError
+
 
 class HermesToolParser(ToolParser):
     """``<tool_call>{"name":..., "arguments":{...}}</tool_call>`` blocks —
@@ -123,6 +130,11 @@ class HermesToolParser(ToolParser):
             return '<tool_call>\n{"name": "%s", "arguments": ' % fn_name
         return "<tool_call>\n"
 
+    def prompt_instruction(self, tools_json):
+        return ("You may call tools. To call one, reply with "
+                '<tool_call>{"name": <name>, "arguments": <args-object>}'
+                "</tool_call>.\nAvailable tools: " + tools_json)
+
 
 class MistralToolParser(ToolParser):
     """``[TOOL_CALLS] [{...}, ...]`` — the Mistral-Instruct convention."""
@@ -156,6 +168,11 @@ class MistralToolParser(ToolParser):
         if fn_name:
             return '[TOOL_CALLS] [{"name": "%s", "arguments": ' % fn_name
         return "[TOOL_CALLS] ["
+
+    def prompt_instruction(self, tools_json):
+        return ("You may call tools. To call one, reply with "
+                '[TOOL_CALLS] [{"name": <name>, "arguments": '
+                "<args-object>}].\nAvailable tools: " + tools_json)
 
 
 class Llama3JsonParser(ToolParser):
@@ -197,6 +214,11 @@ class Llama3JsonParser(ToolParser):
         if fn_name:
             return '{"name": "%s", "parameters": ' % fn_name
         return '{"name": "'
+
+    def prompt_instruction(self, tools_json):
+        return ("You may call tools. To call one, reply with ONLY "
+                '{"name": <name>, "parameters": <args-object>} and no '
+                "other text.\nAvailable tools: " + tools_json)
 
 
 _PARSERS = {p.name: p for p in
