@@ -160,7 +160,8 @@ def _ensure_live_backend(retry: bool = True) -> None:
 def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
                   prefix_caching=False, multi_step=None, quantization=None,
-                  prefill_split=1, kv_quant=None, interleave=False):
+                  prefill_split=1, kv_quant=None, interleave=False,
+                  adaptive_window=True):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -192,7 +193,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     cfg = EngineConfig(model=model, cache=cache, scheduler=sched,
                        attn_impl=attn_impl, enable_prefix_caching=prefix_caching,
                        pipeline_decode=pipeline, speculative=spec,
-                       multi_step=multi_step, quantization=quantization)
+                       multi_step=multi_step, quantization=quantization,
+                       adaptive_multi_step=adaptive_window)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -304,7 +306,7 @@ def _run_workload(engine, prompts, params, arrival_offsets=None):
                                      if pstats is not stats else 0)
     before = {k: getattr(stats, k) for k in
               ("num_decode_steps", "spec_steps", "spec_proposed",
-               "spec_accepted")}
+               "spec_accepted", "latency_windows")}
     rids = []
     pending = None
     # rid -> intended arrival on the monotonic clock.  Arrivals are only
@@ -449,6 +451,10 @@ def main(argv=None):
     ap.add_argument("--multi-step", type=int, default=None, metavar="S",
                     help="fused decode window size (default: auto — 32 on "
                          "TPU, off on CPU); 1 disables")
+    ap.add_argument("--no-adaptive-window", action="store_true",
+                    help="disable adaptive window shrink on arrivals "
+                         "(EngineConfig.adaptive_multi_step) — fixed S "
+                         "windows regardless of offered load")
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="weight-only quantization variant")
     ap.add_argument("--kv-quant", default=None, choices=["int8"],
@@ -545,7 +551,8 @@ def main(argv=None):
                            quantization=args.quant,
                            prefill_split=args.prefill_split,
                            kv_quant=args.kv_quant,
-                           interleave=args.interleave_prefill)
+                           interleave=args.interleave_prefill,
+                           adaptive_window=not args.no_adaptive_window)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -672,6 +679,9 @@ def main(argv=None):
     if poisson:
         out["arrival"] = {"process": "poisson",
                           "rate_req_s": args.arrival_rate}
+    if r.get("latency_windows"):
+        # adaptive window sizing engaged: how many dispatches shrank
+        out["latency_windows"] = r["latency_windows"]
     degraded = os.environ.get("TPUSERVE_BENCH_DEGRADED")
     if degraded:
         out["degraded"] = degraded

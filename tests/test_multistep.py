@@ -393,3 +393,88 @@ def test_randomized_pipelined_equivalence_under_pressure(seed):
         return [eng.requests.pop(r).output_token_ids for r in rids]
 
     assert run(4, True) == run(1, False)
+
+
+# ------------------------------------------------- adaptive window sizing
+
+def test_adaptive_shrinks_on_busy_arrival():
+    # an arrival landing while decode is busy must shrink subsequent
+    # windows to min_multi_step (bounding the arrival's admission wait)
+    eng = _engine(multi_step=8, min_multi_step=2)
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    eng.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    eng.step()                                   # prefill
+    d0 = eng.stats.num_decode_steps
+    eng.step()                                   # full window: idle arrivals
+    assert eng.stats.num_decode_steps - d0 == 8
+    assert eng.stats.latency_windows == 0
+    eng.add_request(prompt_token_ids=[8, 9], params=p)   # busy arrival
+    while eng.has_work():
+        eng.step()
+    assert eng.stats.latency_windows > 0
+
+
+def test_adaptive_tokens_match_fixed():
+    # shrinking windows must not change greedy token streams
+    p = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    fixed = _engine(multi_step=8, adaptive_multi_step=False)
+    r1 = fixed.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    fixed.step()
+    r2 = fixed.add_request(prompt_token_ids=[8, 9], params=p)
+    while fixed.has_work():
+        fixed.step()
+    adaptive = _engine(multi_step=8, min_multi_step=2)
+    a1 = adaptive.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    adaptive.step()
+    a2 = adaptive.add_request(prompt_token_ids=[8, 9], params=p)
+    while adaptive.has_work():
+        adaptive.step()
+    assert adaptive.stats.latency_windows > 0
+    assert adaptive.requests[a1].output_token_ids == \
+        fixed.requests[r1].output_token_ids
+    assert adaptive.requests[a2].output_token_ids == \
+        fixed.requests[r2].output_token_ids
+
+
+def test_adaptive_seeded_sampling_matches_fixed():
+    p = SamplingParams(max_tokens=10, temperature=0.8, seed=7,
+                       ignore_eos=True)
+    fixed = _engine(multi_step=8, adaptive_multi_step=False)
+    f1 = fixed.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    fixed.step()
+    fixed.add_request(prompt_token_ids=[8, 9], params=p)
+    while fixed.has_work():
+        fixed.step()
+    adaptive = _engine(multi_step=8, min_multi_step=2)
+    a1 = adaptive.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    adaptive.step()
+    adaptive.add_request(prompt_token_ids=[8, 9], params=p)
+    while adaptive.has_work():
+        adaptive.step()
+    assert adaptive.stats.latency_windows > 0
+    assert adaptive.requests[a1].output_token_ids == \
+        fixed.requests[f1].output_token_ids
+
+
+def test_adaptive_hold_expires_back_to_full_windows():
+    eng = _engine(multi_step=8, min_multi_step=2,
+                  adaptive_window_hold_s=0.0)    # hold expires immediately
+    p = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+    eng.add_request(prompt_token_ids=[5, 6, 7], params=p)
+    eng.step()
+    eng.add_request(prompt_token_ids=[8, 9], params=p)
+    while eng.has_work():
+        eng.step()
+    assert eng.stats.latency_windows == 0        # expired before any window
+
+
+def test_adaptive_idle_burst_keeps_full_windows():
+    # burst admission into an IDLE engine must not trip latency mode:
+    # the headline burst bench keeps its full-window throughput
+    eng = _engine(multi_step=8, min_multi_step=2)
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    for pr in PROMPTS:
+        eng.add_request(prompt_token_ids=pr, params=p)
+    while eng.has_work():
+        eng.step()
+    assert eng.stats.latency_windows == 0

@@ -65,6 +65,17 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("single-request", ["--batch", "1", "--repeat", "5"], {}),
     ("poisson16", ["--arrival", "poisson", "--arrival-rate", "16"], {}),
     ("poisson32", ["--arrival", "poisson", "--arrival-rate", "32"], {}),
+    # adaptive window sizing (EngineConfig.adaptive_multi_step, default
+    # on): arrivals into a busy engine shrink fused windows to
+    # min_multi_step.  The r4 rows named plain poisson16/poisson32 were
+    # captured pre-feature (commit <= cef5452) = the fixed-window
+    # baseline; these re-measure the same workloads with the feature.
+    ("poisson16-adaptive", ["--arrival", "poisson", "--arrival-rate", "16"],
+     {}),
+    ("poisson32-adaptive", ["--arrival", "poisson", "--arrival-rate", "32"],
+     {}),
+    ("poisson16-fixed", ["--arrival", "poisson", "--arrival-rate", "16",
+                         "--no-adaptive-window"], {}),
     ("poisson16-interleave", ["--arrival", "poisson", "--arrival-rate", "16",
                               "--interleave-prefill"], {}),
     # HBM-roofline headroom probe (VERDICT r3 weak #4: 4,210 tok/s moves

@@ -45,6 +45,9 @@ PRIORITY = [
     "prefill-split2", "prefill-split4",       # p50-TTFT levers (r3 cut)
     "single-request", "poisson16", "poisson32",  # realistic-arrival TTFT
     "poisson16-interleave",                   # ITL-bounding admission mode
+    # adaptive window sizing (added mid-round after the fixed-window
+    # poisson rows measured p50 462 ms): the TTFT-under-load fix
+    "poisson16-adaptive", "poisson32-adaptive", "poisson16-fixed",
     "int8", "int8-multistep32",               # cut by the r3 outage
     "batch128", "int8-batch128", "int8-batch256",  # HBM roofline headroom
     "kv-int8", "int8-kv-int8", "int8-kv-int8-batch256",  # int8 KV cache

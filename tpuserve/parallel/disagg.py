@@ -195,6 +195,13 @@ class DisaggregatedEngine:
         # Adopt the request into the decode engine mid-flight.
         dst.requests[rid] = req
         dst._detok[rid] = self.prefill._detok.pop(rid)
+        if dst._adaptive_window and (dst.scheduler.running
+                                     or dst._pending_window is not None):
+            # a migration into a busy decode pool is an arrival: without
+            # this stamp, adaptive window sizing (engine.py _window_steps)
+            # never engages under disaggregation — migrations bypass
+            # Engine.add_request
+            dst._last_busy_arrival = time.monotonic()
         dst.scheduler.running.append(req)
         self.prefill.block_manager.free(rid)
         self.prefill.requests.pop(rid, None)

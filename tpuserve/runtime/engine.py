@@ -86,6 +86,18 @@ class EngineConfig:
     # multi-host backends), 1 (off) on CPU where the synchronous backend
     # gains little and tests expect per-token streaming.
     multi_step: Optional[int] = None
+    # Adaptive window sizing: a full multi_step window blocks admission for
+    # its whole duration (~430 ms at S=32/batch 64 on v5e), which is the
+    # dominant TTFT term under timed arrivals (measured: poisson16 p50
+    # 462 ms vs 72 ms unloaded, bench_r04_tpu.jsonl).  When an arrival
+    # lands while decode is busy, subsequent windows shrink to
+    # ``min_multi_step`` for ``adaptive_window_hold_s`` seconds, bounding
+    # a new request's wait to one small window; burst workloads (arrivals
+    # into an idle engine) and arrival-free steady state keep the full
+    # window, so peak throughput is unaffected.
+    adaptive_multi_step: bool = True
+    min_multi_step: int = 4
+    adaptive_window_hold_s: float = 0.5
 
     def resolve_pipeline_decode(self) -> bool:
         # Multi-host lockstep serialises every device computation through the
@@ -128,6 +140,7 @@ class EngineStats:
     spec_accepted: int = 0           # draft tokens accepted
     spec_pauses: int = 0             # adaptive governor pauses (spec.py)
     released_blocks: int = 0         # rolling-buffer KV blocks recycled
+    latency_windows: int = 0         # fused windows shrunk for arrivals
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -243,6 +256,11 @@ class Engine:
         self._pending_window: Optional[PendingWindow] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
         self._multi_step = config.resolve_multi_step()
+        self._min_multi_step = min(max(1, config.min_multi_step),
+                                   self._multi_step)
+        self._adaptive_window = (config.adaptive_multi_step
+                                 and self._multi_step > self._min_multi_step)
+        self._last_busy_arrival = float("-inf")
         # Speculation needs a single process: followers can't mirror the
         # data-dependent verify shapes (parallel/multihost broadcasts
         # fixed-shape step kinds only).
@@ -357,6 +375,12 @@ class Engine:
                       params=params, prompt=prompt)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
         self.requests[request_id] = req
+        if self._adaptive_window and (self.scheduler.running
+                                      or self._pending_window is not None):
+            # an arrival into a BUSY engine predicts more: shrink the next
+            # windows so arrivals stop waiting out a full fused window.
+            # Burst admission into an idle engine doesn't trip this.
+            self._last_busy_arrival = time.monotonic()
         self.scheduler.add(req)
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
@@ -402,6 +426,11 @@ class Engine:
         detok.add(first_token)        # seed; its text streamed prefill-side
         self._detok[request_id] = detok
         self.requests[request_id] = req
+        if self._adaptive_window and (self.scheduler.running
+                                      or self._pending_window is not None):
+            # cross-pod migration into a busy decode pod is an arrival
+            # (bypasses add_request's busy-arrival stamp)
+            self._last_busy_arrival = time.monotonic()
         self.scheduler.running.append(req)
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
@@ -499,6 +528,18 @@ class Engine:
                 else self.config.seed ^ (hash(req.request_id) & 0x7FFFFFFF))
         step = len(req.output_token_ids) + extra_step
         return (np.uint32(salt & 0xFFFFFFFF), np.uint32(step))
+
+    def _window_steps(self) -> int:
+        """Fused-window size for the next dispatch: full multi_step in
+        steady state, min_multi_step while arrivals are landing into a
+        busy engine (EngineConfig.adaptive_multi_step) — a new request's
+        admission wait is bounded by one window, so this is the p50-TTFT
+        lever under load."""
+        if self._adaptive_window and (
+                time.monotonic() - self._last_busy_arrival
+                < self.config.adaptive_window_hold_s):
+            return self._min_multi_step
+        return self._multi_step
 
     def _try_reserve_window(self, reqs: list[Request], window: int) -> bool:
         """Reserve ``window`` KV slots past each request's written tokens
@@ -679,7 +720,7 @@ class Engine:
         falls back to the single-step path internally when cache capacity
         can't cover the window.
         """
-        S = self._multi_step
+        S = self._window_steps()
         if any(r.params.needs_penalties or r.params.logprobs is not None
                or r.params.needs_truncation or r.params.needs_logit_bias
                or (r.params.needs_min_tokens
@@ -753,6 +794,10 @@ class Engine:
             jnp.asarray(active), jnp.asarray(keys),
             jnp.asarray(temperature), steps=S, mode=mode)
         self.stats.num_decode_steps += S
+        if S < self._multi_step:
+            # counted at the dispatch, not in _window_steps(): eligibility
+            # bailouts above return before any window actually shrinks
+            self.stats.latency_windows += 1
         if self._pipeline_decode:
             # resolve the PREVIOUS window while this one runs on device.
             # A request that turns out to have finished inside ``p`` (EOS /
@@ -1370,16 +1415,23 @@ class Engine:
                 self._warm_sampling(logits, sample_modes)
                 if self._multi_step > 1:
                     # the windowed executable is the steady-state decode
-                    # path; left cold it stalls the first real window
+                    # path; left cold it stalls the first real window.
+                    # Adaptive sizing adds the latency window's executable
+                    # (min_multi_step) — it must be warm too or the first
+                    # arrival-into-busy-engine stalls on ITS compile.
                     active = jnp.zeros((B,), bool)
                     keys = jnp.zeros((B, 2), jnp.uint32)
                     temp = jnp.zeros((B,), jnp.float32)
+                    sizes = {self._multi_step}
+                    if self._adaptive_window:
+                        sizes.add(self._min_multi_step)
                     for mode in ("greedy", "temperature"):
                         if mode != "greedy" and mode not in sample_modes:
                             continue
-                        _, self.kv_cache = self._exec_decode_multi(
-                            tokens, positions, bt, seq_lens, active, keys,
-                            temp, steps=self._multi_step, mode=mode)
+                        for steps in sorted(sizes):
+                            _, self.kv_cache = self._exec_decode_multi(
+                                tokens, positions, bt, seq_lens, active,
+                                keys, temp, steps=steps, mode=mode)
                 if self._pipeline_decode:
                     # the pipelined paths chain steps/windows through
                     # _select_tokens; left cold, its (tiny) compile stalls

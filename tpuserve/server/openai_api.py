@@ -1003,6 +1003,13 @@ def main(argv=None):
                     help="fused decode window size — S decode+sample steps "
                          "per dispatch (default: auto — 32 on TPU, off on "
                          "CPU; 1 disables).  Tokens stream in bursts of S")
+    ap.add_argument("--no-adaptive-window", action="store_true",
+                    help="fixed S windows: disable the arrival-triggered "
+                         "shrink to --min-multi-step that bounds a new "
+                         "request's admission wait under load")
+    ap.add_argument("--min-multi-step", type=int, default=4,
+                    help="window size while arrivals are landing "
+                         "(adaptive window sizing; default 4)")
     ap.add_argument("--kv-cache-dtype", default="bfloat16",
                     choices=["bfloat16", "float32", "int8"],
                     help="KV cache storage dtype; int8 quantizes on write "
@@ -1042,6 +1049,8 @@ def main(argv=None):
         scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
         attn_impl=args.attn_impl, speculative=spec,
         multi_step=args.multi_step, pipeline_decode=args.pipeline,
+        adaptive_multi_step=not args.no_adaptive_window,
+        min_multi_step=args.min_multi_step,
         quantization=args.quantization)
     mesh = None
     if args.tp > 1:
