@@ -195,6 +195,11 @@ class DisaggregatedEngine:
         # Adopt the request into the decode engine mid-flight.
         dst.requests[rid] = req
         dst._detok[rid] = self.prefill._detok.pop(rid)
+        g = self.prefill._guided.pop(rid, None)
+        if g is not None:
+            # the JSON acceptor follows the request, or guided decoding
+            # silently stops at the pool boundary (and prefill leaks state)
+            dst._guided[rid] = g
         if dst._adaptive_window and (dst.scheduler.running
                                      or dst._pending_window is not None):
             # a migration into a busy decode pool is an arrival: without

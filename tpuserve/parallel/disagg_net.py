@@ -261,10 +261,13 @@ class PrefillHandoffEngine:
             if kind in ("adopted", "release"):
                 self.prefill.block_manager.free(rid)
                 self.prefill._detok.pop(rid, None)
+                # decode pod rebuilt its own acceptor (adopt_prefilled)
+                self.prefill._guided.pop(rid, None)
             elif kind == "fallback":
                 if req.state == RequestState.FINISHED:   # aborted meanwhile
                     self.prefill.block_manager.free(rid)
                     self.prefill._detok.pop(rid, None)
+                    self.prefill._guided.pop(rid, None)
                 else:
                     req._local_decode = True
                     self.prefill.scheduler.running.append(req)

@@ -141,6 +141,7 @@ class EngineStats:
     spec_pauses: int = 0             # adaptive governor pauses (spec.py)
     released_blocks: int = 0         # rolling-buffer KV blocks recycled
     latency_windows: int = 0         # fused windows shrunk for arrivals
+    guided_fallbacks: int = 0        # guided steps that left the top-K
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -249,6 +250,10 @@ class Engine:
         # per-request; concurrent HTTP handler threads must not multiply it
         import threading
         self._embed_lock = threading.Lock()
+        # structured output (params.guided): per-request JSON acceptors +
+        # the lazily-built structural fallback token set (runtime/guided.py)
+        self._guided: dict[str, object] = {}
+        self._guided_fallback_ids: Optional[list[int]] = None
         self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
         self._detok: dict[str, IncrementalDetokenizer] = {}
         self._greedy_cache: dict[int, tuple] = {}
@@ -371,6 +376,17 @@ class Engine:
                 f"{self.cache_cfg.max_model_len} and model position range "
                 f"{self.model_cfg.max_position_embeddings})")
         request_id = request_id or f"req-{next(self._req_counter)}"
+        if params.guided is not None:
+            if params.guided != "json":
+                raise ValueError(f"unsupported guided mode {params.guided!r}"
+                                 " (only 'json')")
+            if params.logprobs is not None:
+                # substitution happens after on-device logprob recording —
+                # the reported tokens would not match the emitted ones
+                raise ValueError(
+                    "logprobs cannot be combined with response_format")
+            from tpuserve.runtime.guided import JsonStateMachine
+            self._guided[request_id] = JsonStateMachine()
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
                       params=params, prompt=prompt)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
@@ -423,8 +439,18 @@ class Engine:
         req.state = RequestState.RUNNING
         req.first_token_time = time.monotonic()
         detok = IncrementalDetokenizer(self.tokenizer)
-        detok.add(first_token)        # seed; its text streamed prefill-side
+        first_text = detok.add(first_token)  # seed; text streamed prefill-side
         self._detok[request_id] = detok
+        if params.guided is not None:
+            # cross-pod migration: rebuild the acceptor and advance it by
+            # the first token's text, mirroring what prefill emitted
+            from tpuserve.runtime.guided import JsonStateMachine
+            st = JsonStateMachine()
+            try:
+                st.feed(first_text)
+                self._guided[request_id] = st
+            except ValueError:
+                pass                     # already off-grammar: unconstrained
         self.requests[request_id] = req
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
@@ -448,6 +474,7 @@ class Engine:
         req.finish_reason = FinishReason.ABORT
         self.block_manager.free(request_id, cache_blocks=not partial)
         self._detok.pop(request_id, None)
+        self._guided.pop(request_id, None)
         return True
 
     def has_work(self) -> bool:
@@ -477,6 +504,7 @@ class Engine:
                                and r.params.min_tokens_active(
                                    len(r.output_token_ids)))
                       and r.params.logprobs is None
+                      and r.params.guided is None
                       for r in batch.requests)):
             outputs = self._run_decode_spec(batch)
         else:
@@ -723,6 +751,7 @@ class Engine:
         S = self._window_steps()
         if any(r.params.needs_penalties or r.params.logprobs is not None
                or r.params.needs_truncation or r.params.needs_logit_bias
+               or r.params.guided is not None
                or (r.params.needs_min_tokens
                    and r.params.min_tokens_active(len(r.output_token_ids)))
                for r in batch.requests):
@@ -858,6 +887,10 @@ class Engine:
         # stale under the pipeline — those batches run synchronously.
         pipeline_ok = self._pipeline_decode and not any(
             r.params.needs_penalties or r.params.logprobs is not None
+            # guided validation substitutes tokens host-side each step —
+            # the pipelined path's device-resident token chain can't see
+            # the substitution
+            or r.params.guided is not None
             # min_tokens reads host-side output lengths, one step stale
             # under the pipeline — the mask could lift one step late/early
             or (r.params.needs_min_tokens
@@ -1073,7 +1106,89 @@ class Engine:
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
-        return np.asarray(jax.device_get(toks))[:n]
+        toks_np = np.asarray(jax.device_get(toks))[:n].copy()
+        if any(r.params.guided is not None for r in reqs):
+            toks_np = self._apply_guided(logits, toks_np, reqs)
+        return toks_np
+
+    GUIDED_TOP_K = 32
+
+    def _apply_guided(self, logits: jnp.ndarray, toks_np: np.ndarray,
+                      reqs: list[Request]) -> np.ndarray:
+        """Structured output: keep the sampled token when its text keeps
+        the document valid; otherwise substitute the most-probable valid
+        candidate from the top-K (then from a structural fallback set).
+        Token substitution is safe on the single-step path: the next
+        step's input token comes from the host, and KV for this position
+        is written by the NEXT dispatch."""
+        k = min(self.GUIDED_TOP_K, self.model_cfg.vocab_size)
+        _, top_ids = jax.lax.top_k(logits, k)
+        ids_h = np.asarray(jax.device_get(top_ids))
+        for i, r in enumerate(reqs):
+            st = self._guided.get(r.request_id)
+            if r.params.guided is None or st is None:
+                continue
+            toks_np[i] = self._guided_pick(
+                r, st, int(toks_np[i]), [int(t) for t in ids_h[i]])
+        return toks_np
+
+    @staticmethod
+    def _guided_text_of(tokenizer, ctx: list, base: str, tok: int) -> str:
+        """Text a candidate token would contribute, via decode-diff over a
+        short context window — exact for any tokenizer (BPE merges,
+        SentencePiece markers) without a vocabulary table.  ``ctx``/``base``
+        are computed once per step by the caller (30-50 candidates share
+        them)."""
+        full = tokenizer.decode(ctx + [tok])
+        d = full[len(base):] if full.startswith(base) else \
+            tokenizer.decode([tok])
+        # trailing replacement char = partial UTF-8 rune still pending —
+        # its bytes aren't text yet
+        return d.rstrip("�")
+
+    def _guided_pick(self, r: Request, st, sampled: int,
+                     candidates: list[int]) -> int:
+        ctx = (r.prompt_token_ids + r.output_token_ids)[-8:]
+        base = self.tokenizer.decode(ctx)
+        for tok in [sampled] + candidates:
+            if tok in self._eos_ids:
+                if st.complete:
+                    return tok
+                continue
+            txt = self._guided_text_of(self.tokenizer, ctx, base, tok)
+            if txt:
+                if st.allows(txt):
+                    return tok
+            elif st.in_string:
+                # no decoded text yet (partial rune / special token):
+                # neutral ONLY where arbitrary text is legal — accepting
+                # it elsewhere lets multibyte garbage assemble outside
+                # strings
+                return tok
+        for tok in self._guided_fallback():
+            txt = self._guided_text_of(self.tokenizer, ctx, base, tok)
+            if txt and st.allows(txt):
+                self.stats.guided_fallbacks += 1
+                return tok
+        # nothing valid exists (pathological tokenizer): give up on the
+        # constraint for this step rather than deadlock
+        self.stats.guided_fallbacks += 1
+        return sampled
+
+    def _guided_fallback(self) -> list[int]:
+        """Single-token encodings of JSON structural strings — the escape
+        hatch when the whole top-K is grammatically invalid (common early
+        on with small/random models)."""
+        if self._guided_fallback_ids is None:
+            ids = []
+            for s in ('"', "}", "]", ":", ",", "{", "[", " ", "0", "1",
+                      "2", "7", "a", "k", "true", "false", "null", "-",
+                      ".", "e"):
+                enc = self.tokenizer.encode(s)
+                if len(enc) == 1:
+                    ids.append(enc[0])
+            self._guided_fallback_ids = ids
+        return self._guided_fallback_ids
 
     def _apply_logit_bias(self, logits: jnp.ndarray, reqs: list[Request],
                           B: int) -> jnp.ndarray:
@@ -1210,6 +1325,20 @@ class Engine:
                 reason = FinishReason.STOP
         else:
             req.output_text += delta
+        if req.params.guided is not None:
+            st = self._guided.get(req.request_id)
+            if st is not None:
+                if delta:
+                    try:
+                        st.feed(delta)       # authoritative state advance
+                    except ValueError:
+                        # gave-up step: DEREGISTER so later steps don't
+                        # validate candidates against a corrupted state
+                        self._guided.pop(req.request_id, None)
+                        st = None
+                if st is not None and st.complete and reason is None:
+                    # root object closed: stop like OpenAI json mode does
+                    reason = FinishReason.STOP
         if reason is None:
             reason = check_stop(req, self._eos_ids, self.max_seq_len)
         finished = reason is not None
@@ -1219,6 +1348,7 @@ class Engine:
             self.scheduler.finish(req)
             self.stats.requests_finished += 1
             self._detok.pop(req.request_id, None)
+            self._guided.pop(req.request_id, None)
         return RequestOutput(
             request_id=req.request_id, new_token_ids=[tok], new_text=delta,
             finished=finished, finish_reason=reason,

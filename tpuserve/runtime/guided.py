@@ -1,0 +1,313 @@
+"""Guided (structured-output) decoding: incremental JSON acceptance.
+
+OpenAI ``response_format: {"type": "json_object"}`` — served by vLLM in
+the stack the reference deploys (reference: llm-d-deploy.yaml pins the
+vLLM OpenAI image) — constrains generation to a valid JSON object.  This
+module is the grammar side: a character-level incremental acceptor the
+engine consults token by token (runtime/engine.py ``_apply_guided``).
+
+The acceptor is a pushdown automaton specialised to JSON: a container
+stack ('O'/'A') plus a small mode word for in-progress scalars.  The
+top level is restricted to an OBJECT (the json_object contract), so
+completion is unambiguous: the moment the root object closes, only
+whitespace may follow and the engine can stop the request.
+
+Design note: the engine validates *candidate token text* against a clone
+of the request's state and substitutes the best valid candidate when the
+sampled token would break the grammar (top-K rejection sampling).  That
+keeps the hot path on-device and tokenizer-agnostic — no vocabulary/DFA
+product tables — at the cost of running guided requests on the
+single-step decode path.
+"""
+
+from __future__ import annotations
+
+_WS = " \t\n\r"
+_DIGITS = "0123456789"
+# number sub-states that may legally end the number
+_NUM_TERMINAL = {"zero", "int", "frac", "exp"}
+
+
+class JsonStateMachine:
+    """Incremental JSON-object acceptor.
+
+    Modes: 'start' (expecting '{'), 'value' (expecting any value),
+    'key' (expecting '"' or — right after '{' — '}'), 'key-required'
+    (after a comma in an object: '"' only), 'colon', 'post' (a value
+    just closed; what follows depends on the stack), 'string'/'key-string'
+    (with escape/unicode counters), 'number' (with ``num`` sub-state),
+    'literal' (true/false/null tail), 'done' (root closed).
+    """
+
+    __slots__ = ("stack", "mode", "esc", "uni", "num", "lit", "ws_run")
+
+    # Longest run of consecutive structural whitespace accepted.  Plain
+    # JSON allows unbounded whitespace, but under guided decoding that is
+    # a degenerate fixed point — a model whose argmax is '\t' emits
+    # whitespace to max_tokens (observed with random weights).  Bounding
+    # the run forces the grammar to demand progress.
+    MAX_WS_RUN = 4
+
+    def __init__(self):
+        self.stack: list = []
+        self.mode = "start"
+        self.esc = False          # inside string: previous char was '\'
+        self.uni = 0              # inside string: \uXXXX hex digits left
+        self.num = ""             # number sub-state
+        self.lit = ""             # remaining chars of true/false/null
+        self.ws_run = 0           # consecutive structural whitespace
+
+    def clone(self) -> "JsonStateMachine":
+        c = JsonStateMachine.__new__(JsonStateMachine)
+        c.stack = list(self.stack)
+        c.mode = self.mode
+        c.esc = self.esc
+        c.uni = self.uni
+        c.num = self.num
+        c.lit = self.lit
+        c.ws_run = self.ws_run
+        return c
+
+    @property
+    def complete(self) -> bool:
+        return self.mode == "done"
+
+    @property
+    def in_string(self) -> bool:
+        """Inside a string (value or key) — the only modes where arbitrary
+        text, and hence a partial multibyte rune contributing no decoded
+        text yet, is legal."""
+        return self.mode in ("string", "key-string")
+
+    def allows(self, text: str) -> bool:
+        """Would ``text`` keep the document valid?  (Clone + feed.)"""
+        c = self.clone()
+        try:
+            c.feed(text)
+        except ValueError:
+            return False
+        return True
+
+    def feed(self, text: str) -> None:
+        for ch in text:
+            self._feed_char(ch)
+
+    # ------------------------------------------------------------------
+
+    def _fail(self, ch: str):
+        raise ValueError(f"invalid JSON char {ch!r} in mode {self.mode}")
+
+    def _close_value(self) -> None:
+        """A value just finished; decide what comes next."""
+        if not self.stack:
+            self.mode = "done"
+        else:
+            self.mode = "post"
+
+    def _feed_char(self, ch: str) -> None:
+        m = self.mode
+        if m == "done":
+            if ch not in _WS:
+                self._fail(ch)
+            self.ws_run += 1
+            if self.ws_run > self.MAX_WS_RUN:
+                self._fail(ch)
+            return
+        if m in ("string", "key-string"):
+            self._string_char(ch)
+            return
+        if m == "number":
+            if self._number_char(ch):
+                return
+            # the char ended the number; fall through and process it in
+            # the post-value context the number closed into
+            m = self.mode
+        if m == "literal":
+            if self.lit and ch == self.lit[0]:
+                self.lit = self.lit[1:]
+                if not self.lit:
+                    self._close_value()
+                return
+            self._fail(ch)
+        if ch in _WS:
+            self.ws_run += 1
+            if self.ws_run > self.MAX_WS_RUN:
+                self._fail(ch)
+            return
+        self.ws_run = 0
+        if m == "start":
+            if ch == "{":
+                self.stack.append("O")
+                self.mode = "key"
+                return
+            self._fail(ch)
+        if m == "value":
+            self._value_start(ch)
+            return
+        if m == "arr-first":                    # right after '[': value or ']'
+            if ch == "]":
+                self.stack.pop()
+                self._close_value()
+                return
+            self._value_start(ch)
+            return
+        if m == "key":
+            if ch == '"':
+                self.mode = "key-string"
+                return
+            if ch == "}":                       # empty object
+                self.stack.pop()
+                self._close_value()
+                return
+            self._fail(ch)
+        if m == "key-required":
+            if ch == '"':
+                self.mode = "key-string"
+                return
+            self._fail(ch)
+        if m == "colon":
+            if ch == ":":
+                self.mode = "value"
+                return
+            self._fail(ch)
+        if m == "post":
+            top = self.stack[-1]
+            if top == "O":
+                if ch == ",":
+                    self.mode = "key-required"
+                    return
+                if ch == "}":
+                    self.stack.pop()
+                    self._close_value()
+                    return
+            else:                               # 'A'
+                if ch == ",":
+                    self.mode = "value"
+                    return
+                if ch == "]":
+                    self.stack.pop()
+                    self._close_value()
+                    return
+            self._fail(ch)
+        self._fail(ch)
+
+    def _value_start(self, ch: str) -> None:
+        if ch == "{":
+            self.stack.append("O")
+            self.mode = "key"
+        elif ch == "[":
+            self.stack.append("A")
+            self.mode = "arr-first"             # value or an immediate ']'
+        elif ch == '"':
+            self.mode = "string"
+        elif ch == "-":
+            self.mode = "number"
+            self.num = "minus"
+        elif ch == "0":
+            self.mode = "number"
+            self.num = "zero"
+        elif ch in "123456789":
+            self.mode = "number"
+            self.num = "int"
+        elif ch == "t":
+            self.mode = "literal"
+            self.lit = "rue"
+        elif ch == "f":
+            self.mode = "literal"
+            self.lit = "alse"
+        elif ch == "n":
+            self.mode = "literal"
+            self.lit = "ull"
+        else:
+            self._fail(ch)
+
+    def _string_char(self, ch: str) -> None:
+        if self.uni:
+            if ch in "0123456789abcdefABCDEF":
+                self.uni -= 1
+                return
+            self._fail(ch)
+        if self.esc:
+            if ch in '"\\/bfnrt':
+                self.esc = False
+                return
+            if ch == "u":
+                self.esc = False
+                self.uni = 4
+                return
+            self._fail(ch)
+        if ch == "\\":
+            self.esc = True
+            return
+        if ch == '"':
+            if self.mode == "key-string":
+                self.mode = "colon"
+            else:
+                self._close_value()
+            return
+        if ch in "\n\r\t" or (len(ch) == 1 and ord(ch) < 0x20):
+            self._fail(ch)                      # control chars must be escaped
+        # any other char (incl. multibyte) is fine inside a string
+
+    def _number_char(self, ch: str) -> bool:
+        """Consume ``ch`` as part of the number.  Returns True if it was
+        part of the number, False if the number ENDED (mode already moved
+        to the closed-value state; the caller re-processes ``ch``)."""
+        n = self.num
+        if n == "minus":
+            if ch == "0":
+                self.num = "zero"
+                return True
+            if ch in "123456789":
+                self.num = "int"
+                return True
+            self._fail(ch)
+        if n == "zero":
+            if ch == ".":
+                self.num = "dot"
+                return True
+            if ch in "eE":
+                self.num = "e"
+                return True
+        elif n == "int":
+            if ch in _DIGITS:
+                return True
+            if ch == ".":
+                self.num = "dot"
+                return True
+            if ch in "eE":
+                self.num = "e"
+                return True
+        elif n == "dot":
+            if ch in _DIGITS:
+                self.num = "frac"
+                return True
+            self._fail(ch)
+        elif n == "frac":
+            if ch in _DIGITS:
+                return True
+            if ch in "eE":
+                self.num = "e"
+                return True
+        elif n == "e":
+            if ch in "+-":
+                self.num = "esign"
+                return True
+            if ch in _DIGITS:
+                self.num = "exp"
+                return True
+            self._fail(ch)
+        elif n == "esign":
+            if ch in _DIGITS:
+                self.num = "exp"
+                return True
+            self._fail(ch)
+        elif n == "exp":
+            if ch in _DIGITS:
+                return True
+        if self.num in _NUM_TERMINAL:
+            self.num = ""
+            self._close_value()
+            return False
+        self._fail(ch)
+

@@ -49,6 +49,11 @@ class SamplingParams:
     # vLLM stop_token_ids: extra ids that finish the request like EOS does
     # (the matched token is emitted; min_tokens suppresses these too)
     stop_token_ids: tuple[int, ...] = ()
+    # Structured output (OpenAI response_format json_object): "json"
+    # constrains generation to one valid JSON object via per-step
+    # candidate validation (runtime/guided.py); runs on the single-step
+    # decode path
+    guided: Optional[str] = None
 
     @property
     def greedy(self) -> bool:
@@ -89,6 +94,9 @@ class SamplingParams:
             ("logit_bias", self.needs_logit_bias),
             ("min_tokens", self.needs_min_tokens),
             ("logprobs", self.logprobs is not None),
+            # per-step host-side candidate validation cannot be mirrored
+            # by the fixed-shape lockstep step kinds
+            ("response_format", self.guided is not None),
         ) if used]
 
     def min_tokens_active(self, n_generated: int, slack: int = 0) -> bool:

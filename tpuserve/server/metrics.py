@@ -97,6 +97,17 @@ class ServerMetrics:
             "tpuserve_window_released_blocks",
             "KV blocks recycled by the sliding-window rolling buffer "
             "(runtime/block_manager.py release_out_of_window)")
+        self.latency_windows = counter(
+            "tpuserve_latency_windows",
+            "Fused decode windows shrunk to min_multi_step because "
+            "arrivals were landing into a busy engine (adaptive window "
+            "sizing, runtime/engine.py _window_steps)")
+        self.guided_fallbacks = counter(
+            "tpuserve_guided_fallbacks",
+            "Guided-decoding steps where the whole top-K was "
+            "grammatically invalid and a structural fallback token was "
+            "substituted — the signal that the constraint is fighting "
+            "the model (runtime/engine.py _guided_pick)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -121,6 +121,19 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
                            and 0 <= t < 2**31 for t in stop_ids)):
             raise ValueError("'stop_token_ids' must be a list of at most "
                              "64 token ids in [0, 2**31)")
+    guided = None
+    rf = body.get("response_format")
+    if rf is not None:
+        if not isinstance(rf, dict) or not isinstance(rf.get("type"), str):
+            raise ValueError("'response_format' must be an object with a "
+                             "'type'")
+        if rf["type"] == "json_object":
+            guided = "json"
+        elif rf["type"] == "json_schema":
+            raise ValueError("response_format 'json_schema' is not "
+                             "supported; use 'json_object'")
+        elif rf["type"] != "text":
+            raise ValueError(f"unknown response_format type {rf['type']!r}")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     return SamplingParams(
         max_tokens=max_tokens,
@@ -137,6 +150,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         logprobs=n_logprobs,
         logit_bias=bias,
         stop_token_ids=tuple(stop_ids),
+        guided=guided,
     )
 
 

@@ -288,7 +288,11 @@ class AsyncEngineRunner:
                                  ("spec_accepted", self.metrics.spec_accepted),
                                  ("spec_pauses", self.metrics.spec_pauses),
                                  ("released_blocks",
-                                  self.metrics.released_blocks)):
+                                  self.metrics.released_blocks),
+                                 ("latency_windows",
+                                  self.metrics.latency_windows),
+                                 ("guided_fallbacks",
+                                  self.metrics.guided_fallbacks)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
 
