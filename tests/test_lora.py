@@ -1,0 +1,208 @@
+"""LoRA adapter merge-at-load (models/weights.py apply_lora).
+
+A synthetic PEFT-format adapter (adapter_config.json +
+adapter_model.safetensors) is merged into random-init weights; the merge
+must change exactly the targeted kernels by s·(B@A)ᵀ, flow through the
+engine end to end (different tokens than the base model), and reject
+malformed adapters loudly — silently dropping adapter keys would serve
+wrong weights.  Reference parity: the deployed vLLM stack serves PEFT
+adapters; here one adapter merges per engine at full base speed."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.models.weights import apply_lora, init_params
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.runtime.request import SamplingParams
+
+CFG = get_model_config("tiny-qwen3")
+
+
+def _write_adapter(path, tensors, r=4, alpha=8):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump({"r": r, "lora_alpha": alpha,
+                   "peft_type": "LORA",
+                   "target_modules": ["q_proj"]}, f)
+    from safetensors.numpy import save_file
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+
+
+def _qproj_tensors(rng, li=0, r=4, out_f=None, in_f=None):
+    in_f = in_f or CFG.hidden_size
+    out_f = out_f or CFG.q_size
+    pre = f"base_model.model.model.layers.{li}.self_attn.q_proj"
+    return {
+        f"{pre}.lora_A.weight": rng.standard_normal((r, in_f)).astype("f4"),
+        f"{pre}.lora_B.weight": rng.standard_normal((out_f, r)).astype("f4"),
+    }
+
+
+def test_apply_lora_exact_delta(tmp_path):
+    rng = np.random.default_rng(0)
+    tensors = _qproj_tensors(rng)
+    _write_adapter(tmp_path / "ad", tensors, r=4, alpha=8)
+    base = init_params(CFG, seed=0)
+    before = np.asarray(base["layers"][0]["q_proj"]["kernel"], dtype=np.float32)
+    untouched = np.asarray(base["layers"][1]["q_proj"]["kernel"],
+                           dtype=np.float32)
+    merged = apply_lora(base, CFG, str(tmp_path / "ad"))
+    after = np.asarray(merged["layers"][0]["q_proj"]["kernel"],
+                       dtype=np.float32)
+    A = next(v for k, v in tensors.items() if "lora_A" in k)
+    B = next(v for k, v in tensors.items() if "lora_B" in k)
+    want = before + (8 / 4) * (A.T @ B.T)
+    # merge computed in f32 then cast to the param dtype (bf16)
+    np.testing.assert_allclose(after, want, atol=0.05, rtol=0.02)
+    assert not np.allclose(after, before)
+    np.testing.assert_array_equal(
+        np.asarray(merged["layers"][1]["q_proj"]["kernel"],
+                   dtype=np.float32), untouched)
+
+
+def test_lora_changes_engine_output(tmp_path):
+    rng = np.random.default_rng(1)
+    tensors = {}
+    for li in range(CFG.num_layers):
+        tensors.update(_qproj_tensors(rng, li=li))
+    _write_adapter(tmp_path / "ad", tensors)
+    kw = dict(
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2))
+    p = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    base = Engine(EngineConfig(model="tiny-qwen3", **kw)) \
+        .generate([[5, 6, 7]], p)[0].output_token_ids
+    tuned = Engine(EngineConfig(model="tiny-qwen3",
+                                lora_dir=str(tmp_path / "ad"), **kw)) \
+        .generate([[5, 6, 7]], p)[0].output_token_ids
+    assert tuned != base
+
+
+def test_lora_composes_with_int8(tmp_path):
+    rng = np.random.default_rng(2)
+    _write_adapter(tmp_path / "ad", _qproj_tensors(rng))
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3", lora_dir=str(tmp_path / "ad"),
+        quantization="int8",
+        cache=CacheConfig(block_size=4, num_blocks=64, max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+    out = eng.generate([[5, 6, 7]],
+                       SamplingParams(max_tokens=4, temperature=0.0,
+                                      ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 4
+
+
+def test_lora_rejects_malformed(tmp_path):
+    rng = np.random.default_rng(3)
+    base = init_params(CFG, seed=0)
+    # unknown module
+    bad = {"base_model.model.model.layers.0.self_attn.zz_proj.lora_A.weight":
+           rng.standard_normal((4, CFG.hidden_size)).astype("f4"),
+           "base_model.model.model.layers.0.self_attn.zz_proj.lora_B.weight":
+           rng.standard_normal((CFG.q_size, 4)).astype("f4")}
+    _write_adapter(tmp_path / "bad1", bad)
+    with pytest.raises(ValueError, match="not supported"):
+        apply_lora(base, CFG, str(tmp_path / "bad1"))
+    # missing B
+    half = {k: v for k, v in _qproj_tensors(rng).items() if "lora_A" in k}
+    _write_adapter(tmp_path / "bad2", half)
+    with pytest.raises(ValueError, match="missing"):
+        apply_lora(base, CFG, str(tmp_path / "bad2"))
+    # layer out of range
+    oob = _qproj_tensors(rng, li=CFG.num_layers + 3)
+    _write_adapter(tmp_path / "bad3", oob)
+    with pytest.raises(ValueError, match="layer"):
+        apply_lora(base, CFG, str(tmp_path / "bad3"))
+    # shape mismatch
+    ws = _qproj_tensors(rng, out_f=CFG.q_size + 8)
+    _write_adapter(tmp_path / "bad4", ws)
+    with pytest.raises(ValueError, match="shape"):
+        apply_lora(base, CFG, str(tmp_path / "bad4"))
+    # empty adapter
+    _write_adapter(tmp_path / "bad5", {})
+    with pytest.raises(ValueError, match="no LoRA pairs"):
+        apply_lora(base, CFG, str(tmp_path / "bad5"))
+
+
+def test_lora_rslora_scaling(tmp_path):
+    rng = np.random.default_rng(4)
+    tensors = _qproj_tensors(rng, r=4)
+    os.makedirs(tmp_path / "rs", exist_ok=True)
+    json.dump({"r": 4, "lora_alpha": 8, "use_rslora": True},
+              open(tmp_path / "rs" / "adapter_config.json", "w"))
+    from safetensors.numpy import save_file
+    save_file(tensors, str(tmp_path / "rs" / "adapter_model.safetensors"))
+    base = init_params(CFG, seed=0)
+    before = np.asarray(base["layers"][0]["q_proj"]["kernel"],
+                        dtype=np.float32)
+    merged = apply_lora(base, CFG, str(tmp_path / "rs"))
+    after = np.asarray(merged["layers"][0]["q_proj"]["kernel"],
+                       dtype=np.float32)
+    A = next(v for k, v in tensors.items() if "lora_A" in k)
+    B = next(v for k, v in tensors.items() if "lora_B" in k)
+    want = before + (8 / 4 ** 0.5) * (A.T @ B.T)     # alpha/sqrt(r)
+    np.testing.assert_allclose(after, want, atol=0.05, rtol=0.02)
+
+
+def test_lora_refuses_quantized_params(tmp_path):
+    from tpuserve.models.weights import quantize_params_int8
+    rng = np.random.default_rng(5)
+    _write_adapter(tmp_path / "ad", _qproj_tensors(rng))
+    qparams = quantize_params_int8(init_params(CFG, seed=0))
+    with pytest.raises(ValueError, match="quantized"):
+        apply_lora(qparams, CFG, str(tmp_path / "ad"))
+
+
+def test_lora_validates_before_mutating(tmp_path):
+    # one good pair + one bad pair: the good one must NOT be merged
+    rng = np.random.default_rng(6)
+    tensors = _qproj_tensors(rng, li=0)
+    tensors.update(_qproj_tensors(rng, li=1, out_f=CFG.q_size + 8))  # bad
+    _write_adapter(tmp_path / "ad", tensors)
+    base = init_params(CFG, seed=0)
+    before = np.asarray(base["layers"][0]["q_proj"]["kernel"],
+                        dtype=np.float32).copy()
+    with pytest.raises(ValueError):
+        apply_lora(base, CFG, str(tmp_path / "ad"))
+    np.testing.assert_array_equal(
+        np.asarray(base["layers"][0]["q_proj"]["kernel"],
+                   dtype=np.float32), before)
+
+
+def test_lora_phi3_fused_qkv_split(tmp_path):
+    # Phi-3 adapters target the FUSED qkv projection; the delta must be
+    # column-split onto q/k/v exactly like the base loader splits weights
+    cfg = CFG                     # split arithmetic is family-independent
+    rng = np.random.default_rng(7)
+    r = 4
+    fused_out = cfg.q_size + 2 * cfg.kv_size
+    pre = "base_model.model.model.layers.0.self_attn.qkv_proj"
+    tensors = {
+        f"{pre}.lora_A.weight":
+            rng.standard_normal((r, cfg.hidden_size)).astype("f4"),
+        f"{pre}.lora_B.weight":
+            rng.standard_normal((fused_out, r)).astype("f4"),
+    }
+    _write_adapter(tmp_path / "ad", tensors, r=r, alpha=4)
+    base = init_params(cfg, seed=0)
+    before = {k: np.asarray(base["layers"][0][k]["kernel"],
+                            dtype=np.float32).copy()
+              for k in ("q_proj", "k_proj", "v_proj")}
+    merged = apply_lora(base, cfg, str(tmp_path / "ad"))
+    A = tensors[f"{pre}.lora_A.weight"]
+    B = tensors[f"{pre}.lora_B.weight"]
+    delta = (A.T @ B.T) * (4 / r)
+    lo = 0
+    for k, w in (("q_proj", cfg.q_size), ("k_proj", cfg.kv_size),
+                 ("v_proj", cfg.kv_size)):
+        after = np.asarray(merged["layers"][0][k]["kernel"],
+                           dtype=np.float32)
+        np.testing.assert_allclose(after, before[k] + delta[:, lo:lo + w],
+                                   atol=0.05, rtol=0.02)
+        lo += w

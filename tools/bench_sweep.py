@@ -98,6 +98,15 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # silicon, not just in interpret-mode tests
     ("long-prompt", ["--prompt-len", "4096", "--gen-len", "64",
                      "--batch", "4"], {}),
+    # Context-length sweep at fixed batch/gen: decode time vs context
+    # separates KV-read cost (scales with ctx) from fixed per-step cost —
+    # the slope is the paged kernel's EFFECTIVE HBM bandwidth against the
+    # 819 GB/s roofline (r4: headline sits at ~0.2 of HBM; where is the
+    # rest going?)
+    ("ctx512", ["--prompt-len", "512"], {}),
+    ("ctx1024", ["--prompt-len", "1024"], {}),
+    ("int8-ctx1024", ["--prompt-len", "1024", "--quant", "int8",
+                      "--kv-quant", "int8"], {}),
     # Alternate served families (the reference's other models,
     # kubernetes-single-node.yaml:15 / templates/*.yaml) — random-init
     # weights (air-gapped build host), so throughput is real but text is

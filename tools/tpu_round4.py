@@ -54,6 +54,7 @@ PRIORITY = [
     "spec4", "disagg",                        # cut by the r3 outage
     "multistep16", "multistep64",
     "long-prompt",
+    "ctx512", "ctx1024", "int8-ctx1024",      # effective-KV-bandwidth slope
     "int8-multistep16",
     "pallas-spp16",                           # re-time with the VMEM clamp
     "flash-q64", "flash-k256",                # prefill block split (TTFT)

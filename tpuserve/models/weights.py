@@ -347,6 +347,140 @@ def quantize_params_int8(params: Params) -> Params:
 
 
 # --------------------------------------------------------------------------
+# LoRA adapters: merge-at-load
+# --------------------------------------------------------------------------
+
+# HF/PEFT module name -> our layer param key(s).  A string maps 1:1; a
+# callable receives the ModelConfig and returns [(key, out_width), ...]
+# column splits for fused projections (Phi-3 qkv/gate_up — the base
+# loader splits the same way at load, see _load_llama_family).
+_LORA_MODULES = {
+    "self_attn.q_proj": "q_proj", "self_attn.k_proj": "k_proj",
+    "self_attn.v_proj": "v_proj", "self_attn.o_proj": "o_proj",
+    "self_attn.out_proj": "o_proj",                       # OPT
+    "mlp.gate_proj": "gate_proj", "mlp.up_proj": "up_proj",
+    "mlp.down_proj": "down_proj",
+    "fc1": "fc1", "fc2": "fc2",                           # OPT
+    "self_attn.qkv_proj": lambda cfg: [                   # Phi-3 fused
+        ("q_proj", cfg.q_size), ("k_proj", cfg.kv_size),
+        ("v_proj", cfg.kv_size)],
+    "mlp.gate_up_proj": lambda cfg: [                     # Phi-3 fused
+        ("gate_proj", cfg.intermediate_size),
+        ("up_proj", cfg.intermediate_size)],
+}
+
+
+def _read_lora_adapter(adapter_dir: str) -> tuple[dict, float]:
+    """(tensors, scaling) from a PEFT adapter directory.  Supports
+    adapter_model.safetensors (preferred) and adapter_model.bin."""
+    import json as _json
+    cfg_path = os.path.join(adapter_dir, "adapter_config.json")
+    with open(cfg_path) as f:
+        acfg = _json.load(f)
+    r = int(acfg.get("r", 8))
+    alpha = float(acfg.get("lora_alpha", r))
+    if acfg.get("use_rslora"):
+        scaling = alpha / max(r, 1) ** 0.5    # rsLoRA: alpha/sqrt(r)
+    else:
+        scaling = alpha / max(r, 1)
+    st = os.path.join(adapter_dir, "adapter_model.safetensors")
+    if os.path.isfile(st):
+        from safetensors import safe_open
+        raw = {}
+        with safe_open(st, framework="numpy") as f:
+            for k in f.keys():
+                raw[k] = f.get_tensor(k)
+        return raw, scaling
+    bin_path = os.path.join(adapter_dir, "adapter_model.bin")
+    if os.path.isfile(bin_path):
+        import torch
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.float().numpy() for k, v in sd.items()}, scaling
+    raise FileNotFoundError(
+        f"no adapter_model.safetensors/.bin in {adapter_dir}")
+
+
+def apply_lora(params: Params, cfg: ModelConfig, adapter_dir: str) -> Params:
+    """Merge a PEFT LoRA adapter into the dense weights: W += s·B@A.
+
+    Merge-at-load serves a finetuned adapter at full base-model speed
+    (zero runtime cost, works under TP sharding and int8 quantization
+    since both happen downstream).  The reference's stack gets adapters
+    through vLLM's LoRA support; per-request adapter multiplexing is out
+    of scope — one adapter per engine.
+
+    Raises on adapter keys that target modules this loader can't map —
+    silently dropping part of an adapter would serve wrong weights.
+    """
+    import re
+    raw, scaling = _read_lora_adapter(adapter_dir)
+    pairs: dict[tuple[int, str], dict[str, jnp.ndarray]] = {}
+    for key, tensor in raw.items():
+        m = re.search(r"layers\.(\d+)\.([a-z_.0-9]+)\.lora_(A|B)\.weight$",
+                      key)
+        if m is None:
+            raise ValueError(f"unsupported LoRA adapter key {key!r}")
+        li, module, ab = int(m.group(1)), m.group(2), m.group(3)
+        if module not in _LORA_MODULES:
+            raise ValueError(f"LoRA target module {module!r} not supported "
+                             f"(key {key!r})")
+        if li >= cfg.num_layers:
+            raise ValueError(f"LoRA key {key!r} targets layer {li} but the "
+                             f"model has {cfg.num_layers}")
+        pairs.setdefault((li, module), {})[ab] = jnp.asarray(
+            tensor, dtype=jnp.float32)
+    if not pairs:
+        raise ValueError(f"adapter at {adapter_dir} contained no LoRA pairs")
+
+    # Phase 1 — validate EVERYTHING (pairs complete, targets exist and are
+    # unquantized, shapes line up) before touching a single weight: a
+    # failure mid-merge would leave the caller's pytree half-merged.
+    plan = []                  # (li, [(param_key, col_lo, col_hi)], delta)
+    for (li, module), ab in sorted(pairs.items()):
+        if "A" not in ab or "B" not in ab:
+            raise ValueError(f"LoRA pair for layer {li} {module} is missing "
+                             f"lora_{'A' if 'A' not in ab else 'B'}")
+        target = _LORA_MODULES[module]
+        splits = (target(cfg) if callable(target)
+                  else [(target, None)])
+        # HF shapes: A (r, in), B (out, r); our kernel is (in, out)
+        delta = (ab["A"].T @ ab["B"].T) * scaling
+        lp = params["layers"][li]
+        col = 0
+        spans = []
+        for pk, width in splits:
+            if pk not in lp or "kernel" not in lp[pk]:
+                raise ValueError(f"model has no dense {pk} in layer {li} "
+                                 "(MoE expert linears are not LoRA targets)")
+            if "scale" in lp[pk]:
+                raise ValueError(
+                    "cannot merge LoRA into already-quantized weights; "
+                    "load the bf16 checkpoint and quantize after")
+            kernel = lp[pk]["kernel"]
+            w = kernel.shape[1] if width is None else width
+            spans.append((pk, col, col + w))
+            if kernel.shape != (delta.shape[0], w):
+                raise ValueError(
+                    f"LoRA delta shape {delta.shape} does not match weight "
+                    f"shape {kernel.shape} for layer {li} {pk}")
+            col += w
+        if col != delta.shape[1]:
+            raise ValueError(
+                f"LoRA delta shape {delta.shape} does not match fused "
+                f"projection width {col} for layer {li} {module}")
+        plan.append((li, spans, delta))
+
+    # Phase 2 — merge.
+    for li, spans, delta in plan:
+        lp = params["layers"][li]
+        for pk, lo, hi in spans:
+            kernel = lp[pk]["kernel"]
+            lp[pk]["kernel"] = (kernel.astype(jnp.float32)
+                                + delta[:, lo:hi]).astype(kernel.dtype)
+    return params
+
+
+# --------------------------------------------------------------------------
 # Orbax save/restore (weight persistence analog of the reference's PVC cache)
 # --------------------------------------------------------------------------
 

@@ -46,6 +46,10 @@ logger = logging.getLogger("tpuserve.engine")
 class EngineConfig:
     model: str = "Qwen/Qwen3-0.6B"
     checkpoint_dir: Optional[str] = None      # HF safetensors dir; None = random init
+    # PEFT LoRA adapter directory, merged into the dense weights at load
+    # (models/weights.py apply_lora) — full base-model speed, one adapter
+    # per engine
+    lora_dir: Optional[str] = None
     # Weight-only quantization: "int8" halves the per-step HBM weight
     # traffic that bounds decode throughput (models/weights.py
     # quantize_params_int8).  None = full precision.
@@ -198,6 +202,11 @@ class Engine:
                                         vocab_size=self.model_cfg.vocab_size)
         if params is None:
             params = load_or_init(self.model_cfg, config.checkpoint_dir, config.seed)
+        if config.lora_dir:
+            # before quantization/sharding: the merge targets bf16 kernels
+            from tpuserve.models.weights import apply_lora
+            params = apply_lora(params, self.model_cfg, config.lora_dir)
+            logger.info("merged LoRA adapter from %s", config.lora_dir)
         if config.quantization == "int8":
             from tpuserve.models.weights import quantize_params_int8
             if "scale" not in params["embed"]:    # not already quantized

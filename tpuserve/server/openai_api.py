@@ -1029,6 +1029,10 @@ def main(argv=None):
                     help="KV cache storage dtype; int8 quantizes on write "
                          "(per-token, per-kv-head scales), halving KV read "
                          "bandwidth and doubling cache capacity")
+    ap.add_argument("--lora", default=None, metavar="DIR",
+                    help="PEFT LoRA adapter directory merged into the "
+                         "weights at load (one adapter per engine, zero "
+                         "runtime cost)")
     ap.add_argument("--quantization", default=None, choices=["int8"],
                     help="weight-only quantization (int8 halves decode's "
                          "HBM weight traffic)")
@@ -1056,6 +1060,7 @@ def main(argv=None):
         spec = SpecConfig(num_draft_tokens=args.speculative_k)
     ecfg = EngineConfig(
         model=args.model, checkpoint_dir=args.checkpoint_dir,
+        lora_dir=args.lora,
         cache=CacheConfig(block_size=args.block_size,
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq,
