@@ -163,3 +163,30 @@ def test_logit_penalties():
     np.testing.assert_allclose(np.asarray(out[0]),
                                [0, 0, -(0.5 + 2 * 0.25), 0, -(0.5 + 0.25), 0],
                                atol=1e-6)
+
+
+def test_sampling_min_p_restricts_support():
+    # probs ~ [.84, .11, .04, ...]: min_p=0.3 keeps only the max token;
+    # min_p=0.05 keeps the top two
+    logits = jnp.zeros((64, 8), jnp.float32).at[:, 2].set(4.0).at[:, 5].set(2.0)
+    strict = sampling_ops.sample_tokens(
+        logits, _keys(64, 4), jnp.ones((64,)), jnp.zeros((64,), jnp.int32),
+        jnp.ones((64,)), min_p=jnp.full((64,), 0.3), mode="full")
+    assert set(np.asarray(strict).tolist()) == {2}
+    loose = sampling_ops.sample_tokens(
+        logits, _keys(64, 5), jnp.ones((64,)), jnp.zeros((64,), jnp.int32),
+        jnp.ones((64,)), min_p=jnp.full((64,), 0.05), mode="full")
+    assert set(np.asarray(loose).tolist()) <= {2, 5}
+    assert len(set(np.asarray(loose).tolist())) == 2     # both reachable
+
+
+def test_sampling_min_p_zero_matches_disabled():
+    logits = jnp.asarray(np.random.default_rng(0).normal(
+        size=(16, 32)), jnp.float32)
+    with_zero = sampling_ops.sample_tokens(
+        logits, _keys(16, 6), jnp.ones((16,)), jnp.zeros((16,), jnp.int32),
+        jnp.ones((16,)), min_p=jnp.zeros((16,)), mode="full")
+    without = sampling_ops.sample_tokens(
+        logits, _keys(16, 6), jnp.ones((16,)), jnp.zeros((16,), jnp.int32),
+        jnp.ones((16,)), mode="full")
+    assert np.asarray(with_zero).tolist() == np.asarray(without).tolist()

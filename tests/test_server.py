@@ -416,3 +416,18 @@ def test_tokenize_detokenize_roundtrip(server):
             assert False, "expected 400"
         except urllib.error.HTTPError as e:
             assert e.code == 400
+
+
+def test_min_p_accepted(server):
+    status, body = _post(server + "/v1/completions", {
+        "prompt": "hi", "max_tokens": 4, "temperature": 1.0,
+        "min_p": 0.2, "ignore_eos": True})
+    assert status == 200
+    assert body["usage"]["completion_tokens"] == 4
+
+
+def test_min_p_range_validation(server):
+    for bad in (1.5, -0.1, float("nan")):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {"prompt": "x", "min_p": bad})
+        assert ei.value.code == 400, bad

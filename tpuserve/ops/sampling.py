@@ -28,16 +28,21 @@ def _row_gumbel(keys: jnp.ndarray, shape: tuple[int, int]) -> jnp.ndarray:
 
 @partial(jax.jit, static_argnames=("mode",))
 def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarray,
-                  top_k: jnp.ndarray, top_p: jnp.ndarray, *, mode: str = "full") -> jnp.ndarray:
+                  top_k: jnp.ndarray, top_p: jnp.ndarray, *,
+                  min_p: jnp.ndarray | None = None,
+                  mode: str = "full") -> jnp.ndarray:
     """Sample next tokens.
 
     logits: (B, V); keys: (B, 2) uint32 per-row PRNG keys;
     temperature/top_k/top_p: (B,) per-request params.
     ``temperature <= 0`` means greedy regardless of mode.  ``top_k <= 0``
-    disables top-k; ``top_p >= 1`` disables top-p.  ``mode`` is static:
+    disables top-k; ``top_p >= 1`` disables top-p.  ``min_p`` (optional
+    (B,), vLLM extension): drop tokens whose probability is below
+    ``min_p * max_prob``; ``<= 0`` disables (full mode only).  ``mode``
+    is static:
       - "greedy": pure argmax (params/keys ignored).
       - "temperature": no top-k/top-p truncation.
-      - "full": sort-based top-k + top-p truncation.
+      - "full": sort-based top-k + top-p (+ min-p) truncation.
     Returns (B,) int32.
     """
     logits = logits.astype(jnp.float32)
@@ -65,7 +70,12 @@ def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarr
     # Keep tokens whose cumulative prob *before* them is < top_p (always keeps
     # the most-likely token).
     keep_p = (cumsum - probs) < top_p[:, None]
-    masked = jnp.where(keep_k & keep_p, sorted_logits, NEG_INF)
+    keep = keep_k & keep_p
+    if min_p is not None:
+        # sorted descending, so probs[:, :1] is each row's max prob; the
+        # most-likely token always survives (1.0 * max >= min_p * max)
+        keep &= probs >= jnp.maximum(min_p, 0.0)[:, None] * probs[:, :1]
+    masked = jnp.where(keep, sorted_logits, NEG_INF)
     choice = jnp.argmax(masked + gumbel, axis=-1)            # index into sorted
     sampled = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)

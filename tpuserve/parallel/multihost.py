@@ -178,8 +178,19 @@ class MultihostCoordinator:
             steps=steps, mode=mode, attn_impl=eng.attn_impl,
             mesh=eng._attn_mesh, out_mesh=eng.mesh)
 
-    def _sample(self, logits, keys, temperature, top_k, top_p, *, mode):
+    def _sample(self, logits, keys, temperature, top_k, top_p, *,
+                min_p=None, mode):
         eng = self.engine
+        if min_p is not None:
+            # an all-zeros min_p is DISABLED (warmup passes one to compile
+            # the wider sampler trace): drop it and serve.  Enabled min_p
+            # is rejected at intake (request.py multihost_unsupported);
+            # this guard catches anything that slips through rather than
+            # desyncing the 4-array lockstep broadcast.
+            if np.asarray(min_p).any():
+                raise ValueError(
+                    "min_p is not supported in multi-host serving")
+            min_p = None
         B = logits.shape[0]
         _broadcast(np.asarray(
             [OP_SAMPLE, B, 0, SAMPLE_MODES.index(mode)], np.int32))

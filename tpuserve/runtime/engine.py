@@ -638,9 +638,10 @@ class Engine:
             mode=mode, attn_impl=self.attn_impl, mesh=self._attn_mesh,
             out_mesh=self.mesh)
 
-    def _exec_sample(self, logits, keys, temperature, top_k, top_p, *, mode):
+    def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
+                     min_p=None, mode):
         return sampling_ops.sample_tokens(
-            logits, keys, temperature, top_k, top_p, mode=mode)
+            logits, keys, temperature, top_k, top_p, min_p=min_p, mode=mode)
 
     # ---- prefill ------------------------------------------------------
 
@@ -1253,16 +1254,21 @@ class Engine:
         temperature = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
+        min_p = np.zeros((B,), np.float32)
         keys = np.zeros((B, 2), np.uint32)
         for i, r in enumerate(reqs):
             temperature[i] = r.params.temperature
             top_k[i] = r.params.top_k
             top_p[i] = r.params.top_p
+            min_p[i] = r.params.min_p
             keys[i] = self._row_key(
                 r, extra_step=1 if r.request_id in in_flight else 0)
+        kw = {}
+        if mode == "full" and (min_p > 0).any():
+            kw["min_p"] = jnp.asarray(min_p)
         return self._exec_sample(
             logits, jnp.asarray(keys), jnp.asarray(temperature),
-            jnp.asarray(top_k), jnp.asarray(top_p), mode=mode)
+            jnp.asarray(top_k), jnp.asarray(top_p), mode=mode, **kw)
 
     def _greedy_dummies(self, B: int):
         """Per-bucket constant sampling inputs, created once.  Building these
@@ -1645,3 +1651,8 @@ class Engine:
         for mode in modes:
             self._warm_tails.append(self._exec_sample(
                 logits, keys, temp, top_k, top_p, mode=mode))
+            if mode == "full":
+                # min_p adds an operand to the full sampler: its own trace
+                self._warm_tails.append(self._exec_sample(
+                    logits, keys, temp, top_k, top_p,
+                    min_p=jnp.zeros((B,)), mode="full"))

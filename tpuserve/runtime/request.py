@@ -33,6 +33,7 @@ class SamplingParams:
     temperature: float = 1.0
     top_k: int = 0                      # <=0 disables
     top_p: float = 1.0                  # >=1 disables
+    min_p: float = 0.0                  # <=0 disables (vLLM extension)
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
@@ -61,7 +62,7 @@ class SamplingParams:
 
     @property
     def needs_truncation(self) -> bool:
-        return self.top_k > 0 or self.top_p < 1.0
+        return self.top_k > 0 or self.top_p < 1.0 or self.min_p > 0.0
 
     @property
     def needs_penalties(self) -> bool:
@@ -93,6 +94,8 @@ class SamplingParams:
              self.needs_penalties),
             ("logit_bias", self.needs_logit_bias),
             ("min_tokens", self.needs_min_tokens),
+            # min_p would extend the 4-array lockstep sample broadcast
+            ("min_p", self.min_p > 0.0),
             ("logprobs", self.logprobs is not None),
             # per-step host-side candidate validation cannot be mirrored
             # by the fixed-shape lockstep step kinds

@@ -121,6 +121,9 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
                            and 0 <= t < 2**31 for t in stop_ids)):
             raise ValueError("'stop_token_ids' must be a list of at most "
                              "64 token ids in [0, 2**31)")
+    min_p = _num(body, "min_p", 0.0, float)
+    if not 0.0 <= min_p <= 1.0:        # NaN fails both comparisons too
+        raise ValueError("'min_p' must be in [0, 1]")
     guided = None
     rf = body.get("response_format")
     if rf is not None:
@@ -141,6 +144,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         temperature=_num(body, "temperature", 1.0, float),
         top_k=_num(body, "top_k", 0, int),
         top_p=_num(body, "top_p", 1.0, float),
+        min_p=min_p,
         presence_penalty=_num(body, "presence_penalty", 0.0, float),
         frequency_penalty=_num(body, "frequency_penalty", 0.0, float),
         repetition_penalty=_num(body, "repetition_penalty", 1.0, float),
