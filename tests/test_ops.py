@@ -190,3 +190,14 @@ def test_sampling_min_p_zero_matches_disabled():
         logits, _keys(16, 6), jnp.ones((16,)), jnp.zeros((16,), jnp.int32),
         jnp.ones((16,)), mode="full")
     assert np.asarray(with_zero).tolist() == np.asarray(without).tolist()
+
+
+def test_sampling_min_p_over_one_keeps_top_token():
+    # >1 / NaN must degrade to argmax support, not uniform noise
+    logits = jnp.zeros((16, 8), jnp.float32).at[:, 4].set(6.0)
+    for bad in (1.5, float("nan")):
+        toks = sampling_ops.sample_tokens(
+            logits, _keys(16, 7), jnp.ones((16,)),
+            jnp.zeros((16,), jnp.int32), jnp.ones((16,)),
+            min_p=jnp.full((16,), bad), mode="full")
+        assert set(np.asarray(toks).tolist()) == {4}, bad

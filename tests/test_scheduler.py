@@ -154,3 +154,40 @@ def test_interleave_batched_prefill():
 
     assert run_kinds(mk(False)) == ["prefill", "prefill", "decode", "decode"]
     assert run_kinds(mk(True)) == ["prefill", "decode", "prefill", "decode"]
+
+
+def test_priority_orders_waiting_queue():
+    sched, _bm = mk_sched()
+    def req(rid, pr):
+        return Request(request_id=rid, prompt_token_ids=[1, 2, 3],
+                       params=SamplingParams(priority=pr))
+    sched.add(req("a", 0))
+    sched.add(req("b", 5))
+    sched.add(req("c", -1))      # lower value = sooner
+    sched.add(req("d", 0))
+    sched.add(req("e", 5))       # FIFO within level 5 (after b)
+    assert [r.request_id for r in sched.waiting] == \
+        ["c", "a", "d", "b", "e"]
+
+
+def test_priority_preempted_resumes_at_head():
+    sched, _bm = mk_sched()
+    low = Request(request_id="low", prompt_token_ids=[1],
+                  params=SamplingParams(priority=9))
+    sched.add(Request(request_id="w", prompt_token_ids=[1],
+                      params=SamplingParams(priority=0)))
+    # a preempted request re-enters at the head regardless of priority
+    sched.waiting.appendleft(low)
+    assert sched.waiting[0].request_id == "low"
+
+
+def test_priority_never_jumps_preempted_midstream_request():
+    sched, _bm = mk_sched()
+    victim = Request(request_id="victim", prompt_token_ids=[1, 2],
+                     params=SamplingParams(priority=9))
+    victim.output_token_ids.append(7)        # preempted mid-stream
+    sched.waiting.appendleft(victim)
+    for i in range(3):
+        sched.add(Request(request_id=f"vip{i}", prompt_token_ids=[1],
+                          params=SamplingParams(priority=-1)))
+    assert sched.waiting[0].request_id == "victim"

@@ -73,8 +73,10 @@ def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarr
     keep = keep_k & keep_p
     if min_p is not None:
         # sorted descending, so probs[:, :1] is each row's max prob; the
-        # most-likely token always survives (1.0 * max >= min_p * max)
-        keep &= probs >= jnp.maximum(min_p, 0.0)[:, None] * probs[:, :1]
+        # clamp makes the most-likely token survive for ANY input (>1 or
+        # NaN would mask every token and sample pure Gumbel noise)
+        mp = jnp.clip(jnp.nan_to_num(min_p, nan=0.0), 0.0, 1.0)
+        keep &= probs >= mp[:, None] * probs[:, :1]
     masked = jnp.where(keep, sorted_logits, NEG_INF)
     choice = jnp.argmax(masked + gumbel, axis=-1)            # index into sorted
     sampled = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)

@@ -50,6 +50,9 @@ class SamplingParams:
     # vLLM stop_token_ids: extra ids that finish the request like EOS does
     # (the matched token is emitted; min_tokens suppresses these too)
     stop_token_ids: tuple[int, ...] = ()
+    # vLLM priority scheduling: LOWER value = admitted sooner; FIFO
+    # within a level (runtime/scheduler.py Scheduler.add)
+    priority: int = 0
     # Structured output (OpenAI response_format json_object): "json"
     # constrains generation to one valid JSON object via per-step
     # candidate validation (runtime/guided.py); runs on the single-step

@@ -70,7 +70,25 @@ class Scheduler:
     # ---- intake ---------------------------------------------------------
 
     def add(self, req: Request) -> None:
-        self.waiting.append(req)
+        """Queue for admission.  FIFO within a priority level; a request
+        with a LOWER ``params.priority`` value is admitted sooner (vLLM
+        priority semantics).  Preempted requests re-enter at the queue
+        head regardless (appendleft at the call sites) — resuming holds
+        its own priority: their KV was already paid for once."""
+        pr = req.params.priority
+        if not self.waiting or self.waiting[-1].params.priority <= pr:
+            self.waiting.append(req)         # common case: same priority
+            return
+        idx = len(self.waiting)
+        while idx > 0 and self.waiting[idx - 1].params.priority > pr:
+            if self.waiting[idx - 1].output_token_ids:
+                # a preempted mid-stream request is a barrier: new
+                # arrivals never insert ahead of it, whatever their
+                # priority — otherwise a sustained higher-priority stream
+                # starves its half-delivered response forever
+                break
+            idx -= 1
+        self.waiting.insert(idx, req)
 
     def abort(self, request_id: str) -> Optional[Request]:
         for q in (self.waiting, self.running):

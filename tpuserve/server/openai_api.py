@@ -124,6 +124,9 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     min_p = _num(body, "min_p", 0.0, float)
     if not 0.0 <= min_p <= 1.0:        # NaN fails both comparisons too
         raise ValueError("'min_p' must be in [0, 1]")
+    priority = _num(body, "priority", 0, int)
+    if not -(2**31) <= priority < 2**31:
+        raise ValueError("'priority' must be a 32-bit integer")
     guided = None
     rf = body.get("response_format")
     if rf is not None:
@@ -155,6 +158,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         logit_bias=bias,
         stop_token_ids=tuple(stop_ids),
         guided=guided,
+        priority=priority,
     )
 
 
