@@ -26,6 +26,7 @@ def runner(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "REPORT_MD", str(tmp_path / "bench.md"))
     # keep the test small: two engine variants, one serving row
     monkeypatch.setattr(mod, "PRIORITY", ["base", "int8"])
+    monkeypatch.setattr(mod, "PRIORITY_B", [])
     monkeypatch.setattr(mod, "SERVING", [("serving-closed32", ["--clients", "32"])])
     monkeypatch.setattr(mod, "append_markdown", lambda r: None)
     return mod
