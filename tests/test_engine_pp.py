@@ -1,0 +1,141 @@
+"""Pipeline-parallel Engine (runtime/engine.py pp mode): the full serving
+path — scheduler, block manager, bucketed prefill, per-step decode,
+sampling — over a staged ('pp',) mesh must emit token-identical streams to
+the single-device engine.  Also pins the pp-mode gates (chunked prefill,
+speculation, embeddings, disagg adoption, mixed meshes)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.parallel.mesh import MeshConfig, make_mesh
+from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.kv_cache import CacheConfig
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.scheduler import SchedulerConfig
+
+
+def _cfg(**kw):
+    cache = CacheConfig(block_size=4, num_blocks=128, max_blocks_per_seq=16)
+    sched = SchedulerConfig(max_num_seqs=8, max_prefill_seqs=4,
+                            max_prefill_tokens=512)
+    return EngineConfig(model="tiny-qwen3", cache=cache, scheduler=sched,
+                        attn_impl="reference", **kw)
+
+
+def _drain(eng, prompts, params):
+    outs = {}
+    rids = [eng.add_request(prompt_token_ids=p, params=params)
+            for p in prompts]
+    while eng.has_work():
+        for o in eng.step():
+            outs.setdefault(o.request_id, []).extend(o.new_token_ids)
+    return [outs[r] for r in rids]
+
+
+@pytest.fixture(scope="module")
+def pp_cfg():
+    # 4 uniform layers so pp=4 divides them; float32 like the repo's other
+    # cross-impl token-equality tests (bf16 argmax flips on reduction
+    # order — the staged trunk scans layers the unrolled loop sums)
+    return dataclasses.replace(get_model_config("tiny-qwen3"), num_layers=4,
+                               dtype="float32")
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pp_engine_token_parity(pp, pp_cfg):
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 500, size=n).tolist()
+               for n in (5, 9, 12, 7)]
+    params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    golden = _drain(Engine(_cfg(), model_cfg=pp_cfg), prompts, params)
+    eng = Engine(_cfg(), model_cfg=pp_cfg,
+                 mesh=make_mesh(MeshConfig(pp=pp)))
+    assert eng._pp == pp and eng._multi_step == 1
+    got = _drain(eng, prompts, params)
+    assert got == golden
+
+
+def test_pp_engine_seeded_sampling_parity(pp_cfg):
+    """Seeded temperature sampling goes through the same row-key path."""
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 500, size=6).tolist() for _ in range(2)]
+    params = SamplingParams(max_tokens=6, temperature=0.8, seed=11,
+                            ignore_eos=True)
+    golden = _drain(Engine(_cfg(), model_cfg=pp_cfg), prompts, params)
+    eng = Engine(_cfg(), model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+    assert _drain(eng, prompts, params) == golden
+
+
+def test_pp_engine_long_prompt_batches_instead_of_chunking(pp_cfg):
+    """A prompt past prefill_chunk_size must take the batched route on a
+    pp engine (allow_chunked_prefill is forced off — the pipelined trunk
+    has no chunk path) and still produce the single-device tokens."""
+    def cfg():
+        c = _cfg()
+        return dataclasses.replace(c, scheduler=dataclasses.replace(
+            c.scheduler, prefill_chunk_size=16, allow_chunked_prefill=False))
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 500, size=21).tolist()]
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    golden = _drain(Engine(cfg(), model_cfg=pp_cfg), prompts, params)
+    eng = Engine(cfg(), model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+    assert not eng.scheduler.cfg.allow_chunked_prefill
+    assert _drain(eng, prompts, params) == golden
+
+
+def test_pp_engine_gates(pp_cfg):
+    eng = Engine(_cfg(), model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+    # chunk routes are closed wholesale at the scheduler
+    assert not eng.scheduler.cfg.allow_chunked_prefill
+
+
+def test_pp_engine_score_budget_guard(pp_cfg):
+    """The intake guard budgets the worst RE-prefill (prompt + max_tokens
+    at its bucket, times co-admittable rows), not just the prompt."""
+    eng = Engine(_cfg(), model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+    eng.PP_PREFILL_SCORE_BUDGET_BYTES = 1024     # force the bound
+    with pytest.raises(ValueError, match="prompt budget"):
+        eng.add_request(prompt_token_ids=[1] * 20,
+                        params=SamplingParams(max_tokens=8))
+    with pytest.raises(ValueError, match="pipeline engine"):
+        eng.embed(["hello"])
+    with pytest.raises(ValueError, match="pipeline engine"):
+        eng.adopt_prefilled("x", [1, 2], 3, SamplingParams(max_tokens=1),
+                            seq_kv=[])
+
+
+def test_pp_engine_non_power_of_two_stages():
+    """pp=3 serves power-of-two engine buckets by degrading microbatch
+    count to a divisor (pipeline._auto_microbatches) instead of crashing
+    mid-serving."""
+    mc3 = dataclasses.replace(get_model_config("tiny-qwen3"), num_layers=3,
+                              dtype="float32")
+    rng = np.random.default_rng(6)
+    prompts = [rng.integers(1, 500, size=6).tolist() for _ in range(3)]
+    params = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    golden = _drain(Engine(_cfg(), model_cfg=mc3), prompts, params)
+    eng = Engine(_cfg(), model_cfg=mc3, mesh=make_mesh(MeshConfig(pp=3)))
+    assert _drain(eng, prompts, params) == golden
+
+
+def test_pp_mesh_rejected_by_disagg(pp_cfg):
+    from tpuserve.parallel.disagg import DisaggregatedEngine
+    with pytest.raises(ValueError, match="pp"):
+        DisaggregatedEngine(_cfg(), _cfg(),
+                            mesh=make_mesh(MeshConfig(pp=2)))
+
+
+def test_pp_engine_rejects_mixed_mesh(pp_cfg):
+    with pytest.raises(ValueError, match="pure"):
+        Engine(_cfg(), model_cfg=pp_cfg,
+               mesh=make_mesh(MeshConfig(pp=2, tp=2)))
+
+
+def test_pp_engine_rejects_speculation(pp_cfg):
+    from tpuserve.runtime.spec import SpecConfig
+    with pytest.raises(ValueError, match="speculative"):
+        Engine(_cfg(speculative=SpecConfig(num_draft_tokens=2)),
+               model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))

@@ -30,7 +30,7 @@ def cfg():
 
 
 def test_mesh_shapes(tp4_mesh):
-    assert tp4_mesh.shape == {"dp": 2, "ep": 1, "tp": 4}
+    assert tp4_mesh.shape == {"dp": 2, "ep": 1, "pp": 1, "tp": 4}
 
 
 def test_mesh_too_large():

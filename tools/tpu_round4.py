@@ -148,7 +148,16 @@ def run_engine_rows(names: list[str], attempts: dict, done: set,
                     env_base: dict) -> int | None:
     """Drain one engine-row list; return 2 to yield to the watcher."""
     variant_table = {n: (a, e) for n, a, e in VARIANTS}
-    for name in names:
+    rows = [(n, *variant_table[n], None) for n in names]
+    return run_rows(rows, attempts, done, env_base)
+
+
+def run_rows(rows, attempts: dict, done: set,
+             env_base: dict) -> int | None:
+    """One retry/refund policy for every row kind.  ``rows``: (name, args,
+    extra_env, bench_path or None).  Returns 2 to yield to the watcher
+    (tunnel down), else None."""
+    for name, vargs, venv, bench_path in rows:
         if name in done:
             continue
         if attempts.get(name, 0) >= MAX_ATTEMPTS:
@@ -160,14 +169,14 @@ def run_engine_rows(names: list[str], attempts: dict, done: set,
             return 2
         attempts[name] = attempts.get(name, 0) + 1
         save_attempts(attempts)
-        vargs, venv = variant_table[name]
         env = dict(env_base)
         env.update(venv)
         cache_override = venv.get("JAX_COMPILATION_CACHE_DIR", "")
         if cache_override.startswith("/tmp/"):
             import shutil
             shutil.rmtree(cache_override, ignore_errors=True)
-        r = run_variant(name, vargs, timeout=5400, env=env)
+        r = run_variant(name, vargs, timeout=5400, env=env,
+                        bench_path=bench_path)
         if r is None:
             # timeout / no JSON: a mid-compile tunnel death looks exactly
             # like a genuinely slow variant.  Re-probe to tell them apart —
@@ -180,7 +189,8 @@ def run_engine_rows(names: list[str], attempts: dict, done: set,
                       "the attempt; yielding to the watcher", flush=True)
                 return 2
             continue                      # failed on a live tunnel: move on
-        if r.get("degraded") or r.get("backend") != "tpu":
+        if (r.get("degraded")
+                or not str(r.get("backend", "")).startswith("tpu")):
             # Degraded on a DOWN tunnel = flap: refund the attempt (the
             # watcher owns retrying through outages).  Degraded on a LIVE
             # tunnel = the variant itself fails (OOM, kernel bug, ...):
@@ -192,8 +202,9 @@ def run_engine_rows(names: list[str], attempts: dict, done: set,
                 print(f"--- {name}: degraded with the tunnel down — "
                       "refunding; yielding to the watcher", flush=True)
                 return 2
-            print(f"--- {name}: degraded on a live tunnel "
-                  f"({r.get('degraded')}) — attempt stands", flush=True)
+            print(f"--- {name}: degraded/off-backend on a live tunnel "
+                  f"({r.get('degraded') or r.get('backend')}) — attempt "
+                  "stands", flush=True)
             continue
         attempts[name] = 0                # success resets the budget
         save_attempts(attempts)
@@ -215,44 +226,11 @@ def main() -> int:
     if rc is not None:
         return rc
 
-    for name, sargs in SERVING:
-        if name in done:
-            continue
-        if attempts.get(name, 0) >= MAX_ATTEMPTS:
-            print(f"=== {name}: skipped ({MAX_ATTEMPTS} failed attempts)",
-                  flush=True)
-            continue
-        if not probe():
-            print("tunnel down — yielding to the watcher", flush=True)
-            return 2
-        attempts[name] = attempts.get(name, 0) + 1
-        save_attempts(attempts)
-        r = run_variant(name, sargs, timeout=5400, env=dict(env_base),
-                        bench_path=os.path.join(ROOT, "tools",
-                                                "bench_serving.py"))
-        if r is None:
-            if not probe():               # flap, not failure: refund
-                attempts[name] -= 1
-                save_attempts(attempts)
-                print(f"--- {name}: died with the tunnel down — refunding "
-                      "the attempt; yielding to the watcher", flush=True)
-                return 2
-            continue
-        if not str(r.get("backend", "")).startswith("tpu"):
-            if not probe():               # flap, not failure: refund
-                attempts[name] -= 1
-                save_attempts(attempts)
-                print(f"--- {name}: backend={r.get('backend')} with the "
-                      "tunnel down — refunding; yielding to the watcher",
-                      flush=True)
-                return 2
-            print(f"--- {name}: backend={r.get('backend')} on a live "
-                  "tunnel — attempt stands", flush=True)
-            continue
-        attempts[name] = 0
-        save_attempts(attempts)
-        record(r)
-        done.add(name)
+    serving_path = os.path.join(ROOT, "tools", "bench_serving.py")
+    rc = run_rows([(n, a, {}, serving_path) for n, a in SERVING],
+                  attempts, done, env_base)
+    if rc is not None:
+        return rc
 
     rc = run_engine_rows(PRIORITY_B, attempts, done, env_base)
     if rc is not None:

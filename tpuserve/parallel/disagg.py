@@ -114,6 +114,12 @@ class DisaggregatedEngine:
     def __init__(self, prefill_config: EngineConfig, decode_config: EngineConfig,
                  decode_device=None, mesh=None):
         import dataclasses as _dc
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            # extract_seq_kv / insert_seq_kv move per-layer page lists; the
+            # pipeline engine's cache is stage-stacked — fail at pair
+            # construction, not with a KeyError mid-transfer
+            raise ValueError("disaggregation is not supported on pipeline "
+                             "(pp) meshes; use tp or plain engines")
         if decode_device is None:
             # colocated: both engines live on the same chip — split the
             # auto-sizing budget or each would claim ~all of HBM and the

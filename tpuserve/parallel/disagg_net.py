@@ -157,6 +157,11 @@ class PrefillHandoffEngine:
         import dataclasses as _dc
 
         from tpuserve.runtime.engine import Engine
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            # extract_seq_kv expects the per-layer page-list cache; a pp
+            # engine's is stage-stacked (see parallel/disagg.py guard)
+            raise ValueError("the prefill pool cannot run on a pipeline "
+                             "(pp) mesh; use tp or plain engines")
         # never window-release on the prefill side: migration ships
         # block_table() pages (see parallel/disagg.py for the full story)
         engine_config = _dc.replace(engine_config, window_release=False)

@@ -8,6 +8,9 @@ Axes:
 - ``dp``: data parallel (batch split; gradient psum when fine-tuning).
 - ``tp``: tensor parallel (attention heads / MLP columns over ICI).
 - ``ep``: expert parallel (MoE expert dim; models/transformer._moe_mlp).
+- ``pp``: pipeline parallel (layer stages; parallel/pipeline.py moves
+  activations stage-to-stage with ``ppermute``, so the axis sits next to
+  ``tp`` in the grid — neighbouring stages are ICI neighbours).
 
 Multi-host: ``jax.distributed.initialize()`` + the same mesh over all
 processes' devices — XLA routes collectives over ICI within a slice and DCN
@@ -24,6 +27,7 @@ from jax.sharding import Mesh
 
 AXIS_DP = "dp"
 AXIS_EP = "ep"
+AXIS_PP = "pp"
 AXIS_TP = "tp"
 
 
@@ -32,10 +36,11 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.ep * self.tp
+        return self.dp * self.ep * self.pp * self.tp
 
 
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
@@ -52,8 +57,8 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         raise ValueError(f"mesh {cfg} needs {cfg.num_devices} devices, "
                          f"have {len(devices)}")
     grid = np.asarray(devices[:cfg.num_devices]).reshape(cfg.dp, cfg.ep,
-                                                         cfg.tp)
-    return Mesh(grid, (AXIS_DP, AXIS_EP, AXIS_TP))
+                                                         cfg.pp, cfg.tp)
+    return Mesh(grid, (AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP))
 
 
 def multihost_initialize(coordinator_address: str | None = None,

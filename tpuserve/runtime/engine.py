@@ -198,6 +198,8 @@ class Engine:
         self.cache_cfg = config.cache
         self.attn_impl = config.resolve_attn_impl()
         self.mesh = mesh
+        from tpuserve.parallel.mesh import AXIS_PP
+        self._pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1
         self.tokenizer = load_tokenizer(config.checkpoint_dir or config.model,
                                         vocab_size=self.model_cfg.vocab_size)
         if params is None:
@@ -220,7 +222,45 @@ class Engine:
                 self.cache_cfg, num_blocks=self._auto_num_blocks(mesh))
             logger.info("auto-sized KV cache: %d blocks of %d tokens",
                         self.cache_cfg.num_blocks, self.cache_cfg.block_size)
-        if mesh is not None:
+        if self._pp > 1:
+            # Pipeline placement: layers + KV stage-stacked over 'pp'
+            # (parallel/pipeline.py) — per-device weight AND cache bytes
+            # divide by the stage count; _exec_prefill/_exec_decode route
+            # to the pipelined trunk.  Single-process, pure-pp mesh, no
+            # fused windows / chunked prefill / speculation (gated below
+            # and at intake) — the footprint-scaling path, not the
+            # peak-throughput path.
+            from tpuserve.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_TP
+            from tpuserve.parallel.pipeline import (create_stacked_cache,
+                                                    stack_pipeline_params)
+            extra = {a: mesh.shape.get(a, 1)
+                     for a in (AXIS_DP, AXIS_EP, AXIS_TP)}
+            if any(v > 1 for v in extra.values()):
+                raise ValueError(
+                    f"pipeline engine needs a pure ('pp',) mesh, got extra "
+                    f"axes {extra} (tp-within-stage composition is future "
+                    "work — use tp OR pp)")
+            if jax.process_count() > 1:
+                raise ValueError("pipeline engine is single-process; "
+                                 "multi-host serving uses the lockstep tp "
+                                 "path (parallel/multihost.py)")
+            if config.speculative:
+                raise ValueError(
+                    "speculative decoding is not supported on the pipeline "
+                    "engine (the verify window would serialise through "
+                    "every stage)")
+            if self.attn_impl == "pallas":
+                logger.warning("pipeline engine runs reference attention; "
+                               "Pallas-under-pp is future work")
+                self.attn_impl = "reference"
+            self._pp_head, self._pp_stages = stack_pipeline_params(
+                self.params, self.model_cfg, mesh)
+            self.kv_cache = create_stacked_cache(self.model_cfg,
+                                                 self.cache_cfg, mesh)
+            # the unstacked copy would pin a full set of weights on one
+            # device for nothing — the pipelined trunk owns the params now
+            self.params = None
+        elif mesh is not None:
             # Tensor-parallel placement: GSPMD inserts the ICI collectives.
             from tpuserve.parallel.sharding import cache_shardings, shard_params
             self.params = shard_params(self.params, self.model_cfg, mesh)
@@ -248,7 +288,15 @@ class Engine:
         self.block_manager = create_block_manager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=config.enable_prefix_caching)
-        self.scheduler = Scheduler(config.scheduler, self.block_manager,
+        sched_cfg = config.scheduler
+        if self._pp > 1 and sched_cfg.allow_chunked_prefill:
+            # the pipelined trunk has no chunked-prefill path; the flag
+            # closes ALL chunk routes (length, prefix-hit-by-choice,
+            # preempt-requeue continuation), so long prompts batch-prefill
+            # at a big bucket instead of crashing _exec_prefill_chunk
+            sched_cfg = dataclasses.replace(sched_cfg,
+                                            allow_chunked_prefill=False)
+        self.scheduler = Scheduler(sched_cfg, self.block_manager,
                                    max_model_len=self.cache_cfg.max_model_len)
         self.stats = EngineStats()
         # device outputs of warmup-only executables (samplers, token
@@ -270,6 +318,12 @@ class Engine:
         self._pending_window: Optional[PendingWindow] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
         self._multi_step = config.resolve_multi_step()
+        if self._pp > 1 and self._multi_step > 1:
+            # a fused window's on-device token feedback would serialise
+            # through the full pipeline depth each iteration; decode runs
+            # the per-step path (with PendingDecode overlap) instead
+            logger.info("pipeline engine: fused decode windows disabled")
+            self._multi_step = 1
         self._min_multi_step = min(max(1, config.min_multi_step),
                                    self._multi_step)
         self._adaptive_window = (config.adaptive_multi_step
@@ -335,14 +389,26 @@ class Engine:
             # elsewhere
             limit = (16 << 30) if jax.default_backend() == "tpu" else (1 << 30)
         limit = int(limit * self.config.hbm_share)
-        tp = 1
-        if mesh is not None:
-            from tpuserve.parallel.mesh import AXIS_TP
-            tp = mesh.shape.get(AXIS_TP, 1)
         from tpuserve.models.weights import param_nbytes
+        shards = 1
         param_bytes = param_nbytes(self.params)
+        if mesh is not None:
+            # tp shards all weights and the cache, so the per-device
+            # arithmetic cancels to the total-budget form.  pp shards the
+            # LAYERS and the cache but replicates the head (embed /
+            # final-norm / lm-head, pipeline.stack_pipeline_params) on
+            # every stage — charge the head once per stage or the budget
+            # converts (pp-1)×head_bytes of phantom headroom into KV
+            # blocks and OOMs on vocab-heavy models.
+            from tpuserve.parallel.mesh import AXIS_PP, AXIS_TP
+            pp_n = mesh.shape.get(AXIS_PP, 1)
+            shards = mesh.shape.get(AXIS_TP, 1) * pp_n
+            if pp_n > 1:
+                head_bytes = param_nbytes(
+                    {k: v for k, v in self.params.items() if k != "layers"})
+                param_bytes += (pp_n - 1) * head_bytes
         blocks = num_blocks_for_budget(
-            self.model_cfg, self.cache_cfg, limit * tp,
+            self.model_cfg, self.cache_cfg, limit * shards,
             weight_bytes=param_bytes)
         # cap at what the scheduler can ever address (+1 decode-headroom
         # block per sequence) — HBM past that is pure waste — and bound
@@ -384,6 +450,34 @@ class Engine:
                 f"length {self.max_seq_len} (min of cache capacity "
                 f"{self.cache_cfg.max_model_len} and model position range "
                 f"{self.model_cfg.max_position_embeddings})")
+        if self._pp > 1:
+            # chunked prefill is closed under pp, so prefill runs batched
+            # REFERENCE attention whose (rows, Hq, L, L) f32 score tensor
+            # is unbounded by chunk size — bound it here (same budget idea
+            # as Engine.embed) instead of OOMing the stages mid-serving.
+            # The worst case is not the prompt itself: a decode-OOM
+            # preemption re-prefills prompt+generated at a bigger bucket,
+            # and the scheduler can batch several prompts into one bucket
+            # (admission charges cand*(picked+1) vs max_prefill_tokens,
+            # with the first pick exempt) — so budget the largest
+            # re-prefill this request can ever grow to, times the rows the
+            # scheduler could co-admit at that bucket.
+            worst = min(len(prompt_token_ids) + (params.max_tokens or 0),
+                        self.max_seq_len)
+            L = next_power_of_2(worst)
+            scfg = self.scheduler.cfg
+            rows = min(scfg.max_prefill_seqs,
+                       max(1, scfg.max_prefill_tokens // L))
+            score = rows * self.model_cfg.num_heads * L * L * 4
+            if score > self.PP_PREFILL_SCORE_BUDGET_BYTES:
+                raise ValueError(
+                    f"prompt length {len(prompt_token_ids)} + max_tokens "
+                    f"{params.max_tokens} exceeds the pipeline engine's "
+                    f"prompt budget: chunked prefill is unavailable under "
+                    f"pp and a (re-)prefill at bucket {L} would need "
+                    f"{score / 2**30:.1f} GiB of attention scores "
+                    f"(budget {self.PP_PREFILL_SCORE_BUDGET_BYTES / 2**30:.0f}"
+                    " GiB); lower max_tokens or use tp instead of pp")
         request_id = request_id or f"req-{next(self._req_counter)}"
         if params.guided is not None:
             if params.guided != "json":
@@ -427,6 +521,11 @@ class Engine:
         """
         from tpuserve.parallel.disagg import insert_seq_kv
         prompt_token_ids = list(prompt_token_ids)
+        if self._pp > 1:
+            raise ValueError("KV adoption (disaggregation) is not supported "
+                             "on the pipeline engine — the transferred "
+                             "per-layer pages don't match the stage-stacked "
+                             "cache layout")
         if request_id in self.requests:
             raise ValueError(f"request {request_id} already exists")
         if len(prompt_token_ids) >= self.max_seq_len:
@@ -601,11 +700,22 @@ class Engine:
     # can't silently bypass the lockstep protocol (the round-1 deadlock).
 
     def _exec_prefill(self, tokens, prompt_lens, slot_ids):
+        if self._pp > 1:
+            from tpuserve.parallel.pipeline import pp_prefill
+            return pp_prefill(self._pp_head, self._pp_stages, self.model_cfg,
+                              tokens, prompt_lens, slot_ids, self.kv_cache,
+                              mesh=self.mesh)
         return transformer.prefill(
             self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
             self.kv_cache, attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_decode(self, tokens, positions, slot_ids, block_tables, seq_lens):
+        if self._pp > 1:
+            from tpuserve.parallel.pipeline import pp_decode_step
+            return pp_decode_step(self._pp_head, self._pp_stages,
+                                  self.model_cfg, tokens, positions,
+                                  slot_ids, block_tables, seq_lens,
+                                  self.kv_cache, mesh=self.mesh)
         return transformer.decode_step(
             self.params, self.model_cfg, tokens, positions, slot_ids,
             block_tables, seq_lens, self.kv_cache, attn_impl=self.attn_impl,
@@ -613,6 +723,9 @@ class Engine:
 
     def _exec_prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables):
+        if self._pp > 1:            # unreachable: gated at add_request
+            raise RuntimeError("chunked prefill is not supported on the "
+                               "pipeline engine")
         return transformer.prefill_chunk(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
             slot_ids, block_tables, self.kv_cache,
@@ -1426,6 +1539,9 @@ class Engine:
     # decode traffic: the batch is auto-chunked down, and a single input
     # too long for the budget alone is rejected with a 400-able error.
     EMBED_SCORE_BUDGET_BYTES = 1 << 30
+    # pp intake guard (add_request): max f32 attention-score bytes one
+    # batched reference prefill may materialise on the staged trunk
+    PP_PREFILL_SCORE_BUDGET_BYTES = 1 << 30
 
     def _embed_max_rows(self, T: int) -> int:
         per_row = self.model_cfg.num_heads * T * T * 4
@@ -1445,6 +1561,9 @@ class Engine:
         if jax.process_count() > 1:
             raise ValueError("embeddings not supported by this multi-host "
                              "deployment; route to a single-host replica")
+        if self._pp > 1:
+            raise ValueError("embeddings not supported on the pipeline "
+                             "engine; route to a non-pp replica")
         if pooling not in ("mean", "last"):
             raise ValueError("pooling must be 'mean' or 'last'")
         if not inputs:
@@ -1597,9 +1716,12 @@ class Engine:
                     _, self.kv_cache = self._exec_decode_verify(
                         vtok, jnp.zeros((B,), jnp.int32),
                         jnp.ones((B,), jnp.int32), vslots, bt)
-            chunk = self.config.scheduler.prefill_chunk_size
+            chunk = self.scheduler.cfg.prefill_chunk_size
             chunk_set = set(chunk_buckets)
-            if self.max_seq_len > chunk:
+            if not self.scheduler.cfg.allow_chunked_prefill:
+                chunk_set = set()     # no chunk route exists (pp engine)
+            if (self.max_seq_len > chunk
+                    and self.scheduler.cfg.allow_chunked_prefill):
                 # long prompts hit the chunked path; the full-chunk
                 # executable must be warm or the first long request stalls
                 # the loop on a compile.  chunk_buckets adds the padded
@@ -1615,6 +1737,9 @@ class Engine:
                     jnp.ones((1,), jnp.int32), slots, bt)
                 self._warm_sampling(logits, sample_modes)
         if embed_buckets:
+            if self._pp > 1:
+                raise ValueError("embeddings not supported on the pipeline "
+                                 "engine (Engine.embed is gated)")
             # embeddings executables are independent of the KV cache —
             # one pass suffices (no layout round-trip to settle)
             from tpuserve.models.transformer import embed_forward

@@ -31,6 +31,12 @@ class SchedulerConfig:
     # against the cache (ONE compiled shape instead of a giant per-length
     # bucket; bounds prefill activation memory for long contexts).
     prefill_chunk_size: int = 2048
+    # The pipeline engine (parallel/pipeline.py) has no chunked-prefill
+    # trunk; with this off, EVERY prefill takes the batched route — long
+    # prompts get their own single-sequence batch at a big bucket instead
+    # of chunking, and prefix-cache hits never chunk by choice.  All three
+    # chunk routes check this flag, so "off" is a guarantee, not a default.
+    allow_chunked_prefill: bool = True
     # Also run one decode step after every BATCHED prefill (not just
     # chunked ones): under sustained arrivals, strict prefill-priority
     # stalls every running stream for the whole admission burst — this
@@ -175,14 +181,16 @@ class Scheduler:
         # Long prompts chunk by necessity (checked first — no cache probe,
         # which would re-hash an unbounded prompt every scheduling cycle
         # while it waits for blocks).
-        if head.num_tokens > self.cfg.prefill_chunk_size:
+        if (self.cfg.allow_chunked_prefill
+                and head.num_tokens > self.cfg.prefill_chunk_size):
             return self._pop_head_for_chunking(head)
         # Prompts with a SUBSTANTIAL prefix-cache hit chunk by choice — the
         # chunked path starts at the cached offset and skips the recompute.
         # A small hit stays on the batched path: recomputing a few cached
         # tokens is far cheaper than giving up prefill batching.
         cached = 0
-        if self.block_manager.enable_prefix_caching:
+        if (self.block_manager.enable_prefix_caching
+                and self.cfg.allow_chunked_prefill):
             _, cached = self.block_manager.lookup_prefix(
                 head.prompt_token_ids + head.output_token_ids,
                 count_stats=False)
@@ -195,7 +203,8 @@ class Scheduler:
         while (self.waiting and len(picked) < self.cfg.max_prefill_seqs
                and len(self.running) + len(picked) < self.cfg.max_num_seqs):
             req = self.waiting[0]
-            if req.num_tokens > self.cfg.prefill_chunk_size:
+            if (self.cfg.allow_chunked_prefill
+                    and req.num_tokens > self.cfg.prefill_chunk_size):
                 # long prompt behind the head: leave it for its own chunked
                 # step — batching it here would one-shot prefill a giant
                 # uncompiled bucket

@@ -996,6 +996,12 @@ def main(argv=None):
     ap.add_argument("--attn-impl", default="auto")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor parallel degree (0 = no mesh)")
+    ap.add_argument("--pp", type=int, default=0,
+                    help="pipeline parallel stages (0 = no mesh): layers + "
+                         "KV cache stage-stacked over a ('pp',) mesh "
+                         "(parallel/pipeline.py) — per-device weight and "
+                         "cache bytes divide by the stage count.  "
+                         "Mutually exclusive with --tp")
     ap.add_argument("--disagg", action="store_true",
                     help="disaggregated prefill/decode pools in-process "
                          "(KV handoff over ICI within the slice)")
@@ -1080,7 +1086,16 @@ def main(argv=None):
         min_multi_step=args.min_multi_step,
         quantization=args.quantization)
     mesh = None
-    if args.tp > 1:
+    if args.pp > 1 and args.tp > 1:
+        ap.error("--pp and --tp are mutually exclusive (tp-within-stage "
+                 "composition is future work)")
+    if args.pp > 1 and (args.disagg or args.role or args.multihost):
+        ap.error("--pp is a single-process colocated topology; drop "
+                 "--disagg/--role/--multihost")
+    if args.pp > 1:
+        from tpuserve.parallel import MeshConfig, make_mesh
+        mesh = make_mesh(MeshConfig(pp=args.pp))
+    elif args.tp > 1:
         from tpuserve.parallel import MeshConfig, make_mesh
         mesh = make_mesh(MeshConfig(dp=1, tp=args.tp))
     elif args.multihost:
