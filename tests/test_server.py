@@ -431,3 +431,73 @@ def test_min_p_range_validation(server):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(server + "/v1/completions", {"prompt": "x", "min_p": bad})
         assert ei.value.code == 400, bad
+
+
+def test_best_of_returns_top_n_by_cumulative_logprob(server):
+    """OpenAI completions best_of: sample best_of candidates, return the
+    top n ranked by cumulative logprob; usage bills every candidate; the
+    internally-recorded ranking logprobs never leak into the response."""
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "rank me", "max_tokens": 4,
+        "temperature": 0.9, "seed": 3, "n": 2, "best_of": 4,
+        "ignore_eos": True})
+    assert status == 200
+    assert len(body["choices"]) == 2
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    assert all("logprobs" not in c for c in body["choices"])
+    assert body["usage"]["completion_tokens"] == 16    # 4 candidates x 4
+    # the returned pair must be the best-ranked subset: re-run with
+    # n=best_of and the same seed to see every candidate's logprobs
+    _, full = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "rank me", "max_tokens": 4,
+        "temperature": 0.9, "seed": 3, "n": 4, "logprobs": 0,
+        "ignore_eos": True})
+    ranked = sorted(
+        full["choices"],
+        key=lambda c: -sum(c["logprobs"]["token_logprobs"]))
+    assert [c["text"] for c in body["choices"]] == \
+        [c["text"] for c in ranked[:2]]
+
+
+def test_best_of_client_logprobs_survive(server):
+    """A client that asks for logprobs WITH best_of still gets them."""
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "rank me", "max_tokens": 3,
+        "temperature": 0.9, "seed": 5, "n": 1, "best_of": 3,
+        "logprobs": 2, "ignore_eos": True})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 3
+    assert all(len(t) <= 2 for t in lp["top_logprobs"])
+
+
+def test_best_of_validation(server):
+    for payload, frag in [
+        ({"best_of": 4, "n": 2, "stream": True, "temperature": 0.9},
+         "stream"),
+        ({"best_of": 2, "temperature": 0.0}, "sampling"),
+        ({"best_of": 99, "temperature": 0.9}, "best_of"),
+        ({"best_of": 1, "n": 2, "temperature": 0.9}, "best_of"),
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+                **payload})
+        assert ei.value.code == 400, payload
+        assert frag in json.loads(ei.value.read())["error"]["message"]
+    # chat rejects best_of outright
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/chat/completions", {
+            "model": "tiny-qwen3",
+            "messages": [{"role": "user", "content": "hi"}],
+            "best_of": 2, "temperature": 0.9, "max_tokens": 2})
+    assert ei.value.code == 400
+
+
+def test_suffix_rejected(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "suffix": "tail",
+            "max_tokens": 2})
+    assert ei.value.code == 400
+    assert "suffix" in json.loads(ei.value.read())["error"]["message"]

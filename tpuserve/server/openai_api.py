@@ -241,6 +241,43 @@ class OpenAIServer:
             raise ValueError(f"'n' must be an integer in 1..{self.MAX_CHOICES}")
         return n
 
+    def parse_best_of(self, body: dict, n: int, chat: bool,
+                      params) -> int:
+        """OpenAI completions ``best_of``: sample best_of candidates
+        server-side, return the top n by cumulative logprob of the
+        generated tokens (the vLLM ranking).  Legacy-completions only,
+        like OpenAI; greedy best_of>n would sample n identical streams,
+        so it is rejected rather than silently wasted."""
+        best_of = body.get("best_of")
+        if best_of is None:
+            return n
+        if chat:
+            raise ValueError("'best_of' is a completions parameter "
+                             "(not supported on chat)")
+        if (not isinstance(best_of, int)
+                or not n <= best_of <= self.MAX_CHOICES):
+            raise ValueError(f"'best_of' must be an integer in "
+                             f"n..{self.MAX_CHOICES}")
+        if best_of > n:
+            if body.get("stream"):
+                raise ValueError("cannot stream with best_of > n: ranking "
+                                 "needs every candidate finished")
+            if params.greedy:
+                raise ValueError("best_of > n requires sampling "
+                                 "(temperature > 0); greedy candidates "
+                                 "would be identical")
+            if params.guided is not None:
+                raise ValueError("best_of > n cannot be combined with "
+                                 "response_format (ranking records "
+                                 "logprobs, which guided decoding "
+                                 "forbids)")
+            import jax
+            if jax.process_count() > 1:
+                raise ValueError("best_of > n not supported by this "
+                                 "multi-host deployment (candidate "
+                                 "ranking records logprobs)")
+        return best_of
+
     def _reject_multihost_unsupported(self, params) -> None:
         """Multi-host lockstep mirrors prefill/decode/sample only; the
         penalty/bias/min-tokens/logprob jits are out of protocol
@@ -414,8 +451,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             body = self._read_body()
+            if not chat and body.get("suffix") is not None:
+                # OpenAI legacy fill-in-the-middle; vLLM rejects it too
+                raise ValueError("'suffix' is not supported")
             prompt, params, toolctx = self.ctx.handle_completion(body, chat)
             n = self.ctx.parse_n(body)
+            best_of = self.ctx.parse_best_of(body, n, chat, params)
         except (ValueError, json.JSONDecodeError) as e:
             self._error(400, str(e))
             return
@@ -440,7 +481,7 @@ class _Handler(BaseHTTPRequestHandler):
                                           toolctx=toolctx)
                 else:
                     self._full_response(body, params, chat, kwargs, n,
-                                        toolctx=toolctx)
+                                        toolctx=toolctx, best_of=best_of)
         except BrokenPipeError:
             pass
         except Exception as e:               # engine-side failure, pre-headers
@@ -699,10 +740,22 @@ class _Handler(BaseHTTPRequestHandler):
         eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
         return eng.tokenizer.decode(kwargs["prompt_token_ids"])
 
-    def _full_response(self, body, params, chat, kwargs, n=1, toolctx=None):
+    def _full_response(self, body, params, chat, kwargs, n=1, toolctx=None,
+                       best_of=None):
         ctx = self.ctx
         t0 = time.monotonic()
-        submits = self._submit_choices(params, kwargs, n)
+        # best_of > n: sample best_of candidates and keep the top n by
+        # cumulative logprob (OpenAI completions semantics; vLLM ranking).
+        # Ranking needs per-token logprobs — record chosen-token-only
+        # (logprobs=0) when the client didn't ask for logprobs, and strip
+        # them from the response afterwards.
+        best_of = best_of or n
+        rank_params = params
+        internal_logprobs = False
+        if best_of > n and params.logprobs is None:
+            rank_params = dataclasses.replace(params, logprobs=0)
+            internal_logprobs = True
+        submits = self._submit_choices(rank_params, kwargs, best_of)
         deadline = t0 + ctx.config.request_timeout_s
         import queue as _queue
 
@@ -712,11 +765,11 @@ class _Handler(BaseHTTPRequestHandler):
                 ctx.engine.requests.pop(rid, None)
             self._error(code, message, etype)
 
-        choices = []
+        cands = []
         prompt_tokens = 0
         completion_tokens = 0
         echo_text = self._echo_text(body, chat, kwargs)
-        for idx, (rid, q) in enumerate(submits):
+        for rid, q in submits:
             text_parts, token_ids, logprob_entries = [], [], []
             finish_reason = "stop"
             while True:
@@ -743,11 +796,23 @@ class _Handler(BaseHTTPRequestHandler):
             text = "".join(text_parts)
             if echo_text is not None:
                 text = echo_text + text
-            if req is not None and params.logprobs is not None:
+            if req is not None and rank_params.logprobs is not None:
                 logprob_entries = req.logprobs
             if req is not None:
                 prompt_tokens = req.num_prompt_tokens
-            completion_tokens += len(token_ids)
+            completion_tokens += len(token_ids)   # usage bills ALL candidates
+            cands.append({"text": text, "entries": logprob_entries,
+                          "finish_reason": finish_reason})
+        if best_of > n:
+            # stable sort: ties keep submission order
+            cands.sort(key=lambda c: -sum(e["logprob"]
+                                          for e in c["entries"]))
+            cands = cands[:n]
+        choices = []
+        for idx, cand in enumerate(cands):
+            text = cand["text"]
+            finish_reason = cand["finish_reason"]
+            logprob_entries = [] if internal_logprobs else cand["entries"]
             if chat:
                 message = {"role": "assistant", "content": text}
                 if toolctx is not None:
