@@ -1,0 +1,259 @@
+"""Schema-constrained structured output (response_format json_schema):
+compile-time keyword validation, the char-level schema acceptor, the
+engine's candidate-substitution path under a schema, and the HTTP
+surface.  Same adversarial setup as test_guided.py: the tiny models have
+RANDOM weights, so every schema-conforming output demonstrates the
+constraint did the work.  vLLM serves this contract via outlines-compiled
+token DFAs inside the reference's serving container; here the acceptor
+is tokenizer-agnostic (runtime/guided.py design note)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.runtime.guided import (SchemaError, SchemaJsonStateMachine,
+                                     compile_schema)
+from tpuserve.runtime.request import SamplingParams
+
+
+def _machine(schema):
+    return SchemaJsonStateMachine(compile_schema(schema))
+
+
+def _feed(schema, text):
+    m = _machine(schema)
+    try:
+        m.feed(text)
+    except ValueError:
+        return None
+    return m
+
+
+# ------------------------------------------------------------ compile
+
+def test_compile_rejects_unsupported_keywords():
+    for bad in ({"oneOf": []}, {"$ref": "#/x"}, {"pattern": "a+"},
+                {"type": "object", "patternProperties": {}},
+                {"minLength": 2}, {"type": "string"},       # non-object root
+                {"enum": [{"a": 1}]}, {"enum": []},
+                {"type": "object", "properties": {'a"b': {}}},
+                {"type": "object", "additionalProperties": False},
+                {"items": [{"type": "string"}], "type": "object"}):
+        with pytest.raises(SchemaError):
+            compile_schema(bad)
+
+
+def test_compile_accepts_subset_and_ignores_annotations():
+    node = compile_schema({
+        "type": "object", "title": "T", "$schema": "x",
+        "properties": {
+            "name": {"type": "string", "description": "d"},
+            "age": {"type": "integer", "minimum": 0, "maximum": 150},
+            "tags": {"type": "array", "items": {"type": "string"},
+                     "minItems": 1, "maxItems": 3},
+            "kind": {"enum": ["cat", "dog"]},
+        },
+        "required": ["name", "age"], "additionalProperties": False})
+    assert set(node["props"]) == {"name", "age", "tags", "kind"}
+    assert node["required"] == {"name", "age"}
+    assert node["additional"] is None
+
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "a": {"type": "integer", "minimum": 0},
+        "s": {"type": "string"},
+        "k": {"enum": ["red", "green", 7, True]},
+        "arr": {"type": "array", "items": {"type": "integer"},
+                "minItems": 1, "maxItems": 2},
+        "nested": {"type": "object",
+                   "properties": {"b": {"type": "boolean"}},
+                   "required": ["b"], "additionalProperties": False},
+    },
+    "required": ["a"],
+    "additionalProperties": False,
+}
+
+
+def test_schema_accepts_conforming_documents():
+    for doc in ('{"a": 3}',
+                '{"a": 0, "s": "hi ☃"}',
+                '{"k": "red", "a": 12}',
+                '{"k": 7, "a": 1}',
+                '{"k": true, "a": 1}',
+                '{"arr": [1, 2], "a": 5}',
+                '{"nested": {"b": false}, "a": 2}'):
+        m = _feed(SCHEMA, doc)
+        assert m is not None and m.complete, doc
+        json.loads(doc)
+
+
+def test_schema_rejections_at_the_earliest_char():
+    for bad in ('{"z"',                # key not in properties
+                '{"a": "',             # wrong type for a
+                '{"a": -',             # minimum 0: '-' can never satisfy
+                '{"a": 3.',            # integer forbids '.'
+                '{"k": "blu',          # enum prefix dies at 'u'
+                '{"k": 9',             # number enum prefix dies
+                '{"k": fal',           # true allowed, false not... dies at 'a'
+                '{"k": {',             # enum value can't be a container
+                '{"arr": []',          # minItems 1
+                '{"arr": [1, 2,',      # maxItems 2: comma is a dead end
+                '{"arr": [1.5',        # items integer
+                '{"nested": {}',       # required b missing
+                '{"nested": {"b": 1',  # boolean expected
+                '{"a": 1, "a"',        # duplicate key
+                '{}'):                 # required a missing
+        assert _feed(SCHEMA, bad) is None, bad
+
+
+def test_schema_number_dead_end_prevention():
+    """Sign/zero/integer-magnitude prefixes that can never satisfy the
+    bounds are rejected at the EARLIEST char — a dead-end state would
+    trap the candidate substitution until max_tokens."""
+    imin = {"type": "object", "additionalProperties": False,
+            "properties": {"a": {"type": "integer", "minimum": 1}}}
+    assert _feed(imin, '{"a": -') is None       # negatives unreachable
+    assert _feed(imin, '{"a": 0') is None       # zero can't grow
+    assert _feed(imin, '{"a": 2}') is not None
+    imax = {"type": "object", "additionalProperties": False,
+            "properties": {"a": {"type": "integer", "maximum": 12}}}
+    assert _feed(imax, '{"a": 15') is None      # digits only grow
+    assert _feed(imax, '{"a": 12}') is not None
+    neg = {"type": "object", "additionalProperties": False,
+           "properties": {"a": {"type": "number", "maximum": -1}}}
+    assert _feed(neg, '{"a": 3') is None        # must start negative
+    assert _feed(neg, '{"a": -0') is None       # -0 == 0 > maximum
+    assert _feed(neg, '{"a": -2.5}') is not None
+    # floats keep exponent escape routes: '15' under maximum 12 is NOT a
+    # dead end (15e-1 = 1.5), so only value-end enforcement applies
+    fmax = {"type": "object", "additionalProperties": False,
+            "properties": {"a": {"type": "number", "maximum": 12}}}
+    assert _feed(fmax, '{"a": 15e-1}') is not None
+    assert _feed(fmax, '{"a": 15}') is None
+
+
+def test_compile_rejects_unsatisfiable_required():
+    with pytest.raises(SchemaError, match="required"):
+        compile_schema({"type": "object",
+                        "properties": {"a": {"type": "integer"}},
+                        "required": ["a", "b"],
+                        "additionalProperties": False})
+
+
+def test_schema_bounds_checked_at_value_end():
+    s = {"type": "object", "properties": {"a": {"type": "number",
+                                                "exclusiveMaximum": 10}},
+         "additionalProperties": False}
+    assert _feed(s, '{"a": 9.5}') is not None
+    assert _feed(s, '{"a": 10}') is None
+    assert _feed(s, '{"a": 1e3}') is None
+
+
+def test_schema_additional_properties_schema_applies():
+    s = {"type": "object", "properties": {"a": {"type": "integer"}},
+         "additionalProperties": {"type": "boolean"}}
+    assert _feed(s, '{"a": 1, "other": true}') is not None
+    assert _feed(s, '{"other": "nope"') is None
+
+
+def test_schema_allows_is_pure():
+    m = _machine(SCHEMA)
+    m.feed('{"a"')
+    before = (m.mode, list(m.frames[-1]["seen"]))
+    assert m.allows(': 3}')
+    assert not m.allows(': "x"')
+    assert (m.mode, list(m.frames[-1]["seen"])) == before
+
+
+# ------------------------------------------------------------ engine e2e
+
+def _engine():
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+def test_engine_schema_guided_output_conforms():
+    eng = _engine()
+    schema = {"type": "object",
+              "properties": {"a": {"type": "integer"}},
+              "required": ["a"], "additionalProperties": False}
+    # bias quote/brace/digit bytes (ByteTokenizer: id = byte + 3) so the
+    # random model closes what it opens within the budget
+    bias = {0x22 + 3: 100.0, 0x7D + 3: 60.0, 0x33 + 3: 40.0}
+    outs = eng.generate(
+        ["x"], [SamplingParams(max_tokens=200, temperature=0.0,
+                               guided="json_schema",
+                               guided_schema=json.dumps(schema),
+                               logit_bias=bias)])
+    (r,) = outs
+    assert r.finish_reason.value == "stop", r.output_text
+    doc = json.loads(r.output_text)
+    assert set(doc) == {"a"} and isinstance(doc["a"], int), doc
+
+
+def test_engine_rejects_bad_schema_mode():
+    eng = _engine()
+    with pytest.raises(ValueError):
+        eng.add_request(prompt_token_ids=[5],
+                        params=SamplingParams(guided="grammar"))
+
+
+# ------------------------------------------------------------ HTTP edge
+
+@pytest.fixture(scope="module")
+def server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    eng = _engine()
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_response_format_json_schema_http(server):
+    status, body = _post(server + "/v1/chat/completions", {
+        "model": "tiny-qwen3",
+        "messages": [{"role": "user", "content": "give me json"}],
+        "max_tokens": 200, "temperature": 0,
+        "logit_bias": {str(0x22 + 3): 100, str(0x7D + 3): 60,
+                       str(0x33 + 3): 40},
+        "response_format": {"type": "json_schema", "json_schema": {
+            "name": "thing", "schema": {
+                "type": "object",
+                "properties": {"a": {"type": "integer"}},
+                "required": ["a"], "additionalProperties": False}}}})
+    assert status == 200
+    doc = json.loads(body["choices"][0]["message"]["content"])
+    assert set(doc) == {"a"} and isinstance(doc["a"], int)
+
+
+def test_response_format_json_schema_bad_schema_400(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "max_tokens": 4,
+            "response_format": {"type": "json_schema", "json_schema": {
+                "name": "t", "schema": {"oneOf": []}}}})
+    assert ei.value.code == 400
+    assert "oneOf" in json.loads(ei.value.read())["error"]["message"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "max_tokens": 4,
+            "response_format": {"type": "json_schema"}})
+    assert ei.value.code == 400

@@ -480,16 +480,15 @@ class Engine:
                     " GiB); lower max_tokens or use tp instead of pp")
         request_id = request_id or f"req-{next(self._req_counter)}"
         if params.guided is not None:
-            if params.guided != "json":
+            if params.guided not in ("json", "json_schema"):
                 raise ValueError(f"unsupported guided mode {params.guided!r}"
-                                 " (only 'json')")
+                                 " (only 'json' / 'json_schema')")
             if params.logprobs is not None:
                 # substitution happens after on-device logprob recording —
                 # the reported tokens would not match the emitted ones
                 raise ValueError(
                     "logprobs cannot be combined with response_format")
-            from tpuserve.runtime.guided import JsonStateMachine
-            self._guided[request_id] = JsonStateMachine()
+            self._guided[request_id] = self._make_guided(params)
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
                       params=params, prompt=prompt)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
@@ -552,8 +551,7 @@ class Engine:
         if params.guided is not None:
             # cross-pod migration: rebuild the acceptor and advance it by
             # the first token's text, mirroring what prefill emitted
-            from tpuserve.runtime.guided import JsonStateMachine
-            st = JsonStateMachine()
+            st = self._make_guided(params)
             try:
                 st.feed(first_text)
                 self._guided[request_id] = st
@@ -1235,6 +1233,20 @@ class Engine:
         return toks_np
 
     GUIDED_TOP_K = 32
+
+    @staticmethod
+    def _make_guided(params):
+        """Acceptor for the request's response_format: plain JSON-object
+        grammar, or the schema-constrained subclass (compiled schema
+        carried as canonical JSON text in params.guided_schema)."""
+        from tpuserve.runtime.guided import (JsonStateMachine,
+                                             SchemaJsonStateMachine,
+                                             compile_schema)
+        if params.guided == "json_schema":
+            import json as _json
+            return SchemaJsonStateMachine(
+                compile_schema(_json.loads(params.guided_schema)))
+        return JsonStateMachine()
 
     def _apply_guided(self, logits: jnp.ndarray, toks_np: np.ndarray,
                       reqs: list[Request]) -> np.ndarray:

@@ -53,11 +53,14 @@ class SamplingParams:
     # vLLM priority scheduling: LOWER value = admitted sooner; FIFO
     # within a level (runtime/scheduler.py Scheduler.add)
     priority: int = 0
-    # Structured output (OpenAI response_format json_object): "json"
-    # constrains generation to one valid JSON object via per-step
-    # candidate validation (runtime/guided.py); runs on the single-step
-    # decode path
+    # Structured output (OpenAI response_format): "json" constrains
+    # generation to one valid JSON object, "json_schema" additionally to
+    # ``guided_schema`` — both via per-step candidate validation
+    # (runtime/guided.py); runs on the single-step decode path
     guided: Optional[str] = None
+    # canonical JSON text of the compiled schema ("json_schema" mode);
+    # kept as text so SamplingParams stays hash/replace-friendly
+    guided_schema: Optional[str] = None
 
     @property
     def greedy(self) -> bool:

@@ -128,6 +128,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     if not -(2**31) <= priority < 2**31:
         raise ValueError("'priority' must be a 32-bit integer")
     guided = None
+    guided_schema = None
     rf = body.get("response_format")
     if rf is not None:
         if not isinstance(rf, dict) or not isinstance(rf.get("type"), str):
@@ -136,8 +137,20 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         if rf["type"] == "json_object":
             guided = "json"
         elif rf["type"] == "json_schema":
-            raise ValueError("response_format 'json_schema' is not "
-                             "supported; use 'json_object'")
+            # OpenAI shape: {"type": "json_schema",
+            #               "json_schema": {"name": ..., "schema": {...}}}
+            js = rf.get("json_schema")
+            if not isinstance(js, dict) or not isinstance(
+                    js.get("schema"), dict):
+                raise ValueError("response_format json_schema needs a "
+                                 "'json_schema' object with a 'schema'")
+            from tpuserve.runtime.guided import SchemaError, compile_schema
+            try:
+                compile_schema(js["schema"])     # 400 unsupported keywords
+            except SchemaError as e:
+                raise ValueError(f"unsupported json_schema: {e}") from None
+            guided = "json_schema"
+            guided_schema = json.dumps(js["schema"])
         elif rf["type"] != "text":
             raise ValueError(f"unknown response_format type {rf['type']!r}")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
@@ -158,6 +171,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         logit_bias=bias,
         stop_token_ids=tuple(stop_ids),
         guided=guided,
+        guided_schema=guided_schema,
         priority=priority,
     )
 
