@@ -53,7 +53,7 @@ def _norm(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     return layernorm(x, p["scale"], p["bias"], cfg.norm_eps)
 
 
-def _linear(x: jnp.ndarray, p: dict) -> jnp.ndarray:
+def _linear(x: jnp.ndarray, p: dict, ad: jnp.ndarray | None = None) -> jnp.ndarray:
     w = p["kernel"]
     if "scale" in p:
         # int8 weight-only quantization (models/weights.py
@@ -63,9 +63,30 @@ def _linear(x: jnp.ndarray, p: dict) -> jnp.ndarray:
         y = (x @ w.astype(x.dtype)) * p["scale"].astype(x.dtype)
     else:
         y = x @ w
+    if ad is not None and "lora" in p:
+        y = y + _lora_delta(x, p["lora"], ad)
     if "bias" in p:
         y = y + p["bias"].astype(y.dtype)
     return y
+
+
+def _lora_delta(x: jnp.ndarray, la: dict, ad: jnp.ndarray) -> jnp.ndarray:
+    """Per-row multi-LoRA contribution (weights.load_lora_stack layout).
+
+    ``ad`` (B, n) one-hot adapter weights per batch row (all-zero = base
+    model).  The contraction folds the stacked factors into per-row
+    (H, r)/(r, W) matrices first — n and r are small, so this is noise
+    next to the dense matmul — then applies the rank-r bottleneck.  Dense
+    over the adapter dim like the MoE expert dispatch: no gathers, no
+    ragged shapes, mixed-adapter batches in one executable."""
+    A = la["A"].astype(x.dtype)                    # (n, H, r)
+    Bm = la["B"].astype(x.dtype)                   # (n, r, W)
+    adx = ad.astype(x.dtype)
+    Ar = jnp.einsum("bn,nhr->bhr", adx, A)
+    Br = jnp.einsum("bn,nrw->brw", adx, Bm)
+    if x.ndim == 2:                                # decode: (B, H)
+        return jnp.einsum("bh,bhr,brw->bw", x, Ar, Br)
+    return jnp.einsum("bth,bhr,brw->btw", x, Ar, Br)   # prefill: (B, T, H)
 
 
 def _act(x: jnp.ndarray, name: str) -> jnp.ndarray:
@@ -78,33 +99,37 @@ def _act(x: jnp.ndarray, name: str) -> jnp.ndarray:
     raise ValueError(f"unknown activation {name}")
 
 
-def _attn_residual(out: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+def _attn_residual(out: jnp.ndarray, lp: dict, cfg: ModelConfig,
+                   ad: jnp.ndarray | None = None) -> jnp.ndarray:
     """Attention output projection; Gemma2 sandwich norms apply a
     post-attention layernorm to the projected output before the residual
     add."""
-    att = _linear(out, lp["o_proj"])
+    att = _linear(out, lp["o_proj"], ad)
     if cfg.sandwich_norms:
         att = _norm(att, lp["post_attn_norm"], cfg)
     return att
 
 
-def _mlp_residual(h: jnp.ndarray, lp: dict, cfg: ModelConfig) -> jnp.ndarray:
+def _mlp_residual(h: jnp.ndarray, lp: dict, cfg: ModelConfig,
+                  ad: jnp.ndarray | None = None) -> jnp.ndarray:
     """Pre-norm MLP branch; under sandwich norms the pre-norm weights are
     the checkpoint's pre_feedforward_layernorm (mapped onto ``mlp_norm``)
     and a post-feedforward layernorm wraps the output before the add."""
-    m = _mlp(_norm(h, lp["mlp_norm"], cfg), lp, cfg)
+    m = _mlp(_norm(h, lp["mlp_norm"], cfg), lp, cfg, ad)
     if cfg.sandwich_norms:
         m = _norm(m, lp["post_mlp_norm"], cfg)
     return m
 
 
-def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
+def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig,
+         ad: jnp.ndarray | None = None) -> jnp.ndarray:
     if cfg.num_experts:
         return _moe_mlp(x, p, cfg)
     if cfg.mlp_style == "gated":
-        gate = _act(_linear(x, p["gate_proj"]), cfg.act)
-        return _linear(gate * _linear(x, p["up_proj"]), p["down_proj"])
-    return _linear(_act(_linear(x, p["fc1"]), cfg.act), p["fc2"])
+        gate = _act(_linear(x, p["gate_proj"], ad), cfg.act)
+        return _linear(gate * _linear(x, p["up_proj"], ad), p["down_proj"],
+                       ad)
+    return _linear(_act(_linear(x, p["fc1"], ad), cfg.act), p["fc2"], ad)
 
 
 def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
@@ -159,14 +184,14 @@ def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
 # --------------------------------------------------------------------------
 
 def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray,
-         layer_idx: int):
+         layer_idx: int, ad: jnp.ndarray | None = None):
     """h: (..., H) -> q (..., Hq, D), k/v (..., Hkv, D), with qk-norm and
     RoPE.  ``layer_idx`` selects per-layer rope (Gemma3: windowed layers
     rotate at the local base frequency unscaled; full layers at
     rope_theta with the linear position scaling)."""
-    q = _linear(h, lp["q_proj"]).reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
-    k = _linear(h, lp["k_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
-    v = _linear(h, lp["v_proj"]).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    q = _linear(h, lp["q_proj"], ad).reshape(*h.shape[:-1], cfg.num_heads, cfg.head_dim)
+    k = _linear(h, lp["k_proj"], ad).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
+    v = _linear(h, lp["v_proj"], ad).reshape(*h.shape[:-1], cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         q = rmsnorm(q, lp["q_norm"]["scale"], cfg.norm_eps,
                     cfg.norm_weight_offset)
@@ -225,7 +250,8 @@ def _unembed(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
          donate_argnames=("kv_cache",))
 def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             prompt_lens: jnp.ndarray, slot_ids: jnp.ndarray,
-            kv_cache: list, *, attn_impl: str = "reference", mesh=None):
+            kv_cache: list, ad: jnp.ndarray | None = None, *,
+            attn_impl: str = "reference", mesh=None):
     """Run full prompts through the model.
 
     tokens: (B, T) right-padded prompts; prompt_lens: (B,); slot_ids: (B, T)
@@ -244,7 +270,7 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        q, k, v = _qkv(hn, lp, cfg, positions, li, ad)
         # batched prefill attends over the FRESH k/v (full precision even
         # when the cache stores int8 — only cache READS see quantization)
         new_cache.append(attn_ops.write_kv_entry(kv_cache[li], k, v,
@@ -264,8 +290,8 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                              sliding_window=sw,
                                              logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, T, cfg.q_size)
-        h = h + _attn_residual(out, lp, cfg)
-        h = h + _mlp_residual(h, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg, ad)
+        h = h + _mlp_residual(h, lp, cfg, ad)
     last_idx = jnp.maximum(prompt_lens - 1, 0)
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]  # (B, H)
     return _unembed(params, cfg, h_last), new_cache
@@ -280,8 +306,8 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                   slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                  kv_cache: list, *, attn_impl: str = "reference",
-                  mesh=None):
+                  kv_cache: list, ad: jnp.ndarray | None = None, *,
+                  attn_impl: str = "reference", mesh=None):
     """Process one chunk of each prompt against the paged cache.
 
     Long prompts run as a sequence of fixed-size chunks (bounded memory and
@@ -302,7 +328,7 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     with pallas, the kernel runs head-parallel over tp via shard_map.
     """
     h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
-                                slot_ids, block_tables, kv_cache,
+                                slot_ids, block_tables, kv_cache, ad,
                                 attn_impl=attn_impl, mesh=mesh)
     last_idx = jnp.maximum(chunk_lens - 1, 0)
     h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
@@ -360,7 +386,8 @@ def embed_forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,
                  slot_ids: jnp.ndarray, block_tables: jnp.ndarray,
-                 kv_cache: list, *, attn_impl: str = "reference", mesh=None):
+                 kv_cache: list, ad: jnp.ndarray | None = None, *,
+                 attn_impl: str = "reference", mesh=None):
     """Shared layer loop for cache-relative windows: writes the window's KV
     and attends against cached context + causal-within-window.  Used by both
     prefill_chunk (last-row logits) and decode_verify (all-row argmax)."""
@@ -372,7 +399,7 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        q, k, v = _qkv(hn, lp, cfg, positions, li, ad)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
         ck, cv = entry["k"], entry["v"]
@@ -395,8 +422,8 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                 k_scale=ks, v_scale=vs, sliding_window=sw,
                 logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, C, cfg.q_size)
-        h = h + _attn_residual(out, lp, cfg)
-        h = h + _mlp_residual(h, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg, ad)
+        h = h + _mlp_residual(h, lp, cfg, ad)
     return h, new_cache
 
 
@@ -432,7 +459,8 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  positions: jnp.ndarray, slot_ids: jnp.ndarray,
                  block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                 kv_cache: list, attn_impl: str, mesh):
+                 kv_cache: list, attn_impl: str, mesh,
+                 ad: jnp.ndarray | None = None):
     """Shared single-token decode trunk: write the token's KV, attend
     against the paged cache, return (logits (B, V), new kv_cache).  Used by
     :func:`decode_step` (one dispatch per token) and :func:`decode_multi`
@@ -444,7 +472,7 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)  # (B, Hq/Hkv, D)
+        q, k, v = _qkv(hn, lp, cfg, positions, li, ad)  # (B, Hq/Hkv, D)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
         ck, cv = entry["k"], entry["v"]
@@ -467,8 +495,8 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                                   sliding_window=sw,
                                                   logit_softcap=cfg.attn_logit_softcapping)
         out = out.reshape(B, cfg.q_size)
-        h = h + _attn_residual(out, lp, cfg)
-        h = h + _mlp_residual(h, lp, cfg)
+        h = h + _attn_residual(out, lp, cfg, ad)
+        h = h + _mlp_residual(h, lp, cfg, ad)
     return _unembed(params, cfg, h), new_cache
 
 
@@ -477,7 +505,8 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                 positions: jnp.ndarray, slot_ids: jnp.ndarray,
                 block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
-                kv_cache: list, *, attn_impl: str = "reference", mesh=None):
+                kv_cache: list, ad: jnp.ndarray | None = None, *,
+                attn_impl: str = "reference", mesh=None):
     """One decode step for a batch of sequences.
 
     tokens/positions/slot_ids/seq_lens: (B,); block_tables: (B, max_blocks).
@@ -487,7 +516,8 @@ def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     ``mesh``: static; see :func:`prefill` — head-parallel Pallas under tp.
     """
     return _decode_body(params, cfg, tokens, positions, slot_ids,
-                        block_tables, seq_lens, kv_cache, attn_impl, mesh)
+                        block_tables, seq_lens, kv_cache, attn_impl, mesh,
+                        ad=ad)
 
 
 @partial(jax.jit,
@@ -498,7 +528,8 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  positions: jnp.ndarray, block_tables: jnp.ndarray,
                  seq_lens: jnp.ndarray, active: jnp.ndarray,
                  keys: jnp.ndarray, temperature: jnp.ndarray,
-                 kv_cache: list, *, steps: int, mode: str = "greedy",
+                 kv_cache: list, ad: jnp.ndarray | None = None, *,
+                 steps: int, mode: str = "greedy",
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -532,7 +563,7 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         slot = jnp.where(active, slot, attn_ops.PAD_SLOT)
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
-                                     attn_impl, mesh)
+                                     attn_impl, mesh, ad=ad)
         if mode == "greedy":
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:

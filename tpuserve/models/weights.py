@@ -400,84 +400,146 @@ def _read_lora_adapter(adapter_dir: str) -> tuple[dict, float]:
         f"no adapter_model.safetensors/.bin in {adapter_dir}")
 
 
+def _parse_lora_factors(params: Params, cfg: ModelConfig, adapter_dir: str,
+                        label: str = "") -> list:
+    """Parse + validate one PEFT adapter against the model, returning
+    low-rank factors [(li, param_key, A (in, r), B (r, w))] with the PEFT
+    scaling folded into B and fused HF projections (Phi-3 qkv/gate_up)
+    already split into this model's per-projection columns.  ONE parser
+    for both consumers — :func:`apply_lora` (merge) and
+    :func:`load_lora_stack` (runtime stack) — so they can never accept
+    different adapter sets.  Validates everything before returning:
+    callers may mutate params knowing nothing else will raise."""
+    import re
+
+    import numpy as np
+    tag = f" in {label!r}" if label else ""
+    raw, scaling = _read_lora_adapter(adapter_dir)
+    pairs: dict[tuple[int, str], dict] = {}
+    for key, tensor in raw.items():
+        m = re.search(r"layers\.(\d+)\.([a-z_.0-9]+)\.lora_(A|B)\.weight$",
+                      key)
+        if m is None:
+            raise ValueError(f"unsupported LoRA adapter key {key!r}{tag}")
+        li, module, ab = int(m.group(1)), m.group(2), m.group(3)
+        if module not in _LORA_MODULES:
+            raise ValueError(f"LoRA target module {module!r} not supported "
+                             f"(key {key!r}){tag}")
+        if li >= cfg.num_layers:
+            raise ValueError(f"LoRA key {key!r} targets layer {li} but the "
+                             f"model has {cfg.num_layers}{tag}")
+        pairs.setdefault((li, module), {})[ab] = np.asarray(
+            tensor, dtype=np.float32)
+    if not pairs:
+        raise ValueError(f"adapter at {adapter_dir} contained no LoRA pairs")
+    factors = []
+    for (li, module), ab in sorted(pairs.items()):
+        if "A" not in ab or "B" not in ab:
+            raise ValueError(f"LoRA pair for layer {li} {module} is missing "
+                             f"lora_{'A' if 'A' not in ab else 'B'}{tag}")
+        target = _LORA_MODULES[module]
+        splits = target(cfg) if callable(target) else [(target, None)]
+        # HF shapes: A (r, in), B (out, r) -> ours (in, r) / (r, out)
+        A = ab["A"].T
+        B = ab["B"].T * scaling
+        lp = params["layers"][li]
+        col = 0
+        for pk, width in splits:
+            if pk not in lp or "kernel" not in lp[pk]:
+                raise ValueError(f"model has no dense {pk} in layer {li} "
+                                 "(MoE expert linears are not LoRA targets)")
+            kernel = lp[pk]["kernel"]
+            w = kernel.shape[1] if width is None else width
+            if kernel.shape[0] != A.shape[0]:
+                raise ValueError(
+                    f"LoRA delta shape {(A.shape[0], B.shape[1])} does not "
+                    f"match weight shape {tuple(kernel.shape)} for layer "
+                    f"{li} {pk}{tag}")
+            factors.append((li, pk, A, B[:, col:col + w]))
+            col += w
+        if col != B.shape[1]:
+            raise ValueError(
+                f"LoRA delta shape {(A.shape[0], B.shape[1])} does not "
+                f"match fused projection width {col} for layer {li} "
+                f"{module}{tag}")
+    return factors
+
+
 def apply_lora(params: Params, cfg: ModelConfig, adapter_dir: str) -> Params:
     """Merge a PEFT LoRA adapter into the dense weights: W += s·B@A.
 
     Merge-at-load serves a finetuned adapter at full base-model speed
     (zero runtime cost, works under TP sharding and int8 quantization
-    since both happen downstream).  The reference's stack gets adapters
-    through vLLM's LoRA support; per-request adapter multiplexing is out
-    of scope — one adapter per engine.
+    since both happen downstream).  For per-request adapter multiplexing
+    see :func:`load_lora_stack`.
 
     Raises on adapter keys that target modules this loader can't map —
     silently dropping part of an adapter would serve wrong weights.
     """
-    import re
-    raw, scaling = _read_lora_adapter(adapter_dir)
-    pairs: dict[tuple[int, str], dict[str, jnp.ndarray]] = {}
-    for key, tensor in raw.items():
-        m = re.search(r"layers\.(\d+)\.([a-z_.0-9]+)\.lora_(A|B)\.weight$",
-                      key)
-        if m is None:
-            raise ValueError(f"unsupported LoRA adapter key {key!r}")
-        li, module, ab = int(m.group(1)), m.group(2), m.group(3)
-        if module not in _LORA_MODULES:
-            raise ValueError(f"LoRA target module {module!r} not supported "
-                             f"(key {key!r})")
-        if li >= cfg.num_layers:
-            raise ValueError(f"LoRA key {key!r} targets layer {li} but the "
-                             f"model has {cfg.num_layers}")
-        pairs.setdefault((li, module), {})[ab] = jnp.asarray(
-            tensor, dtype=jnp.float32)
-    if not pairs:
-        raise ValueError(f"adapter at {adapter_dir} contained no LoRA pairs")
-
-    # Phase 1 — validate EVERYTHING (pairs complete, targets exist and are
-    # unquantized, shapes line up) before touching a single weight: a
-    # failure mid-merge would leave the caller's pytree half-merged.
-    plan = []                  # (li, [(param_key, col_lo, col_hi)], delta)
-    for (li, module), ab in sorted(pairs.items()):
-        if "A" not in ab or "B" not in ab:
-            raise ValueError(f"LoRA pair for layer {li} {module} is missing "
-                             f"lora_{'A' if 'A' not in ab else 'B'}")
-        target = _LORA_MODULES[module]
-        splits = (target(cfg) if callable(target)
-                  else [(target, None)])
-        # HF shapes: A (r, in), B (out, r); our kernel is (in, out)
-        delta = (ab["A"].T @ ab["B"].T) * scaling
-        lp = params["layers"][li]
-        col = 0
-        spans = []
-        for pk, width in splits:
-            if pk not in lp or "kernel" not in lp[pk]:
-                raise ValueError(f"model has no dense {pk} in layer {li} "
-                                 "(MoE expert linears are not LoRA targets)")
-            if "scale" in lp[pk]:
-                raise ValueError(
-                    "cannot merge LoRA into already-quantized weights; "
-                    "load the bf16 checkpoint and quantize after")
-            kernel = lp[pk]["kernel"]
-            w = kernel.shape[1] if width is None else width
-            spans.append((pk, col, col + w))
-            if kernel.shape != (delta.shape[0], w):
-                raise ValueError(
-                    f"LoRA delta shape {delta.shape} does not match weight "
-                    f"shape {kernel.shape} for layer {li} {pk}")
-            col += w
-        if col != delta.shape[1]:
+    factors = _parse_lora_factors(params, cfg, adapter_dir)
+    # validate the merge targets BEFORE touching a weight: a failure
+    # mid-merge would leave the caller's pytree half-merged
+    for li, pk, _, _ in factors:
+        if "scale" in params["layers"][li][pk]:
             raise ValueError(
-                f"LoRA delta shape {delta.shape} does not match fused "
-                f"projection width {col} for layer {li} {module}")
-        plan.append((li, spans, delta))
-
-    # Phase 2 — merge.
-    for li, spans, delta in plan:
+                "cannot merge LoRA into already-quantized weights; "
+                "load the bf16 checkpoint and quantize after")
+    for li, pk, A, B in factors:
         lp = params["layers"][li]
-        for pk, lo, hi in spans:
-            kernel = lp[pk]["kernel"]
-            lp[pk]["kernel"] = (kernel.astype(jnp.float32)
-                                + delta[:, lo:hi]).astype(kernel.dtype)
+        kernel = lp[pk]["kernel"]
+        # A @ B[:, lo:hi] == (A @ B)[:, lo:hi] bitwise — columns of a
+        # matmul are independent — so the factor form merges identically
+        lp[pk]["kernel"] = (kernel.astype(jnp.float32)
+                            + jnp.asarray(A @ B)).astype(kernel.dtype)
     return params
+
+
+def load_lora_stack(params: Params, cfg: ModelConfig,
+                    adapters: "dict[str, str]") -> list:
+    """Load MULTIPLE PEFT adapters for per-request multiplexing.
+
+    vLLM's multi-LoRA serving (punica SGMV kernels batching rows of
+    different adapters) is the delegated analog; the TPU-native form is
+    pure einsum: each targeted linear gains a ``lora`` sub-dict of
+    STACKED low-rank factors — A (n, in, r_max), B (n, r_max, out) with
+    the PEFT scaling folded into B and short-rank adapters zero-padded —
+    and the per-row one-hot adapter weights contract against the stack at
+    runtime (models/transformer._lora_delta).  A base-model row is an
+    all-zero one-hot: it reads the stack but adds exactly nothing, so
+    mixed batches need no gather/scatter, branches, or ragged shapes —
+    the XLA-friendly dense-dispatch idiom also used for MoE experts.
+
+    Unlike :func:`apply_lora` (merge-at-load, one adapter, zero runtime
+    cost) this composes with int8 base weights: the delta applies after
+    the dequantizing matmul.  Returns the adapter names in index order;
+    mutates ``params`` in place.
+    """
+    import numpy as np
+    names = list(adapters)
+    if not names:
+        raise ValueError("load_lora_stack needs at least one adapter")
+    # (li, pk) -> per-adapter {idx: (A (in, r), B (r, w))}
+    factors: dict[tuple[int, str], dict[int, tuple]] = {}
+    for idx, (name, adapter_dir) in enumerate(adapters.items()):
+        for li, pk, A, B in _parse_lora_factors(params, cfg, adapter_dir,
+                                                label=name):
+            factors.setdefault((li, pk), {})[idx] = (A, B)
+
+    dtype = jnp.dtype(cfg.dtype)
+    n = len(names)
+    for (li, pk), per in factors.items():
+        lp = params["layers"][li]
+        in_f = lp[pk]["kernel"].shape[0]
+        w = per[next(iter(per))][1].shape[1]
+        r_max = max(a.shape[1] for a, _ in per.values())
+        A_st = np.zeros((n, in_f, r_max), np.float32)
+        B_st = np.zeros((n, r_max, w), np.float32)
+        for idx, (A, B) in per.items():
+            A_st[idx, :, :A.shape[1]] = A
+            B_st[idx, :B.shape[0], :] = B
+        lp[pk]["lora"] = {"A": jnp.asarray(A_st, dtype),
+                          "B": jnp.asarray(B_st, dtype)}
+    return names
 
 
 # --------------------------------------------------------------------------

@@ -114,6 +114,13 @@ class DisaggregatedEngine:
     def __init__(self, prefill_config: EngineConfig, decode_config: EngineConfig,
                  decode_device=None, mesh=None):
         import dataclasses as _dc
+        if prefill_config.lora_modules or decode_config.lora_modules:
+            # the migrated Request doesn't carry adapter_idx, and the two
+            # pools' adapter banks could differ — decode would silently
+            # run base weights on adapter KV
+            raise ValueError("multi-LoRA (lora_modules) is not supported "
+                             "on disaggregated topologies; use "
+                             "merge-at-load lora_dir")
         if mesh is not None and mesh.shape.get("pp", 1) > 1:
             # extract_seq_kv / insert_seq_kv move per-layer page lists; the
             # pipeline engine's cache is stage-stacked — fail at pair

@@ -162,6 +162,10 @@ class PrefillHandoffEngine:
             # engine's is stage-stacked (see parallel/disagg.py guard)
             raise ValueError("the prefill pool cannot run on a pipeline "
                              "(pp) mesh; use tp or plain engines")
+        if engine_config.lora_modules:
+            raise ValueError("multi-LoRA is not supported on disaggregated "
+                             "topologies (adapter identity doesn't "
+                             "migrate); use merge-at-load lora_dir")
         # never window-release on the prefill side: migration ships
         # block_table() pages (see parallel/disagg.py for the full story)
         engine_config = _dc.replace(engine_config, window_release=False)

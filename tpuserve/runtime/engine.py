@@ -50,6 +50,11 @@ class EngineConfig:
     # (models/weights.py apply_lora) — full base-model speed, one adapter
     # per engine
     lora_dir: Optional[str] = None
+    # Multi-LoRA serving (vLLM --lora-modules): {name: adapter_dir} loaded
+    # as STACKED low-rank factors (weights.load_lora_stack); requests pick
+    # an adapter by name and mixed batches contract per-row one-hot
+    # weights against the stack — no merge, composes with int8
+    lora_modules: Optional[dict] = None
     # Weight-only quantization: "int8" halves the per-step HBM weight
     # traffic that bounds decode throughput (models/weights.py
     # quantize_params_int8).  None = full precision.
@@ -213,6 +218,31 @@ class Engine:
             from tpuserve.models.weights import quantize_params_int8
             if "scale" not in params["embed"]:    # not already quantized
                 params = quantize_params_int8(params)
+        self._lora_names: Optional[list] = None
+        if config.lora_modules:
+            # after quantization on purpose: the stacked deltas apply
+            # AFTER the dequantizing matmul, so int8 base + bf16 adapters
+            # compose (unlike apply_lora's merge)
+            if jax.process_count() > 1:
+                raise ValueError("multi-LoRA serving is single-process "
+                                 "(the lockstep protocol doesn't broadcast "
+                                 "adapter weights)")
+            if mesh is not None:
+                raise ValueError("multi-LoRA with a tp/pp mesh is not "
+                                 "supported yet (the stacked factors have "
+                                 "no shardings); use merge-at-load "
+                                 "lora_dir under TP")
+            if config.speculative:
+                raise ValueError("multi-LoRA cannot combine with "
+                                 "speculative decoding (the verify trunk "
+                                 "doesn't thread adapter weights)")
+            from tpuserve.models.weights import load_lora_stack
+            self._lora_names = load_lora_stack(params, self.model_cfg,
+                                               config.lora_modules)
+            self._lora_index = {n: i for i, n in
+                                enumerate(self._lora_names)}
+            logger.info("loaded %d LoRA adapter(s): %s",
+                        len(self._lora_names), self._lora_names)
         self.params = params
         if self.cache_cfg.num_blocks == 0:
             # vLLM gpu_memory_utilization analog: size the KV cache to
@@ -285,9 +315,17 @@ class Engine:
                     "(%d %% %d); falling back to reference attention",
                     self.model_cfg.num_kv_heads, mesh.shape.get(AXIS_TP, 1))
                 self.attn_impl = "reference"
+        prefix_caching = config.enable_prefix_caching
+        if prefix_caching and config.lora_modules:
+            # cached KV is adapter-specific: a base-model prefix hit reused
+            # for an adapter request (or across adapters) would serve KV
+            # computed under different weights
+            logger.info("multi-LoRA: prefix caching disabled (cached KV "
+                        "is adapter-specific)")
+            prefix_caching = False
         self.block_manager = create_block_manager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
-            enable_prefix_caching=config.enable_prefix_caching)
+            enable_prefix_caching=prefix_caching)
         sched_cfg = config.scheduler
         if self._pp > 1 and sched_cfg.allow_chunked_prefill:
             # the pipelined trunk has no chunked-prefill path; the flag
@@ -425,8 +463,18 @@ class Engine:
     def add_request(self, prompt: str | None = None,
                     prompt_token_ids: Optional[Sequence[int]] = None,
                     params: Optional[SamplingParams] = None,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    adapter: Optional[str] = None) -> str:
         params = params or SamplingParams()
+        adapter_idx = None
+        if adapter is not None:
+            if not self._lora_names:
+                raise ValueError(f"adapter {adapter!r} requested but no "
+                                 "lora_modules are loaded")
+            adapter_idx = self._lora_index.get(adapter)
+            if adapter_idx is None:
+                raise ValueError(f"unknown adapter {adapter!r}; loaded: "
+                                 f"{self._lora_names}")
         if prompt_token_ids is None:
             if prompt is None:
                 raise ValueError("need prompt or prompt_token_ids")
@@ -490,7 +538,7 @@ class Engine:
                     "logprobs cannot be combined with response_format")
             self._guided[request_id] = self._make_guided(params)
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
-                      params=params, prompt=prompt)
+                      params=params, prompt=prompt, adapter_idx=adapter_idx)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
         self.requests[request_id] = req
         if self._adaptive_window and (self.scheduler.running
@@ -697,7 +745,19 @@ class Engine:
     # hook; tests/test_multihost.py asserts that by AST so a new call site
     # can't silently bypass the lockstep protocol (the round-1 deadlock).
 
-    def _exec_prefill(self, tokens, prompt_lens, slot_ids):
+    def _lora_ad(self, reqs: list, B: int) -> "Optional[jnp.ndarray]":
+        """Per-row one-hot adapter weights (B, n) for a batch — None when
+        no adapter stack is loaded (the transformer then compiles without
+        the lora contraction at all).  Padding/base rows are all-zero."""
+        if not self._lora_names:
+            return None
+        ad = np.zeros((B, len(self._lora_names)), np.float32)
+        for i, r in enumerate(reqs):
+            if r.adapter_idx is not None:
+                ad[i, r.adapter_idx] = 1.0
+        return jnp.asarray(ad)
+
+    def _exec_prefill(self, tokens, prompt_lens, slot_ids, ad=None):
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_prefill
             return pp_prefill(self._pp_head, self._pp_stages, self.model_cfg,
@@ -705,9 +765,11 @@ class Engine:
                               mesh=self.mesh)
         return transformer.prefill(
             self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
-            self.kv_cache, attn_impl=self.attn_impl, mesh=self._attn_mesh)
+            self.kv_cache, ad, attn_impl=self.attn_impl,
+            mesh=self._attn_mesh)
 
-    def _exec_decode(self, tokens, positions, slot_ids, block_tables, seq_lens):
+    def _exec_decode(self, tokens, positions, slot_ids, block_tables,
+                     seq_lens, ad=None):
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_decode_step
             return pp_decode_step(self._pp_head, self._pp_stages,
@@ -716,17 +778,17 @@ class Engine:
                                   self.kv_cache, mesh=self.mesh)
         return transformer.decode_step(
             self.params, self.model_cfg, tokens, positions, slot_ids,
-            block_tables, seq_lens, self.kv_cache, attn_impl=self.attn_impl,
-            mesh=self._attn_mesh)
+            block_tables, seq_lens, self.kv_cache, ad,
+            attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
-                            block_tables):
+                            block_tables, ad=None):
         if self._pp > 1:            # unreachable: gated at add_request
             raise RuntimeError("chunked prefill is not supported on the "
                                "pipeline engine")
         return transformer.prefill_chunk(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
-            slot_ids, block_tables, self.kv_cache,
+            slot_ids, block_tables, self.kv_cache, ad,
             attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_decode_verify(self, tokens, ctx_lens, chunk_lens, slot_ids,
@@ -742,12 +804,13 @@ class Engine:
             slot_ids, block_tables, self.kv_cache)
 
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
-                           active, keys, temperature, *, steps, mode):
+                           active, keys, temperature, *, steps, mode,
+                           ad=None):
         return transformer.decode_multi(
             self.params, self.model_cfg, tokens, positions, block_tables,
-            seq_lens, active, keys, temperature, self.kv_cache, steps=steps,
-            mode=mode, attn_impl=self.attn_impl, mesh=self._attn_mesh,
-            out_mesh=self.mesh)
+            seq_lens, active, keys, temperature, self.kv_cache, ad,
+            steps=steps, mode=mode, attn_impl=self.attn_impl,
+            mesh=self._attn_mesh, out_mesh=self.mesh)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
                      min_p=None, mode):
@@ -771,9 +834,11 @@ class Engine:
             prompt_lens[i] = len(ids)
             slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
                                                        len(ids))
+        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
+              else {})
         logits, self.kv_cache = self._exec_prefill(
             jnp.asarray(tokens), jnp.asarray(prompt_lens),
-            jnp.asarray(slot_ids))
+            jnp.asarray(slot_ids), **kw)
         self.scheduler.mark_running(reqs)
         self.stats.num_prefill_steps += 1
         new_tokens = self._sample(logits, reqs, B)
@@ -834,11 +899,13 @@ class Engine:
         block_tables = np.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
         block_tables[0, :len(bt)] = bt
+        kw = ({"ad": self._lora_ad([req], 1)} if self._lora_names
+              else {})
         logits, self.kv_cache = self._exec_prefill_chunk(
             jnp.asarray(tokens),
             jnp.asarray(np.asarray([done], np.int32)),
             jnp.asarray(np.asarray([n], np.int32)),
-            jnp.asarray(slot_ids), jnp.asarray(block_tables))
+            jnp.asarray(slot_ids), jnp.asarray(block_tables), **kw)
         req.num_prefilled = done + n
         self.stats.num_prefill_steps += 1
         if req.num_prefilled < len(ids):
@@ -938,11 +1005,13 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
+        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
+              else {})
         toks, self.kv_cache = self._exec_decode_multi(
             tokens, jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(active), jnp.asarray(keys),
-            jnp.asarray(temperature), steps=S, mode=mode)
+            jnp.asarray(temperature), steps=S, mode=mode, **kw)
         self.stats.num_decode_steps += S
         if S < self._multi_step:
             # counted at the dispatch, not in _window_steps(): eligibility
@@ -1084,9 +1153,11 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
+        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
+              else {})
         logits, self.kv_cache = self._exec_decode(
             tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens))
+            jnp.asarray(block_tables), jnp.asarray(seq_lens), **kw)
         self.stats.num_decode_steps += 1
         if pipeline_ok:
             if any(r.params.needs_logit_bias for r in reqs):
@@ -1678,7 +1749,10 @@ class Engine:
                 tokens = jnp.zeros((B, L), jnp.int32)
                 lens = jnp.ones((B,), jnp.int32)
                 slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
-                logits, self.kv_cache = self._exec_prefill(tokens, lens, slots)
+                wkw = ({"ad": jnp.zeros((B, len(self._lora_names)))}
+                       if self._lora_names else {})
+                logits, self.kv_cache = self._exec_prefill(tokens, lens,
+                                                           slots, **wkw)
                 self._warm_sampling(logits, sample_modes)
             for B in decode_buckets:
                 tokens = jnp.zeros((B,), jnp.int32)
@@ -1686,8 +1760,10 @@ class Engine:
                 slots = jnp.full((B,), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
                 seq_lens = jnp.ones((B,), jnp.int32)
+                wkw = ({"ad": jnp.zeros((B, len(self._lora_names)))}
+                       if self._lora_names else {})
                 logits, self.kv_cache = self._exec_decode(
-                    tokens, positions, slots, bt, seq_lens)
+                    tokens, positions, slots, bt, seq_lens, **wkw)
                 self._warm_sampling(logits, sample_modes)
                 if self._multi_step > 1:
                     # the windowed executable is the steady-state decode
@@ -1707,7 +1783,7 @@ class Engine:
                         for steps in sorted(sizes):
                             _, self.kv_cache = self._exec_decode_multi(
                                 tokens, positions, bt, seq_lens, active,
-                                keys, temp, steps=steps, mode=mode)
+                                keys, temp, steps=steps, mode=mode, **wkw)
                 if self._pipeline_decode:
                     # the pipelined paths chain steps/windows through
                     # _select_tokens; left cold, its (tiny) compile stalls
@@ -1744,9 +1820,11 @@ class Engine:
                 slots = jnp.full((1, C), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                jnp.int32)
+                ckw = ({"ad": jnp.zeros((1, len(self._lora_names)))}
+                       if self._lora_names else {})
                 logits, self.kv_cache = self._exec_prefill_chunk(
                     tokens, jnp.zeros((1,), jnp.int32),
-                    jnp.ones((1,), jnp.int32), slots, bt)
+                    jnp.ones((1,), jnp.int32), slots, bt, **ckw)
                 self._warm_sampling(logits, sample_modes)
         if embed_buckets:
             if self._pp > 1:

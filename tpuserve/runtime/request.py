@@ -144,6 +144,9 @@ class Request:
     # chunked prefill progress: prompt tokens already written to the cache
     # (reset on preemption along with the cache itself)
     num_prefilled: int = 0
+    # multi-LoRA: index into the engine's loaded adapter stack
+    # (weights.load_lora_stack); None = base model
+    adapter_idx: Optional[int] = None
 
     @property
     def num_prompt_tokens(self) -> int:

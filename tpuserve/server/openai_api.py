@@ -188,6 +188,10 @@ class OpenAIServer:
                 getattr(engine, "prefill", None)
             model_name = getattr(getattr(cfg_owner, "config", None), "model", "model")
         self.model_name = model_name
+        # multi-LoRA adapter names (engine._lora_names; disagg facades
+        # expose the prefill engine's) — routed by the request's "model"
+        base_eng = getattr(engine, "prefill", engine)
+        self.lora_names = list(getattr(base_eng, "_lora_names", None) or [])
         self.metrics = metrics or ServerMetrics(model_name)
         self.runner = AsyncEngineRunner(engine, self.metrics)
         self.engine = engine
@@ -410,12 +414,19 @@ class _Handler(BaseHTTPRequestHandler):
                                    getattr(ctx.engine, "decode", None))
                        if e is not None] or [ctx.engine]
             eng = engines[0]
-            self._json(200, {"object": "list", "data": [{
+            now = int(time.time())
+            data = [{
                 "id": ctx.model_name, "object": "model",
-                "created": int(time.time()), "owned_by": "tpuserve",
+                "created": now, "owned_by": "tpuserve",
                 "max_model_len": min(e.max_seq_len for e in engines),
                 "quantization": eng.config.quantization,
-                "kv_cache_dtype": eng.cache_cfg.dtype}]})
+                "kv_cache_dtype": eng.cache_cfg.dtype}]
+            # loaded LoRA adapters serve as selectable models (vLLM's
+            # --lora-modules listing: parent links the base)
+            data += [{"id": name, "object": "model", "created": now,
+                      "owned_by": "tpuserve", "parent": ctx.model_name}
+                     for name in ctx.lora_names]
+            self._json(200, {"object": "list", "data": data})
         elif self.path == "/metrics":
             data = ctx.metrics.render()
             self.send_response(200)
@@ -481,6 +492,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
                   else {"prompt": prompt})
+        # multi-LoRA routing (vLLM semantics): "model" naming a loaded
+        # adapter selects it; the base model name (or anything else, for
+        # compat with clients that send their own aliases) serves base
+        adapter = body.get("model")
+        if (isinstance(adapter, str) and adapter != self.ctx.model_name
+                and adapter in (self.ctx.lora_names or ())):
+            kwargs["adapter"] = adapter
         from tpuserve.server.tracing import get_tracer
         try:
             with get_tracer().request_span(
@@ -612,6 +630,14 @@ class _Handler(BaseHTTPRequestHandler):
         eng = getattr(ctx.engine, "prefill", None) or ctx.engine
         try:
             body = self._read_body()
+            if body.get("model") in (ctx.lora_names or ()):
+                # /v1/models advertises adapters, but the embed trunk has
+                # no adapter threading — a silent base-model 200 would be
+                # wrong vectors for a listed model id
+                raise ValueError(
+                    f"model {body.get('model')!r} is a LoRA adapter; "
+                    "embeddings are served by the base model only — "
+                    f"use model={ctx.model_name!r}")
             raw = body.get("input")
             if isinstance(raw, str):
                 inputs = [raw]
@@ -757,6 +783,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _full_response(self, body, params, chat, kwargs, n=1, toolctx=None,
                        best_of=None):
         ctx = self.ctx
+        # multi-LoRA: echo the ADAPTER id the request selected (vLLM
+        # does); mixed-adapter traffic is otherwise unattributable
+        served = kwargs.get("adapter") or ctx.model_name
         t0 = time.monotonic()
         # best_of > n: sample best_of candidates and keep the top n by
         # cumulative logprob (OpenAI completions semantics; vLLM ranking).
@@ -855,11 +884,12 @@ class _Handler(BaseHTTPRequestHandler):
         }
         obj = "chat.completion" if chat else "text_completion"
         self._json(200, {"id": oid, "object": obj, "created": int(time.time()),
-                         "model": ctx.model_name, "choices": choices,
+                         "model": served, "choices": choices,
                          "usage": usage})
 
     def _stream_response(self, body, params, chat, kwargs, n=1, toolctx=None):
         ctx = self.ctx
+        served = kwargs.get("adapter") or ctx.model_name
         # vLLM-compatible extension: carry each chunk's token ids so
         # clients (and the load harness) can count tokens exactly — chunk
         # count != token count under fused multi-step decode.
@@ -912,7 +942,7 @@ class _Handler(BaseHTTPRequestHandler):
             if chat:
                 for i in range(n):
                     chunk = {"id": oid, "object": "chat.completion.chunk",
-                             "model": ctx.model_name,
+                             "model": served,
                              "choices": [{"index": i,
                                           "delta": {"role": "assistant"},
                                           "finish_reason": None}]}
@@ -932,7 +962,7 @@ class _Handler(BaseHTTPRequestHandler):
                         choice["token_ids"] = []
                     chunk = {"id": oid, "object": "text_completion",
                              "created": int(time.time()),
-                             "model": ctx.model_name,
+                             "model": served,
                              "choices": [choice]}
                     if include_usage:
                         chunk["usage"] = None
@@ -1009,7 +1039,7 @@ class _Handler(BaseHTTPRequestHandler):
                 prompt_toks = item.num_prompt_tokens
                 chunk = {"id": oid, "object": obj,
                          "created": int(time.time()),
-                         "model": ctx.model_name, "choices": [choice]}
+                         "model": served, "choices": [choice]}
                 if include_usage:
                     chunk["usage"] = None     # OpenAI: null until the final chunk
                 send_chunk(chunk)
@@ -1018,7 +1048,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # finish_reason (the content chunk above sent None)
                     tchunk = {"id": oid, "object": obj,
                               "created": int(time.time()),
-                              "model": ctx.model_name,
+                              "model": served,
                               "choices": [{"index": idx,
                                            "delta": {"tool_calls": tc_deltas},
                                            "finish_reason": finish}]}
@@ -1033,7 +1063,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "object": ("chat.completion.chunk" if chat
                                        else "text_completion"),
                             "created": int(time.time()),
-                            "model": ctx.model_name, "choices": [],
+                            "model": served, "choices": [],
                             "usage": {
                                 "prompt_tokens": prompt_toks,
                                 "completion_tokens": completion_toks,
@@ -1126,6 +1156,13 @@ def main(argv=None):
                     help="PEFT LoRA adapter directory merged into the "
                          "weights at load (one adapter per engine, zero "
                          "runtime cost)")
+    ap.add_argument("--lora-modules", default=None, nargs="+",
+                    metavar="NAME=DIR",
+                    help="multi-LoRA serving (vLLM flag): load adapters as "
+                         "a stacked bank; requests select one by sending "
+                         "its NAME as the 'model' field, mixed-adapter "
+                         "batches run in one dispatch; composes with "
+                         "--quantization int8")
     ap.add_argument("--quantization", default=None, choices=["int8"],
                     help="weight-only quantization (int8 halves decode's "
                          "HBM weight traffic)")
@@ -1151,9 +1188,24 @@ def main(argv=None):
     if args.speculative_k > 0:
         from tpuserve.runtime.spec import SpecConfig
         spec = SpecConfig(num_draft_tokens=args.speculative_k)
+    lora_modules = None
+    if args.lora_modules:
+        lora_modules = {}
+        for spec_str in args.lora_modules:
+            name, sep, path = spec_str.partition("=")
+            if not sep or not name or not path:
+                ap.error(f"--lora-modules entries must be NAME=DIR, got "
+                         f"{spec_str!r}")
+            if name == args.model:
+                ap.error(f"adapter name {name!r} collides with the base "
+                         "model name")
+            if name in lora_modules:
+                ap.error(f"duplicate adapter name {name!r} in "
+                         "--lora-modules")
+            lora_modules[name] = path
     ecfg = EngineConfig(
         model=args.model, checkpoint_dir=args.checkpoint_dir,
-        lora_dir=args.lora,
+        lora_dir=args.lora, lora_modules=lora_modules,
         cache=CacheConfig(block_size=args.block_size,
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq,

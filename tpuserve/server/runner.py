@@ -38,6 +38,7 @@ class _Submit:
     rid_event: threading.Event
     request_id: Optional[str] = None
     assigned_id: Optional[str] = None
+    adapter: Optional[str] = None     # multi-LoRA adapter name
 
 
 @dataclasses.dataclass
@@ -99,6 +100,7 @@ class AsyncEngineRunner:
                prompt_token_ids: Optional[Sequence[int]] = None,
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
+               adapter: Optional[str] = None,
                ) -> tuple[str, "queue.Queue[RequestOutput | Exception | None]"]:
         """Enqueue a request; returns (request_id, output queue).  The queue
         yields RequestOutput items, then None when finished; an Exception
@@ -107,7 +109,7 @@ class AsyncEngineRunner:
                       prompt_token_ids=list(prompt_token_ids) if prompt_token_ids else None,
                       params=params or SamplingParams(),
                       out_queue=queue.Queue(), rid_event=threading.Event(),
-                      request_id=request_id)
+                      request_id=request_id, adapter=adapter)
         self._intake.put(sub)
         self._wake.set()
         sub.rid_event.wait(timeout=60)
@@ -192,9 +194,10 @@ class AsyncEngineRunner:
                 msg.rid_event.set()
                 continue
             try:
+                kw = {"adapter": msg.adapter} if msg.adapter else {}
                 rid = self.engine.add_request(
                     prompt=msg.prompt, prompt_token_ids=msg.prompt_token_ids,
-                    params=msg.params, request_id=msg.request_id)
+                    params=msg.params, request_id=msg.request_id, **kw)
             except Exception as e:           # invalid request: report, don't die
                 msg.assigned_id = msg.request_id or "rejected"
                 msg.rid_event.set()
