@@ -191,3 +191,29 @@ def test_priority_never_jumps_preempted_midstream_request():
         sched.add(Request(request_id=f"vip{i}", prompt_token_ids=[1],
                           params=SamplingParams(priority=-1)))
     assert sched.waiting[0].request_id == "victim"
+
+
+def test_admission_backpressure_cap():
+    import pytest
+    """Scheduler.add rejects past max_waiting (MemoryError -> the API's
+    503); preemption re-entry (appendleft) bypasses the cap — running
+    work is never dropped for queue pressure."""
+    from tpuserve.runtime.block_manager import create_block_manager
+    from tpuserve.runtime.request import Request, SamplingParams
+    from tpuserve.runtime.scheduler import Scheduler, SchedulerConfig
+    cfg = SchedulerConfig(max_num_seqs=4, max_waiting=2)
+    sched = Scheduler(cfg, create_block_manager(16, 4), max_model_len=64)
+
+    def req(i):
+        return Request(request_id=f"r{i}", prompt_token_ids=[1, 2],
+                       params=SamplingParams(max_tokens=4))
+    sched.add(req(0))
+    sched.add(req(1))
+    with pytest.raises(MemoryError, match="waiting queue full"):
+        sched.add(req(2))
+    # preempted work re-enters at the head regardless of the cap
+    sched.waiting.appendleft(req(3))
+    assert sched.num_waiting == 3
+    # auto default: 4x max_num_seqs; negative disables
+    assert SchedulerConfig(max_num_seqs=8).resolve_max_waiting() == 32
+    assert SchedulerConfig(max_waiting=-1).resolve_max_waiting() >= 1 << 29

@@ -501,3 +501,44 @@ def test_suffix_rejected(server):
             "max_tokens": 2})
     assert ei.value.code == 400
     assert "suffix" in json.loads(ei.value.read())["error"]["message"]
+
+
+def test_backpressure_maps_to_503(server):
+    """An intake MemoryError (scheduler max_waiting) surfaces as a
+    retryable 503, not a 500 — gateways use it for flow control."""
+    # simulate a full queue at the engine boundary
+    import tpuserve.runtime.engine as engine_mod
+    orig = engine_mod.Engine.add_request
+
+    def full(self, *a, **kw):
+        raise MemoryError("waiting queue full (test)")
+    engine_mod.Engine.add_request = full
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2})
+        assert ei.value.code == 503
+        assert "queue full" in json.loads(
+            ei.value.read())["error"]["message"]
+    finally:
+        engine_mod.Engine.add_request = orig
+
+
+def test_backpressure_streaming_gets_real_503(server):
+    """Streamed requests hold the 200 until the first engine item, so an
+    intake rejection surfaces as a real 503 status — not an SSE error
+    chunk inside a 200 that gateways can't act on."""
+    import tpuserve.runtime.engine as engine_mod
+    orig = engine_mod.Engine.add_request
+
+    def full(self, *a, **kw):
+        raise MemoryError("waiting queue full (test)")
+    engine_mod.Engine.add_request = full
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+                "stream": True})
+        assert ei.value.code == 503
+    finally:
+        engine_mod.Engine.add_request = orig

@@ -541,13 +541,25 @@ class Engine:
                       params=params, prompt=prompt, adapter_idx=adapter_idx)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
         self.requests[request_id] = req
+        try:
+            self.scheduler.add(req)
+        except MemoryError:
+            # backpressure rejection must not leak the half-registered
+            # request record
+            self.requests.pop(request_id, None)
+            self._detok.pop(request_id, None)
+            self._guided.pop(request_id, None)
+            raise
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
             # an arrival into a BUSY engine predicts more: shrink the next
             # windows so arrivals stop waiting out a full fused window.
-            # Burst admission into an idle engine doesn't trip this.
+            # Burst admission into an idle engine doesn't trip this —
+            # and neither does a BACKPRESSURE-REJECTED arrival (stamped
+            # only after scheduler.add succeeds): a retry flood against a
+            # full queue must not pin running streams at min_multi_step
+            # exactly when max throughput would drain the queue fastest.
             self._last_busy_arrival = time.monotonic()
-        self.scheduler.add(req)
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
 

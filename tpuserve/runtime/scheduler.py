@@ -37,6 +37,17 @@ class SchedulerConfig:
     # of chunking, and prefix-cache hits never chunk by choice.  All three
     # chunk routes check this flag, so "off" is a guarantee, not a default.
     allow_chunked_prefill: bool = True
+    # Admission backpressure: new requests beyond this many waiting are
+    # rejected (MemoryError -> HTTP 503) instead of growing host-side
+    # queue state without bound under a flood.  0 = auto (4x
+    # max_num_seqs); negative disables the cap.  Preemption re-entries
+    # bypass it — running work must never be dropped for queue pressure.
+    max_waiting: int = 0
+
+    def resolve_max_waiting(self) -> int:
+        if self.max_waiting < 0:
+            return 1 << 30
+        return self.max_waiting or 4 * self.max_num_seqs
     # Also run one decode step after every BATCHED prefill (not just
     # chunked ones): under sustained arrivals, strict prefill-priority
     # stalls every running stream for the whole admission burst — this
@@ -79,8 +90,14 @@ class Scheduler:
         """Queue for admission.  FIFO within a priority level; a request
         with a LOWER ``params.priority`` value is admitted sooner (vLLM
         priority semantics).  Preempted requests re-enter at the queue
-        head regardless (appendleft at the call sites) — resuming holds
-        its own priority: their KV was already paid for once."""
+        head regardless (appendleft at the call sites, which also bypasses
+        the backpressure cap) — resuming holds its own priority: their KV
+        was already paid for once."""
+        if len(self.waiting) >= self.cfg.resolve_max_waiting():
+            raise MemoryError(
+                f"waiting queue full ({len(self.waiting)} requests); "
+                "retry later or add replicas (backpressure — the engine "
+                "bounds host-side queue state)")
         pr = req.params.priority
         if not self.waiting or self.waiting[-1].params.priority <= pr:
             self.waiting.append(req)         # common case: same priority

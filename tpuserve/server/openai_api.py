@@ -828,6 +828,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if isinstance(item, Exception):
                     if isinstance(item, ValueError):   # rejected at intake
                         fail(400, str(item))
+                    elif isinstance(item, MemoryError):
+                        # admission backpressure (scheduler max_waiting):
+                        # retryable, not a server fault
+                        fail(503, str(item), "server_error")
                     else:                              # engine-side fault
                         fail(500, str(item), "server_error")
                     return
@@ -896,6 +900,39 @@ class _Handler(BaseHTTPRequestHandler):
         ret_ids = bool(body.get("return_token_ids"))
         submits = self._submit_choices(params, kwargs, n)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+
+        def abort_all():
+            for rid, _ in submits:
+                ctx.runner.abort(rid)
+
+        # HOLD the 200 until choice 0 produces its first item: an intake
+        # rejection (400 validation, 503 backpressure) must surface as a
+        # real status line — a gateway doing flow control on 503s never
+        # sees an error that only exists as an SSE chunk inside a 200.
+        # Deferring headers to the first output costs nothing: the first
+        # byte a healthy stream can send is the first token anyway.
+        deadline = time.monotonic() + ctx.config.request_timeout_s
+        import queue as _queue
+        try:
+            first0 = submits[0][1].get(
+                timeout=max(deadline - time.monotonic(), 0.001))
+        except _queue.Empty:
+            abort_all()
+            for rid, _ in submits:
+                ctx.engine.requests.pop(rid, None)
+            self._error(504, "request timed out", "server_error")
+            return
+        if isinstance(first0, Exception):
+            abort_all()
+            for rid, _ in submits:
+                ctx.engine.requests.pop(rid, None)
+            if isinstance(first0, ValueError):
+                self._error(400, str(first0))
+            elif isinstance(first0, MemoryError):
+                self._error(503, str(first0), "server_error")
+            else:
+                self._error(500, str(first0), "server_error")
+            return
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -907,18 +944,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(hex(len(data))[2:].encode() + b"\r\n" + data + b"\r\n")
             self.wfile.flush()
 
-        def abort_all():
-            for rid, _ in submits:
-                ctx.runner.abort(rid)
-
         # n > 1: merge the per-choice output queues into one, tagged with
         # the choice index, so chunks interleave as they are produced (the
         # OpenAI streaming shape — each chunk carries its choice index).
-        import queue as _queue
+        # The held-back first item re-enters ahead of everything else.
         if n == 1:
             merged = None
         else:
             merged = _queue.Queue()
+            merged.put((0, first0))
             import threading as _threading
 
             def pump(idx, q):
@@ -930,8 +964,6 @@ class _Handler(BaseHTTPRequestHandler):
             for i, (_, q) in enumerate(submits):
                 _threading.Thread(target=pump, args=(i, q),
                                   daemon=True).start()
-
-        deadline = time.monotonic() + ctx.config.request_timeout_s
         try:
             # computed BEFORE any chunk goes out: with include_usage,
             # OpenAI sends "usage": null on EVERY non-final chunk — role
@@ -976,9 +1008,18 @@ class _Handler(BaseHTTPRequestHandler):
             filters = ([toolctx.stream_filter() for _ in range(n)]
                        if chat and toolctx is not None else None)
             live = n
+            # choice 0's first item was read before the headers; for n > 1
+            # it was re-injected into the merged queue instead.  Sentinel,
+            # not None: a first item of None (finish marker after an
+            # instant abort) must still be delivered, not dropped.
+            _consumed = object()
+            held = first0 if merged is None else _consumed
             while live:
                 try:
-                    if merged is None:
+                    if held is not _consumed:
+                        idx, item = 0, held
+                        held = _consumed
+                    elif merged is None:
                         idx, item = 0, submits[0][1].get(
                             timeout=max(deadline - time.monotonic(), 0.001))
                     else:
@@ -1102,6 +1143,10 @@ def main(argv=None):
                          "gpu_memory_utilization analog)")
     ap.add_argument("--max-blocks-per-seq", type=int, default=64)
     ap.add_argument("--max-num-seqs", type=int, default=64)
+    ap.add_argument("--max-waiting", type=int, default=0,
+                    help="admission backpressure: reject (HTTP 503) new "
+                         "requests beyond this many waiting (0 = auto, "
+                         "4x max-num-seqs; -1 disables)")
     ap.add_argument("--attn-impl", default="auto")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor parallel degree (0 = no mesh)")
@@ -1210,7 +1255,8 @@ def main(argv=None):
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq,
                           dtype=args.kv_cache_dtype),
-        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs),
+        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs,
+                                  max_waiting=args.max_waiting),
         attn_impl=args.attn_impl, speculative=spec,
         multi_step=args.multi_step, pipeline_decode=args.pipeline,
         adaptive_multi_step=not args.no_adaptive_window,
