@@ -1,0 +1,179 @@
+"""Prompt logprobs (OpenAI echo+logprobs / vLLM prompt_logprobs):
+transformer.score_prompt must match a full-logits forward pass exactly,
+Engine.score_prompts must shape/shift entries correctly (first token
+null), and the HTTP surface must serve echo+logprobs and the
+max_tokens=0 pure-scoring form."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.models import transformer
+from tpuserve.models.config import get_model_config
+from tpuserve.models.weights import init_params
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+
+CFG = get_model_config("tiny-qwen3")
+
+
+def test_score_prompt_matches_forward():
+    import dataclasses
+    # float32: two separately-jitted bf16 trunks fuse differently enough
+    # to shift logprobs ~1e-3, which is rounding, not a bug
+    cfg = dataclasses.replace(CFG, dtype="float32")
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(1)
+    B, T = 2, 16
+    tokens = rng.integers(1, cfg.vocab_size - 1, size=(B, T)).astype(np.int32)
+    lens = np.asarray([16, 11], np.int32)
+    chosen, top_ids, top_lps = transformer.score_prompt(
+        params, cfg, jnp.asarray(tokens), jnp.asarray(lens), top_n=3)
+    full = transformer.forward(params, cfg, jnp.asarray(tokens),
+                               jnp.asarray(lens))
+    lps = jax.nn.log_softmax(full, axis=-1)
+    for b in range(B):
+        for i in range(lens[b] - 1):
+            want = float(lps[b, i, tokens[b, i + 1]])
+            np.testing.assert_allclose(float(chosen[b, i]), want,
+                                       rtol=1e-5, atol=1e-5)
+            wt_l, wt_i = jax.lax.top_k(lps[b, i], 3)
+            np.testing.assert_array_equal(np.asarray(top_ids[b, i]),
+                                          np.asarray(wt_i))
+            np.testing.assert_allclose(np.asarray(top_lps[b, i]),
+                                       np.asarray(wt_l), rtol=1e-5,
+                                       atol=1e-5)
+
+
+def _engine():
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+def test_engine_score_prompts_entries():
+    eng = _engine()
+    prompts = [[5, 9, 12, 44, 7], [101, 55, 3]]
+    out = eng.score_prompts(prompts, top_n=2)
+    assert len(out) == 2
+    for ids, entries in zip(prompts, out):
+        assert [e["token_id"] for e in entries] == ids
+        assert entries[0]["logprob"] is None and entries[0]["top"] == []
+        for e in entries[1:]:
+            assert e["logprob"] is not None and e["logprob"] <= 0.0
+            assert len(e["top"]) == 2
+            # chosen logprob can't beat the top-1 alternative
+            assert e["logprob"] <= e["top"][0][1] + 1e-5
+    # batching path: mixed lengths grouped into one padded call must give
+    # the same numbers as one-at-a-time calls
+    solo = [eng.score_prompts([p], top_n=2)[0] for p in prompts]
+    for a, b in zip(out, solo):
+        for ea, eb in zip(a, b):
+            if ea["logprob"] is not None:
+                assert abs(ea["logprob"] - eb["logprob"]) < 1e-4
+
+
+def test_engine_score_validation():
+    eng = _engine()
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.score_prompts([[]])
+
+
+# ------------------------------------------------------------ HTTP edge
+
+@pytest.fixture(scope="module")
+def server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    srv = OpenAIServer(_engine(), ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_scoring_only(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [5, 9, 12, 44], "max_tokens": 0,
+        "echo": True, "logprobs": 2})
+    assert status == 200
+    c = body["choices"][0]
+    lp = c["logprobs"]
+    assert lp["tokens"] == [5, 9, 12, 44]
+    assert lp["token_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    assert body["usage"] == {"prompt_tokens": 4, "completion_tokens": 0,
+                             "total_tokens": 4}
+    assert c["finish_reason"] == "length"
+
+
+def test_http_echo_logprobs_covers_prompt_and_completion(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [5, 9, 12], "max_tokens": 3,
+        "temperature": 0, "echo": True, "logprobs": 1,
+        "ignore_eos": True})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 6                  # 3 prompt + 3 generated
+    assert lp["tokens"][:3] == [5, 9, 12]
+    assert lp["token_logprobs"][0] is None
+    assert all(v is not None for v in lp["token_logprobs"][1:])
+
+
+def test_http_scoring_validation(server):
+    for payload in (
+        {"max_tokens": 0},                              # no echo/logprobs
+        {"max_tokens": 0, "echo": True},                # no logprobs
+        {"max_tokens": 0, "echo": True, "logprobs": 1, "stream": True},
+        {"max_tokens": -1},
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "x", **payload})
+        assert ei.value.code == 400, payload
+
+
+def test_http_streaming_echo_logprobs_covers_prompt(server):
+    """Streamed echo+logprobs: the echo chunk carries the prompt's
+    logprob arrays (first entry null), aligning the stream's arrays with
+    the echoed tokens like the non-streaming response."""
+    req = urllib.request.Request(
+        server + "/v1/completions",
+        data=json.dumps({"model": "tiny-qwen3", "prompt": [5, 9, 12],
+                         "max_tokens": 2, "temperature": 0, "echo": True,
+                         "logprobs": 1, "stream": True,
+                         "ignore_eos": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    chunks = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunks.append(json.loads(line[6:]))
+    echo_chunk = chunks[0]
+    lp = echo_chunk["choices"][0]["logprobs"]
+    assert lp["tokens"] == [5, 9, 12]
+    assert lp["token_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    # completion chunks still stream their own incremental logprobs
+    # (text may be empty — random-weight ids decode to nothing — so key
+    # off the logprobs field itself)
+    gen_lp = [c["choices"][0]["logprobs"] for c in chunks[1:]
+              if c["choices"] and c["choices"][0].get("logprobs")]
+    assert gen_lp and all(
+        all(isinstance(v, float) for v in g["token_logprobs"])
+        for g in gen_lp)

@@ -379,6 +379,59 @@ def embed_forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
 
 
+@partial(jax.jit, static_argnames=("cfg", "top_n", "chunk"))
+def score_prompt(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                 prompt_lens: jnp.ndarray, *, top_n: int = 0,
+                 chunk: int = 16):
+    """Per-position prompt logprobs (OpenAI ``echo`` + ``logprobs``; the
+    vLLM ``prompt_logprobs`` surface — served by the stack the reference
+    deploys).
+
+    tokens: (B, T) right-padded, T a multiple of ``chunk``; prompt_lens:
+    (B,).  Runs the cache-less causal trunk, then scores the UNEMBED in
+    (B, chunk, V) slices — materialising all (B, T, V) float32 logits at
+    a 150k vocab would cost GBs for a page of text.  Returns
+    (chosen (B, T), top_ids (B, T, top_n), top_lps (B, T, top_n)) where
+    ``chosen[:, i]`` is log p(token_{i+1} | tokens_{<=i}) — callers shift
+    by one (the first prompt token has no conditional).
+    """
+    B, T = tokens.shape
+    positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+    h = _embed(params, cfg, tokens, positions)
+    scale = cfg.attn_scale
+    for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
+        hn = _norm(h, lp["attn_norm"], cfg)
+        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
+                                         sliding_window=sw,
+                                         logit_softcap=cfg.attn_logit_softcapping)
+        h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+        h = h + _mlp_residual(h, lp, cfg)
+    # next-token targets: position i scores tokens[i+1]
+    nxt = jnp.concatenate([tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)],
+                          axis=1)
+    n_chunks = T // chunk
+    hs = h.reshape(B, n_chunks, chunk, -1).swapaxes(0, 1)
+    ns = nxt.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+    k_eff = min(top_n, cfg.vocab_size) if top_n else 0
+
+    def one(args):
+        hc, nc = args                            # (B, chunk, H), (B, chunk)
+        lps = jax.nn.log_softmax(_unembed(params, cfg, hc), axis=-1)
+        chosen = jnp.take_along_axis(lps, nc[..., None], axis=-1)[..., 0]
+        if k_eff:
+            tl, ti = jax.lax.top_k(lps, k_eff)
+        else:
+            ti = jnp.zeros(nc.shape + (0,), jnp.int32)
+            tl = jnp.zeros(nc.shape + (0,), jnp.float32)
+        return chosen, ti.astype(jnp.int32), tl
+
+    chosen, top_ids, top_lps = jax.lax.map(one, (hs, ns))
+    merge = lambda x: x.swapaxes(0, 1).reshape((B, T) + x.shape[3:])
+    return merge(chosen), merge(top_ids), merge(top_lps)
+
+
 # --------------------------------------------------------------------------
 # Speculative verify: score a draft window, return per-row greedy argmax
 # --------------------------------------------------------------------------

@@ -1642,6 +1642,92 @@ class Engine:
         per_row = self.model_cfg.num_heads * T * T * 4
         return max(int(self.EMBED_SCORE_BUDGET_BYTES // max(per_row, 1)), 0)
 
+    def score_prompts(self, ids_list: Sequence[Sequence[int]],
+                      top_n: int = 0) -> list:
+        """Prompt logprobs (OpenAI ``echo``+``logprobs``; vLLM
+        ``prompt_logprobs``): per-token log p(t_i | t_<i) with optional
+        top alternatives, via the cache-less scoring trunk
+        (models/transformer.score_prompt — unembed in vocab slices, so a
+        page of text never materialises (T, V) float32 logits).
+
+        Returns one entry list per prompt, shaped like Request.logprobs
+        entries; the FIRST token's logprob is None (no conditional), as
+        OpenAI reports it.  Shares the embed lock and attention-score
+        budget — both paths run the quadratic reference attention."""
+        if jax.process_count() > 1:
+            raise ValueError("prompt scoring not supported by this "
+                             "multi-host deployment")
+        if self._pp > 1:
+            raise ValueError("prompt scoring not supported on the pipeline "
+                             "engine; route to a non-pp replica")
+        top_n = min(max(int(top_n), 0), self.MAX_LOGPROBS)
+        prepared = []
+        for ids in ids_list:
+            ids = [int(t) for t in ids]
+            if not ids:
+                raise ValueError("prompts must be non-empty")
+            limit = self.model_cfg.max_position_embeddings
+            if len(ids) > limit:
+                raise ValueError(f"prompt length {len(ids)} exceeds model "
+                                 f"position range {limit}")
+            if self._embed_max_rows(max(next_power_of_2(len(ids)), 16)) < 1:
+                raise ValueError(
+                    f"prompt length {len(ids)} exceeds the scoring "
+                    "attention budget for this model; shorten the input")
+            prepared.append(ids)
+        with self._embed_lock:
+            return self._score_locked(prepared, top_n)
+
+    def _trunk_batches(self, ids_list, min_t: int):
+        """Greedy (B, T) batching shared by the cache-less trunk callers
+        (embed, prompt scoring): largest prefix whose padded shape fits
+        the attention-score budget, power-of-2 buckets to bound
+        recompiles.  Yields (group, tokens (B, T), lens (B,))."""
+        i = 0
+        while i < len(ids_list):
+            T = max(next_power_of_2(len(ids_list[i])), min_t)
+            j = i + 1
+            while j < len(ids_list):
+                T2 = max(T, next_power_of_2(len(ids_list[j])), min_t)
+                if j + 1 - i > min(self._embed_max_rows(T2),
+                                   self.MAX_EMBED_BATCH):
+                    break
+                T = T2
+                j += 1
+            group = ids_list[i:j]
+            B = next_power_of_2(len(group))
+            if B > self._embed_max_rows(T):     # padding rows count too
+                B = max(len(group), 1)
+            tokens = np.zeros((B, T), dtype=np.int32)
+            lens = np.ones((B,), dtype=np.int32)   # pad rows: avoid 0-len
+            for k, ids in enumerate(group):
+                tokens[k, :len(ids)] = ids
+                lens[k] = len(ids)
+            yield group, tokens, lens
+            i = j
+
+    def _score_locked(self, ids_list, top_n):
+        from tpuserve.models.transformer import score_prompt
+        results = []
+        for group, tokens, lens in self._trunk_batches(ids_list, 16):
+            chosen, top_ids, top_lps = score_prompt(
+                self.params, self.model_cfg, tokens, lens, top_n=top_n)
+            chosen = np.asarray(chosen)
+            top_ids = np.asarray(top_ids)
+            top_lps = np.asarray(top_lps)
+            for k, ids in enumerate(group):
+                entries = [{"token_id": ids[0], "logprob": None, "top": []}]
+                for p in range(1, len(ids)):
+                    # position p-1's distribution scores token p
+                    entries.append({
+                        "token_id": ids[p],
+                        "logprob": float(chosen[k, p - 1]),
+                        "top": [(int(t), float(l)) for t, l in
+                                zip(top_ids[k, p - 1], top_lps[k, p - 1])],
+                    })
+                results.append(entries)
+        return results
+
     def embed(self, inputs: Sequence[str] | Sequence[Sequence[int]],
               pooling: str = "mean"):
         """Sentence embeddings for /v1/embeddings (vLLM-surface parity).
@@ -1688,31 +1774,10 @@ class Engine:
     def _embed_locked(self, ids_list, pooling):
         from tpuserve.models.transformer import embed_forward
         outs = []
-        i = 0
-        while i < len(ids_list):
-            # greedy chunk: largest prefix whose padded (B, T) fits budget
-            T = max(next_power_of_2(len(ids_list[i])), 8)
-            j = i + 1
-            while j < len(ids_list):
-                T2 = max(T, next_power_of_2(len(ids_list[j])), 8)
-                if j + 1 - i > min(self._embed_max_rows(T2),
-                                   self.MAX_EMBED_BATCH):
-                    break
-                T = T2
-                j += 1
-            group = ids_list[i:j]
-            B = next_power_of_2(len(group))
-            if B > self._embed_max_rows(T):     # padding rows count too
-                B = max(len(group), 1)
-            tokens = np.zeros((B, T), dtype=np.int32)
-            lens = np.ones((B,), dtype=np.int32)   # pad rows: avoid 0-len
-            for k, ids in enumerate(group):
-                tokens[k, :len(ids)] = ids
-                lens[k] = len(ids)
+        for group, tokens, lens in self._trunk_batches(ids_list, 8):
             out = embed_forward(self.params, self.model_cfg, tokens, lens,
                                 pooling=pooling)
             outs.append(np.asarray(out)[:len(group)])
-            i = j
         return np.concatenate(outs, axis=0), [len(x) for x in ids_list]
 
     # ------------------------------------------------------------------

@@ -154,6 +154,9 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         elif rf["type"] != "text":
             raise ValueError(f"unknown response_format type {rf['type']!r}")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
+    if max_tokens < 0:
+        raise ValueError("'max_tokens' must be >= 0 (0 only for prompt "
+                         "scoring: completions with echo + logprobs)")
     return SamplingParams(
         max_tokens=max_tokens,
         min_tokens=max(0, min(_num(body, "min_tokens", 0, int), max_tokens)),
@@ -499,6 +502,30 @@ class _Handler(BaseHTTPRequestHandler):
         if (isinstance(adapter, str) and adapter != self.ctx.model_name
                 and adapter in (self.ctx.lora_names or ())):
             kwargs["adapter"] = adapter
+        if not chat and body.get("echo") and params.logprobs is not None \
+                and "adapter" in kwargs:
+            # the scoring trunk has no adapter threading — base-model
+            # prompt logprobs next to adapter completions would be wrong
+            self._error(400, "echo+logprobs (prompt scoring) is served by "
+                             "the base model; drop echo or use "
+                             f"model={self.ctx.model_name!r}")
+            return
+        if params.max_tokens == 0:
+            # OpenAI prompt scoring: max_tokens=0 + echo + logprobs returns
+            # the prompt's own logprobs with no generation (completions
+            # only — chat has no echo, so 0 tokens buys nothing there)
+            if (chat or stream or not body.get("echo")
+                    or params.logprobs is None or n != 1):
+                self._error(400, "max_tokens=0 is prompt scoring: requires "
+                                 "completions with echo=true and logprobs, "
+                                 "non-streaming, n=1")
+                return
+            try:
+                self._score_only_response(body, params, kwargs)
+            except Exception as e:        # scoring faults need a status too
+                logger.exception("prompt scoring failed")
+                self._error(500, str(e), "server_error")
+            return
         from tpuserve.server.tracing import get_tracer
         try:
             with get_tracer().request_span(
@@ -771,6 +798,36 @@ class _Handler(BaseHTTPRequestHandler):
                               for t, lp in e["top"]]}
             for e in entries]}
 
+    def _prompt_ids(self, kwargs) -> list:
+        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
+        if "prompt_token_ids" in kwargs:
+            return list(kwargs["prompt_token_ids"])
+        return list(eng.tokenizer.encode(kwargs["prompt"]))
+
+    def _score_only_response(self, body, params, kwargs):
+        """OpenAI prompt scoring: completions with max_tokens=0 + echo +
+        logprobs — the prompt's own logprobs, no generation (vLLM serves
+        the same via prompt_logprobs)."""
+        ctx = self.ctx
+        eng = getattr(ctx.engine, "prefill", ctx.engine)
+        ids = self._prompt_ids(kwargs)
+        try:
+            entries = eng.score_prompts([ids], top_n=params.logprobs)[0]
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        text = kwargs.get("prompt")
+        if text is None:
+            text = eng.tokenizer.decode(ids)
+        choice = {"index": 0, "text": text, "finish_reason": "length",
+                  "logprobs": self._completions_logprobs(entries)}
+        self._json(200, {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion", "created": int(time.time()),
+            "model": ctx.model_name, "choices": [choice],
+            "usage": {"prompt_tokens": len(ids), "completion_tokens": 0,
+                      "total_tokens": len(ids)}})
+
     def _echo_text(self, body, chat, kwargs):
         """OpenAI completions `echo`: the prompt text to prepend, or None."""
         if chat or not body.get("echo"):
@@ -812,6 +869,26 @@ class _Handler(BaseHTTPRequestHandler):
         prompt_tokens = 0
         completion_tokens = 0
         echo_text = self._echo_text(body, chat, kwargs)
+        prompt_entries = None
+        if not chat and echo_text is not None and \
+                params.logprobs is not None:
+            # OpenAI echo+logprobs: the logprob arrays cover the PROMPT
+            # tokens too (first entry null), then the completion's
+            eng = getattr(ctx.engine, "prefill", ctx.engine)
+            try:
+                prompt_entries = eng.score_prompts(
+                    [self._prompt_ids(kwargs)],
+                    top_n=params.logprobs)[0]
+            except ValueError as e:
+                fail(400, str(e))
+                return
+            except Exception as e:
+                # any scoring fault must still abort the already-submitted
+                # generation requests or they decode to max_tokens and
+                # leak their engine records
+                logger.exception("prompt scoring failed")
+                fail(500, str(e), "server_error")
+                return
         for rid, q in submits:
             text_parts, token_ids, logprob_entries = [], [], []
             finish_reason = "stop"
@@ -860,6 +937,8 @@ class _Handler(BaseHTTPRequestHandler):
             text = cand["text"]
             finish_reason = cand["finish_reason"]
             logprob_entries = [] if internal_logprobs else cand["entries"]
+            if prompt_entries is not None:
+                logprob_entries = prompt_entries + logprob_entries
             if chat:
                 message = {"role": "assistant", "content": text}
                 if toolctx is not None:
@@ -986,10 +1065,33 @@ class _Handler(BaseHTTPRequestHandler):
                 # OpenAI echo semantics: the prompt text leads the stream.
                 # Prompt tokens are not completion tokens, so token_ids is
                 # empty — but present when requested, preserving the
-                # every-chunk counting contract.
+                # every-chunk counting contract.  With logprobs, the echo
+                # chunk carries the PROMPT's logprob arrays (first entry
+                # null) so the stream's arrays align with the echoed
+                # tokens like the non-streaming response (vLLM streams
+                # prompt_logprobs the same way).
+                prompt_lp = None
+                if params.logprobs is not None:
+                    eng = getattr(ctx.engine, "prefill", ctx.engine)
+                    try:
+                        prompt_lp = self._completions_logprobs(
+                            eng.score_prompts([self._prompt_ids(kwargs)],
+                                              top_n=params.logprobs)[0])
+                    except Exception as e:   # headers are out: error chunk
+                        logger.exception("prompt scoring failed")
+                        abort_all()
+                        send_chunk({"error": {"message": str(e)}})
+                        done = b"data: [DONE]\n\n"
+                        self.wfile.write(hex(len(done))[2:].encode()
+                                         + b"\r\n" + done + b"\r\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        return
                 for i in range(n):
                     choice = {"index": i, "text": echo_text,
                               "finish_reason": None}
+                    if prompt_lp is not None:
+                        choice["logprobs"] = prompt_lp
                     if ret_ids:
                         choice["token_ids"] = []
                     chunk = {"id": oid, "object": "text_completion",
