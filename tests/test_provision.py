@@ -725,3 +725,48 @@ def test_wait_pods_fails_fast_on_image_pull_backoff(tmp_path, monkeypatch):
     # failed fast: one Ready wait slice, not pods_ready_timeout_s/30 of them
     waits = sum("wait --for=condition=Ready" in a for a in runner.argvs())
     assert waits == 1
+
+
+def test_engine_deployment_pp_lora_backpressure_knobs():
+    """The deploy layer must express every serving feature the engine has
+    (config.py note) — pp stages become the chip request, adapters ride
+    --lora-modules, the backpressure cap forwards."""
+    cfg = _cfg(tensor_parallel=1, pipeline_parallel=4,
+               max_waiting=128)
+    c = manifests.engine_deployment(cfg)["spec"]["template"]["spec"][
+        "containers"][0]
+    cmd = c["command"]
+    assert ["--pp", "4"] == cmd[cmd.index("--pp"):cmd.index("--pp") + 2]
+    assert "--tp" not in cmd
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert ["--max-waiting", "128"] == \
+        cmd[cmd.index("--max-waiting"):cmd.index("--max-waiting") + 2]
+
+    cfg = _cfg(tensor_parallel=1,
+               lora_modules={"sql": "/models/adapters/sql"})
+    cmd = manifests.engine_deployment(cfg)["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    i = cmd.index("--lora-modules")
+    assert cmd[i + 1] == "sql=/models/adapters/sql"
+
+
+def test_config_rejects_incoherent_parallelism():
+    import pytest
+    from tpuserve.provision.config import DeployConfig
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        DeployConfig(tensor_parallel=4, pipeline_parallel=2).validate()
+    with pytest.raises(ValueError, match="disagg"):
+        DeployConfig(tensor_parallel=1, pipeline_parallel=2,
+                     disaggregated=True).validate()
+    with pytest.raises(ValueError, match="single-chip"):
+        DeployConfig(tensor_parallel=4,
+                     lora_modules={"a": "/x"}).validate()
+    with pytest.raises(ValueError, match="adapter names"):
+        DeployConfig(tensor_parallel=1,
+                     lora_modules={"a=b": "/x"}).validate()
+    with pytest.raises(ValueError, match="single-host"):
+        # one v5litepod-4 node has 4 chips; 8 stages can't schedule
+        DeployConfig(tensor_parallel=1, pipeline_parallel=8).validate()
+    with pytest.raises(ValueError, match="collides"):
+        DeployConfig(tensor_parallel=1, model="m",
+                     lora_modules={"m": "/x"}).validate()

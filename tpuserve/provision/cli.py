@@ -54,7 +54,7 @@ def deploy(cfg: DeployConfig, runner: CommandRunner,
     cluster_layer.bootstrap(cfg, kube)
 
     print(f"==> [4/6] Deploying serving stack (model={cfg.model}, "
-          f"tp={cfg.tensor_parallel}, disagg={cfg.disaggregated})")
+          f"{cfg.parallelism_desc}, disagg={cfg.disaggregated})")
     serving.deploy(cfg, kube)
 
     print("==> [5/6] Running API smoke tests")

@@ -50,6 +50,15 @@ class DeployConfig:
     kv_cache_dtype: str = "bfloat16"       # "int8" = quantized KV cache
     speculative_k: int = 0                 # n-gram speculative decoding
     multi_step: Optional[int] = None       # fused decode window override
+    # Pipeline parallelism: stage count per replica (mutually exclusive
+    # with tensor_parallel > 1; parallel/pipeline.py).  Chips per replica
+    # become pipeline_parallel instead of tensor_parallel.
+    pipeline_parallel: int = 1
+    # Multi-LoRA serving: {adapter_name: path-inside-model-pvc}; forwarded
+    # as --lora-modules so requests pick adapters by the "model" field
+    lora_modules: Optional[dict] = None
+    # Admission backpressure cap (server --max-waiting); 0 = auto
+    max_waiting: int = 0
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
@@ -114,6 +123,45 @@ class DeployConfig:
             raise ValueError("speculative_k must be >= 0")
         if self.multi_step is not None and self.multi_step < 1:
             raise ValueError("multi_step must be >= 1 when set")
+        if self.pipeline_parallel < 1:
+            raise ValueError("pipeline_parallel must be >= 1")
+        if self.pipeline_parallel > 1 and self.tensor_parallel > 1:
+            raise ValueError("pipeline_parallel and tensor_parallel are "
+                             "mutually exclusive (the server rejects "
+                             "--pp with --tp)")
+        if self.pipeline_parallel > 1 and (self.disaggregated
+                                           or self.disagg_cross_pod):
+            raise ValueError("pipeline_parallel is incompatible with "
+                             "disaggregated topologies")
+        if self.pipeline_parallel > self.chips_per_node:
+            # the multihost StatefulSet path is tp-only (the server
+            # rejects --pp with --multihost); an oversized pp would emit
+            # an unschedulable single-pod chip request and hang the
+            # deploy for pods_ready_timeout_s
+            raise ValueError(
+                f"pipeline_parallel={self.pipeline_parallel} exceeds the "
+                f"{self.chips_per_node} chips of one {self.tpu_type} node "
+                "(pipeline stages are single-host)")
+        if self.lora_modules is not None:
+            if not isinstance(self.lora_modules, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str) and k and v
+                    and "=" not in k
+                    for k, v in self.lora_modules.items()):
+                raise ValueError("lora_modules must map adapter names "
+                                 "(no '=') to paths")
+            if self.model in self.lora_modules:
+                # the server's argparse rejects this at startup — catch it
+                # before it becomes an in-cluster CrashLoopBackOff
+                raise ValueError(f"adapter name {self.model!r} collides "
+                                 "with the served model name")
+            if self.tensor_parallel > 1 or self.pipeline_parallel > 1 \
+                    or self.disaggregated or self.disagg_cross_pod \
+                    or self.speculative_k:
+                raise ValueError("lora_modules needs plain single-chip "
+                                 "replicas (the engine rejects multi-LoRA "
+                                 "with tp/pp/disagg/speculation)")
+        if self.max_waiting < -1:
+            raise ValueError("max_waiting must be >= -1")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.
@@ -125,6 +173,20 @@ class DeployConfig:
             return int(self.tpu_type.rsplit("-", 1)[1])
         except (IndexError, ValueError):
             return 4
+
+    @property
+    def chips_per_replica(self) -> int:
+        """TPU chips one engine replica requests — pipeline stages or
+        tensor shards, whichever parallelism is active.  The ONE place
+        the pp-vs-tp arithmetic lives (manifests + CLI consume it)."""
+        return (self.pipeline_parallel if self.pipeline_parallel > 1
+                else self.tensor_parallel)
+
+    @property
+    def parallelism_desc(self) -> str:
+        return (f"pp={self.pipeline_parallel}"
+                if self.pipeline_parallel > 1
+                else f"tp={self.tensor_parallel}")
 
 
 _ENV_PREFIX = "TPUSERVE_"
@@ -235,6 +297,17 @@ PRESETS: dict[str, dict] = {
         "tensor_parallel": 4,
         "disaggregated": True, "disagg_cross_pod": True,
         "prefill_replicas": 1, "decode_replicas": 1,
+    },
+    # pipeline-parallel serving on a v5e-4: 8B bf16 weights (~16 GB)
+    # exceed one chip's HBM; four stages hold ~4 GB of layers + their KV
+    # slice each (parallel/pipeline.py — the footprint-scaling path,
+    # without quantizing)
+    "llama3-8b-pp4-v5e4": {
+        "model": "meta-llama/Meta-Llama-3-8B-Instruct",
+        "tpu_type": "v5litepod-4", "tpu_topology": "2x2",
+        "machine_type": "ct5lp-hightpu-4t",
+        "tensor_parallel": 1, "pipeline_parallel": 4,
+        "storage_size": "100Gi",
     },
     # harness-friendly CPU smoke path (BASELINE "CPU smoke" config)
     "cpu-smoke": {

@@ -101,7 +101,7 @@ def provision(cfg: DeployConfig, runner: CommandRunner, workdir: str = ".",
         write_inventory(rec, workdir)
         write_details(rec, workdir, extra={
             "Model": cfg.model, "Namespace": cfg.namespace,
-            "Tensor Parallel": str(cfg.tensor_parallel),
+            "Parallelism": cfg.parallelism_desc,
         })
     logger.info("provisioned cluster %s (%s)", rec.cluster_id, cfg.provider)
     return rec

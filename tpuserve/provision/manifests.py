@@ -150,8 +150,13 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
     args = ["python", "-m", "tpuserve.server",
             "--model", cfg.model,
             "--checkpoint-dir", f"/models/{cfg.model}",
-            "--port", str(cfg.engine_port),
-            "--tp", str(cfg.tensor_parallel)]
+            "--port", str(cfg.engine_port)]
+    if cfg.pipeline_parallel > 1:
+        # pp replica: chips become pipeline stages (layers + KV sharded
+        # per stage) instead of tensor shards
+        args += ["--pp", str(cfg.pipeline_parallel)]
+    else:
+        args += ["--tp", str(cfg.tensor_parallel)]
     if cfg.quantization:
         args += ["--quantization", cfg.quantization]
     if cfg.kv_cache_dtype != "bfloat16":
@@ -160,8 +165,13 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         args += ["--speculative-k", str(cfg.speculative_k)]
     if cfg.multi_step is not None:
         args += ["--multi-step", str(cfg.multi_step)]
+    if cfg.lora_modules:
+        args += ["--lora-modules"] + [f"{name}={path}" for name, path
+                                      in cfg.lora_modules.items()]
+    if cfg.max_waiting:
+        args += ["--max-waiting", str(cfg.max_waiting)]
     args += extra_args or []
-    tpu_req = {TPU_RESOURCE: str(cfg.tensor_parallel)} \
+    tpu_req = {TPU_RESOURCE: str(cfg.chips_per_replica)} \
         if cfg.provider == "gke" else {}
     env = [{"name": "HF_TOKEN", "valueFrom": {"secretKeyRef": {
         "name": "hf-token", "key": "token", "optional": True}}},
