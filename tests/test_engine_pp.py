@@ -53,9 +53,30 @@ def test_pp_engine_token_parity(pp, pp_cfg):
     golden = _drain(Engine(_cfg(), model_cfg=pp_cfg), prompts, params)
     eng = Engine(_cfg(), model_cfg=pp_cfg,
                  mesh=make_mesh(MeshConfig(pp=pp)))
-    assert eng._pp == pp and eng._multi_step == 1
+    assert eng._pp == pp
     got = _drain(eng, prompts, params)
     assert got == golden
+
+
+@pytest.mark.parametrize("mode_params", [
+    dict(temperature=0.0),
+    dict(temperature=0.8, seed=13),
+])
+def test_pp_engine_fused_windows_parity(mode_params, pp_cfg):
+    """Fused decode windows (multi_step>1) through pp_decode_multi must
+    emit the same streams as the single-device windowed engine — greedy
+    AND seeded sampling (the per-row key/step arithmetic is shared)."""
+    def cfg():
+        return _cfg(multi_step=4)
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(1, 500, size=6).tolist() for _ in range(3)]
+    params = SamplingParams(max_tokens=9, ignore_eos=True, **mode_params)
+    golden_eng = Engine(cfg(), model_cfg=pp_cfg)
+    assert golden_eng._multi_step == 4
+    golden = _drain(golden_eng, prompts, params)
+    eng = Engine(cfg(), model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+    assert eng._multi_step == 4          # windows no longer forced off
+    assert _drain(eng, prompts, params) == golden
 
 
 def test_pp_engine_seeded_sampling_parity(pp_cfg):

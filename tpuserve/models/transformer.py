@@ -509,6 +509,35 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 # Decode: one token per sequence against the paged cache
 # --------------------------------------------------------------------------
 
+def window_slot(block_tables: jnp.ndarray, pos: jnp.ndarray,
+                active: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    """On-device cache-slot derivation for one fused-window iteration —
+    shared by :func:`decode_multi` and the pipelined
+    parallel.pipeline.pp_decode_multi so the two window implementations
+    can't drift.  Inactive (padding) rows write to PAD_SLOT (dropped)."""
+    slot = (jnp.take_along_axis(block_tables,
+                                (pos // block_size)[:, None], axis=1)[:, 0]
+            * block_size + pos % block_size)
+    return jnp.where(active, slot, attn_ops.PAD_SLOT)
+
+
+def window_sample(logits: jnp.ndarray, keys: jnp.ndarray,
+                  temperature: jnp.ndarray, s: jnp.ndarray,
+                  mode: str) -> jnp.ndarray:
+    """One fused-window sampling step: greedy argmax or temperature
+    sampling with the per-row key's step word folded by +s (matching the
+    engine's host-side per-step key construction).  One source of truth
+    for both window implementations."""
+    if mode == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    from tpuserve.ops import sampling as sampling_ops
+    B = logits.shape[0]
+    step_key = jnp.array([0, 1], jnp.uint32)[None, :]
+    return sampling_ops.sample_tokens(
+        logits, keys + step_key * s.astype(jnp.uint32), temperature,
+        jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+        mode="temperature")
+
 def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  positions: jnp.ndarray, slot_ids: jnp.ndarray,
                  block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
@@ -606,25 +635,14 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     B = tokens.shape[0]
     block_size = kv_cache[0]["k"].shape[1]
-    step_key = jnp.array([0, 1], jnp.uint32)[None, :]
 
     def one(carry, s):
         toks, pos, lens, cache = carry
-        slot = (jnp.take_along_axis(block_tables,
-                                    (pos // block_size)[:, None], axis=1)[:, 0]
-                * block_size + pos % block_size)
-        slot = jnp.where(active, slot, attn_ops.PAD_SLOT)
+        slot = window_slot(block_tables, pos, active, block_size)
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
                                      attn_impl, mesh, ad=ad)
-        if mode == "greedy":
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            from tpuserve.ops import sampling as sampling_ops
-            nxt = sampling_ops.sample_tokens(
-                logits, keys + step_key * s.astype(jnp.uint32), temperature,
-                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
-                mode="temperature")
+        nxt = window_sample(logits, keys, temperature, s, mode)
         return (nxt, pos + 1, lens + 1, cache), nxt
 
     carry = (tokens, positions, seq_lens, kv_cache)

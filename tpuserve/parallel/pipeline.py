@@ -287,6 +287,62 @@ def pp_decode_step(head, stages, cfg: ModelConfig, tokens, positions,
     return tf._unembed(head, cfg, h_out), new_cache
 
 
+@partial(jax.jit,
+         static_argnames=("cfg", "mesh", "steps", "mode",
+                          "num_microbatches"),
+         donate_argnames=("stage_cache",))
+def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
+                    block_tables, seq_lens, active, keys, temperature,
+                    stage_cache, *, mesh, steps: int, mode: str = "greedy",
+                    num_microbatches: int = 0):
+    """``steps`` fused decode+sample iterations through the staged trunk
+    in ONE dispatch — transformer.decode_multi's contract over a pp mesh.
+
+    Each iteration is a full pipeline pass (M microbatches overlap across
+    stages); the sampled token feeds the next iteration entirely on
+    device, so the host syncs once per window instead of once per token —
+    the same S-fold host-round-trip win the single-device engine measured
+    (BENCHMARKS.md S=1 vs S=32).  Sampling runs on the replicated logits
+    outside the shard_map region.  Slot ids are derived on device from
+    ``block_tables`` and the advancing positions; the window's KV slots
+    must be pre-reserved (engine._try_reserve_window).
+    """
+    S = mesh.shape[AXIS_PP]
+    B = tokens.shape[0]
+    M = num_microbatches or _auto_microbatches(B, S)
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    block_size = jax.tree.leaves(stage_cache)[0].shape[3]
+
+    def layer_fn(h, lp, entry, slots_t, meta_t):
+        pos_t, bt_t, sl_t = meta_t
+        return _decode_layer(h, lp, entry, cfg, pos_t, slots_t, bt_t, sl_t)
+
+    wrap, trunk, specs_in = _pipeline_trunk(mesh, cfg, M, layer_fn)
+    run_trunk = wrap(trunk, in_specs=specs_in + (P(),) * 5,
+                     out_specs=(P(), P(AXIS_PP)))
+    bt_mb = _split_micro(block_tables, M)
+
+    def one(carry, s):
+        toks, pos, lens, cache = carry
+        # slot derivation + window sampling shared with decode_multi
+        # (models/transformer.py window_slot/window_sample) — the two
+        # fused-window implementations must not drift
+        slot = tf.window_slot(block_tables, pos, active, block_size)
+        h = tf._embed(head, cfg, toks, pos)
+        out, cache = run_trunk(stages, cache, _split_micro(h, M),
+                               _split_micro(slot, M), _split_micro(pos, M),
+                               bt_mb, _split_micro(lens, M))
+        logits = tf._unembed(head, cfg, out.reshape(B, -1))
+        nxt = tf.window_sample(logits, keys, temperature, s, mode)
+        return (nxt, pos + 1, lens + 1, cache), nxt
+
+    carry = (tokens, positions, seq_lens, stage_cache)
+    (_, _, _, stage_cache), outs = jax.lax.scan(
+        one, carry, jnp.arange(steps, dtype=jnp.int32))
+    return jnp.swapaxes(outs, 0, 1), stage_cache
+
+
 @partial(jax.jit, static_argnames=("cfg", "mesh", "num_microbatches"),
          donate_argnames=("stage_cache",))
 def pp_prefill(head, stages, cfg: ModelConfig, tokens, prompt_lens,

@@ -256,10 +256,9 @@ class Engine:
             # Pipeline placement: layers + KV stage-stacked over 'pp'
             # (parallel/pipeline.py) — per-device weight AND cache bytes
             # divide by the stage count; _exec_prefill/_exec_decode route
-            # to the pipelined trunk.  Single-process, pure-pp mesh, no
-            # fused windows / chunked prefill / speculation (gated below
-            # and at intake) — the footprint-scaling path, not the
-            # peak-throughput path.
+            # to the pipelined trunk (incl. fused decode windows via
+            # pp_decode_multi).  Single-process, pure-pp mesh, no chunked
+            # prefill / speculation (gated below and at the scheduler).
             from tpuserve.parallel.mesh import AXIS_DP, AXIS_EP, AXIS_TP
             from tpuserve.parallel.pipeline import (create_stacked_cache,
                                                     stack_pipeline_params)
@@ -356,12 +355,6 @@ class Engine:
         self._pending_window: Optional[PendingWindow] = None
         self._pipeline_decode = config.resolve_pipeline_decode()
         self._multi_step = config.resolve_multi_step()
-        if self._pp > 1 and self._multi_step > 1:
-            # a fused window's on-device token feedback would serialise
-            # through the full pipeline depth each iteration; decode runs
-            # the per-step path (with PendingDecode overlap) instead
-            logger.info("pipeline engine: fused decode windows disabled")
-            self._multi_step = 1
         self._min_multi_step = min(max(1, config.min_multi_step),
                                    self._multi_step)
         self._adaptive_window = (config.adaptive_multi_step
@@ -818,6 +811,13 @@ class Engine:
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
                            ad=None):
+        if self._pp > 1:
+            from tpuserve.parallel.pipeline import pp_decode_multi
+            return pp_decode_multi(
+                self._pp_head, self._pp_stages, self.model_cfg, tokens,
+                positions, block_tables, seq_lens, active, keys,
+                temperature, self.kv_cache, mesh=self.mesh, steps=steps,
+                mode=mode)
         return transformer.decode_multi(
             self.params, self.model_cfg, tokens, positions, block_tables,
             seq_lens, active, keys, temperature, self.kv_cache, ad,
