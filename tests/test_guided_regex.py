@@ -1,0 +1,210 @@
+"""Regex-constrained decoding (runtime/guided_regex.py + the vLLM
+guided_regex body param): NFA acceptance semantics, dead-end-free char
+rejection, EOS gating via can_finish, engine substitution e2e on random
+weights, and the HTTP surface."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.runtime.guided_regex import (RegexError, RegexStateMachine,
+                                           compile_regex)
+from tpuserve.runtime.request import SamplingParams
+
+
+def _m(pattern):
+    return RegexStateMachine(compile_regex(pattern))
+
+
+def _feed(pattern, text):
+    m = _m(pattern)
+    try:
+        m.feed(text)
+    except ValueError:
+        return None
+    return m
+
+
+# ------------------------------------------------------------ acceptance
+
+ACCEPT = [
+    (r"abc", "abc"),
+    (r"a+b*", "aaa"),
+    (r"[0-9]{2,4}", "123"),
+    (r"(ab|cd)+", "abcdab"),
+    (r"\d\d-\d\d", "12-34"),
+    (r"[a-f]*z", "deadz"),
+    (r"hel{2}o", "hello"),
+    (r"a?b", "b"),
+    (r"\w+@\w+\.(com|org)", "me@host.org"),
+    (r"[^x]+", "abc def"),
+    (r".+", "any thing"),
+]
+
+
+def test_full_matches_accept_and_finish():
+    import re
+    for pattern, text in ACCEPT:
+        m = _feed(pattern, text)
+        assert m is not None and m.can_finish, (pattern, text)
+        assert re.fullmatch(pattern, text), (pattern, text)  # sanity
+
+
+def test_prefixes_accepted_but_not_finishable():
+    m = _feed(r"\d\d-\d\d", "12-")
+    assert m is not None and not m.can_finish and not m.complete
+
+
+def test_rejection_at_earliest_dead_char():
+    for pattern, text in [
+        (r"abc", "abd"),
+        (r"[0-9]+", "12x"),
+        (r"(ab|cd)", "ax"),
+        (r"a{2,3}", "aaaa"),
+        (r"[^x]+", "ax"),
+        (r".", "a\n"),                      # dot excludes newline... at char 2
+    ]:
+        assert _feed(pattern, text) is None, (pattern, text)
+
+
+def test_complete_only_when_inextensible():
+    m = _feed(r"ab", "ab")
+    assert m.complete                       # nothing can follow
+    m = _feed(r"ab+", "ab")
+    assert m.can_finish and not m.complete  # more b's possible
+
+
+def test_bounded_repetition_edges():
+    assert _feed(r"a{2,3}", "a") is not None          # prefix
+    assert not _feed(r"a{2,3}", "a").can_finish
+    assert _feed(r"a{2,3}", "aa").can_finish
+    assert _feed(r"a{2,3}", "aaa").complete
+    assert _feed(r"a{0,2}b", "b") is not None
+    assert _feed(r"a{3}", "aaa").complete
+
+
+def test_allows_is_pure():
+    m = _m(r"[ab]+c")
+    m.feed("ab")
+    before = m.states
+    assert m.allows("ac") and not m.allows("x")
+    assert m.states is before
+
+
+def test_unsupported_syntax_rejected():
+    for bad in (r"^abc$", r"(?P<x>a)", r"(?:ab)", r"a(?=b)", r"a{1,999}",
+                r"a**", r"(ab", r"[a-", "", r"\q", r"a{,",
+                "(" * 80 + "a" + ")" * 80,        # depth bound -> 400 not 500
+                r"[a-\d]", r"[\d-x]",           # class escapes can't bound ranges
+                r"[\q]"):
+        with pytest.raises(RegexError):
+            compile_regex(bad)
+
+
+def test_zero_repetition_matches_empty_only():
+    import re
+    assert re.fullmatch(r"ab{0}c", "ac")
+    assert _feed(r"ab{0}c", "ac").can_finish
+    assert _feed(r"ab{0}c", "abc") is None        # {0} must not wire a copy
+    assert _feed(r"a{0,0}x", "x").can_finish
+    assert _feed(r"a{0,0}x", "ax") is None
+
+
+# ------------------------------------------------------------ engine e2e
+
+def _engine():
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+def test_engine_regex_guided_output_matches():
+    """Random weights + the substitution machinery must emit a full match
+    of the pattern (ByteTokenizer: every ASCII char is a single token, so
+    the fallback can always find a valid candidate)."""
+    import re
+    eng = _engine()
+    pattern = r"[ab]{3}-[0-9]{2}"
+    outs = eng.generate(
+        ["x"], [SamplingParams(max_tokens=40, temperature=0.0,
+                               guided="regex", guided_schema=pattern)])
+    (r,) = outs
+    assert r.finish_reason.value == "stop", r.output_text
+    assert re.fullmatch(pattern, r.output_text), r.output_text
+
+
+def test_engine_regex_extensible_end_allows_eos():
+    """A pattern with an extensible accept ([ab]+): EOS becomes legal the
+    moment the match is accepting, so the stream ends cleanly by EOS or
+    max_tokens with a valid match either way."""
+    import re
+    eng = _engine()
+    outs = eng.generate(
+        ["y"], [SamplingParams(max_tokens=6, temperature=0.0,
+                               guided="regex", guided_schema=r"[ab]+")])
+    (r,) = outs
+    assert re.fullmatch(r"[ab]+", r.output_text), r.output_text
+
+
+# ------------------------------------------------------------ HTTP edge
+
+@pytest.fixture(scope="module")
+def server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    srv = OpenAIServer(_engine(), ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_guided_regex(server):
+    import re
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "id:", "max_tokens": 30,
+        "temperature": 0, "guided_regex": r"[0-9]{3}"})
+    assert status == 200
+    assert re.fullmatch(r"[0-9]{3}", body["choices"][0]["text"])
+
+
+def test_http_guided_regex_validation(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+            "guided_regex": r"(?:bad)"})
+    assert ei.value.code == 400
+    assert "guided_regex" in json.loads(ei.value.read())["error"]["message"]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+            "guided_regex": r"a+",
+            "response_format": {"type": "json_object"}})
+    assert ei.value.code == 400
+
+
+def test_engine_regex_nonstructural_chars_via_fallback():
+    """Chars outside the JSON-structural fallback ('!', '@') must still
+    be producible — the tier-2 printable-ASCII fallback.  Regression: a
+    fallback that can't produce the pattern's next char silently drops
+    the constraint (observed emitting garbage after 'yes, ' live)."""
+    import re
+    eng = _engine()
+    pattern = r"(yes|no)! [a-z]{2}@end"
+    outs = eng.generate(
+        ["q"], [SamplingParams(max_tokens=40, temperature=0.0,
+                               guided="regex", guided_schema=pattern)])
+    (r,) = outs
+    assert re.fullmatch(pattern, r.output_text), r.output_text

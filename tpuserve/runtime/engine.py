@@ -521,9 +521,9 @@ class Engine:
                     " GiB); lower max_tokens or use tp instead of pp")
         request_id = request_id or f"req-{next(self._req_counter)}"
         if params.guided is not None:
-            if params.guided not in ("json", "json_schema"):
+            if params.guided not in ("json", "json_schema", "regex"):
                 raise ValueError(f"unsupported guided mode {params.guided!r}"
-                                 " (only 'json' / 'json_schema')")
+                                 " (only 'json' / 'json_schema' / 'regex')")
             if params.logprobs is not None:
                 # substitution happens after on-device logprob recording —
                 # the reported tokens would not match the emitted ones
@@ -1329,6 +1329,10 @@ class Engine:
             import json as _json
             return SchemaJsonStateMachine(
                 compile_schema(_json.loads(params.guided_schema)))
+        if params.guided == "regex":
+            from tpuserve.runtime.guided_regex import (RegexStateMachine,
+                                                       compile_regex)
+            return RegexStateMachine(compile_regex(params.guided_schema))
         return JsonStateMachine()
 
     def _apply_guided(self, logits: jnp.ndarray, toks_np: np.ndarray,
@@ -1370,7 +1374,7 @@ class Engine:
         base = self.tokenizer.decode(ctx)
         for tok in [sampled] + candidates:
             if tok in self._eos_ids:
-                if st.complete:
+                if st.can_finish:      # JSON: root closed; regex: accepting
                     return tok
                 continue
             txt = self._guided_text_of(self.tokenizer, ctx, base, tok)
@@ -1394,16 +1398,24 @@ class Engine:
         return sampled
 
     def _guided_fallback(self) -> list[int]:
-        """Single-token encodings of JSON structural strings — the escape
-        hatch when the whole top-K is grammatically invalid (common early
-        on with small/random models)."""
+        """Single-token encodings of candidate strings — the escape hatch
+        when the whole top-K is grammatically invalid (common early on
+        with small/random models).  Tier 1: JSON structural strings (the
+        json/json_schema fast path).  Tier 2: every printable-ASCII
+        single char — a regex can demand ANY next char ('!', '@', ...),
+        and a fallback that can't produce it silently drops the whole
+        constraint (found by a live guided_regex drive emitting garbage
+        after the pattern's '!')."""
         if self._guided_fallback_ids is None:
-            ids = []
-            for s in ('"', "}", "]", ":", ",", "{", "[", " ", "0", "1",
-                      "2", "7", "a", "k", "true", "false", "null", "-",
-                      ".", "e"):
+            import string
+            ids, seen = [], set()
+            tier1 = ('"', "}", "]", ":", ",", "{", "[", " ", "0", "1",
+                     "2", "7", "a", "k", "true", "false", "null", "-",
+                     ".", "e")
+            for s in tier1 + tuple(string.printable):
                 enc = self.tokenizer.encode(s)
-                if len(enc) == 1:
+                if len(enc) == 1 and enc[0] not in seen:
+                    seen.add(enc[0])
                     ids.append(enc[0])
             self._guided_fallback_ids = ids
         return self._guided_fallback_ids

@@ -73,6 +73,15 @@ class JsonStateMachine:
         return self.mode == "done"
 
     @property
+    def can_finish(self) -> bool:
+        """EOS is legal here (the engine's _guided_pick gate).  For JSON
+        the document is finishable exactly when the root closed; regex
+        acceptors (guided_regex.py) override with accepting-state
+        liveness, which can be true while the match is still
+        extensible."""
+        return self.complete
+
+    @property
     def in_string(self) -> bool:
         """Inside a string (value or key) — the only modes where arbitrary
         text, and hence a partial multibyte rune contributing no decoded

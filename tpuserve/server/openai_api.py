@@ -153,6 +153,21 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
             guided_schema = json.dumps(js["schema"])
         elif rf["type"] != "text":
             raise ValueError(f"unknown response_format type {rf['type']!r}")
+    gre = body.get("guided_regex")
+    if gre is not None:
+        # vLLM extension: constrain the output to fully match a regex
+        if guided is not None:
+            raise ValueError("'guided_regex' cannot be combined with "
+                             "response_format json modes")
+        if not isinstance(gre, str):
+            raise ValueError("'guided_regex' must be a string pattern")
+        from tpuserve.runtime.guided_regex import RegexError, compile_regex
+        try:
+            compile_regex(gre)          # 400 on unsupported syntax
+        except RegexError as e:
+            raise ValueError(f"unsupported guided_regex: {e}") from None
+        guided = "regex"
+        guided_schema = gre
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     if max_tokens < 0:
         raise ValueError("'max_tokens' must be >= 0 (0 only for prompt "
