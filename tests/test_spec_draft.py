@@ -1,0 +1,123 @@
+"""Draft-model speculative decoding (SpecConfig.draft_model): the
+stateless truncated-window draft proposer, the identity property (a
+draft equal to the target proposes exactly the target's greedy path, so
+EVERYTHING is accepted and the output stream is unchanged), and the
+intake guards."""
+
+import numpy as np
+import pytest
+
+from tpuserve.models import transformer
+from tpuserve.models.config import get_model_config
+from tpuserve.models.weights import init_params
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.spec import SpecConfig
+
+
+import dataclasses
+# float32 like test_spec_decode.py: the verify trunk and the decode path
+# are different executables whose bf16 rounding can flip the "target
+# greedy" argmax they must agree on
+MC32 = dataclasses.replace(get_model_config("tiny-qwen3"), dtype="float32")
+
+
+def _cfg(spec=None):
+    return EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=256,
+                          max_blocks_per_seq=32, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        speculative=spec)
+
+
+def _drain(eng, prompts, params):
+    outs = {}
+    rids = [eng.add_request(prompt_token_ids=p, params=params)
+            for p in prompts]
+    while eng.has_work():
+        for o in eng.step():
+            outs.setdefault(o.request_id, []).extend(o.new_token_ids)
+    return [outs[r] for r in rids]
+
+
+def test_draft_propose_matches_sequential_greedy():
+    """The batched k-step proposer must equal k sequential single-step
+    greedy extensions of the same window."""
+    import jax.numpy as jnp
+    cfg = get_model_config("tiny-qwen3")
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(2)
+    W, k, B = 12, 3, 2
+    tokens = np.zeros((B, W + k), np.int32)
+    lens = np.asarray([12, 7], np.int32)
+    for i in range(B):
+        tokens[i, :lens[i]] = rng.integers(1, 500, size=lens[i])
+    got = np.asarray(transformer.draft_propose(
+        params, cfg, jnp.asarray(tokens), jnp.asarray(lens), k=k))
+    for i in range(B):
+        ids = list(tokens[i, :lens[i]])
+        for j in range(k):
+            buf = np.zeros((1, len(ids) + 1), np.int32)
+            buf[0, :len(ids)] = ids
+            logits = transformer.forward(
+                params, cfg, jnp.asarray(buf),
+                jnp.asarray([len(ids)], np.int32))
+            nxt = int(np.argmax(np.asarray(logits)[0, len(ids) - 1]))
+            assert int(got[i, j]) == nxt, (i, j)
+            ids.append(nxt)
+
+
+def test_identity_draft_accepts_everything_and_matches():
+    """draft == target (same config, same random seed): every proposal
+    is the target's own greedy token, so acceptance is 100%, spec steps
+    emit k+1 tokens per weight pass, and the stream is identical to the
+    plain engine."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 500, size=9).tolist() for _ in range(2)]
+    params = SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
+    plain = _drain(Engine(_cfg(), model_cfg=MC32), prompts, params)
+    eng = Engine(_cfg(SpecConfig(num_draft_tokens=3,
+                                 draft_model="tiny-qwen3",
+                                 adaptive=False)), model_cfg=MC32)
+    assert eng._draft_params is not None
+    # true identity: the registry draft is bf16 while the test target is
+    # f32 — swap in the f32 twin so draft numerics equal the target's
+    eng._draft_cfg = MC32
+    eng._draft_params = init_params(MC32, seed=eng.config.seed)
+    got = _drain(eng, prompts, params)
+    assert got == plain
+    assert eng.stats.spec_steps > 0
+    assert eng.stats.spec_proposed > 0
+    assert eng.stats.spec_accepted == eng.stats.spec_proposed  # 100%
+    # 100% acceptance => every spec step emitted k+1 per sequence
+    assert eng.stats.generated_tokens >= eng.stats.spec_steps * 4
+
+
+def test_draft_window_truncation_still_serves():
+    """Prompts longer than draft_window: the draft sees a truncated
+    context (worse proposals), but verify keeps the stream equal to the
+    plain engine — speculation can only cost speed, never correctness."""
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 500, size=30).tolist()]
+    params = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    plain = _drain(Engine(_cfg(), model_cfg=MC32), prompts, params)
+    eng = Engine(_cfg(SpecConfig(num_draft_tokens=2,
+                                 draft_model="tiny-qwen3",
+                                 draft_window=8, adaptive=False)),
+                 model_cfg=MC32)
+    assert _drain(eng, prompts, params) == plain
+
+
+def test_vocab_mismatch_rejected():
+    with pytest.raises(ValueError, match="vocab"):
+        Engine(_cfg(SpecConfig(draft_model="tiny-llama")))
+
+
+def test_missing_draft_checkpoint_rejected(tmp_path):
+    """An explicit draft dir with no weights must error, not silently
+    random-init (a garbage draft degrades to ~0 acceptance invisibly)."""
+    with pytest.raises(ValueError, match="safetensors"):
+        Engine(_cfg(SpecConfig(draft_model="tiny-qwen3",
+                               draft_checkpoint_dir=str(tmp_path))))

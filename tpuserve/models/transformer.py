@@ -660,6 +660,54 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return out, kv_cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def draft_propose(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  lens: jnp.ndarray, *, k: int):
+    """Stateless draft-model proposal for speculative decoding.
+
+    tokens: (B, W + k) — the last W context tokens right-padded with k
+    scratch slots; lens: (B,) valid context lengths.  Runs the cache-less
+    causal trunk k times, each pass extending every row by its greedy
+    next token — no draft KV cache, so the draft needs no block-manager
+    mirroring of the target's sequence lifecycle (the design risk of
+    draft-model speculation; vLLM manages a second paged cache instead).
+    k cache-less passes over a W-token window on a SMALL draft model cost
+    less than one verify pass on the target; the truncated context is the
+    quality trade the acceptance governor prices online.
+
+    Returns (B, k) int32 proposals.
+    """
+    B, T = tokens.shape
+
+    positions = jnp.arange(T)[None, :].repeat(B, axis=0)
+    scale = cfg.attn_scale
+
+    def one(carry, j):
+        toks, cur = carry
+        h = _embed(params, cfg, toks, positions)
+        for li, lp in enumerate(params["layers"]):
+            hn = _norm(h, lp["attn_norm"], cfg)
+            q, kk, v = _qkv(hn, lp, cfg, positions, li)
+            out = attn_ops.prefill_attention(
+                q, kk, v, cur, scale, sliding_window=cfg.layer_window(li),
+                logit_softcap=cfg.attn_logit_softcapping)
+            h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+            h = h + _mlp_residual(h, lp, cfg)
+        # unembed ONLY each row's last position — the full (B, T, V)
+        # logits would be GBs at serving batch sizes
+        h_last = jnp.take_along_axis(h, (cur - 1)[:, None, None],
+                                     axis=1)[:, 0]
+        nxt = jnp.argmax(_unembed(params, cfg, h_last),
+                         axis=-1).astype(jnp.int32)
+        toks = jnp.where(
+            jnp.arange(T)[None, :] == cur[:, None], nxt[:, None], toks)
+        return (toks, cur + 1), nxt
+
+    (_, _), outs = jax.lax.scan(one, (tokens, lens),
+                                jnp.arange(k, dtype=jnp.int32))
+    return jnp.swapaxes(outs, 0, 1)                      # (B, k)
+
+
 # --------------------------------------------------------------------------
 # Plain forward (no cache) — for fine-tuning / the graft entry point
 # --------------------------------------------------------------------------

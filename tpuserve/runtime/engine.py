@@ -370,6 +370,42 @@ class Engine:
         # at which a paused spec path may probe again
         self._spec_window = [0, 0]
         self._spec_resume_step = 0
+        # draft-model speculation: the draft's params live alongside the
+        # target's; proposals run statelessly over a truncated window
+        # (runtime/spec.py SpecConfig.draft_model rationale)
+        self._draft_params = None
+        self._draft_cfg = None
+        if self._spec is not None and self._spec.draft_model:
+            self._draft_cfg = get_model_config(self._spec.draft_model)
+            if self._draft_cfg.vocab_size != self.model_cfg.vocab_size:
+                raise ValueError(
+                    f"draft model {self._spec.draft_model!r} vocab "
+                    f"{self._draft_cfg.vocab_size} != target vocab "
+                    f"{self.model_cfg.vocab_size} — draft tokens must be "
+                    "target tokens")
+            ddir = self._spec.draft_checkpoint_dir
+            if ddir:
+                import glob as _glob
+                import os as _os
+                if not _glob.glob(_os.path.join(ddir, "*.safetensors")):
+                    # load_or_init would silently random-init — a garbage
+                    # draft degrades to ~0 acceptance with NO error (the
+                    # governor just pauses), invisible unlike a garbage
+                    # TARGET model
+                    raise ValueError(
+                        f"draft checkpoint dir {ddir!r} has no "
+                        "*.safetensors — a typo here would silently "
+                        "serve a random-weights draft")
+            self._draft_params = load_or_init(self._draft_cfg, ddir,
+                                              config.seed)
+            if mesh is not None:
+                # replicate the (small) draft across the mesh so spec
+                # steps run SPMD alongside the sharded target instead of
+                # pinning one chip while the others idle
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as _P)
+                self._draft_params = jax.device_put(
+                    self._draft_params, NamedSharding(mesh, _P()))
         self._req_counter = itertools.count()
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._eos_ids = set(self.tokenizer.eos_token_ids)
@@ -808,6 +844,14 @@ class Engine:
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
             slot_ids, block_tables, self.kv_cache)
 
+    def _exec_draft_propose(self, tokens, lens, *, k):
+        # Draft-model speculation is single-process only (gated with the
+        # rest of speculation in __init__); the hook exists so the AST
+        # coverage test can hold the "no direct transformer calls" line
+        # everywhere (see _exec_decode_verify).
+        return transformer.draft_propose(self._draft_params,
+                                         self._draft_cfg, tokens, lens, k=k)
+
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
                            ad=None):
@@ -1199,10 +1243,13 @@ class Engine:
             return outputs
         k = self._spec.num_draft_tokens
         K = k + 1
-        drafts = [spec_mod.ngram_propose(
-            r.prompt_token_ids + r.output_token_ids, k,
-            self._spec.max_ngram, self._spec.min_ngram,
-            self._spec.max_lookback) for r in reqs]
+        if self._draft_params is not None:
+            drafts = self._draft_propose(reqs, k)
+        else:
+            drafts = [spec_mod.ngram_propose(
+                r.prompt_token_ids + r.output_token_ids, k,
+                self._spec.max_ngram, self._spec.min_ngram,
+                self._spec.max_lookback) for r in reqs]
         # The verify pass costs every row ~(k+1)x a decode step; it only
         # pays when enough of the batch actually has drafts to accept.
         coverage = sum(1 for d in drafts if d) / len(drafts)
@@ -1249,6 +1296,25 @@ class Engine:
         self.stats.spec_accepted += step_accepted
         self._spec_govern(step_proposed, step_accepted)
         return outputs
+
+    def _draft_propose(self, reqs: list, k: int) -> list:
+        """Batched stateless draft proposals: each row's window is its
+        last ``draft_window`` tokens; the draft model extends every row
+        by k greedy tokens in one jitted call
+        (models/transformer.draft_propose).  Window and batch are padded
+        to fixed buckets so repeat spec steps share one executable."""
+        W = self._spec.draft_window
+        B = next_power_of_2(len(reqs))
+        T = W + k
+        tokens = np.zeros((B, T), np.int32)
+        lens = np.ones((B,), np.int32)
+        for i, r in enumerate(reqs):
+            ids = (r.prompt_token_ids + r.output_token_ids)[-W:]
+            tokens[i, :len(ids)] = ids
+            lens[i] = len(ids)
+        out = np.asarray(self._exec_draft_propose(
+            jnp.asarray(tokens), jnp.asarray(lens), k=k))
+        return [[int(t) for t in out[i]] for i in range(len(reqs))]
 
     def _spec_govern(self, proposed: int, accepted: int) -> None:
         """Adaptive speculation (SpecConfig.adaptive): accumulate a rolling

@@ -23,6 +23,18 @@ import dataclasses
 @dataclasses.dataclass(frozen=True)
 class SpecConfig:
     num_draft_tokens: int = 4        # k: draft window is k+1 rows
+    # ---- draft-model speculation (vLLM's draft-model mode) --------------
+    # A smaller registered model proposes the k tokens instead of prompt
+    # lookup.  The draft runs STATELESSLY over the last ``draft_window``
+    # tokens each spec step (models/transformer.draft_propose) — no draft
+    # KV cache to mirror through the target's allocate/advance/preempt
+    # lifecycle, which is where draft-model implementations rot.  The
+    # truncated context costs some proposal quality; the governor below
+    # measures what acceptance actually survives and pauses when it
+    # doesn't pay.  None = n-gram prompt lookup (model-free).
+    draft_model: str | None = None
+    draft_checkpoint_dir: str | None = None
+    draft_window: int = 64           # context the draft sees per proposal
     max_ngram: int = 3               # longest trailing n-gram to match
     min_ngram: int = 1
     # only the most recent window is scanned for matches: the proposer runs

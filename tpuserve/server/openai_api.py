@@ -1296,8 +1296,18 @@ def main(argv=None):
                          "(e.g. '8x128,1x512') so first /v1/embeddings "
                          "requests don't stall on a trunk compile")
     ap.add_argument("--speculative-k", type=int, default=0,
-                    help="n-gram speculative decoding with k draft tokens "
-                         "(0 disables; greedy requests only)")
+                    help="speculative decoding with k draft tokens "
+                         "(0 disables; greedy requests only).  Proposals "
+                         "come from n-gram prompt lookup, or a draft "
+                         "model with --speculative-draft-model")
+    ap.add_argument("--speculative-draft-model", default=None,
+                    help="registered model name proposing the draft "
+                         "tokens (stateless truncated-window drafts — "
+                         "vLLM's draft-model mode); needs the target's "
+                         "vocab")
+    ap.add_argument("--speculative-draft-dir", default=None,
+                    help="checkpoint dir for the draft model (default: "
+                         "random init — test/smoke only)")
     ap.add_argument("--multi-step", type=int, default=None,
                     help="fused decode window size — S decode+sample steps "
                          "per dispatch (default: auto — 32 on TPU, off on "
@@ -1349,7 +1359,14 @@ def main(argv=None):
     spec = None
     if args.speculative_k > 0:
         from tpuserve.runtime.spec import SpecConfig
-        spec = SpecConfig(num_draft_tokens=args.speculative_k)
+        spec = SpecConfig(num_draft_tokens=args.speculative_k,
+                          draft_model=args.speculative_draft_model,
+                          draft_checkpoint_dir=args.speculative_draft_dir)
+    elif args.speculative_draft_model:
+        ap.error("--speculative-draft-model needs --speculative-k > 0")
+    if args.speculative_draft_dir and not args.speculative_draft_model:
+        ap.error("--speculative-draft-dir needs --speculative-draft-model "
+                 "(the dir would be silently ignored)")
     lora_modules = None
     if args.lora_modules:
         lora_modules = {}
