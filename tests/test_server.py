@@ -3,6 +3,7 @@ the gateway (llm-d-test.yaml: GET /v1/models, POST /v1/completions), plus
 chat, streaming, metrics, and probes."""
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -542,3 +543,58 @@ def test_backpressure_streaming_gets_real_503(server):
         assert ei.value.code == 503
     finally:
         engine_mod.Engine.add_request = orig
+
+
+def test_graceful_drain_finishes_inflight_and_rejects_new():
+    """drain(): readyz flips to 503 and new requests 503 immediately,
+    while an in-flight stream runs to completion — the K8s rolling-update
+    contract (SIGTERM -> drain inside terminationGracePeriodSeconds)."""
+    import threading
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=256,
+                          max_blocks_per_seq=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    result = {}
+
+    def long_request():
+        try:
+            result["body"] = _post(base + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": [5, 9, 12],
+                "max_tokens": 220, "temperature": 0,
+                "ignore_eos": True})[1]
+        except Exception as e:                    # pragma: no cover
+            result["err"] = e
+
+    t = threading.Thread(target=long_request)
+    t.start()
+    # wait until the request is actually in flight
+    for _ in range(200):
+        if eng.has_work():
+            break
+        time.sleep(0.01)
+    drained = {}
+    dt = threading.Thread(target=lambda: drained.setdefault(
+        "ok", srv.drain(timeout_s=60)))
+    dt.start()
+    for _ in range(200):
+        if srv.draining:
+            break
+        time.sleep(0.01)
+    # new work is rejected while the old stream keeps running
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/v1/completions", {"model": "tiny-qwen3",
+                                         "prompt": "x", "max_tokens": 2})
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base + "/readyz")
+    assert ei.value.code == 503
+    t.join(timeout=120)
+    dt.join(timeout=120)
+    assert drained.get("ok") is True
+    assert "err" not in result
+    assert result["body"]["usage"]["completion_tokens"] == 220

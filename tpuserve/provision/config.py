@@ -59,6 +59,10 @@ class DeployConfig:
     lora_modules: Optional[dict] = None
     # Admission backpressure cap (server --max-waiting); 0 = auto
     max_waiting: int = 0
+    # Graceful-drain budget on SIGTERM (server --drain-timeout); the
+    # emitted pod spec's terminationGracePeriodSeconds is derived from
+    # this (+35 s headroom) so K8s never SIGKILLs mid-drain
+    drain_timeout_s: int = 25
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
     storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
@@ -162,6 +166,8 @@ class DeployConfig:
                                  "with tp/pp/disagg/speculation)")
         if self.max_waiting < -1:
             raise ValueError("max_waiting must be >= -1")
+        if self.drain_timeout_s < 0:
+            raise ValueError("drain_timeout_s must be >= 0")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.

@@ -170,6 +170,8 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
                                       in cfg.lora_modules.items()]
     if cfg.max_waiting:
         args += ["--max-waiting", str(cfg.max_waiting)]
+    if cfg.drain_timeout_s != 25:
+        args += ["--drain-timeout", str(cfg.drain_timeout_s)]
     args += extra_args or []
     tpu_req = {TPU_RESOURCE: str(cfg.chips_per_replica)} \
         if cfg.provider == "gke" else {}
@@ -236,6 +238,11 @@ def engine_deployment(cfg: DeployConfig, *, role: Optional[str] = None,
                 "containers": [_engine_container(cfg, role=role,
                                                  extra_args=extra_args)],
                 "volumes": volumes,
+                # rolling updates: the server drains on SIGTERM (readyz
+                # flips, in-flight streams finish) inside
+                # drain_timeout_s; the grace period is DERIVED from it
+                # (+35 s headroom) so K8s never SIGKILLs mid-drain
+                "terminationGracePeriodSeconds": cfg.drain_timeout_s + 35,
             },
         },
     }

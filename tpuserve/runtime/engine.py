@@ -1331,13 +1331,14 @@ class Engine:
             return
         acc = self._spec_window[1] / self._spec_window[0]
         self._spec_window = [0, 0]
-        if acc < cfg.min_acceptance:
+        floor = cfg.effective_min_acceptance   # draft mode pays k extra
+        if acc < floor:                        # device passes per step
             self._spec_resume_step = (self.stats.num_decode_steps
                                       + cfg.adaptive_pause_steps)
             self.stats.spec_pauses += 1
             logger.info(
                 "speculation paused: rolling acceptance %.3f < %.3f; "
-                "re-probing after %d decode steps", acc, cfg.min_acceptance,
+                "re-probing after %d decode steps", acc, floor,
                 cfg.adaptive_pause_steps)
 
     def _flush_pending(self) -> list[RequestOutput]:

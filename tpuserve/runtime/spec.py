@@ -57,6 +57,16 @@ class SpecConfig:
     # roughly (verify_cost/decode_cost - 1) / k ~= 0.08 at k=4; 0.15 adds
     # margin for the host-side proposer cost.
     min_acceptance: float = 0.15
+    # draft-model break-even is much higher: each spec step also pays k
+    # cache-less draft forward passes on the device BEFORE the verify
+    # pass, so a mediocre draft must clear a real bar or speculation is a
+    # permanent slowdown the governor never notices
+    min_acceptance_draft: float = 0.35
+
+    @property
+    def effective_min_acceptance(self) -> float:
+        return (self.min_acceptance_draft if self.draft_model
+                else self.min_acceptance)
     # judge only after this many proposed tokens (a handful of cold steps
     # must not condemn the workload)
     adaptive_window_proposed: int = 256

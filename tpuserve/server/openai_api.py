@@ -214,6 +214,12 @@ class OpenAIServer:
         self.runner = AsyncEngineRunner(engine, self.metrics)
         self.engine = engine
         self.ready = threading.Event()
+        self.draining = False          # drain(): reject new work, finish old
+        # live POST handlers: drain() must wait for DELIVERY, not just for
+        # the engine to queue the last token — a slow-reading stream would
+        # otherwise be cut when daemon handler threads die at process exit
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
         self._chat_template = None
@@ -257,6 +263,37 @@ class OpenAIServer:
         logger.info("serving %s on %s:%d", self.model_name,
                     self.config.host, port)
         return port
+
+    def _handler_enter(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def _handler_exit(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def drain(self, timeout_s: float = 25.0) -> bool:
+        """Graceful shutdown, the K8s rolling-update contract: flip
+        /readyz to 503 (the Service stops routing here), reject NEW
+        requests with a retryable 503, let in-flight generation finish,
+        then stop.  Returns True when everything drained inside the
+        timeout (which must be shorter than the pod's
+        terminationGracePeriodSeconds, or SIGKILL cuts the streams this
+        method exists to protect).
+        """
+        self.draining = True
+        self.ready.clear()
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            if self.runner.idle() and self._inflight == 0:
+                drained = True
+                break
+            time.sleep(0.05)
+        if not drained:
+            logger.warning("drain timed out with work in flight")
+        self.shutdown()
+        return drained
 
     def shutdown(self) -> None:
         self.ready.clear()
@@ -476,6 +513,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
 
     def do_POST(self):
+        if self.ctx.draining:
+            # graceful drain: in-flight streams keep running; everything
+            # new gets a retryable 503 (the LB already saw /readyz flip)
+            self._error(503, "server is draining; retry another replica",
+                        "server_error")
+            return
+        self.ctx._handler_enter()
+        try:
+            self._do_post_inner()
+        finally:
+            self.ctx._handler_exit()
+
+    def _do_post_inner(self):
         if self.path == "/internal/migrate":
             self._handle_migrate()
             return
@@ -1350,6 +1400,9 @@ def main(argv=None):
     ap.add_argument("--no-pipeline", dest="pipeline", action="store_false",
                     help="force synchronous decode")
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--drain-timeout", type=float, default=25.0,
+                    help="graceful-drain budget on SIGTERM, seconds; keep "
+                         "below the pod's terminationGracePeriodSeconds")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -1457,10 +1510,18 @@ def main(argv=None):
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)
+    # K8s rolling updates SIGTERM the pod, then SIGKILL after
+    # terminationGracePeriodSeconds: drain (readyz->503, new work 503,
+    # in-flight finishes) inside that window instead of dying mid-stream
+    import signal
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
     try:
-        threading.Event().wait()
+        stop.wait()
+        logger.info("SIGTERM: draining")
+        server.drain(timeout_s=args.drain_timeout)
     except KeyboardInterrupt:
-        server.shutdown()
+        server.drain(timeout_s=args.drain_timeout)
 
 
 if __name__ == "__main__":

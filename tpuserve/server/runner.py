@@ -88,6 +88,18 @@ class AsyncEngineRunner:
             self._started = True
             self._thread.start()
 
+    def idle(self) -> bool:
+        """No engine work and no undelivered outputs — safe to stop.
+        Polled by the server's graceful drain."""
+        try:
+            busy = self.engine.has_work()
+        except Exception:
+            busy = False
+        # _intake matters too: a request accepted by the handler just
+        # before draining flipped may still sit queued for the engine
+        # loop — stopping now would silently drop it
+        return not busy and not self._out_queues and self._intake.empty()
+
     def shutdown(self) -> None:
         self._stop.set()
         self._wake.set()
