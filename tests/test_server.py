@@ -598,3 +598,26 @@ def test_graceful_drain_finishes_inflight_and_rejects_new():
     assert drained.get("ok") is True
     assert "err" not in result
     assert result["body"]["usage"]["completion_tokens"] == 220
+
+
+def test_retrieve_model_route(server):
+    status, body = _get(server + "/v1/models/tiny-qwen3")
+    assert status == 200 and body["id"] == "tiny-qwen3"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server + "/v1/models/nope")
+    assert ei.value.code == 404
+
+
+def test_truncate_prompt_tokens(server):
+    """vLLM truncate_prompt_tokens: only the LAST N prompt tokens count
+    (visible via usage.prompt_tokens)."""
+    _, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": list(range(1, 21)),
+        "truncate_prompt_tokens": 5, "max_tokens": 2, "temperature": 0,
+        "ignore_eos": True})
+    assert body["usage"]["prompt_tokens"] == 5
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x",
+            "truncate_prompt_tokens": 0, "max_tokens": 2})
+    assert ei.value.code == 400

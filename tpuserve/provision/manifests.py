@@ -170,8 +170,10 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
                                       in cfg.lora_modules.items()]
     if cfg.max_waiting:
         args += ["--max-waiting", str(cfg.max_waiting)]
-    if cfg.drain_timeout_s != 25:
-        args += ["--drain-timeout", str(cfg.drain_timeout_s)]
+    # always emitted: the config value and the pod's grace period are
+    # derived together — relying on the server's CLI default here would
+    # let the two skew if that default ever moves
+    args += ["--drain-timeout", str(cfg.drain_timeout_s)]
     args += extra_args or []
     tpu_req = {TPU_RESOURCE: str(cfg.chips_per_replica)} \
         if cfg.provider == "gke" else {}
@@ -191,6 +193,13 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         "name": role or "engine",
         "image": cfg.image,
         "command": args,
+        # preStop sleep: K8s removes the pod from Service endpoints
+        # concurrently with termination; holding SIGTERM for a few
+        # seconds lets that propagate so new requests stop ARRIVING
+        # before the drain starts 503ing them (no client-visible errors
+        # on a routine rollout)
+        "lifecycle": {"preStop": {"exec": {
+            "command": ["sleep", "5"]}}},
         "ports": [{"containerPort": cfg.engine_port, "name": "http"}],
         "env": env,
         "resources": {"limits": dict(tpu_req)} if tpu_req else {},
@@ -241,8 +250,9 @@ def engine_deployment(cfg: DeployConfig, *, role: Optional[str] = None,
                 # rolling updates: the server drains on SIGTERM (readyz
                 # flips, in-flight streams finish) inside
                 # drain_timeout_s; the grace period is DERIVED from it
-                # (+35 s headroom) so K8s never SIGKILLs mid-drain
-                "terminationGracePeriodSeconds": cfg.drain_timeout_s + 35,
+                # (+ the 5 s preStop + 35 s headroom) so K8s never
+                # SIGKILLs mid-drain
+                "terminationGracePeriodSeconds": cfg.drain_timeout_s + 40,
             },
         },
     }

@@ -126,6 +126,15 @@ _probe = {
     "additionalProperties": False,
 }
 
+_lifecycle_handler = {
+    "type": "object",
+    "properties": {
+        "exec": _probe["properties"]["exec"],
+        "httpGet": _probe["properties"]["httpGet"],
+    },
+    "additionalProperties": False,
+}
+
 _container = {
     "type": "object",
     "properties": {
@@ -162,6 +171,14 @@ _container = {
         "livenessProbe": _probe,
         "startupProbe": _probe,
         "securityContext": {"type": "object"},
+        "lifecycle": {
+            "type": "object",
+            "properties": {
+                "preStop": _lifecycle_handler,
+                "postStart": _lifecycle_handler,
+            },
+            "additionalProperties": False,
+        },
     },
     "required": ["name", "image"],
     "additionalProperties": False,

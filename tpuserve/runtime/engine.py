@@ -509,6 +509,14 @@ class Engine:
                 raise ValueError("need prompt or prompt_token_ids")
             prompt_token_ids = self.tokenizer.encode(prompt)
         prompt_token_ids = list(prompt_token_ids)
+        if params.truncate_prompt_tokens is not None:
+            if params.truncate_prompt_tokens < 1:
+                # a negative slice would keep all-but-the-FIRST-N tokens —
+                # the opposite of the documented keep-last-N semantics
+                raise ValueError("truncate_prompt_tokens must be >= 1")
+            # vLLM semantics: keep the LAST N tokens
+            prompt_token_ids = prompt_token_ids[
+                -params.truncate_prompt_tokens:]
         if not prompt_token_ids:
             raise ValueError("empty prompt")
         if jax.process_count() > 1 and params.multihost_unsupported():

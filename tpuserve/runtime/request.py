@@ -53,6 +53,9 @@ class SamplingParams:
     # vLLM priority scheduling: LOWER value = admitted sooner; FIFO
     # within a level (runtime/scheduler.py Scheduler.add)
     priority: int = 0
+    # vLLM truncate_prompt_tokens: keep only the LAST N prompt tokens
+    # at intake (clients cap their own context budget server-side)
+    truncate_prompt_tokens: Optional[int] = None
     # Structured output (OpenAI response_format): "json" constrains
     # generation to one valid JSON object, "json_schema" additionally to
     # ``guided_schema`` — both via per-step candidate validation

@@ -168,6 +168,9 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
             raise ValueError(f"unsupported guided_regex: {e}") from None
         guided = "regex"
         guided_schema = gre
+    tpt = _num(body, "truncate_prompt_tokens", None, int)
+    if tpt is not None and tpt < 1:
+        raise ValueError("'truncate_prompt_tokens' must be >= 1")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     if max_tokens < 0:
         raise ValueError("'max_tokens' must be >= 0 (0 only for prompt "
@@ -191,6 +194,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         guided=guided,
         guided_schema=guided_schema,
         priority=priority,
+        truncate_prompt_tokens=tpt,
     )
 
 
@@ -482,6 +486,22 @@ class _Handler(BaseHTTPRequestHandler):
                       "owned_by": "tpuserve", "parent": ctx.model_name}
                      for name in ctx.lora_names]
             self._json(200, {"object": "list", "data": data})
+        elif self.path.startswith("/v1/models/"):
+            # OpenAI retrieve-model: GET /v1/models/{id} (ids may contain
+            # '/', e.g. Qwen/Qwen3-0.6B — match the raw suffix)
+            from urllib.parse import unquote
+            wanted = unquote(self.path[len("/v1/models/"):])
+            now = int(time.time())
+            if wanted == ctx.model_name:
+                self._json(200, {"id": wanted, "object": "model",
+                                 "created": now, "owned_by": "tpuserve"})
+            elif wanted in (ctx.lora_names or ()):
+                self._json(200, {"id": wanted, "object": "model",
+                                 "created": now, "owned_by": "tpuserve",
+                                 "parent": ctx.model_name})
+            else:
+                self._error(404, f"model {wanted!r} not found",
+                            "invalid_request_error")
         elif self.path == "/metrics":
             data = ctx.metrics.render()
             self.send_response(200)
@@ -513,14 +533,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
 
     def do_POST(self):
-        if self.ctx.draining:
-            # graceful drain: in-flight streams keep running; everything
-            # new gets a retryable 503 (the LB already saw /readyz flip)
-            self._error(503, "server is draining; retry another replica",
-                        "server_error")
-            return
+        # enter BEFORE the draining check: checking first races drain()'s
+        # inflight==0 poll — a thread descheduled between check and enter
+        # would submit into an already-stopped engine loop and hang its
+        # client for the submit timeout
         self.ctx._handler_enter()
         try:
+            if self.ctx.draining:
+                # graceful drain: in-flight streams keep running;
+                # everything new gets a retryable 503
+                self._error(503, "server is draining; retry another "
+                                 "replica", "server_error")
+                return
             self._do_post_inner()
         finally:
             self.ctx._handler_exit()
