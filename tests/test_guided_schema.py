@@ -95,7 +95,7 @@ def test_schema_accepts_conforming_documents():
 def test_schema_rejections_at_the_earliest_char():
     for bad in ('{"z"',                # key not in properties
                 '{"a": "',             # wrong type for a
-                '{"a": -',             # minimum 0: '-' can never satisfy
+                '{"a": -4',            # minimum 0: negatives die at '4'
                 '{"a": 3.',            # integer forbids '.'
                 '{"k": "blu',          # enum prefix dies at 'u'
                 '{"k": 9',             # number enum prefix dies
@@ -112,13 +112,15 @@ def test_schema_rejections_at_the_earliest_char():
 
 
 def test_schema_number_dead_end_prevention():
-    """Sign/zero/integer-magnitude prefixes that can never satisfy the
-    bounds are rejected at the EARLIEST char — a dead-end state would
-    trap the candidate substitution until max_tokens."""
+    """Sign/integer prefixes that can never satisfy the bounds are
+    rejected at the EARLIEST char — a dead-end state would trap the
+    candidate substitution until max_tokens.  Floats keep their
+    fraction/exponent escape routes ('0.5e3' = 500), so only SIGN-level
+    exclusions are decidable early there."""
     imin = {"type": "object", "additionalProperties": False,
             "properties": {"a": {"type": "integer", "minimum": 1}}}
     assert _feed(imin, '{"a": -') is None       # negatives unreachable
-    assert _feed(imin, '{"a": 0') is None       # zero can't grow
+    assert _feed(imin, '{"a": 0') is None       # integer zero can't grow
     assert _feed(imin, '{"a": 2}') is not None
     imax = {"type": "object", "additionalProperties": False,
             "properties": {"a": {"type": "integer", "maximum": 12}}}
@@ -127,7 +129,11 @@ def test_schema_number_dead_end_prevention():
     neg = {"type": "object", "additionalProperties": False,
            "properties": {"a": {"type": "number", "maximum": -1}}}
     assert _feed(neg, '{"a": 3') is None        # must start negative
-    assert _feed(neg, '{"a": -0') is None       # -0 == 0 > maximum
+    # float '-0' reaches -0.5e1 = -5: a valid prefix; the VALUE -0 still
+    # fails at value end
+    assert _feed(neg, '{"a": -0') is not None
+    assert _feed(neg, '{"a": -0}') is None
+    assert _feed(neg, '{"a": -0.5e1}') is not None
     assert _feed(neg, '{"a": -2.5}') is not None
     # floats keep exponent escape routes: '15' under maximum 12 is NOT a
     # dead end (15e-1 = 1.5), so only value-end enforcement applies
@@ -135,6 +141,18 @@ def test_schema_number_dead_end_prevention():
             "properties": {"a": {"type": "number", "maximum": 12}}}
     assert _feed(fmax, '{"a": 15e-1}') is not None
     assert _feed(fmax, '{"a": 15}') is None
+    # regression (r4 review): fractional bounds must not kill zero starts
+    fr = {"type": "object", "additionalProperties": False,
+          "properties": {"a": {"type": "number", "minimum": 0.5}}}
+    assert _feed(fr, '{"a": 0.7}') is not None
+    assert _feed(fr, '{"a": 0.3}') is None      # value end
+    pos = {"type": "object", "additionalProperties": False,
+           "properties": {"a": {"type": "number",
+                                "exclusiveMinimum": 0}}}
+    assert _feed(pos, '{"a": 0.5}') is not None
+    negf = {"type": "object", "additionalProperties": False,
+            "properties": {"a": {"type": "number", "maximum": -0.5}}}
+    assert _feed(negf, '{"a": -0.7}') is not None
 
 
 def test_compile_rejects_unsatisfiable_required():

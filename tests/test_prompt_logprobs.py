@@ -177,3 +177,16 @@ def test_http_streaming_echo_logprobs_covers_prompt(server):
     assert gen_lp and all(
         all(isinstance(v, float) for v in g["token_logprobs"])
         for g in gen_lp)
+
+
+def test_scoring_honors_truncate_prompt_tokens(server):
+    """Prompt scoring must see the SAME context the engine serves
+    (r4 review: untruncated scoring misaligned the arrays with usage)."""
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": list(range(1, 21)),
+        "truncate_prompt_tokens": 5, "max_tokens": 0,
+        "echo": True, "logprobs": 1})
+    assert status == 200
+    lp = body["choices"][0]["logprobs"]
+    assert lp["tokens"] == list(range(16, 21))     # the LAST 5
+    assert body["usage"]["prompt_tokens"] == 5

@@ -621,3 +621,28 @@ def test_truncate_prompt_tokens(server):
             "model": "tiny-qwen3", "prompt": "x",
             "truncate_prompt_tokens": 0, "max_tokens": 2})
     assert ei.value.code == 400
+
+
+def test_streaming_partial_choice_rejection_gets_status(server):
+    """n>1 stream where a LATER choice is rejected at intake: the hold-
+    back must cover every choice, so the client sees a real 503 — not a
+    200 with the error buried in an SSE chunk (r4 review)."""
+    import tpuserve.runtime.engine as engine_mod
+    orig = engine_mod.Engine.add_request
+    calls = {"n": 0}
+
+    def second_fails(self, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise MemoryError("waiting queue full (test)")
+        return orig(self, *a, **kw)
+    engine_mod.Engine.add_request = second_fails
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "x", "max_tokens": 4,
+                "n": 2, "temperature": 0.9, "stream": True,
+                "ignore_eos": True})
+        assert ei.value.code == 503
+    finally:
+        engine_mod.Engine.add_request = orig

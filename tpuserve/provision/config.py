@@ -153,6 +153,7 @@ class DeployConfig:
                     for k, v in self.lora_modules.items()):
                 raise ValueError("lora_modules must map adapter names "
                                  "(no '=') to paths")
+        if self.lora_modules:      # empty dict = no adapters = no limits
             if self.model in self.lora_modules:
                 # the server's argparse rejects this at startup — catch it
                 # before it becomes an in-cluster CrashLoopBackOff

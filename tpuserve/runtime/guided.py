@@ -709,25 +709,32 @@ class SchemaJsonStateMachine(JsonStateMachine):
                     and node["exclusiveMaximum"] <= 0))
 
     def _check_number_start(self, node, ch: str) -> None:
-        """Reject sign/zero starts that can NEVER satisfy the bounds —
-        left alone they become dead-end states the candidate substitution
-        cannot escape (every terminator fails the bound at value end,
-        while digits stay 'valid' until max_tokens)."""
-        no_negative = ((node.get("minimum") is not None
-                        and node["minimum"] >= 0)
-                       or (node.get("exclusiveMinimum") is not None
-                           and node["exclusiveMinimum"] >= 0))
-        if ch == "-" and no_negative:
-            raise ValueError("schema bounds forbid negative numbers")
-        if ch != "-" and self._only_negative(node):
+        """Reject sign starts that can NEVER satisfy the bounds — left
+        alone they become dead-end states the candidate substitution
+        cannot escape.  Only SIGN-level exclusions are decidable at the
+        first char for floats: exponents make almost any magnitude
+        reachable from any prefix ('0.5e3' = 500), so '-' is dead only
+        when the bounds exclude ALL of (-inf, 0], and a digit start only
+        when they exclude all of [0, inf).  Integers (no '.'/'e') get the
+        stricter zero/magnitude checks in _hook_scalar_char."""
+        lo = node.get("minimum")
+        elo = node.get("exclusiveMinimum")
+        if ch == "-":
+            # reachable values: (-inf, 0] (-0 == 0 covers minimum == 0)
+            if (lo is not None and lo > 0) or \
+                    (elo is not None and elo >= 0):
+                raise ValueError("schema bounds forbid negative numbers")
+            return
+        # digit start: reachable values [0, inf)
+        if self._only_negative(node):
             raise ValueError("schema bounds require a negative number")
-        zero_dead = ((node.get("minimum") is not None
-                      and node["minimum"] > 0)
-                     or (node.get("exclusiveMinimum") is not None
-                         and node["exclusiveMinimum"] >= 0))
-        if ch == "0" and zero_dead:
-            # '0' admits only '.'/'e' continuations — the value stays 0
-            raise ValueError("schema bounds forbid zero")
+        allowed = _allowed_types(node)
+        if ch == "0" and "integer" in allowed and "number" not in allowed:
+            # integer '0' cannot grow (leading-zero rule, no exponent):
+            # the value IS 0
+            if (lo is not None and lo > 0) or \
+                    (elo is not None and elo >= 0):
+                raise ValueError("schema bounds forbid zero")
 
     def _hook_scalar_char(self, ch: str) -> None:
         if self.enum_cands is not None:
@@ -744,8 +751,9 @@ class SchemaJsonStateMachine(JsonStateMachine):
                             and "number" not in allowed)
             if integer_only and ch in ".eE":
                 raise ValueError("schema expects an integer")
-            if ch == "0" and self.val_text == "-" \
+            if ch == "0" and self.val_text == "-" and integer_only \
                     and self._only_negative(node):
+                # integer '-0' IS 0 (no fraction/exponent escape)
                 raise ValueError("schema bounds forbid -0")
             self.val_text += ch
             # integer magnitude dead-ends: no exponent can shrink an

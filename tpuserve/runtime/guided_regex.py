@@ -349,9 +349,29 @@ class _Parser:
         return _Frag(a, [b])
 
 
-def compile_regex(pattern: str) -> _State:
-    """Compile to an NFA start state; raises :class:`RegexError` on
-    unsupported syntax (listed in the module docstring)."""
+class CompiledRegex:
+    """NFA start state + a transition memo SHARED by every machine over
+    this pattern.  The per-candidate clone+feed in the engine's
+    substitution loop re-walks the same state sets thousands of times per
+    generated token; memoising (state_set, char) -> next_set turns that
+    into dict lookups (a lazily-built DFA).  Dead transitions memoise
+    too — rejection is the common case while filtering candidates."""
+
+    __slots__ = ("start", "memo")
+
+    MAX_MEMO = 1 << 16      # lazily-built DFA edge cap (bypass past it)
+
+    def __init__(self, start: _State):
+        self.start = start
+        self.memo: dict = {}
+
+
+_DEAD = frozenset()
+
+
+def compile_regex(pattern: str) -> CompiledRegex:
+    """Compile to an NFA; raises :class:`RegexError` on unsupported
+    syntax (listed in the module docstring)."""
     if not isinstance(pattern, str) or not pattern:
         raise RegexError("pattern must be a non-empty string")
     parser = _Parser(pattern)
@@ -363,7 +383,7 @@ def compile_regex(pattern: str) -> _State:
     end.accept = True
     for o in frag.outs:
         o.eps.append(end)
-    return frag.start
+    return CompiledRegex(frag.start)
 
 
 def _closure(states: frozenset) -> frozenset:
@@ -388,15 +408,15 @@ class RegexStateMachine:
     runes) are substituted, never waved through.
     """
 
-    __slots__ = ("start", "states")
+    __slots__ = ("compiled", "states")
 
-    def __init__(self, start: _State):
-        self.start = start
-        self.states = _closure(frozenset((start,)))
+    def __init__(self, compiled: CompiledRegex):
+        self.compiled = compiled
+        self.states = _closure(frozenset((compiled.start,)))
 
     def clone(self) -> "RegexStateMachine":
         c = RegexStateMachine.__new__(RegexStateMachine)
-        c.start = self.start
+        c.compiled = self.compiled
         c.states = self.states
         return c
 
@@ -422,10 +442,18 @@ class RegexStateMachine:
 
     def feed(self, text: str) -> None:
         states = self.states
+        memo = self.compiled.memo
         for ch in text:
-            nxt = {t for s in states for pred, t in s.trans if pred(ch)}
+            key = (states, ch)
+            nxt = memo.get(key)
+            if nxt is None:
+                raw = {t for s in states for pred, t in s.trans
+                       if pred(ch)}
+                nxt = _closure(frozenset(raw)) if raw else _DEAD
+                if len(memo) < CompiledRegex.MAX_MEMO:
+                    memo[key] = nxt
             if not nxt:
                 raise ValueError(
                     f"char {ch!r} matches no continuation of the pattern")
-            states = _closure(frozenset(nxt))
+            states = nxt
         self.states = states

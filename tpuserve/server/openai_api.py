@@ -887,11 +887,17 @@ class _Handler(BaseHTTPRequestHandler):
                               for t, lp in e["top"]]}
             for e in entries]}
 
-    def _prompt_ids(self, kwargs) -> list:
+    def _prompt_ids(self, kwargs, params=None) -> list:
         eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
         if "prompt_token_ids" in kwargs:
-            return list(kwargs["prompt_token_ids"])
-        return list(eng.tokenizer.encode(kwargs["prompt"]))
+            ids = list(kwargs["prompt_token_ids"])
+        else:
+            ids = list(eng.tokenizer.encode(kwargs["prompt"]))
+        if params is not None and params.truncate_prompt_tokens:
+            # scoring must see the SAME context the engine serves, or the
+            # logprob arrays misalign with usage and the conditioning
+            ids = ids[-params.truncate_prompt_tokens:]
+        return ids
 
     def _score_only_response(self, body, params, kwargs):
         """OpenAI prompt scoring: completions with max_tokens=0 + echo +
@@ -899,14 +905,15 @@ class _Handler(BaseHTTPRequestHandler):
         the same via prompt_logprobs)."""
         ctx = self.ctx
         eng = getattr(ctx.engine, "prefill", ctx.engine)
-        ids = self._prompt_ids(kwargs)
+        ids = self._prompt_ids(kwargs, params)
         try:
             entries = eng.score_prompts([ids], top_n=params.logprobs)[0]
         except ValueError as e:
             self._error(400, str(e))
             return
         text = kwargs.get("prompt")
-        if text is None:
+        if text is None or params.truncate_prompt_tokens:
+            # truncation: echo what actually conditioned the scoring
             text = eng.tokenizer.decode(ids)
         choice = {"index": 0, "text": text, "finish_reason": "length",
                   "logprobs": self._completions_logprobs(entries)}
@@ -917,13 +924,18 @@ class _Handler(BaseHTTPRequestHandler):
             "usage": {"prompt_tokens": len(ids), "completion_tokens": 0,
                       "total_tokens": len(ids)}})
 
-    def _echo_text(self, body, chat, kwargs):
-        """OpenAI completions `echo`: the prompt text to prepend, or None."""
+    def _echo_text(self, body, chat, kwargs, params=None):
+        """OpenAI completions `echo`: the prompt text to prepend, or None.
+        Under truncate_prompt_tokens the TRUNCATED text is echoed — that
+        is what conditioned the completion (and what the prompt-logprob
+        arrays cover)."""
         if chat or not body.get("echo"):
             return None
+        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
+        if params is not None and params.truncate_prompt_tokens:
+            return eng.tokenizer.decode(self._prompt_ids(kwargs, params))
         if "prompt" in kwargs:
             return kwargs["prompt"]
-        eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
         return eng.tokenizer.decode(kwargs["prompt_token_ids"])
 
     def _full_response(self, body, params, chat, kwargs, n=1, toolctx=None,
@@ -957,7 +969,7 @@ class _Handler(BaseHTTPRequestHandler):
         cands = []
         prompt_tokens = 0
         completion_tokens = 0
-        echo_text = self._echo_text(body, chat, kwargs)
+        echo_text = self._echo_text(body, chat, kwargs, params)
         prompt_entries = None
         if not chat and echo_text is not None and \
                 params.logprobs is not None:
@@ -966,7 +978,7 @@ class _Handler(BaseHTTPRequestHandler):
             eng = getattr(ctx.engine, "prefill", ctx.engine)
             try:
                 prompt_entries = eng.score_prompts(
-                    [self._prompt_ids(kwargs)],
+                    [self._prompt_ids(kwargs, params)],
                     top_n=params.logprobs)[0]
             except ValueError as e:
                 fail(400, str(e))
@@ -1073,33 +1085,41 @@ class _Handler(BaseHTTPRequestHandler):
             for rid, _ in submits:
                 ctx.runner.abort(rid)
 
-        # HOLD the 200 until choice 0 produces its first item: an intake
-        # rejection (400 validation, 503 backpressure) must surface as a
-        # real status line — a gateway doing flow control on 503s never
-        # sees an error that only exists as an SSE chunk inside a 200.
-        # Deferring headers to the first output costs nothing: the first
-        # byte a healthy stream can send is the first token anyway.
+        # HOLD the 200 until EVERY choice produces its first item: an
+        # intake rejection (400 validation, 503 backpressure) must surface
+        # as a real status line — a gateway doing flow control on 503s
+        # never sees an error that only exists as an SSE chunk inside a
+        # 200.  All n choices, not just choice 0: backpressure can admit
+        # the first and reject the second.  Deferring headers costs
+        # nothing: the choices share one prefill batch, so their first
+        # tokens land together.
         deadline = time.monotonic() + ctx.config.request_timeout_s
         import queue as _queue
-        try:
-            first0 = submits[0][1].get(
-                timeout=max(deadline - time.monotonic(), 0.001))
-        except _queue.Empty:
+        firsts = []
+        err = None
+        for rid, q in submits:
+            try:
+                item = q.get(timeout=max(deadline - time.monotonic(),
+                                         0.001))
+            except _queue.Empty:
+                err = TimeoutError("request timed out")
+                break
+            firsts.append(item)
+            if isinstance(item, Exception):
+                err = item
+                break
+        if err is not None:
             abort_all()
             for rid, _ in submits:
                 ctx.engine.requests.pop(rid, None)
-            self._error(504, "request timed out", "server_error")
-            return
-        if isinstance(first0, Exception):
-            abort_all()
-            for rid, _ in submits:
-                ctx.engine.requests.pop(rid, None)
-            if isinstance(first0, ValueError):
-                self._error(400, str(first0))
-            elif isinstance(first0, MemoryError):
-                self._error(503, str(first0), "server_error")
+            if isinstance(err, TimeoutError):
+                self._error(504, str(err), "server_error")
+            elif isinstance(err, MemoryError):
+                self._error(503, str(err), "server_error")
+            elif isinstance(err, ValueError):
+                self._error(400, str(err))
             else:
-                self._error(500, str(first0), "server_error")
+                self._error(500, str(err), "server_error")
             return
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
@@ -1120,7 +1140,8 @@ class _Handler(BaseHTTPRequestHandler):
             merged = None
         else:
             merged = _queue.Queue()
-            merged.put((0, first0))
+            for i, item in enumerate(firsts):
+                merged.put((i, item))
             import threading as _threading
 
             def pump(idx, q):
@@ -1149,7 +1170,7 @@ class _Handler(BaseHTTPRequestHandler):
                     if include_usage:
                         chunk["usage"] = None
                     send_chunk(chunk)
-            echo_text = self._echo_text(body, chat, kwargs)
+            echo_text = self._echo_text(body, chat, kwargs, params)
             if echo_text is not None:
                 # OpenAI echo semantics: the prompt text leads the stream.
                 # Prompt tokens are not completion tokens, so token_ids is
@@ -1164,8 +1185,9 @@ class _Handler(BaseHTTPRequestHandler):
                     eng = getattr(ctx.engine, "prefill", ctx.engine)
                     try:
                         prompt_lp = self._completions_logprobs(
-                            eng.score_prompts([self._prompt_ids(kwargs)],
-                                              top_n=params.logprobs)[0])
+                            eng.score_prompts(
+                                [self._prompt_ids(kwargs, params)],
+                                top_n=params.logprobs)[0])
                     except Exception as e:   # headers are out: error chunk
                         logger.exception("prompt scoring failed")
                         abort_all()
@@ -1199,12 +1221,13 @@ class _Handler(BaseHTTPRequestHandler):
             filters = ([toolctx.stream_filter() for _ in range(n)]
                        if chat and toolctx is not None else None)
             live = n
-            # choice 0's first item was read before the headers; for n > 1
-            # it was re-injected into the merged queue instead.  Sentinel,
-            # not None: a first item of None (finish marker after an
-            # instant abort) must still be delivered, not dropped.
+            # every choice's first item was read before the headers; for
+            # n > 1 they were re-injected into the merged queue instead.
+            # Sentinel, not None: a first item of None (finish marker
+            # after an instant abort) must still be delivered, not
+            # dropped.
             _consumed = object()
-            held = first0 if merged is None else _consumed
+            held = firsts[0] if merged is None else _consumed
             while live:
                 try:
                     if held is not _consumed:
