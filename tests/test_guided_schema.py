@@ -153,6 +153,19 @@ def test_schema_number_dead_end_prevention():
     negf = {"type": "object", "additionalProperties": False,
             "properties": {"a": {"type": "number", "maximum": -0.5}}}
     assert _feed(negf, '{"a": -0.7}') is not None
+    # regression (r4 review #2): a nonzero significand digit commits the
+    # sign — under minimum 0 the prefix '-3' can never terminate (all
+    # reachable values are strictly negative), so the DIGIT must die
+    m0 = {"type": "object", "additionalProperties": False,
+          "properties": {"a": {"type": "number", "minimum": 0}}}
+    assert _feed(m0, '{"a": -3') is None
+    assert _feed(m0, '{"a": -0.3') is None        # frac digit commits too
+    assert _feed(m0, '{"a": -0}') is not None     # -0 == 0 stays legal
+    x0 = {"type": "object", "additionalProperties": False,
+          "properties": {"a": {"type": "number", "maximum": 0}}}
+    assert _feed(x0, '{"a": 3') is None
+    assert _feed(x0, '{"a": 0}') is not None
+    assert _feed(x0, '{"a": -3}') is not None
 
 
 def test_compile_rejects_unsatisfiable_required():

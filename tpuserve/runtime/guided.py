@@ -85,8 +85,14 @@ class JsonStateMachine:
     def in_string(self) -> bool:
         """Inside a string (value or key) — the only modes where arbitrary
         text, and hence a partial multibyte rune contributing no decoded
-        text yet, is legal."""
-        return self.mode in ("string", "key-string")
+        text yet, is legal.  NOT while an escape or \\uXXXX sequence is
+        pending: those demand specific next chars, so a neutral-accepted
+        partial rune would assemble into a char the escape then rejects —
+        failing the authoritative feed and silently dropping the whole
+        constraint (observed as ~2% garbage-output flake under unseeded
+        sampling)."""
+        return (self.mode in ("string", "key-string")
+                and not self.esc and not self.uni)
 
     def allows(self, text: str) -> bool:
         """Would ``text`` keep the document valid?  (Clone + feed.)"""
@@ -550,6 +556,8 @@ class SchemaJsonStateMachine(JsonStateMachine):
         rejects, and the feed failure would deregister the whole
         constraint.  Report False there so such tokens are substituted
         instead of accepted."""
+        if self.esc or self.uni:       # see JsonStateMachine.in_string
+            return False
         if self.mode == "key-string":
             return not (self.frames
                         and self.frames[-1]["node"].get("additional",
@@ -755,6 +763,25 @@ class SchemaJsonStateMachine(JsonStateMachine):
                     and self._only_negative(node):
                 # integer '-0' IS 0 (no fraction/exponent escape)
                 raise ValueError("schema bounds forbid -0")
+            if ch in "123456789" and "e" not in self.val_text \
+                    and "E" not in self.val_text:
+                # a nonzero SIGNIFICAND digit commits the value's sign —
+                # exponents scale magnitude but never flip sign or zero a
+                # nonzero significand.  When the bounds confine this sign
+                # to exactly zero (minimum 0 after '-', maximum 0 on a
+                # positive start — the strict exclusions already rejected
+                # at the first char), the state is a dead end every
+                # terminator fails: reject the digit itself.
+                if self.val_text.startswith("-"):
+                    lo = node.get("minimum")
+                    if lo is not None and lo >= 0:
+                        raise ValueError(
+                            "schema bounds forbid negative numbers")
+                else:
+                    hi = node.get("maximum")
+                    if hi is not None and hi <= 0:
+                        raise ValueError(
+                            "schema bounds forbid positive numbers")
             self.val_text += ch
             # integer magnitude dead-ends: no exponent can shrink an
             # integer back under a bound, and further digits only grow it

@@ -538,6 +538,7 @@ class _Handler(BaseHTTPRequestHandler):
         # would submit into an already-stopped engine loop and hang its
         # client for the submit timeout
         self.ctx._handler_enter()
+        self._pid_cache = None     # per-request memo (keep-alive reuse)
         try:
             if self.ctx.draining:
                 # graceful drain: in-flight streams keep running;
@@ -888,16 +889,23 @@ class _Handler(BaseHTTPRequestHandler):
             for e in entries]}
 
     def _prompt_ids(self, kwargs, params=None) -> list:
+        # memoised per POST (reset in do_POST): echo + truncation +
+        # scoring would otherwise re-encode a long prompt up to 3x
+        key = params.truncate_prompt_tokens if params is not None else None
+        cached = getattr(self, "_pid_cache", None)
+        if cached is not None and cached[0] == key:
+            return list(cached[1])
         eng = getattr(self.ctx.engine, "prefill", self.ctx.engine)
         if "prompt_token_ids" in kwargs:
             ids = list(kwargs["prompt_token_ids"])
         else:
             ids = list(eng.tokenizer.encode(kwargs["prompt"]))
-        if params is not None and params.truncate_prompt_tokens:
+        if key:
             # scoring must see the SAME context the engine serves, or the
             # logprob arrays misalign with usage and the conditioning
-            ids = ids[-params.truncate_prompt_tokens:]
-        return ids
+            ids = ids[-key:]
+        self._pid_cache = (key, ids)
+        return list(ids)
 
     def _score_only_response(self, body, params, kwargs):
         """OpenAI prompt scoring: completions with max_tokens=0 + echo +
