@@ -432,7 +432,9 @@ def _best_tpu_result(model):
                 best = {k: row.get(k) for k in
                         ("value", "unit", "vs_baseline", "variant",
                          "multi_step", "attn_impl", "ttft_ms", "model",
-                         "batch", "prompt_len", "gen_len", "ts", "commit")}
+                         "batch", "prompt_len", "gen_len", "ts", "commit",
+                         "reconstructed_from")
+                        if row.get(k) is not None}
                 best["from_log"] = name        # actual source of the row
     if best is not None:
         best["tpu_rows_recorded"] = n_rows
