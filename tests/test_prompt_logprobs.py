@@ -31,7 +31,7 @@ def test_score_prompt_matches_forward():
     B, T = 2, 16
     tokens = rng.integers(1, cfg.vocab_size - 1, size=(B, T)).astype(np.int32)
     lens = np.asarray([16, 11], np.int32)
-    chosen, top_ids, top_lps = transformer.score_prompt(
+    chosen, ranks, top_ids, top_lps = transformer.score_prompt(
         params, cfg, jnp.asarray(tokens), jnp.asarray(lens), top_n=3)
     full = transformer.forward(params, cfg, jnp.asarray(tokens),
                                jnp.asarray(lens))
@@ -41,6 +41,8 @@ def test_score_prompt_matches_forward():
             want = float(lps[b, i, tokens[b, i + 1]])
             np.testing.assert_allclose(float(chosen[b, i]), want,
                                        rtol=1e-5, atol=1e-5)
+            want_rank = 1 + int(np.sum(np.asarray(lps[b, i]) > want))
+            assert int(ranks[b, i]) == want_rank
             wt_l, wt_i = jax.lax.top_k(lps[b, i], 3)
             np.testing.assert_array_equal(np.asarray(top_ids[b, i]),
                                           np.asarray(wt_i))
@@ -190,3 +192,30 @@ def test_scoring_honors_truncate_prompt_tokens(server):
     lp = body["choices"][0]["logprobs"]
     assert lp["tokens"] == list(range(16, 21))     # the LAST 5
     assert body["usage"]["prompt_tokens"] == 5
+
+
+def test_vllm_prompt_logprobs_param(server):
+    """The literal vLLM extension field: prompt_logprobs=N returns a
+    per-choice list — None first, then {token_id: {logprob, rank,
+    decoded_token}} — alongside normal generation."""
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [5, 9, 12], "max_tokens": 2,
+        "temperature": 0, "prompt_logprobs": 2, "ignore_eos": True})
+    assert status == 200
+    plp = body["choices"][0]["prompt_logprobs"]
+    assert plp[0] is None and len(plp) == 3
+    for el, tid in zip(plp[1:], [9, 12]):
+        # chosen token present with a true full-vocab rank, plus the
+        # top-N alternatives (vLLM shape)
+        assert str(tid) in el and len(el) >= 2
+        chosen = el[str(tid)]
+        assert isinstance(chosen["logprob"], float)
+        assert isinstance(chosen["rank"], int) and chosen["rank"] >= 1
+        for v in el.values():
+            assert set(v) == {"logprob", "rank", "decoded_token"}
+    # streamed form rejected with guidance
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": "x", "max_tokens": 2,
+            "prompt_logprobs": 1, "stream": True})
+    assert ei.value.code == 400

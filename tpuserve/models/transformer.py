@@ -391,9 +391,11 @@ def score_prompt(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     (B,).  Runs the cache-less causal trunk, then scores the UNEMBED in
     (B, chunk, V) slices — materialising all (B, T, V) float32 logits at
     a 150k vocab would cost GBs for a page of text.  Returns
-    (chosen (B, T), top_ids (B, T, top_n), top_lps (B, T, top_n)) where
-    ``chosen[:, i]`` is log p(token_{i+1} | tokens_{<=i}) — callers shift
-    by one (the first prompt token has no conditional).
+    (chosen (B, T), ranks (B, T), top_ids (B, T, top_n),
+    top_lps (B, T, top_n)) where ``chosen[:, i]`` is
+    log p(token_{i+1} | tokens_{<=i}) and ``ranks[:, i]`` its 1-based
+    FULL-VOCAB rank (vLLM's prompt_logprobs contract) — callers shift by
+    one (the first prompt token has no conditional).
     """
     B, T = tokens.shape
     positions = jnp.arange(T)[None, :].repeat(B, axis=0)
@@ -420,16 +422,18 @@ def score_prompt(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         hc, nc = args                            # (B, chunk, H), (B, chunk)
         lps = jax.nn.log_softmax(_unembed(params, cfg, hc), axis=-1)
         chosen = jnp.take_along_axis(lps, nc[..., None], axis=-1)[..., 0]
+        rank = (jnp.sum(lps > chosen[..., None], axis=-1)
+                .astype(jnp.int32) + 1)          # 1-based full-vocab rank
         if k_eff:
             tl, ti = jax.lax.top_k(lps, k_eff)
         else:
             ti = jnp.zeros(nc.shape + (0,), jnp.int32)
             tl = jnp.zeros(nc.shape + (0,), jnp.float32)
-        return chosen, ti.astype(jnp.int32), tl
+        return chosen, rank, ti.astype(jnp.int32), tl
 
-    chosen, top_ids, top_lps = jax.lax.map(one, (hs, ns))
+    chosen, ranks, top_ids, top_lps = jax.lax.map(one, (hs, ns))
     merge = lambda x: x.swapaxes(0, 1).reshape((B, T) + x.shape[3:])
-    return merge(chosen), merge(top_ids), merge(top_lps)
+    return merge(chosen), merge(ranks), merge(top_ids), merge(top_lps)
 
 
 # --------------------------------------------------------------------------

@@ -1797,18 +1797,21 @@ class Engine:
         from tpuserve.models.transformer import score_prompt
         results = []
         for group, tokens, lens in self._trunk_batches(ids_list, 16):
-            chosen, top_ids, top_lps = score_prompt(
+            chosen, ranks, top_ids, top_lps = score_prompt(
                 self.params, self.model_cfg, tokens, lens, top_n=top_n)
             chosen = np.asarray(chosen)
+            ranks = np.asarray(ranks)
             top_ids = np.asarray(top_ids)
             top_lps = np.asarray(top_lps)
             for k, ids in enumerate(group):
-                entries = [{"token_id": ids[0], "logprob": None, "top": []}]
+                entries = [{"token_id": ids[0], "logprob": None,
+                            "rank": None, "top": []}]
                 for p in range(1, len(ids)):
                     # position p-1's distribution scores token p
                     entries.append({
                         "token_id": ids[p],
                         "logprob": float(chosen[k, p - 1]),
+                        "rank": int(ranks[k, p - 1]),
                         "top": [(int(t), float(l)) for t, l in
                                 zip(top_ids[k, p - 1], top_lps[k, p - 1])],
                     })

@@ -171,6 +171,9 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     tpt = _num(body, "truncate_prompt_tokens", None, int)
     if tpt is not None and tpt < 1:
         raise ValueError("'truncate_prompt_tokens' must be >= 1")
+    plp = _num(body, "prompt_logprobs", None, int)
+    if plp is not None and plp < 0:
+        raise ValueError("'prompt_logprobs' must be >= 0")
     max_tokens = min(_num(body, "max_tokens", 16, int), cap)
     if max_tokens < 0:
         raise ValueError("'max_tokens' must be >= 0 (0 only for prompt "
@@ -592,6 +595,18 @@ class _Handler(BaseHTTPRequestHandler):
         if (isinstance(adapter, str) and adapter != self.ctx.model_name
                 and adapter in (self.ctx.lora_names or ())):
             kwargs["adapter"] = adapter
+        if body.get("prompt_logprobs") is not None:
+            # vLLM extension: per-choice prompt logprobs on the response
+            if stream:
+                self._error(400, "prompt_logprobs is not supported with "
+                                 "stream=true; use echo+logprobs for "
+                                 "streamed prompt logprobs")
+                return
+            if "adapter" in kwargs:
+                self._error(400, "prompt_logprobs is served by the base "
+                                 "model; drop it or use "
+                                 f"model={self.ctx.model_name!r}")
+                return
         if not chat and body.get("echo") and params.logprobs is not None \
                 and "adapter" in kwargs:
             # the scoring trunk has no adapter threading — base-model
@@ -605,10 +620,13 @@ class _Handler(BaseHTTPRequestHandler):
             # the prompt's own logprobs with no generation (completions
             # only — chat has no echo, so 0 tokens buys nothing there)
             if (chat or stream or not body.get("echo")
-                    or params.logprobs is None or n != 1):
+                    or params.logprobs is None or n != 1
+                    or body.get("prompt_logprobs") is not None):
                 self._error(400, "max_tokens=0 is prompt scoring: requires "
                                  "completions with echo=true and logprobs, "
-                                 "non-streaming, n=1")
+                                 "non-streaming, n=1 (and not combined "
+                                 "with prompt_logprobs — it would be "
+                                 "redundant)")
                 return
             try:
                 self._score_only_response(body, params, kwargs)
@@ -978,16 +996,21 @@ class _Handler(BaseHTTPRequestHandler):
         prompt_tokens = 0
         completion_tokens = 0
         echo_text = self._echo_text(body, chat, kwargs, params)
+        # ONE scoring pass feeds both prompt-logprob response shapes:
+        # the vLLM prompt_logprobs field and the OpenAI echo+logprobs
+        # arrays (double-scoring a long prompt runs the quadratic
+        # cache-less trunk twice while generation requests sit submitted)
+        prompt_lp_field = None
         prompt_entries = None
-        if not chat and echo_text is not None and \
-                params.logprobs is not None:
-            # OpenAI echo+logprobs: the logprob arrays cover the PROMPT
-            # tokens too (first entry null), then the completion's
+        plp = body.get("prompt_logprobs")
+        want_echo_entries = (not chat and echo_text is not None
+                             and params.logprobs is not None)
+        if plp is not None or want_echo_entries:
             eng = getattr(ctx.engine, "prefill", ctx.engine)
             try:
-                prompt_entries = eng.score_prompts(
+                pent = eng.score_prompts(
                     [self._prompt_ids(kwargs, params)],
-                    top_n=params.logprobs)[0]
+                    top_n=max(int(plp or 0), params.logprobs or 0))[0]
             except ValueError as e:
                 fail(400, str(e))
                 return
@@ -998,6 +1021,25 @@ class _Handler(BaseHTTPRequestHandler):
                 logger.exception("prompt scoring failed")
                 fail(500, str(e), "server_error")
                 return
+            if want_echo_entries:
+                k = params.logprobs
+                prompt_entries = [dict(e, top=e["top"][:k]) for e in pent]
+            if plp is not None:
+                # vLLM shape: one element per prompt token — None first
+                # (no conditional), then {token_id: {logprob, rank,
+                # decoded_token}} covering the top-N alternatives AND the
+                # chosen token, with true full-vocab ranks
+                tok = eng.tokenizer.id_to_token
+                prompt_lp_field = [None]
+                for e in pent[1:]:
+                    el = {}
+                    for i, (tid, lp) in enumerate(e["top"][:int(plp)]):
+                        el[str(tid)] = {"logprob": lp, "rank": i + 1,
+                                        "decoded_token": tok(tid)}
+                    el[str(e["token_id"])] = {
+                        "logprob": e["logprob"], "rank": e["rank"],
+                        "decoded_token": tok(e["token_id"])}
+                    prompt_lp_field.append(el)
         for rid, q in submits:
             text_parts, token_ids, logprob_entries = [], [], []
             finish_reason = "stop"
@@ -1067,6 +1109,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if logprob_entries:
                     choice["logprobs"] = self._completions_logprobs(
                         logprob_entries)
+            if prompt_lp_field is not None:
+                choice["prompt_logprobs"] = prompt_lp_field
             choices.append(choice)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
         usage = {
