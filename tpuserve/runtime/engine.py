@@ -806,6 +806,15 @@ class Engine:
                 ad[i, r.adapter_idx] = 1.0
         return jnp.asarray(ad)
 
+    def _lora_kw(self, reqs: list, B: int) -> dict:
+        """Conditional ``ad=`` kwarg for the exec hooks: an EMPTY dict
+        when no adapter stack is loaded, so multihost wrappers (whose
+        hook signatures predate the arg) are never passed it.  One home
+        for the dance instead of six call sites."""
+        if not self._lora_names:
+            return {}
+        return {"ad": self._lora_ad(reqs, B)}
+
     def _exec_prefill(self, tokens, prompt_lens, slot_ids, ad=None):
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_prefill
@@ -898,8 +907,7 @@ class Engine:
             prompt_lens[i] = len(ids)
             slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
                                                        len(ids))
-        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
-              else {})
+        kw = self._lora_kw(reqs, B)
         logits, self.kv_cache = self._exec_prefill(
             jnp.asarray(tokens), jnp.asarray(prompt_lens),
             jnp.asarray(slot_ids), **kw)
@@ -963,8 +971,7 @@ class Engine:
         block_tables = np.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
         block_tables[0, :len(bt)] = bt
-        kw = ({"ad": self._lora_ad([req], 1)} if self._lora_names
-              else {})
+        kw = self._lora_kw([req], 1)
         logits, self.kv_cache = self._exec_prefill_chunk(
             jnp.asarray(tokens),
             jnp.asarray(np.asarray([done], np.int32)),
@@ -1069,8 +1076,7 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
-        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
-              else {})
+        kw = self._lora_kw(reqs, B)
         toks, self.kv_cache = self._exec_decode_multi(
             tokens, jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
@@ -1217,8 +1223,7 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
-        kw = ({"ad": self._lora_ad(reqs, B)} if self._lora_names
-              else {})
+        kw = self._lora_kw(reqs, B)
         logits, self.kv_cache = self._exec_decode(
             tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
             jnp.asarray(block_tables), jnp.asarray(seq_lens), **kw)
@@ -1916,8 +1921,7 @@ class Engine:
                 tokens = jnp.zeros((B, L), jnp.int32)
                 lens = jnp.ones((B,), jnp.int32)
                 slots = jnp.full((B, L), PAD_SLOT, jnp.int32)
-                wkw = ({"ad": jnp.zeros((B, len(self._lora_names)))}
-                       if self._lora_names else {})
+                wkw = self._lora_kw([], B)
                 logits, self.kv_cache = self._exec_prefill(tokens, lens,
                                                            slots, **wkw)
                 self._warm_sampling(logits, sample_modes)
@@ -1927,8 +1931,7 @@ class Engine:
                 slots = jnp.full((B,), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((B, self.cache_cfg.max_blocks_per_seq), jnp.int32)
                 seq_lens = jnp.ones((B,), jnp.int32)
-                wkw = ({"ad": jnp.zeros((B, len(self._lora_names)))}
-                       if self._lora_names else {})
+                wkw = self._lora_kw([], B)
                 logits, self.kv_cache = self._exec_decode(
                     tokens, positions, slots, bt, seq_lens, **wkw)
                 self._warm_sampling(logits, sample_modes)
@@ -1987,8 +1990,7 @@ class Engine:
                 slots = jnp.full((1, C), PAD_SLOT, jnp.int32)
                 bt = jnp.zeros((1, self.cache_cfg.max_blocks_per_seq),
                                jnp.int32)
-                ckw = ({"ad": jnp.zeros((1, len(self._lora_names)))}
-                       if self._lora_names else {})
+                ckw = self._lora_kw([], 1)
                 logits, self.kv_cache = self._exec_prefill_chunk(
                     tokens, jnp.zeros((1,), jnp.int32),
                     jnp.ones((1,), jnp.int32), slots, bt, **ckw)

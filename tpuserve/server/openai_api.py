@@ -906,6 +906,24 @@ class _Handler(BaseHTTPRequestHandler):
                               for t, lp in e["top"]]}
             for e in entries]}
 
+    @staticmethod
+    def _vllm_prompt_logprobs(pent, plp: int, tok) -> list:
+        """vLLM prompt_logprobs response shape from scoring entries: one
+        element per prompt token — None first (no conditional), then
+        {token_id: {logprob, rank, decoded_token}} covering the top-N
+        alternatives AND the chosen token, with true full-vocab ranks."""
+        out = [None]
+        for e in pent[1:]:
+            el = {}
+            for i, (tid, lp) in enumerate(e["top"][:plp]):
+                el[str(tid)] = {"logprob": lp, "rank": i + 1,
+                                "decoded_token": tok(tid)}
+            el[str(e["token_id"])] = {
+                "logprob": e["logprob"], "rank": e["rank"],
+                "decoded_token": tok(e["token_id"])}
+            out.append(el)
+        return out
+
     def _prompt_ids(self, kwargs, params=None) -> list:
         # memoised per POST (reset in do_POST): echo + truncation +
         # scoring would otherwise re-encode a long prompt up to 3x
@@ -1025,21 +1043,8 @@ class _Handler(BaseHTTPRequestHandler):
                 k = params.logprobs
                 prompt_entries = [dict(e, top=e["top"][:k]) for e in pent]
             if plp is not None:
-                # vLLM shape: one element per prompt token — None first
-                # (no conditional), then {token_id: {logprob, rank,
-                # decoded_token}} covering the top-N alternatives AND the
-                # chosen token, with true full-vocab ranks
-                tok = eng.tokenizer.id_to_token
-                prompt_lp_field = [None]
-                for e in pent[1:]:
-                    el = {}
-                    for i, (tid, lp) in enumerate(e["top"][:int(plp)]):
-                        el[str(tid)] = {"logprob": lp, "rank": i + 1,
-                                        "decoded_token": tok(tid)}
-                    el[str(e["token_id"])] = {
-                        "logprob": e["logprob"], "rank": e["rank"],
-                        "decoded_token": tok(e["token_id"])}
-                    prompt_lp_field.append(el)
+                prompt_lp_field = self._vllm_prompt_logprobs(
+                    pent, int(plp), eng.tokenizer.id_to_token)
         for rid, q in submits:
             text_parts, token_ids, logprob_entries = [], [], []
             finish_reason = "stop"
