@@ -646,3 +646,50 @@ def test_streaming_partial_choice_rejection_gets_status(server):
         assert ei.value.code == 503
     finally:
         engine_mod.Engine.add_request = orig
+
+
+def test_malformed_bodies_never_5xx(server):
+    """Fuzz the completion surface with structurally hostile bodies:
+    every response must be 2xx/4xx — a 5xx means unvalidated client
+    input reached engine internals (the class of bug the 4xx validation
+    layer exists to prevent)."""
+    import random
+    rng = random.Random(11)
+    junk_values = [None, True, False, -1, 0, 1.5, 2**40, -2**40, "x",
+                   "", [], ["a"], [None], {}, {"a": None}, float("inf"),
+                   float("-inf"), "NaN", [2**40], [-5], {"k": []}]
+    keys = ["model", "prompt", "messages", "max_tokens", "min_tokens",
+            "temperature", "top_k", "top_p", "min_p", "seed", "stop",
+            "stop_token_ids", "logit_bias", "logprobs", "top_logprobs",
+            "n", "best_of", "echo", "stream", "stream_options",
+            "response_format", "guided_regex", "prompt_logprobs",
+            "truncate_prompt_tokens", "priority", "presence_penalty",
+            "frequency_penalty", "repetition_penalty", "ignore_eos",
+            "tools", "tool_choice"]
+    def probe(path, body):
+        data = json.dumps(body, allow_nan=True).encode()
+        req = urllib.request.Request(
+            server + path, data=data,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status < 500, (path, body)
+                r.read()
+        except urllib.error.HTTPError as e:
+            assert e.code < 500, (path, body, e.read()[:200])
+
+    for path in ("/v1/completions", "/v1/chat/completions"):
+        base = ({"prompt": "x"} if "chat" not in path else
+                {"messages": [{"role": "user", "content": "x"}]})
+        base["max_tokens"] = 1
+        # single-key pass FIRST: multi-key bodies can mask a crash behind
+        # an earlier-validated key's 400 (validation-order shadowing let
+        # int(Infinity) escape the original fuzz)
+        for k in keys:
+            for v in junk_values:
+                probe(path, dict(base, **{k: v}))
+        for trial in range(60):
+            body = dict(base)
+            for k in rng.sample(keys, rng.randint(1, 5)):
+                body[k] = rng.choice(junk_values)
+            probe(path, body)

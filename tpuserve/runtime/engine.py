@@ -495,6 +495,7 @@ class Engine:
                     request_id: Optional[str] = None,
                     adapter: Optional[str] = None) -> str:
         params = params or SamplingParams()
+        caller_ids = prompt_token_ids is not None
         adapter_idx = None
         if adapter is not None:
             if not self._lora_names:
@@ -509,6 +510,16 @@ class Engine:
                 raise ValueError("need prompt or prompt_token_ids")
             prompt_token_ids = self.tokenizer.encode(prompt)
         prompt_token_ids = list(prompt_token_ids)
+        if caller_ids and prompt_token_ids and not all(
+                isinstance(t, int) and 0 <= t < self.model_cfg.vocab_size
+                for t in prompt_token_ids):
+            # out-of-int32 ids crash the prefill buffers; out-of-vocab
+            # ids would gather-clamp into silently wrong embeddings.
+            # Only CALLER-supplied ids are scanned — the tokenizer's own
+            # output is trusted, keeping string-prompt admission flat.
+            raise ValueError(
+                "prompt token ids must be integers in [0, "
+                f"{self.model_cfg.vocab_size})")
         if params.truncate_prompt_tokens is not None:
             if params.truncate_prompt_tokens < 1:
                 # a negative slice would keep all-but-the-FIRST-N tokens —
@@ -1558,7 +1569,11 @@ class Engine:
         keys = np.zeros((B, 2), np.uint32)
         for i, r in enumerate(reqs):
             temperature[i] = r.params.temperature
-            top_k[i] = r.params.top_k
+            # clamp: vocab_size bounds the meaningful range and keeps
+            # direct-caller values inside the int32 array (a 2**40 here
+            # crashed the whole co-batched step — found by fuzzing)
+            top_k[i] = max(min(r.params.top_k,
+                               self.model_cfg.vocab_size), -1)
             top_p[i] = r.params.top_p
             min_p[i] = r.params.min_p
             keys[i] = self._row_key(

@@ -70,7 +70,10 @@ def _num(body: dict, key: str, default, cast):
         return default
     try:
         return cast(val)
-    except (TypeError, ValueError):
+    except (TypeError, ValueError, OverflowError):
+        # OverflowError: int(float('inf')) — json.loads accepts Infinity
+        # literals, and an uncaught cast kills the connection with no
+        # response at all (found by single-key fuzzing)
         raise ValueError(f"'{key}' must be a number, got {val!r}") from None
 
 
@@ -124,6 +127,28 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     min_p = _num(body, "min_p", 0.0, float)
     if not 0.0 <= min_p <= 1.0:        # NaN fails both comparisons too
         raise ValueError("'min_p' must be in [0, 1]")
+    temperature = _num(body, "temperature", 1.0, float)
+    if not 0.0 <= temperature <= 100.0:     # NaN/inf fail; generous cap
+        raise ValueError("'temperature' must be in [0, 100]")
+    top_k = _num(body, "top_k", 0, int)
+    if not -(2**31) <= top_k < 2**31:
+        # found by fuzzing: 2**40 reached the int32 sampling arrays and
+        # crashed the whole co-batched engine step
+        raise ValueError("'top_k' must be a 32-bit integer (<=0 disables)")
+    top_p = _num(body, "top_p", 1.0, float)
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError("'top_p' must be in [0, 1]")
+    penalties = {}
+    for pen, default in (("presence_penalty", 0.0),
+                         ("frequency_penalty", 0.0),
+                         ("repetition_penalty", 1.0)):
+        v = _num(body, pen, default, float)
+        if not -1e6 <= v <= 1e6:           # NaN/inf fail
+            raise ValueError(f"'{pen}' must be a finite number")
+        penalties[pen] = v
+    if n_logprobs is not None and not 0 <= n_logprobs <= 2**31 - 1:
+        raise ValueError("'logprobs' must be a non-negative 32-bit "
+                         "integer")
     priority = _num(body, "priority", 0, int)
     if not -(2**31) <= priority < 2**31:
         raise ValueError("'priority' must be a 32-bit integer")
@@ -181,13 +206,13 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     return SamplingParams(
         max_tokens=max_tokens,
         min_tokens=max(0, min(_num(body, "min_tokens", 0, int), max_tokens)),
-        temperature=_num(body, "temperature", 1.0, float),
-        top_k=_num(body, "top_k", 0, int),
-        top_p=_num(body, "top_p", 1.0, float),
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
         min_p=min_p,
-        presence_penalty=_num(body, "presence_penalty", 0.0, float),
-        frequency_penalty=_num(body, "frequency_penalty", 0.0, float),
-        repetition_penalty=_num(body, "repetition_penalty", 1.0, float),
+        presence_penalty=penalties["presence_penalty"],
+        frequency_penalty=penalties["frequency_penalty"],
+        repetition_penalty=penalties["repetition_penalty"],
         stop=tuple(stop),
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=seed,
