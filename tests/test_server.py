@@ -693,3 +693,51 @@ def test_malformed_bodies_never_5xx(server):
             for k in rng.sample(keys, rng.randint(1, 5)):
                 body[k] = rng.choice(junk_values)
             probe(path, body)
+
+
+def test_include_stop_str_in_output(server):
+    """vLLM include_stop_str_in_output: the matched stop string stays in
+    the text (OpenAI default strips it).  ByteTokenizer id = byte + 3, so
+    biasing 'A' (0x41) makes the greedy output deterministic 'AAAA...'
+    and 'AA' a guaranteed stop match."""
+    bias = {str(0x41 + 3): 100}
+    common = {"model": "tiny-qwen3", "prompt": [5, 9, 12],
+              "max_tokens": 12, "temperature": 0, "ignore_eos": True,
+              "logit_bias": bias, "stop": "AA"}
+    _, kept = _post(server + "/v1/completions",
+                    dict(common, include_stop_str_in_output=True))
+    _, stripped = _post(server + "/v1/completions", common)
+    assert kept["choices"][0]["text"] == "AA"
+    assert stripped["choices"][0]["text"] == ""
+    assert kept["choices"][0]["finish_reason"] == "stop" \
+        and stripped["choices"][0]["finish_reason"] == "stop"
+
+
+def test_stop_prefix_holdback_flushes_on_finish(server):
+    """A held stop-prefix that never completes a match is real output:
+    with stop='AB' and a deterministic all-'A' stream, every 'A' is
+    momentarily held but must ALL be present when the request finishes
+    by length."""
+    _, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [5, 9, 12], "max_tokens": 6,
+        "temperature": 0, "ignore_eos": True,
+        "logit_bias": {str(0x41 + 3): 100}, "stop": "AB"})
+    assert body["choices"][0]["text"] == "AAAAAA"
+    assert body["choices"][0]["finish_reason"] == "length"
+
+
+def test_stop_spans_min_tokens_boundary(server):
+    """A stop string straddling the min_tokens boundary must still match
+    once the floor lifts (r4 review: the hold-back rewrite initially
+    scanned only unemitted text, losing boundary-spanning matches)."""
+    # deterministic all-'A' stream; stop "AA"; min_tokens 1 means the
+    # first 'A' streams under suppression and the match completes with
+    # the second
+    _, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [5, 9, 12], "max_tokens": 8,
+        "temperature": 0, "ignore_eos": True, "min_tokens": 1,
+        "logit_bias": {str(0x41 + 3): 100}, "stop": "AA"})
+    c = body["choices"][0]
+    assert c["finish_reason"] == "stop"
+    # the first A streamed under the floor; stored text honours the stop
+    assert len(c["text"]) <= 1

@@ -1644,7 +1644,8 @@ class Engine:
                   from_prefill: bool = False) -> RequestOutput:
         req.output_token_ids.append(tok)
         self.stats.generated_tokens += 1
-        delta = self._detok[req.request_id].add(tok)
+        raw_delta = self._detok[req.request_id].add(tok)
+        delta = raw_delta
         reason = None
         if req.params.stop and not req.params.min_tokens_active(
                 len(req.output_token_ids)):
@@ -1658,9 +1659,13 @@ class Engine:
         if req.params.guided is not None:
             st = self._guided.get(req.request_id)
             if st is not None:
-                if delta:
+                if raw_delta:
                     try:
-                        st.feed(delta)       # authoritative state advance
+                        # the RAW delta: guided state must track what was
+                        # SAMPLED, not what stop hold-back emitted — a
+                        # held stop-prefix would leave the acceptor
+                        # lagging ctx and validating against stale state
+                        st.feed(raw_delta)   # authoritative state advance
                     except ValueError:
                         # gave-up step: DEREGISTER so later steps don't
                         # validate candidates against a corrupted state
@@ -1672,6 +1677,12 @@ class Engine:
         if reason is None:
             reason = check_stop(req, self._eos_ids, self.max_seq_len)
         finished = reason is not None
+        if finished and req.stop_held:
+            # the held stop-prefix never completed a match: it is real
+            # output and must not be swallowed
+            req.output_text += req.stop_held
+            delta += req.stop_held
+            req.stop_held = ""
         if finished:
             req.finish_reason = reason
             req.finish_time = time.monotonic()
@@ -1687,28 +1698,71 @@ class Engine:
             from_prefill=from_prefill)
 
     def _match_stop(self, req: Request, delta: str) -> tuple[str, bool]:
-        """Bounded stop-string search over the tail.  Appends ``delta`` to
-        ``req.output_text``; on a match, truncates so the stop string is
-        neither stored nor streamed (OpenAI semantics — the reference smoke
-        tests hit an OpenAI-compatible API, llm-d-test.yaml:61-78).
+        """Stop-string search with PREFIX HOLD-BACK.  A stop string can
+        span deltas; emitting eagerly would stream its prefix before the
+        match completes (a client sees 'A' of a matched 'AA' it was never
+        supposed to get — the stored text truncates but the stream cannot
+        retract).  Scanning runs over held + delta; a tail that is a
+        proper prefix of any stop string is WITHHELD (req.stop_held) and
+        either consumed by a later match, or flushed when the request
+        finishes for another reason.  On a match the stop string is
+        dropped (OpenAI semantics) or kept
+        (include_stop_str_in_output, the vLLM extension).
         Returns (emitted_delta, stopped)."""
-        max_stop = max(len(s) for s in req.params.stop)
-        prev_len = len(req.output_text)
-        # A match must overlap the new delta, so only the tail can matter.
-        window_start = max(0, prev_len - max(max_stop - 1, 0))
-        text = req.output_text + delta
-        tail = text[window_start:]
+        stops = req.params.stop
+        if any(not s for s in stops):
+            # the empty stop string matches everywhere: stop NOW, emit
+            # nothing new (pre-hold-back behaviour)
+            req.stop_held = ""
+            return "", True
+        max_stop = max(len(s) for s in stops)
+        # Scan over: emitted tail + held + delta.  The emitted tail exists
+        # so matches SPANNING already-emitted text are still found — in
+        # particular across the min_tokens boundary, where suppressed text
+        # bypassed this function entirely — but a candidate must consume
+        # at least one unemitted char (ending at most at `base` would
+        # mean an earlier scan already decided it).
+        prev_tail = req.output_text[-(max_stop - 1):] if max_stop > 1 else ""
+        base = len(prev_tail)
+        text = prev_tail + req.stop_held + delta
         best = None
-        for s in req.params.stop:
-            pos = tail.find(s)
-            if pos != -1 and (best is None or pos < best[0]):
-                best = (pos, s)
-        if best is None:
-            req.output_text = text
-            return delta, False
-        cut_abs = window_start + best[0]
-        req.output_text = text[:cut_abs]
-        return text[prev_len:cut_abs] if cut_abs > prev_len else "", True
+        for s in stops:
+            start = 0
+            while True:
+                pos = text.find(s, start)
+                if pos == -1:
+                    break
+                if pos + len(s) > base:
+                    if best is None or pos < best[0]:
+                        best = (pos, s)
+                    break
+                start = pos + 1
+        if best is not None:
+            keep_until = best[0]
+            if req.params.include_stop_str_in_output:
+                keep_until += len(best[1])
+            req.stop_held = ""
+            if keep_until >= base:
+                emit = text[base:keep_until]
+                req.output_text += emit
+                return emit, True
+            # cut inside already-emitted text (min_tokens spanning edge):
+            # the stream cannot retract, but the STORED text honours the
+            # stop semantics like the pre-hold-back implementation did
+            req.output_text = req.output_text[
+                :len(req.output_text) - (base - keep_until)]
+            return "", True
+        # no match: hold the longest UNEMITTED tail that could still
+        # become one (an emitted prefix is covered by prev_tail above)
+        held = 0
+        for k in range(min(len(text) - base, max_stop - 1), 0, -1):
+            if any(s.startswith(text[-k:]) for s in stops):
+                held = k
+                break
+        emit = text[base:len(text) - held]
+        req.stop_held = text[len(text) - held:] if held else ""
+        req.output_text += emit
+        return emit, False
 
     def generate(self, prompts: Sequence[str] | Sequence[Sequence[int]],
                  params: SamplingParams | Sequence[SamplingParams] | None = None,

@@ -50,6 +50,9 @@ class SamplingParams:
     # vLLM stop_token_ids: extra ids that finish the request like EOS does
     # (the matched token is emitted; min_tokens suppresses these too)
     stop_token_ids: tuple[int, ...] = ()
+    # vLLM include_stop_str_in_output: keep the matched stop string in
+    # the emitted/stored text instead of truncating it (OpenAI default)
+    include_stop_str_in_output: bool = False
     # vLLM priority scheduling: LOWER value = admitted sooner; FIFO
     # within a level (runtime/scheduler.py Scheduler.add)
     priority: int = 0
@@ -147,6 +150,10 @@ class Request:
     # chunked prefill progress: prompt tokens already written to the cache
     # (reset on preemption along with the cache itself)
     num_prefilled: int = 0
+    # stop-string hold-back: text withheld from emission because it is a
+    # prefix of a stop string that may complete in a later delta (flushed
+    # on finish; engine._match_stop owns it)
+    stop_held: str = ""
     # multi-LoRA: index into the engine's loaded adapter stack
     # (weights.load_lora_stack); None = base model
     adapter_idx: Optional[int] = None

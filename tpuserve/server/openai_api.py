@@ -215,6 +215,8 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         repetition_penalty=penalties["repetition_penalty"],
         stop=tuple(stop),
         ignore_eos=bool(body.get("ignore_eos", False)),
+        include_stop_str_in_output=bool(
+            body.get("include_stop_str_in_output", False)),
         seed=seed,
         logprobs=n_logprobs,
         logit_bias=bias,
