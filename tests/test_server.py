@@ -658,7 +658,8 @@ def test_malformed_bodies_never_5xx(server):
     junk_values = [None, True, False, -1, 0, 1.5, 2**40, -2**40, "x",
                    "", [], ["a"], [None], {}, {"a": None}, float("inf"),
                    float("-inf"), "NaN", [2**40], [-5], {"k": []}]
-    keys = ["model", "prompt", "messages", "max_tokens", "min_tokens",
+    keys = ["model", "prompt", "messages", "input", "tokens",
+            "encoding_format", "dimensions", "max_tokens", "min_tokens",
             "temperature", "top_k", "top_p", "min_p", "seed", "stop",
             "stop_token_ids", "logit_bias", "logprobs", "top_logprobs",
             "n", "best_of", "echo", "stream", "stream_options",
@@ -678,10 +679,11 @@ def test_malformed_bodies_never_5xx(server):
         except urllib.error.HTTPError as e:
             assert e.code < 500, (path, body, e.read()[:200])
 
-    for path in ("/v1/completions", "/v1/chat/completions"):
-        base = ({"prompt": "x"} if "chat" not in path else
-                {"messages": [{"role": "user", "content": "x"}]})
-        base["max_tokens"] = 1
+    for path in ("/v1/completions", "/v1/chat/completions",
+                 "/v1/embeddings", "/tokenize", "/detokenize"):
+        base = {"prompt": "x", "input": "x", "tokens": [1], "max_tokens": 1}
+        if "chat" in path:
+            base["messages"] = [{"role": "user", "content": "x"}]
         # single-key pass FIRST: multi-key bodies can mask a crash behind
         # an earlier-validated key's 400 (validation-order shadowing let
         # int(Infinity) escape the original fuzz)
