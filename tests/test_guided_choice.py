@@ -1,0 +1,222 @@
+"""Choice-constrained decoding (runtime/guided_choice.py + the vLLM
+guided_choice body param): prefix-set acceptance semantics, dead-end-free
+char rejection, EOS gating via can_finish, engine substitution e2e on
+random weights, and the HTTP surface."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SchedulerConfig
+from tpuserve.runtime.guided_choice import (ChoiceError, ChoiceStateMachine,
+                                            compile_choices)
+from tpuserve.runtime.request import SamplingParams
+
+
+def _m(choices):
+    return ChoiceStateMachine(compile_choices(choices))
+
+
+def _feed(choices, text):
+    m = _m(choices)
+    try:
+        m.feed(text)
+    except ValueError:
+        return None
+    return m
+
+
+# ------------------------------------------------------------ acceptance
+
+def test_full_matches_accept_and_finish():
+    for choices, text in [
+        (["yes", "no"], "yes"),
+        (["yes", "no"], "no"),
+        (["alpha", "alphabet"], "alpha"),
+        (["alpha", "alphabet"], "alphabet"),
+        (["multi word choice"], "multi word choice"),
+        (["with\nnewline"], "with\nnewline"),
+        (["ünïcödé"], "ünïcödé"),
+        (["a.b*c"], "a.b*c"),          # regex metachars are literal here
+    ]:
+        m = _feed(choices, text)
+        assert m is not None and m.can_finish, (choices, text)
+
+
+def test_prefixes_accepted_but_not_finishable():
+    m = _feed(["yes", "yesterday"], "yes")
+    assert m is not None and m.can_finish and not m.complete
+    m2 = _feed(["yes", "yesterday"], "yest")
+    assert m2 is not None and not m2.can_finish and not m2.complete
+
+
+def test_complete_only_when_inextensible():
+    m = _feed(["yes", "no"], "no")
+    assert m.complete                       # nothing extends "no"
+    m2 = _feed(["yes", "yesterday"], "yesterday")
+    assert m2.complete
+
+
+def test_rejection_at_earliest_dead_char():
+    m = _m(["yes", "no"])
+    with pytest.raises(ValueError):
+        m.feed("ye" + "x")
+    # a failed feed leaves the machine unusable only via the failed clone
+    # path; the authoritative machine is fed only validated text
+    assert _feed(["yes", "no"], "q") is None
+
+
+def test_allows_is_pure():
+    m = _m(["left", "light"])
+    m.feed("l")
+    assert m.allows("e") and m.allows("i") and not m.allows("x")
+    # allows must not advance the authoritative state
+    assert m.pos == 1 and m.allows("e")
+
+
+def test_shared_prefix_narrowing():
+    m = _m(["cat", "car", "dog"])
+    m.feed("ca")
+    assert not m.can_finish
+    assert m.allows("t") and m.allows("r") and not m.allows("d")
+    m.feed("t")
+    assert m.complete
+
+
+def test_bad_choice_lists_rejected():
+    for bad in [[], "yes", [1, 2], ["ok", ""], None]:
+        with pytest.raises(ChoiceError):
+            compile_choices(bad)
+    with pytest.raises(ChoiceError):
+        compile_choices(["x"] * 600)
+    # lone surrogates survive json.loads but can't be tokenized or ever
+    # appear in output text — must 400 at the edge, not crash the step
+    # loop's canonical-plan encode (round-4 review finding)
+    with pytest.raises(ChoiceError):
+        compile_choices(["ok", "\ud800bad"])
+
+
+def test_duplicates_collapse():
+    assert compile_choices(["a", "b", "a"]) == ("a", "b")
+
+
+# ------------------------------------------------------------ engine e2e
+
+def _engine():
+    return Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+def test_engine_choice_guided_output_is_a_choice():
+    """Random weights + the substitution machinery must emit exactly one
+    of the choices (ByteTokenizer: every ASCII char is a single token, so
+    the fallback can always find a valid candidate)."""
+    eng = _engine()
+    choices = ["approve", "reject", "defer"]
+    outs = eng.generate(
+        ["x"], [SamplingParams(max_tokens=40, temperature=0.0,
+                               guided="choice",
+                               guided_schema=json.dumps(choices))])
+    (r,) = outs
+    assert r.finish_reason.value == "stop", r.output_text
+    assert r.output_text in choices, r.output_text
+
+
+def test_engine_choice_prefix_extension():
+    """With one choice a strict prefix of another, the engine must either
+    stop at the short one (EOS legal there) or complete the long one —
+    never emit the dead zone in between."""
+    eng = _engine()
+    choices = ["go", "gone"]
+    outs = eng.generate(
+        ["y"], [SamplingParams(max_tokens=10, temperature=0.0,
+                               guided="choice",
+                               guided_schema=json.dumps(choices))])
+    (r,) = outs
+    assert r.output_text in choices, r.output_text
+
+
+def test_engine_choice_non_ascii_commits_canonical_plan():
+    """Choices whose next char is non-ASCII defeat char-level
+    substitution (the first byte token of a multi-byte rune decodes to
+    no text, so every candidate is rejected): the engine must commit to
+    the tokenizer's canonical encoding of a viable suffix instead of
+    silently dropping the constraint (round-4 review finding)."""
+    eng = _engine()
+    choices = ["ünïcödé", "naïve"]
+    outs = eng.generate(
+        ["x"], [SamplingParams(max_tokens=40, temperature=0.0,
+                               guided="choice",
+                               guided_schema=json.dumps(choices))])
+    (r,) = outs
+    assert r.output_text in choices, r.output_text
+    assert r.finish_reason.value == "stop"
+    assert eng.stats.guided_plans >= 1
+    assert not eng._guided_plan            # plan state fully reclaimed
+
+
+def test_engine_choice_mixed_ascii_unicode_stream():
+    """ASCII head + unicode tail: the head may resolve char-by-char, the
+    tail through a committed plan — either way the final text is exactly
+    one choice and no plan state leaks across requests."""
+    eng = _engine()
+    choices = ["ok→done", "ok→retry"]
+    outs = eng.generate(
+        ["a", "b"],
+        [SamplingParams(max_tokens=40, temperature=0.9, seed=s,
+                        guided="choice",
+                        guided_schema=json.dumps(choices))
+         for s in (1, 2)])
+    for r in outs:
+        assert r.output_text in choices, r.output_text
+    assert not eng._guided_plan
+
+
+# ------------------------------------------------------------ HTTP edge
+
+@pytest.fixture(scope="module")
+def server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    srv = OpenAIServer(_engine(), ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_guided_choice(server):
+    status, body = _post(server + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": "pick:", "max_tokens": 20,
+        "temperature": 0.0, "guided_choice": ["red", "green", "blue"]})
+    assert status == 200
+    assert body["choices"][0]["text"] in ("red", "green", "blue")
+
+
+def test_http_guided_choice_bad_list_is_400(server):
+    for payload in [
+        {"guided_choice": []},
+        {"guided_choice": ["ok", 3]},
+        {"guided_choice": "red"},
+        {"guided_choice": ["red"], "response_format": {"type": "json_object"}},
+        {"guided_choice": ["red"], "guided_regex": "a+"},
+    ]:
+        try:
+            status, _ = _post(server + "/v1/completions", {
+                "model": "tiny-qwen3", "prompt": "p", "max_tokens": 4,
+                **payload})
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 400, payload

@@ -663,7 +663,8 @@ def test_malformed_bodies_never_5xx(server):
             "temperature", "top_k", "top_p", "min_p", "seed", "stop",
             "stop_token_ids", "logit_bias", "logprobs", "top_logprobs",
             "n", "best_of", "echo", "stream", "stream_options",
-            "response_format", "guided_regex", "prompt_logprobs",
+            "response_format", "guided_regex", "guided_choice",
+            "prompt_logprobs",
             "truncate_prompt_tokens", "priority", "presence_penalty",
             "frequency_penalty", "repetition_penalty", "ignore_eos",
             "tools", "tool_choice"]

@@ -151,6 +151,7 @@ class EngineStats:
     released_blocks: int = 0         # rolling-buffer KV blocks recycled
     latency_windows: int = 0         # fused windows shrunk for arrivals
     guided_fallbacks: int = 0        # guided steps that left the top-K
+    guided_plans: int = 0            # committed canonical-suffix completions
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -348,6 +349,11 @@ class Engine:
         # the lazily-built structural fallback token set (runtime/guided.py)
         self._guided: dict[str, object] = {}
         self._guided_fallback_ids: Optional[list[int]] = None
+        # committed canonical completions: when char-level substitution
+        # can't spell the next legal char in single tokens (non-ASCII
+        # choices under a byte-fallback vocab), _guided_pick encodes a
+        # viable suffix once and emits its token ids verbatim
+        self._guided_plan: dict[str, list[int]] = {}
         self.requests: dict[str, Request] = {}   # all live + finished-unclaimed
         self._detok: dict[str, IncrementalDetokenizer] = {}
         self._greedy_cache: dict[int, tuple] = {}
@@ -576,9 +582,11 @@ class Engine:
                     " GiB); lower max_tokens or use tp instead of pp")
         request_id = request_id or f"req-{next(self._req_counter)}"
         if params.guided is not None:
-            if params.guided not in ("json", "json_schema", "regex"):
+            if params.guided not in ("json", "json_schema", "regex",
+                                     "choice"):
                 raise ValueError(f"unsupported guided mode {params.guided!r}"
-                                 " (only 'json' / 'json_schema' / 'regex')")
+                                 " (only 'json' / 'json_schema' / 'regex' /"
+                                 " 'choice')")
             if params.logprobs is not None:
                 # substitution happens after on-device logprob recording —
                 # the reported tokens would not match the emitted ones
@@ -597,6 +605,7 @@ class Engine:
             self.requests.pop(request_id, None)
             self._detok.pop(request_id, None)
             self._guided.pop(request_id, None)
+            self._guided_plan.pop(request_id, None)
             raise
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
@@ -689,6 +698,7 @@ class Engine:
         self.block_manager.free(request_id, cache_blocks=not partial)
         self._detok.pop(request_id, None)
         self._guided.pop(request_id, None)
+        self._guided_plan.pop(request_id, None)
         return True
 
     def has_work(self) -> bool:
@@ -1424,6 +1434,12 @@ class Engine:
             from tpuserve.runtime.guided_regex import (RegexStateMachine,
                                                        compile_regex)
             return RegexStateMachine(compile_regex(params.guided_schema))
+        if params.guided == "choice":
+            import json as _json
+            from tpuserve.runtime.guided_choice import (ChoiceStateMachine,
+                                                        compile_choices)
+            return ChoiceStateMachine(
+                compile_choices(_json.loads(params.guided_schema)))
         return JsonStateMachine()
 
     def _apply_guided(self, logits: jnp.ndarray, toks_np: np.ndarray,
@@ -1461,6 +1477,15 @@ class Engine:
 
     def _guided_pick(self, r: Request, st, sampled: int,
                      candidates: list[int]) -> int:
+        plan = self._guided_plan.get(r.request_id)
+        if plan:
+            # mid-plan: emit the committed canonical encoding verbatim —
+            # mixing sampled tokens back in would break the byte
+            # alignment the plan was committed to preserve
+            tok = plan.pop(0)
+            if not plan:
+                self._guided_plan.pop(r.request_id, None)
+            return tok
         ctx = (r.prompt_token_ids + r.output_token_ids)[-8:]
         base = self.tokenizer.decode(ctx)
         for tok in [sampled] + candidates:
@@ -1483,6 +1508,27 @@ class Engine:
             if txt and st.allows(txt):
                 self.stats.guided_fallbacks += 1
                 return tok
+        # Last resort before dropping the constraint: acceptors that can
+        # enumerate their legal continuations (guided_choice) let us
+        # commit to the tokenizer's OWN encoding of one — correct even
+        # when no single token spells the next char (non-ASCII choices:
+        # the first byte token decodes to no text yet, so every
+        # char-level candidate above was rejected).
+        suffixes = getattr(st, "viable_suffixes", None)
+        if suffixes is not None:
+            for s in suffixes():
+                ids = self.tokenizer.encode(s)
+                # strict IN-CONTEXT round-trip gate: the plan's tokens are
+                # emitted after ctx, so validate what they decode to THERE
+                # — a standalone decode(encode(s)) == s check would pass a
+                # SentencePiece-style tokenizer whose sequence-initial
+                # marker then surfaces as a stray leading space in context,
+                # failing the acceptor mid-plan.  Skip rather than corrupt.
+                if ids and self.tokenizer.decode(ctx + ids) == base + s:
+                    if len(ids) > 1:
+                        self._guided_plan[r.request_id] = ids[1:]
+                    self.stats.guided_plans += 1
+                    return ids[0]
         # nothing valid exists (pathological tokenizer): give up on the
         # constraint for this step rather than deadlock
         self.stats.guided_fallbacks += 1
@@ -1670,6 +1716,7 @@ class Engine:
                         # gave-up step: DEREGISTER so later steps don't
                         # validate candidates against a corrupted state
                         self._guided.pop(req.request_id, None)
+                        self._guided_plan.pop(req.request_id, None)
                         st = None
                 if st is not None and st.complete and reason is None:
                     # root object closed: stop like OpenAI json mode does
@@ -1690,6 +1737,7 @@ class Engine:
             self.stats.requests_finished += 1
             self._detok.pop(req.request_id, None)
             self._guided.pop(req.request_id, None)
+            self._guided_plan.pop(req.request_id, None)
         return RequestOutput(
             request_id=req.request_id, new_token_ids=[tok], new_text=delta,
             finished=finished, finish_reason=reason,

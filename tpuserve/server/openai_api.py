@@ -193,6 +193,20 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
             raise ValueError(f"unsupported guided_regex: {e}") from None
         guided = "regex"
         guided_schema = gre
+    gch = body.get("guided_choice")
+    if gch is not None:
+        # vLLM extension: output must be exactly one of the given strings
+        if guided is not None:
+            raise ValueError("'guided_choice' cannot be combined with "
+                             "other guided modes")
+        from tpuserve.runtime.guided_choice import (ChoiceError,
+                                                    compile_choices)
+        try:
+            choices = compile_choices(gch)   # 400 on bad lists
+        except ChoiceError as e:
+            raise ValueError(f"unsupported guided_choice: {e}") from None
+        guided = "choice"
+        guided_schema = json.dumps(list(choices))
     tpt = _num(body, "truncate_prompt_tokens", None, int)
     if tpt is not None and tpt < 1:
         raise ValueError("'truncate_prompt_tokens' must be >= 1")
