@@ -97,3 +97,36 @@ def test_variant_names_unique_and_quick_subset():
     names = [n for n, _, _ in sweep.VARIANTS]
     assert len(names) == len(set(names))
     assert set(sweep.QUICK) <= set(names)
+
+
+def test_run_variant_kills_zero_cpu_stall(tmp_path):
+    """A bench hard-blocked in a dead-tunnel RPC accrues ~zero CPU; the
+    watchdog must kill it well before the wall-clock timeout (round 4:
+    a flapped tunnel left a sleeping bench burning 90 min per variant)."""
+    import time as _time
+    sweep = _load_sweep()
+    sweep.STALL_WINDOW_S = 2
+    sweep.POLL_S = 0.2
+    stub = _stub_bench(tmp_path, "import time\ntime.sleep(600)\n")
+    t0 = _time.monotonic()
+    r = sweep.run_variant("stall", [], timeout=500, bench_path=stub)
+    assert r is None
+    assert _time.monotonic() - t0 < 60       # killed by watchdog, not timeout
+
+
+def test_run_variant_spares_active_process(tmp_path):
+    """CPU-burning benches must NOT trip the stall watchdog even when
+    they run longer than the stall window."""
+    sweep = _load_sweep()
+    sweep.STALL_WINDOW_S = 1
+    sweep.POLL_S = 0.2
+    stub = _stub_bench(tmp_path, """
+import json, time
+t0 = time.time()
+while time.time() - t0 < 3:
+    sum(i * i for i in range(100000))
+print(json.dumps({"metric": "decode_throughput", "value": 7.0,
+                  "unit": "tok/s/chip", "vs_baseline": 0.0}))
+""")
+    r = sweep.run_variant("busy", [], timeout=60, bench_path=stub)
+    assert r is not None and r["value"] == 7.0

@@ -24,6 +24,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -145,19 +146,116 @@ def cpu_env() -> dict[str, str]:
         "cpu-only sweep (--cpu): relative variant data, NOT a TPU result")
 
 
+STALL_WINDOW_S = 240      # zero-CPU window that means "tunnel-dead block"
+STALL_TICKS = 5           # < this many jiffies across the window = stalled
+POLL_S = 15               # watchdog poll cadence (module-level for tests)
+
+
+def _cpu_ticks(pid: int) -> int | None:
+    """CPU jiffies of pid's whole process TREE (Linux), None once the
+    root is gone.  Must count descendants: bench.py's patient-probe
+    phase delegates the actual work to child probe subprocesses while
+    the parent sleeps — parent-only accounting would kill a bench that
+    is working exactly as designed (bench.py _ensure_live_backend).
+    Live children are found by walking /proc ppids; already-reaped ones
+    are covered by the parent's cutime/cstime (fields 16-17)."""
+    def _stat(p):
+        with open(f"/proc/{p}/stat") as f:
+            return f.read().rsplit(") ", 1)[1].split()
+    try:
+        parts = _stat(pid)
+    except (OSError, IndexError, ValueError):
+        return None
+    # self + children already waited on (cutime/cstime accrue at reap)
+    total = sum(int(parts[i]) for i in (11, 12, 13, 14))
+    ppids = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == pid:
+            continue
+        try:
+            p = _stat(entry)
+            ppids[int(entry)] = (int(p[1]),
+                                 int(p[11]) + int(p[12])
+                                 + int(p[13]) + int(p[14]))
+        except (OSError, IndexError, ValueError):
+            continue
+    # sum every live descendant of pid (transitively)
+    children = {}
+    for cpid, (ppid, _t) in ppids.items():
+        children.setdefault(ppid, []).append(cpid)
+    stack = [pid]
+    while stack:
+        for c in children.get(stack.pop(), []):
+            total += ppids[c][1]
+            stack.append(c)
+    return total
+
+
 def run_variant(name: str, args: list[str], timeout: int,
                 env: dict[str, str] | None = None,
                 bench_path: str | None = None) -> dict | None:
+    """Run one bench variant with a stall watchdog.
+
+    A tunnel flap mid-variant leaves the bench hard-blocked inside a
+    PJRT RPC — observed in round 4 as a process sleeping with ZERO CPU
+    ticks for half an hour while the per-variant timeout (90 min) slowly
+    burned.  A healthy run never looks like that: XLA compiles are
+    host-CPU-heavy and the decode loop dispatches every few hundred ms,
+    so CPU time always accrues.  If the bench gains < STALL_TICKS
+    jiffies over STALL_WINDOW_S, kill it; the caller's re-probe then
+    classifies the death as a flap and refunds the attempt
+    (tools/tpu_round4.py run_rows)."""
     cmd = [sys.executable, bench_path or os.path.join(ROOT, "bench.py")] + args
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=timeout, cwd=ROOT, env=env)
-    except subprocess.TimeoutExpired:
-        print(f"--- {name}: TIMEOUT after {timeout}s", flush=True)
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=ROOT,
+                            env=env)
+    import threading
+    start = time.monotonic()
+    win_t0, win_ticks = start, _cpu_ticks(proc.pid) or 0
+    stalled = False
+    # read pipes from threads so a chatty bench can't deadlock on a full
+    # pipe while the main thread watches the clock
+    bufs = {"out": "", "err": ""}
+
+    def _drain(stream, key):
+        bufs[key] = stream.read() or ""
+
+    threads = [threading.Thread(target=_drain, args=(proc.stdout, "out"),
+                                daemon=True),
+               threading.Thread(target=_drain, args=(proc.stderr, "err"),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    while proc.poll() is None:
+        if time.monotonic() - start > timeout:
+            proc.kill()
+            print(f"--- {name}: TIMEOUT after {timeout}s", flush=True)
+            proc.wait()
+            return None
+        try:
+            proc.wait(timeout=POLL_S)     # return promptly on exit
+        except subprocess.TimeoutExpired:
+            pass
+        ticks = _cpu_ticks(proc.pid)
+        if ticks is None:
+            break
+        if ticks - win_ticks >= STALL_TICKS:
+            win_t0, win_ticks = time.monotonic(), ticks
+        elif time.monotonic() - win_t0 > STALL_WINDOW_S:
+            stalled = True
+            proc.kill()
+            print(f"--- {name}: STALLED ({ticks - win_ticks} CPU ticks in "
+                  f"{STALL_WINDOW_S}s — tunnel-dead block); killed",
+                  flush=True)
+            break
+    proc.wait()
+    for t in threads:
+        t.join(timeout=30)
+    if stalled:
         return None
     result = None
-    for l in (proc.stdout or "").splitlines():
+    for l in bufs["out"].splitlines():
         l = l.strip()
         if l.startswith("{") and '"metric"' in l:
             try:
@@ -166,7 +264,7 @@ def run_variant(name: str, args: list[str], timeout: int,
                 continue
     if result is None:
         print(f"--- {name}: no JSON (rc={proc.returncode})\n"
-              f"{(proc.stderr or '')[-2000:]}", flush=True)
+              f"{bufs['err'][-2000:]}", flush=True)
         return None
     if proc.returncode != 0:
         # measured but died in teardown (e.g. tunnel loss after the print):
