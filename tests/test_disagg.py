@@ -200,3 +200,24 @@ def test_disagg_sliding_window_migration_correct():
     outs = d.generate(prompts, p)
     for a, b in zip(plain, outs):
         assert a.output_token_ids == b.output_token_ids
+
+
+def test_disagg_guided_choice_plan_follows_migration():
+    """A guided_choice request whose FIRST token opens a committed
+    canonical-suffix plan (non-ASCII choice: prefill emits a partial-rune
+    byte token) must keep its plan across the prefill->decode handoff —
+    dropping it strands dangling bytes in ctx and silently unconstrains
+    the output (round-4 review finding)."""
+    import json
+    disagg = DisaggregatedEngine(_cfg(), _cfg())
+    choices = ["ünïcödé", "Ωmega"]
+    outs = disagg.generate(
+        ["x"], [SamplingParams(max_tokens=40, temperature=0.0,
+                               guided="choice",
+                               guided_schema=json.dumps(choices))])
+    (r,) = outs
+    assert r.output_text in choices, r.output_text
+    # the scenario is only exercised if prefill really opened a plan
+    assert disagg.prefill.stats.guided_plans >= 1
+    # plan state fully reclaimed on both pools
+    assert not disagg.prefill._guided_plan and not disagg.decode._guided_plan

@@ -213,6 +213,12 @@ class DisaggregatedEngine:
             # the JSON acceptor follows the request, or guided decoding
             # silently stops at the pool boundary (and prefill leaks state)
             dst._guided[rid] = g
+        plan = self.prefill._guided_plan.pop(rid, None)
+        if plan:
+            # a committed canonical-suffix plan follows too — dropping it
+            # mid-rune would strand dangling bytes in ctx (see
+            # adopt_prefilled's guided_plan for the cross-pod twin)
+            dst._guided_plan[rid] = plan
         if dst._adaptive_window and (dst.scheduler.running
                                      or dst._pending_window is not None):
             # a migration into a busy decode pool is an arrival: without

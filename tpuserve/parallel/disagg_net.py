@@ -299,6 +299,14 @@ class PrefillHandoffEngine:
             "num_valid_blocks": len(blocks),
             "params": sampling_to_dict(req.params),
         }
+        plan = self.prefill._guided_plan.get(rid)
+        if plan:
+            # a guided request whose first token opened a committed
+            # canonical-suffix plan (engine._guided_pick): the decode pod
+            # must keep emitting the SAME token sequence or the partial
+            # rune in ctx can never complete and the constraint silently
+            # drops at the first feed failure
+            meta["guided_plan"] = list(plan)
         total, make_chunks = migration_payload(meta, seq_kv)
         cancel = threading.Event()
         with self._lock:

@@ -622,7 +622,8 @@ class Engine:
 
     def adopt_prefilled(self, request_id: str,
                         prompt_token_ids: Sequence[int], first_token: int,
-                        params: SamplingParams, seq_kv: list) -> str:
+                        params: SamplingParams, seq_kv: list,
+                        guided_plan: Optional[Sequence[int]] = None) -> str:
         """Adopt a sequence prefilled on another pod (cross-pod
         disaggregation, parallel/disagg_net.py): allocate blocks, scatter
         the transferred KV pages into this cache, and drop the request
@@ -672,6 +673,13 @@ class Engine:
             try:
                 st.feed(first_text)
                 self._guided[request_id] = st
+                if guided_plan:
+                    # the first token opened a committed canonical-suffix
+                    # plan on the prefill pod (possibly a partial rune —
+                    # first_text empty): keep emitting the same sequence,
+                    # or the dangling bytes in ctx never complete and the
+                    # constraint silently drops (round-4 review finding)
+                    self._guided_plan[request_id] = list(guided_plan)
             except ValueError:
                 pass                     # already off-grammar: unconstrained
         self.requests[request_id] = req

@@ -191,7 +191,7 @@ class AsyncEngineRunner:
                     rid = self.engine.adopt_prefilled(
                         m["request_id"], m["prompt_token_ids"],
                         m["first_token"], sampling_from_dict(m["params"]),
-                        msg.seq_kv)
+                        msg.seq_kv, guided_plan=m.get("guided_plan"))
                 except Exception as e:
                     msg.error = e
                     msg.rid_event.set()
