@@ -207,10 +207,90 @@ def _mistral():
         attn_implementation="eager"))
 
 
+def _deepseek_v3(**over):
+    # The full V3 trait set in one tiny model: MLA with q-lora and
+    # INTERLEAVED rope weights (the loader's de-interleave permutation is
+    # load-bearing), sigmoid scoring with a non-zero correction bias,
+    # grouped top-k, shared experts, routed scaling, first layer dense
+    kw = dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
+        n_group=2, topk_group=1, routed_scaling_factor=1.5,
+        norm_topk_prob=True, first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=24, qk_rope_head_dim=16,
+        qk_nope_head_dim=32, v_head_dim=32,
+        max_position_embeddings=512, rope_theta=10000.0,
+        tie_word_embeddings=True, attention_bias=False,
+        rope_interleave=True, rms_norm_eps=1e-6,
+        bos_token_id=0, eos_token_id=1, attn_implementation="eager")
+    kw.update(over)
+    m = transformers.DeepseekV3ForCausalLM(transformers.DeepseekV3Config(**kw))
+    with torch.no_grad():
+        for layer in m.model.layers:
+            if hasattr(layer.mlp, "gate"):
+                # a zero bias would leave the biased-selection path untested
+                layer.mlp.gate.e_score_correction_bias.uniform_(-0.05, 0.05)
+    return m
+
+
+def _deepseek_v3_yarn():
+    # YaRN long-context scaling: original_max (8) < T (12) puts real
+    # positions past the pretraining window; mscale_all_dim squares into
+    # the attention scale (ops/rope.py yarn path + ModelConfig.attn_scale)
+    return _deepseek_v3(rope_scaling={
+        "rope_type": "yarn", "factor": 4.0, "beta_fast": 32,
+        "beta_slow": 1, "mscale": 0.707, "mscale_all_dim": 0.707,
+        "original_max_position_embeddings": 8})
+
+
+def _deepseek_v2():
+    # V2-Lite shape: direct q projection (no q-lora), softmax scoring with
+    # greedy top-k, NO topk renormalisation, two shared experts.  Also
+    # proves the interleave handling against V2's complex-pair rope (the
+    # q.k dot product is invariant to the shared channel permutation).
+    return transformers.DeepseekV2ForCausalLM(transformers.DeepseekV2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, n_shared_experts=2, num_experts_per_tok=2,
+        topk_method="greedy", norm_topk_prob=False,
+        routed_scaling_factor=1.0, first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=None, qk_rope_head_dim=16,
+        qk_nope_head_dim=32, v_head_dim=32,
+        max_position_embeddings=512, rope_theta=10000.0,
+        tie_word_embeddings=True, attention_bias=False,
+        rms_norm_eps=1e-6, bos_token_id=0, eos_token_id=1,
+        attn_implementation="eager"))
+
+
+def _deepseek_v2_grouped():
+    # full-V2/V2.5 routing: group_limited_greedy scores a group by its
+    # single MAX member — not V3's top-2 sum (using the wrong one routes
+    # to different expert groups; round-4 review finding)
+    return transformers.DeepseekV2ForCausalLM(transformers.DeepseekV2Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
+        topk_method="group_limited_greedy", n_group=4, topk_group=2,
+        norm_topk_prob=False, routed_scaling_factor=1.0,
+        first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=None, qk_rope_head_dim=16,
+        qk_nope_head_dim=32, v_head_dim=32,
+        max_position_embeddings=512, rope_theta=10000.0,
+        tie_word_embeddings=True, rms_norm_eps=1e-6,
+        bos_token_id=0, eos_token_id=1, attn_implementation="eager"))
+
+
 _FAMILIES = {"phi3": _phi3, "opt": _opt, "llama": _llama,
              "qwen3_moe": _qwen3_moe, "qwen2": _qwen2, "gemma": _gemma,
              "mistral": _mistral, "qwen2_swa": _qwen2_swa,
-             "gemma2": _gemma2, "gemma3": _gemma3, "llama31": _llama31}
+             "gemma2": _gemma2, "gemma3": _gemma3, "llama31": _llama31,
+             "deepseek_v3": _deepseek_v3, "deepseek_v3_yarn": _deepseek_v3_yarn,
+             "deepseek_v2": _deepseek_v2,
+             "deepseek_v2_grouped": _deepseek_v2_grouped}
 
 
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
@@ -257,6 +337,23 @@ def test_family_logits_match_transformers(family, tmp_path):
         assert cfg.layer_window(0) == 5 and cfg.layer_window(5) is None
         assert cfg.layer_rope(0) == (10000.0, 1.0)          # local layer
         assert cfg.layer_rope(5) == (1_000_000.0, 8.0)      # global layer
+    if family.startswith("deepseek"):
+        assert cfg.is_mla and cfg.cache_kv_heads == 1
+        assert cfg.cache_head_dim == 32 + 16                # latent ⊕ rope
+        assert cfg.moe_first_k_dense == 1
+    if family == "deepseek_v3":
+        assert cfg.moe_scoring == "sigmoid" and cfg.moe_router_bias
+        assert cfg.moe_n_group == 2 and cfg.moe_routed_scaling == 1.5
+        assert cfg.mla_q_lora_rank == 24
+    if family == "deepseek_v3_yarn":
+        assert cfg.rope_yarn == (4.0, 32, 1, 0.707, 0.707, 8)
+        import math
+        m = 0.1 * 0.707 * math.log(4.0) + 1.0
+        assert abs(cfg.attn_scale - (48 ** -0.5) * m * m) < 1e-9
+    if family == "deepseek_v2":
+        assert cfg.moe_scoring == "softmax" and not cfg.moe_router_bias
+        assert not cfg.norm_topk_prob and cfg.mla_q_lora_rank is None
+        assert cfg.moe_shared_experts == 2
     params = weights.load_hf_checkpoint(cfg, str(path))
 
     rng = np.random.default_rng(7)

@@ -69,6 +69,10 @@ class ModelConfig:
     # high_freq_factor, original_max_position_embeddings) — see
     # ops/rope.py rope_freqs.
     rope_llama3_scaling: Optional[tuple] = None
+    # YaRN long-context scaling (DeepSeek): (factor, beta_fast, beta_slow,
+    # mscale, mscale_all_dim, original_max_position_embeddings) — see
+    # ops/rope.py rope_freqs; mscale_all_dim also squares into attn_scale.
+    rope_yarn: Optional[tuple] = None
     # Gemma2 traits: tanh softcaps on attention scores / final logits,
     # attention scale from query_pre_attn_scalar instead of head_dim, and
     # sandwich norms (post-attention + pre/post-feedforward layernorms).
@@ -89,6 +93,34 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None
     norm_topk_prob: bool = True      # renormalise the top-k router weights
+    # DeepSeek MoE extensions (deepseek_v2/v3; HF modeling_deepseek_v3):
+    # sigmoid expert scoring with a selection-only correction bias
+    # (e_score_correction_bias), grouped top-k (pick topk_group of n_group
+    # expert groups, then top-k inside the surviving groups), a scaling
+    # factor on the combine weights, always-on shared experts added to the
+    # routed output, and the first k layers staying dense.
+    moe_scoring: str = "softmax"     # "softmax" (Qwen3) | "sigmoid" (DSv3)
+    moe_router_bias: bool = False    # e_score_correction_bias on selection
+    moe_n_group: int = 1
+    moe_topk_group: int = 1
+    moe_routed_scaling: float = 1.0
+    moe_shared_experts: int = 0      # shared-expert width multiplier
+    moe_first_k_dense: int = 0       # first_k_dense_replace
+    # Multi-head latent attention (DeepSeek MLA): K/V are compressed to a
+    # kv_lora_rank latent + one shared roped key per token, so the cache
+    # stores ONE (kv_lora_rank + qk_rope_head_dim)-wide "head" per token
+    # instead of num_heads full K/V pairs — ~10x less KV HBM traffic and
+    # capacity, the TPU-first win for decode.  head_dim must equal
+    # qk_nope + qk_rope (the q/k attention width); v_head_dim is separate.
+    mla_kv_lora_rank: Optional[int] = None   # None = standard attention
+    mla_q_lora_rank: Optional[int] = None    # None = direct q projection
+    mla_qk_rope_head_dim: int = 64
+    mla_v_head_dim: int = 128
+    # DeepSeek checkpoints store rope-dim weights channel-INTERLEAVED
+    # (GPT-J pairing).  The loader de-interleaves those output channels
+    # once at load (models/weights.py _mla_deinterleave), so the forward
+    # always runs the NeoX split-half rope — zero runtime cost.
+    mla_rope_interleave: bool = True
 
     def layer_window(self, layer_idx: int) -> Optional[int]:
         """Effective sliding window for one layer — ONE implementation for
@@ -129,12 +161,25 @@ class ModelConfig:
     @property
     def attn_scale(self) -> float:
         """Attention score scale: Gemma2 uses query_pre_attn_scalar**-0.5
-        instead of head_dim**-0.5."""
-        return (self.query_pre_attn_scalar or self.head_dim) ** -0.5
+        instead of head_dim**-0.5; under YaRN with mscale_all_dim the
+        DeepSeek magnitude correction squares in (HF DeepseekV3Attention)."""
+        scale = (self.query_pre_attn_scalar or self.head_dim) ** -0.5
+        if self.rope_yarn is not None and self.rope_yarn[4]:
+            from tpuserve.ops.rope import yarn_mscale
+            m = yarn_mscale(self.rope_yarn[0], self.rope_yarn[4])
+            scale *= m * m
+        return scale
 
     @property
     def q_size(self) -> int:
         return self.num_heads * self.head_dim
+
+    @property
+    def attn_out_size(self) -> int:
+        """Width of the attention output fed to o_proj: MLA values are
+        mla_v_head_dim wide, not head_dim."""
+        return self.num_heads * (self.mla_v_head_dim if self.is_mla
+                                 else self.head_dim)
 
     @property
     def kv_size(self) -> int:
@@ -143,6 +188,37 @@ class ModelConfig:
     @property
     def expert_intermediate_size(self) -> int:
         return self.moe_intermediate_size or self.intermediate_size
+
+    @property
+    def is_mla(self) -> bool:
+        return self.mla_kv_lora_rank is not None
+
+    @property
+    def mla_qk_nope_head_dim(self) -> int:
+        """q/k split: head_dim covers nope + rope (matches HF qk_head_dim,
+        so attn_scale = head_dim**-0.5 is DeepSeek's scaling)."""
+        return self.head_dim - self.mla_qk_rope_head_dim
+
+    @property
+    def mla_latent_dim(self) -> int:
+        """Width of the single cached vector per token: the compressed KV
+        latent plus the shared roped key."""
+        return self.mla_kv_lora_rank + self.mla_qk_rope_head_dim
+
+    @property
+    def cache_kv_heads(self) -> int:
+        """KV-cache head count: MLA stores one latent "head"."""
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def cache_head_dim(self) -> int:
+        """KV-cache per-head width: MLA stores the latent vector."""
+        return self.mla_latent_dim if self.is_mla else self.head_dim
+
+    def moe_layer_is_dense(self, layer_idx: int) -> bool:
+        """DeepSeek first_k_dense_replace: the first k layers keep a dense
+        MLP even in MoE models."""
+        return bool(self.num_experts) and layer_idx < self.moe_first_k_dense
 
     @property
     def num_params(self) -> int:
@@ -215,6 +291,57 @@ def config_from_hf_json(name: str, hf: dict) -> ModelConfig:
             learned_pos_offset=2,
             attention_bias=True,
             mlp_bias=True,
+            **common,
+        )
+    if family.startswith("deepseek_v") or arch.startswith("deepseekv"):
+        # DeepSeek V2/V3 (MLA + DeepSeek-MoE).  head_dim is the q/k
+        # attention width (qk_nope + qk_rope = HF qk_head_dim); the cache
+        # stores the kv_lora_rank+rope latent instead (cache_head_dim).
+        rs = hf.get("rope_scaling") or {}
+        yarn = None
+        if rs.get("type", rs.get("rope_type")) == "yarn":
+            yarn = (rs["factor"], rs.get("beta_fast", 32),
+                    rs.get("beta_slow", 1), rs.get("mscale", 1.0),
+                    rs.get("mscale_all_dim", 0),
+                    rs.get("original_max_position_embeddings",
+                           common["max_position_embeddings"]))
+        moe = {}
+        if hf.get("n_routed_experts"):
+            moe = dict(
+                num_experts=hf["n_routed_experts"],
+                num_experts_per_tok=hf["num_experts_per_tok"],
+                moe_intermediate_size=hf["moe_intermediate_size"],
+                norm_topk_prob=hf.get("norm_topk_prob", True),
+                # V3 checkpoints say scoring_func sigmoid / topk_method
+                # noaux_tc; the integrated transformers DeepseekV3Config
+                # hardcodes both, so default by generation
+                moe_scoring=hf.get(
+                    "scoring_func",
+                    "sigmoid" if "v3" in family or "v3" in arch
+                    else "softmax"),
+                moe_router_bias=(hf.get("topk_method") == "noaux_tc"
+                                 or ("topk_method" not in hf
+                                     and ("v3" in family or "v3" in arch))),
+                moe_n_group=hf.get("n_group") or 1,
+                moe_topk_group=hf.get("topk_group") or 1,
+                moe_routed_scaling=hf.get("routed_scaling_factor", 1.0),
+                moe_shared_experts=hf.get("n_shared_experts") or 0,
+                moe_first_k_dense=hf.get("first_k_dense_replace", 0),
+            )
+        return ModelConfig(
+            intermediate_size=hf["intermediate_size"],
+            num_kv_heads=hf["num_attention_heads"],
+            head_dim=hf["qk_nope_head_dim"] + hf["qk_rope_head_dim"],
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_yarn=yarn,
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            attention_bias=hf.get("attention_bias", False),
+            mla_kv_lora_rank=hf["kv_lora_rank"],
+            mla_q_lora_rank=hf.get("q_lora_rank"),
+            mla_qk_rope_head_dim=hf["qk_rope_head_dim"],
+            mla_v_head_dim=hf["v_head_dim"],
+            mla_rope_interleave=hf.get("rope_interleave", True),
+            **moe,
             **common,
         )
     # gemma generations by model_type OR architectures (some configs omit
@@ -544,6 +671,39 @@ register_model_config(ModelConfig(
     bos_token_id=151643, eos_token_id=151645,
 ), "qwen3-30b-a3b")
 
+# DeepSeek family (MLA + DeepSeek-MoE).  MLA is the TPU-first long-context
+# cache design: one 576-wide latent per token instead of per-head K/V.
+register_model_config(ModelConfig(
+    name="deepseek-ai/DeepSeek-V2-Lite",
+    vocab_size=102400, hidden_size=2048, intermediate_size=10944,
+    num_layers=27, num_heads=16, num_kv_heads=16, head_dim=192,
+    max_position_embeddings=163840, rope_theta=10000.0,
+    rope_yarn=(40.0, 32, 1, 0.707, 0.707, 4096),
+    norm_eps=1e-6, tie_word_embeddings=False,
+    mla_kv_lora_rank=512, mla_q_lora_rank=None,
+    mla_qk_rope_head_dim=64, mla_v_head_dim=128,
+    num_experts=64, num_experts_per_tok=6, moe_intermediate_size=1408,
+    norm_topk_prob=False, moe_scoring="softmax", moe_routed_scaling=1.0,
+    moe_shared_experts=2, moe_first_k_dense=1,
+    bos_token_id=100000, eos_token_id=100001,
+), "deepseek-v2-lite")
+
+register_model_config(ModelConfig(
+    name="deepseek-ai/DeepSeek-V3",
+    vocab_size=129280, hidden_size=7168, intermediate_size=18432,
+    num_layers=61, num_heads=128, num_kv_heads=128, head_dim=192,
+    max_position_embeddings=163840, rope_theta=10000.0,
+    rope_yarn=(40.0, 32, 1, 1.0, 1.0, 4096),
+    norm_eps=1e-6, tie_word_embeddings=False,
+    mla_kv_lora_rank=512, mla_q_lora_rank=1536,
+    mla_qk_rope_head_dim=64, mla_v_head_dim=128,
+    num_experts=256, num_experts_per_tok=8, moe_intermediate_size=2048,
+    norm_topk_prob=True, moe_scoring="sigmoid", moe_router_bias=True,
+    moe_n_group=8, moe_topk_group=4, moe_routed_scaling=2.5,
+    moe_shared_experts=1, moe_first_k_dense=3,
+    bos_token_id=0, eos_token_id=1,
+), "deepseek-v3", "deepseek-r1")
+
 # Tiny configs for tests / CPU smoke (one per architectural family).
 register_model_config(ModelConfig(
     name="tiny-qwen3",
@@ -560,6 +720,22 @@ register_model_config(ModelConfig(
     max_position_embeddings=512, rope_theta=1e6,
     qk_norm=True, tie_word_embeddings=True, eos_token_id=1,
     num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+))
+
+# MLA + V3-style MoE in one tiny config: q-lora, sigmoid+bias grouped
+# routing, shared experts, first layer dense.
+register_model_config(ModelConfig(
+    name="tiny-deepseek",
+    vocab_size=512, hidden_size=64, intermediate_size=128,
+    num_layers=3, num_heads=4, num_kv_heads=4, head_dim=48,
+    max_position_embeddings=512, rope_theta=10000.0,
+    tie_word_embeddings=True, eos_token_id=1,
+    mla_kv_lora_rank=32, mla_q_lora_rank=24,
+    mla_qk_rope_head_dim=16, mla_v_head_dim=32,
+    num_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+    moe_scoring="sigmoid", moe_router_bias=True,
+    moe_n_group=2, moe_topk_group=1, moe_routed_scaling=1.5,
+    moe_shared_experts=1, moe_first_k_dense=1,
 ))
 
 register_model_config(ModelConfig(

@@ -123,7 +123,10 @@ def _mlp_residual(h: jnp.ndarray, lp: dict, cfg: ModelConfig,
 
 def _mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig,
          ad: jnp.ndarray | None = None) -> jnp.ndarray:
-    if cfg.num_experts:
+    # branch on the PARAMS, not cfg.num_experts: DeepSeek keeps the first
+    # first_k_dense_replace layers dense inside an MoE model, so those
+    # layers carry plain gated-MLP params (weights.init_params)
+    if "experts" in p:
         return _moe_mlp(x, p, cfg)
     if cfg.mlp_style == "gated":
         gate = _act(_linear(x, p["gate_proj"], ad), cfg.act)
@@ -152,12 +155,46 @@ def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     xt = x.reshape(-1, shape[-1])                              # (T, H)
     T = xt.shape[0]
     router = _linear(xt, p["router"]).astype(jnp.float32)      # (T, E)
-    probs = jax.nn.softmax(router, axis=-1)
+    # DeepSeek-V3 scores experts with a sigmoid; selection adds the
+    # auxiliary-loss-free correction bias and (optionally) restricts the
+    # top-k to the best topk_group of n_group expert groups — but the
+    # COMBINE weights always come from the unbiased scores (HF
+    # DeepseekV3TopkRouter.get_topk_indices/forward).
+    if cfg.moe_scoring == "sigmoid":
+        scores = jax.nn.sigmoid(router)
+    else:
+        scores = jax.nn.softmax(router, axis=-1)
+    choice = scores
+    if "router_bias" in p:
+        choice = choice + p["router_bias"]["bias"][None, :]
+    if cfg.moe_n_group > 1:
+        E = scores.shape[-1]
+        G = cfg.moe_n_group
+        grouped = choice.reshape(T, G, E // G)
+        # group score: V3 (sigmoid) sums the group's top-2 member scores;
+        # V2's group_limited_greedy (softmax) takes the single max (HF
+        # modeling_deepseek_v2 vs _v3 — using the wrong one silently
+        # routes full V2/V2.5 checkpoints to different expert groups)
+        if cfg.moe_scoring == "sigmoid":
+            group_scores = jnp.sum(jax.lax.top_k(grouped, 2)[0], axis=-1)
+        else:
+            group_scores = jnp.max(grouped, axis=-1)
+        _, gidx = jax.lax.top_k(group_scores, cfg.moe_topk_group)
+        gmask = jnp.zeros_like(group_scores).at[
+            jnp.arange(T)[:, None], gidx].set(1.0)             # (T, G)
+        # HF masks non-selected groups to 0.0, not -inf
+        choice = jnp.where(gmask[..., None] > 0, grouped,
+                           0.0).reshape(T, E)
     k = cfg.num_experts_per_tok
-    topv, topi = jax.lax.top_k(probs, k)                       # (T, k)
+    _, topi = jax.lax.top_k(choice, k)                         # (T, k)
+    topv = jnp.take_along_axis(scores, topi, axis=-1)          # unbiased
     if cfg.norm_topk_prob:
-        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-    combine = jnp.zeros_like(probs).at[
+        # HF adds 1e-20 on the sigmoid path (sums are not 1 there)
+        eps = 1e-20 if cfg.moe_scoring == "sigmoid" else 0.0
+        topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + eps)
+    if cfg.moe_routed_scaling != 1.0:
+        topv = topv * cfg.moe_routed_scaling
+    combine = jnp.zeros_like(scores).at[
         jnp.arange(T)[:, None], topi].set(topv)                # (T, E)
     ek = p["experts"]
 
@@ -176,6 +213,11 @@ def _moe_mlp(x: jnp.ndarray, p: dict, cfg: ModelConfig) -> jnp.ndarray:
     h = _act(g, cfg.act) * u
     o = expert_proj("tei,eih->teh", h, ek["down_proj"])
     y = jnp.einsum("teh,te->th", o, combine.astype(o.dtype))
+    if "shared" in p:
+        # DeepSeek shared experts: an always-on gated MLP beside the
+        # routed ones (HF DeepseekV3MoE.shared_experts) — p["shared"] has
+        # no "experts" key, so _mlp runs its plain gated branch
+        y = y + _mlp(xt, p["shared"], cfg)
     return y.reshape(shape)
 
 
@@ -208,6 +250,113 @@ def _qkv(h: jnp.ndarray, lp: dict, cfg: ModelConfig, positions: jnp.ndarray,
         q = rope_ops.apply_rope(q, cos, sin)
         k = rope_ops.apply_rope(k, cos, sin)
     return q, k, v
+
+
+# --------------------------------------------------------------------------
+# Multi-head latent attention (DeepSeek MLA)
+# --------------------------------------------------------------------------
+#
+# The cache stores ONE vector per token: the rmsnorm'd kv_lora_rank latent
+# concatenated with a single shared roped key (cfg.mla_latent_dim wide,
+# cache_kv_heads == 1) — ~10x less KV HBM traffic and capacity than
+# materialised per-head K/V, which is the whole point on TPU where decode
+# is KV-bandwidth-bound.  Prefill decompresses K/V for the prompt (naive
+# form: compute-bound anyway); chunked prefill and decode run the ABSORBED
+# form — W_UK folds into the query and W_UV into the output, so attention
+# happens entirely in latent space and the paged-attention op reads the
+# latent pages as both K and V (scores need q_lat . c plus the rope dot;
+# the value contraction needs only the first kv_lora_rank columns of the
+# output).  References: DeepSeek-V2 paper §2.1; HF modeling_deepseek_v3
+# (the naive form this must match numerically).
+
+def _mla_proj(hn: jnp.ndarray, lp: dict, cfg: ModelConfig,
+              positions: jnp.ndarray, ad: jnp.ndarray | None = None):
+    """q_nope (..., H, nope), roped q_rope (..., H, rope), and the
+    cache-ready latent (..., latent_dim) = rmsnorm(c_kv) ⊕ roped key."""
+    if "q_a_proj" in lp:
+        cq = rmsnorm(_linear(hn, lp["q_a_proj"], ad),
+                     lp["q_a_norm"]["scale"], cfg.norm_eps,
+                     cfg.norm_weight_offset)
+        q = _linear(cq, lp["q_b_proj"], ad)
+    else:
+        q = _linear(hn, lp["q_proj"], ad)
+    q = q.reshape(*hn.shape[:-1], cfg.num_heads, cfg.head_dim)
+    nope = cfg.mla_qk_nope_head_dim
+    q_nope, q_rope = q[..., :nope], q[..., nope:]
+    ckv = _linear(hn, lp["kv_a_proj"], ad)
+    c = rmsnorm(ckv[..., :cfg.mla_kv_lora_rank],
+                lp["kv_a_norm"]["scale"], cfg.norm_eps,
+                cfg.norm_weight_offset)
+    k_rope = ckv[..., cfg.mla_kv_lora_rank:]
+    cos, sin = rope_ops.rope_freqs(positions, cfg.mla_qk_rope_head_dim,
+                                   cfg.rope_theta,
+                                   yarn_scaling=cfg.rope_yarn)
+    q_rope = rope_ops.apply_rope(q_rope, cos, sin)
+    k_rope = rope_ops.apply_rope(k_rope[..., None, :], cos, sin)[..., 0, :]
+    return q_nope, q_rope, jnp.concatenate([c, k_rope], axis=-1)
+
+
+def _mla_kv_b(lp: dict, cfg: ModelConfig, dtype) -> tuple:
+    """kv_b_proj split into W_UK (kv_lora, H, nope) / W_UV (kv_lora, H, v)
+    — dequantized when the kernel is int8 (weights.quantize_params_int8)."""
+    p = lp["kv_b_proj"]
+    w = p["kernel"].astype(dtype)
+    if "scale" in p:
+        w = w * p["scale"][None].astype(dtype)
+    w = w.reshape(cfg.mla_kv_lora_rank, cfg.num_heads,
+                  cfg.mla_qk_nope_head_dim + cfg.mla_v_head_dim)
+    return w[..., :cfg.mla_qk_nope_head_dim], \
+        w[..., cfg.mla_qk_nope_head_dim:]
+
+
+def _mla_decompress(latent, lp, cfg: ModelConfig, dtype):
+    """Materialise per-head K (..., H, head_dim) and V (..., H, v_dim)
+    from latents — the naive form for compute-bound full-sequence paths."""
+    w_uk, w_uv = _mla_kv_b(lp, cfg, dtype)
+    c = latent[..., :cfg.mla_kv_lora_rank]
+    k_nope = jnp.einsum("...tc,chn->...thn", c, w_uk)
+    v = jnp.einsum("...tc,chv->...thv", c, w_uv)
+    k_rope = jnp.broadcast_to(
+        latent[..., None, cfg.mla_kv_lora_rank:],
+        (*k_nope.shape[:-1], cfg.mla_qk_rope_head_dim))
+    return jnp.concatenate([k_nope, k_rope], axis=-1), v
+
+
+def _mla_naive_qkv(hn, lp, cfg: ModelConfig, positions,
+                   ad: jnp.ndarray | None = None):
+    """Drop-in _qkv analog for cache-free MLA paths: full q and
+    decompressed per-head k/v (v is mla_v_head_dim wide — the shared
+    attention ops contract the value dim independently)."""
+    q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
+    k, v = _mla_decompress(latent, lp, cfg, q_nope.dtype)
+    return jnp.concatenate([q_nope, q_rope], axis=-1), k, v
+
+
+def _mla_prefill_out(q_nope, q_rope, latent, lp, cfg: ModelConfig,
+                     prompt_lens, scale: float) -> jnp.ndarray:
+    """Naive (decompressed) attention over fresh prompt K/V: prefill is
+    compute-bound, so materialising per-head K/V for the prompt costs
+    little and reuses the masked prefill attention op unchanged."""
+    k, v = _mla_decompress(latent, lp, cfg, q_nope.dtype)
+    q = jnp.concatenate([q_nope, q_rope], axis=-1)
+    return attn_ops.prefill_attention(q, k, v, prompt_lens, scale)
+
+
+def _mla_absorb_q(q_nope, q_rope, lp, cfg: ModelConfig) -> jnp.ndarray:
+    """Fold W_UK into the query: scores against raw latents become exact
+    (q_lat . c == q_nope . k_nope); the roped dims ride alongside."""
+    w_uk, _ = _mla_kv_b(lp, cfg, q_nope.dtype)
+    q_lat = jnp.einsum("...hn,chn->...hc", q_nope, w_uk)
+    return jnp.concatenate([q_lat, q_rope], axis=-1)
+
+
+def _mla_unabsorb(out_lat, lp, cfg: ModelConfig) -> jnp.ndarray:
+    """Latent-space attention output -> per-head values via W_UV.  The
+    paged op returned p @ [c ⊕ k_rope]; only the first kv_lora_rank
+    columns are the value contraction, the rope tail is discarded."""
+    _, w_uv = _mla_kv_b(lp, cfg, out_lat.dtype)
+    return jnp.einsum("...hc,chv->...hv",
+                      out_lat[..., :cfg.mla_kv_lora_rank], w_uv)
 
 
 def _embed(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -270,6 +419,19 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
+        if cfg.is_mla:
+            # MLA prefill: cache the latent, attend naively (decompressed)
+            # over the fresh prompt K/V — reference impl only; the Pallas
+            # kernels assume materialised per-head K/V pages
+            q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
+            new_cache.append(attn_ops.write_mla_entry(kv_cache[li], latent,
+                                                      slot_ids))
+            out = _mla_prefill_out(q_nope, q_rope, latent, lp, cfg,
+                                   prompt_lens, scale)
+            out = out.reshape(B, T, cfg.num_heads * cfg.mla_v_head_dim)
+            h = h + _attn_residual(out, lp, cfg, ad)
+            h = h + _mlp_residual(h, lp, cfg, ad)
+            continue
         q, k, v = _qkv(hn, lp, cfg, positions, li, ad)
         # batched prefill attends over the FRESH k/v (full precision even
         # when the cache stores int8 — only cache READS see quantization)
@@ -358,11 +520,12 @@ def embed_forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        q, k, v = (_mla_naive_qkv(hn, lp, cfg, positions) if cfg.is_mla
+                   else _qkv(hn, lp, cfg, positions, li))
         out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
                                          sliding_window=sw,
                                          logit_softcap=cfg.attn_logit_softcapping)
-        out = out.reshape(B, T, cfg.q_size)
+        out = out.reshape(B, T, cfg.attn_out_size)
         h = h + _attn_residual(out, lp, cfg)
         h = h + _mlp_residual(h, lp, cfg)
     if cfg.final_layernorm:
@@ -404,11 +567,12 @@ def score_prompt(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        q, k, v = (_mla_naive_qkv(hn, lp, cfg, positions) if cfg.is_mla
+                   else _qkv(hn, lp, cfg, positions, li))
         out = attn_ops.prefill_attention(q, k, v, prompt_lens, scale,
                                          sliding_window=sw,
                                          logit_softcap=cfg.attn_logit_softcapping)
-        h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+        h = h + _attn_residual(out.reshape(B, T, cfg.attn_out_size), lp, cfg)
         h = h + _mlp_residual(h, lp, cfg)
     # next-token targets: position i scores tokens[i+1]
     nxt = jnp.concatenate([tokens[:, 1:], jnp.zeros((B, 1), tokens.dtype)],
@@ -456,6 +620,22 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
+        if cfg.is_mla:
+            # MLA window: write the latent, attend ABSORBED against the
+            # latent pages (k == v == latent; value = first kv_lora cols)
+            q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
+            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids)
+            new_cache.append(entry)
+            q_eff = _mla_absorb_q(q_nope, q_rope, lp, cfg)
+            out = attn_ops.chunked_prefill_attention(
+                q_eff, entry["k"], entry["k"], block_tables, ctx_lens,
+                chunk_lens, scale, k_scale=entry.get("ks"),
+                v_scale=entry.get("ks"))
+            out = _mla_unabsorb(out, lp, cfg)
+            out = out.reshape(B, C, cfg.num_heads * cfg.mla_v_head_dim)
+            h = h + _attn_residual(out, lp, cfg, ad)
+            h = h + _mlp_residual(h, lp, cfg, ad)
+            continue
         q, k, v = _qkv(hn, lp, cfg, positions, li, ad)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
@@ -558,6 +738,22 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     for li, lp in enumerate(params["layers"]):
         sw = cfg.layer_window(li)
         hn = _norm(h, lp["attn_norm"], cfg)
+        if cfg.is_mla:
+            # MLA decode: absorbed attention straight against the latent
+            # pages — the step reads mla_latent_dim bytes per cached token
+            # instead of 2 * Hkv * head_dim (the ~10x KV-bandwidth win)
+            q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
+            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids)
+            new_cache.append(entry)
+            q_eff = _mla_absorb_q(q_nope, q_rope, lp, cfg)
+            out = attn_ops.paged_decode_attention(
+                q_eff, entry["k"], entry["k"], block_tables, seq_lens,
+                scale, k_scale=entry.get("ks"), v_scale=entry.get("ks"))
+            out = _mla_unabsorb(out, lp, cfg)
+            out = out.reshape(B, cfg.num_heads * cfg.mla_v_head_dim)
+            h = h + _attn_residual(out, lp, cfg, ad)
+            h = h + _mlp_residual(h, lp, cfg, ad)
+            continue
         q, k, v = _qkv(hn, lp, cfg, positions, li, ad)  # (B, Hq/Hkv, D)
         entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
         new_cache.append(entry)
@@ -691,11 +887,14 @@ def draft_propose(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         h = _embed(params, cfg, toks, positions)
         for li, lp in enumerate(params["layers"]):
             hn = _norm(h, lp["attn_norm"], cfg)
-            q, kk, v = _qkv(hn, lp, cfg, positions, li)
+            q, kk, v = (_mla_naive_qkv(hn, lp, cfg, positions)
+                        if cfg.is_mla
+                        else _qkv(hn, lp, cfg, positions, li))
             out = attn_ops.prefill_attention(
                 q, kk, v, cur, scale, sliding_window=cfg.layer_window(li),
                 logit_softcap=cfg.attn_logit_softcapping)
-            h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+            h = h + _attn_residual(out.reshape(B, T, cfg.attn_out_size),
+                                   lp, cfg)
             h = h + _mlp_residual(h, lp, cfg)
         # unembed ONLY each row's last position — the full (B, T, V)
         # logits would be GBs at serving batch sizes
@@ -727,10 +926,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     scale = cfg.attn_scale
     for li, lp in enumerate(params["layers"]):
         hn = _norm(h, lp["attn_norm"], cfg)
-        q, k, v = _qkv(hn, lp, cfg, positions, li)
+        q, k, v = (_mla_naive_qkv(hn, lp, cfg, positions) if cfg.is_mla
+                   else _qkv(hn, lp, cfg, positions, li))
         out = attn_ops.prefill_attention(q, k, v, seq_lens, scale,
                                          sliding_window=cfg.layer_window(li),
                                          logit_softcap=cfg.attn_logit_softcapping)
-        h = h + _attn_residual(out.reshape(B, T, cfg.q_size), lp, cfg)
+        h = h + _attn_residual(out.reshape(B, T, cfg.attn_out_size), lp, cfg)
         h = h + _mlp_residual(h, lp, cfg)
     return _unembed(params, cfg, h)

@@ -69,22 +69,47 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
 
     h, d = cfg.hidden_size, cfg.head_dim
     layers = []
-    for _ in range(cfg.num_layers):
-        lp = {
-            "attn_norm": norm(h),
-            "q_proj": dense(h, cfg.q_size, cfg.attention_bias),
-            "k_proj": dense(h, cfg.kv_size, cfg.attention_bias),
-            "v_proj": dense(h, cfg.kv_size, cfg.attention_bias),
-            "o_proj": dense(cfg.q_size, h, cfg.attention_bias and cfg.pos == "learned"),
-            "mlp_norm": norm(h),
-        }
+    for li in range(cfg.num_layers):
+        if cfg.is_mla:
+            # DeepSeek MLA: low-rank q (optional), compressed-KV latent +
+            # shared roped key, per-head up-projections packed in kv_b_proj
+            lp = {
+                "attn_norm": norm(h),
+                "kv_a_proj": dense(h, cfg.mla_latent_dim,
+                                   cfg.attention_bias),
+                "kv_a_norm": norm(cfg.mla_kv_lora_rank),
+                "kv_b_proj": dense(
+                    cfg.mla_kv_lora_rank,
+                    cfg.num_heads * (cfg.mla_qk_nope_head_dim
+                                     + cfg.mla_v_head_dim), False),
+                "o_proj": dense(cfg.num_heads * cfg.mla_v_head_dim, h,
+                                cfg.attention_bias),
+                "mlp_norm": norm(h),
+            }
+            if cfg.mla_q_lora_rank:
+                lp["q_a_proj"] = dense(h, cfg.mla_q_lora_rank,
+                                       cfg.attention_bias)
+                lp["q_a_norm"] = norm(cfg.mla_q_lora_rank)
+                lp["q_b_proj"] = dense(cfg.mla_q_lora_rank, cfg.q_size,
+                                       False)
+            else:
+                lp["q_proj"] = dense(h, cfg.q_size, False)
+        else:
+            lp = {
+                "attn_norm": norm(h),
+                "q_proj": dense(h, cfg.q_size, cfg.attention_bias),
+                "k_proj": dense(h, cfg.kv_size, cfg.attention_bias),
+                "v_proj": dense(h, cfg.kv_size, cfg.attention_bias),
+                "o_proj": dense(cfg.q_size, h, cfg.attention_bias and cfg.pos == "learned"),
+                "mlp_norm": norm(h),
+            }
         if cfg.qk_norm:
             lp["q_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
             lp["k_norm"] = {"scale": jnp.full((d,), norm_init, dtype)}
         if cfg.sandwich_norms:
             lp["post_attn_norm"] = norm(h)
             lp["post_mlp_norm"] = norm(h)
-        if cfg.num_experts:
+        if cfg.num_experts and not cfg.moe_layer_is_dense(li):
             ei = cfg.expert_intermediate_size
             E = cfg.num_experts
 
@@ -93,9 +118,17 @@ def init_params(cfg: ModelConfig, seed: int = 0) -> Params:
                     rng.standard_normal((E, n_in, n_out), dtype=np.float32)
                     / np.sqrt(n_in), dtype=dtype)}
             lp["router"] = dense(h, E, False)
+            if cfg.moe_router_bias:
+                # e_score_correction_bias: selection-only, stays f32
+                lp["router_bias"] = {"bias": jnp.zeros((E,), jnp.float32)}
             lp["experts"] = {"gate_proj": experts(h, ei),
                              "up_proj": experts(h, ei),
                              "down_proj": experts(ei, h)}
+            if cfg.moe_shared_experts:
+                si = ei * cfg.moe_shared_experts
+                lp["shared"] = {"gate_proj": dense(h, si, False),
+                                "up_proj": dense(h, si, False),
+                                "down_proj": dense(si, h, False)}
         elif cfg.mlp_style == "gated":
             lp["gate_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
             lp["up_proj"] = dense(h, cfg.intermediate_size, cfg.mlp_bias)
@@ -143,6 +176,32 @@ def _t(w: jnp.ndarray, dtype) -> jnp.ndarray:
     return jnp.asarray(w, dtype=dtype).T
 
 
+def _mla_deinterleave(p: dict, cfg, heads: int, head_width: int) -> dict:
+    """Bake DeepSeek's interleaved-rope channel order out of a projection.
+
+    HF applies rope to DeepSeek checkpoints with GPT-J channel pairing
+    (apply_rotary_pos_emb_interleave: view(d/2, 2).transpose) — a pure
+    permutation of the rope-dim channels.  Since those channels come
+    straight out of this weight, permuting the weight's output channels
+    once at load makes the NeoX split-half rope (ops/rope.py) exact, at
+    zero runtime cost.  ``heads``/``head_width``: the projection's output
+    is [heads x head_width] with the LAST mla_qk_rope_head_dim channels
+    of each head being the rope slice (kv_a_proj: one latent+rope row).
+    """
+    if not cfg.mla_rope_interleave:
+        return p
+    d = cfg.mla_qk_rope_head_dim
+    perm = np.concatenate([np.arange(0, d, 2), np.arange(1, d, 2)])
+    idx = np.arange(heads * head_width)
+    for hh in range(heads):
+        lo = hh * head_width + head_width - d
+        idx[lo:lo + d] = lo + perm
+    out = {"kernel": p["kernel"][:, idx]}
+    if "bias" in p:
+        out["bias"] = p["bias"][idx]
+    return out
+
+
 def load_hf_checkpoint(cfg: ModelConfig, ckpt_dir: str) -> Params:
     """Convert an HF checkpoint directory into the transformer param pytree."""
     raw = _read_safetensors(ckpt_dir)
@@ -184,7 +243,28 @@ def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
         else:
             lp["mlp_norm"] = norm_scale(
                 pre + "post_attention_layernorm.weight")
-        if pre + "self_attn.qkv_proj.weight" in raw:            # Phi-3 fused qkv
+        if cfg.is_mla:                                          # DeepSeek MLA
+            rope_d = cfg.mla_qk_rope_head_dim
+            lp["kv_a_proj"] = _mla_deinterleave(
+                dense(pre + "self_attn.kv_a_proj_with_mqa.weight",
+                      pre + "self_attn.kv_a_proj_with_mqa.bias"),
+                cfg, heads=1, head_width=cfg.mla_latent_dim)
+            lp["kv_a_norm"] = norm_scale(
+                pre + "self_attn.kv_a_layernorm.weight")
+            lp["kv_b_proj"] = dense(pre + "self_attn.kv_b_proj.weight")
+            if cfg.mla_q_lora_rank:
+                lp["q_a_proj"] = dense(pre + "self_attn.q_a_proj.weight",
+                                       pre + "self_attn.q_a_proj.bias")
+                lp["q_a_norm"] = norm_scale(
+                    pre + "self_attn.q_a_layernorm.weight")
+                lp["q_b_proj"] = _mla_deinterleave(
+                    dense(pre + "self_attn.q_b_proj.weight"), cfg,
+                    heads=cfg.num_heads, head_width=cfg.head_dim)
+            else:
+                lp["q_proj"] = _mla_deinterleave(
+                    dense(pre + "self_attn.q_proj.weight"), cfg,
+                    heads=cfg.num_heads, head_width=cfg.head_dim)
+        elif pre + "self_attn.qkv_proj.weight" in raw:          # Phi-3 fused qkv
             qkv = jnp.asarray(raw[pre + "self_attn.qkv_proj.weight"], dtype=dtype)
             q, k, v = jnp.split(qkv, [cfg.q_size, cfg.q_size + cfg.kv_size], axis=0)
             lp["q_proj"], lp["k_proj"], lp["v_proj"] = ({"kernel": q.T}, {"kernel": k.T}, {"kernel": v.T})
@@ -195,13 +275,22 @@ def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
         if cfg.qk_norm:
             lp["q_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.q_norm.weight"), dtype=dtype)}
             lp["k_norm"] = {"scale": jnp.asarray(get(pre + "self_attn.k_norm.weight"), dtype=dtype)}
-        if cfg.num_experts:                                     # Qwen3-MoE
+        moe_layer = cfg.num_experts and not cfg.moe_layer_is_dense(i)
+        if moe_layer:                                           # Qwen3/DS MoE
             lp["router"] = {"kernel": _t(get(pre + "mlp.gate.weight"), dtype)}
+            if cfg.moe_router_bias:
+                lp["router_bias"] = {"bias": jnp.asarray(
+                    get(pre + "mlp.gate.e_score_correction_bias"),
+                    jnp.float32)}
             lp["experts"] = {
                 proj: {"kernel": jnp.stack([
                     _t(get(pre + f"mlp.experts.{e}.{proj}.weight"), dtype)
                     for e in range(cfg.num_experts)])}
                 for proj in ("gate_proj", "up_proj", "down_proj")}
+            if cfg.moe_shared_experts:
+                lp["shared"] = {
+                    proj: dense(pre + f"mlp.shared_experts.{proj}.weight")
+                    for proj in ("gate_proj", "up_proj", "down_proj")}
         elif pre + "mlp.gate_up_proj.weight" in raw:            # Phi-3 fused mlp
             gu = jnp.asarray(raw[pre + "mlp.gate_up_proj.weight"], dtype=dtype)
             g, u = jnp.split(gu, 2, axis=0)
@@ -209,7 +298,7 @@ def _load_llama_family(cfg: ModelConfig, raw: dict, dtype) -> Params:
         else:
             lp["gate_proj"] = dense(pre + "mlp.gate_proj.weight")
             lp["up_proj"] = dense(pre + "mlp.up_proj.weight")
-        if not cfg.num_experts:
+        if not moe_layer:
             lp["down_proj"] = dense(pre + "mlp.down_proj.weight")
         layers.append(lp)
 
@@ -331,6 +420,9 @@ def quantize_params_int8(params: Params) -> Params:
         for name, p in lp.items():
             if name == "experts":
                 out[name] = quant_experts(p)
+            elif name == "shared":
+                # DeepSeek shared experts: a nested dict of plain linears
+                out[name] = {k: quant_linear(v) for k, v in p.items()}
             else:
                 out[name] = quant_linear(p) if "kernel" in p else p
         return out

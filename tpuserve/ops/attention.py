@@ -253,6 +253,24 @@ def write_kv_entry(entry: dict, k: jnp.ndarray, v: jnp.ndarray,
             "v": write_kv_cache(entry["v"], v, slots)}
 
 
+def write_mla_entry(entry: dict, latent: jnp.ndarray,
+                    slots: jnp.ndarray) -> dict:
+    """Write MLA latent vectors into a k-only cache entry.
+
+    MLA (DeepSeek) caches ONE (latent ⊕ roped-key) vector per token —
+    the entry carries no "v" pages at all; the decode path reads the "k"
+    pages as both K and V (models/transformer.py absorbed form).
+    latent: (..., D) with no head axis; the cache stores it as a single
+    kv head.  int8 entries ("ks") quantize on write like write_kv_entry.
+    """
+    lat = latent[..., None, :]                     # add the 1-head axis
+    if "ks" in entry:
+        q, s = quantize_kv(lat)
+        return {"k": write_kv_cache(entry["k"], q, slots),
+                "ks": write_kv_scales(entry["ks"], s, slots)}
+    return {"k": write_kv_cache(entry["k"], lat, slots)}
+
+
 def write_kv_cache(cache: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:
     """Scatter new K or V vectors into the paged cache.
 

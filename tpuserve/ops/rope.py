@@ -6,9 +6,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention-magnitude correction (HF yarn_get_mscale)."""
+    if scale <= 1:
+        return 1.0
+    import math
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
 def rope_freqs(positions: jnp.ndarray, head_dim: int, theta: float,
                rotary_dim: int | None = None,
-               llama3_scaling: tuple | None = None
+               llama3_scaling: tuple | None = None,
+               yarn_scaling: tuple | None = None
                ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for ``positions``.
 
@@ -24,6 +33,39 @@ def rope_freqs(positions: jnp.ndarray, head_dim: int, theta: float,
     """
     rotary_dim = rotary_dim or head_dim
     inv_freq = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32) / rotary_dim))
+    attention_factor = 1.0
+    if yarn_scaling is not None:
+        # YaRN (DeepSeek long context; mirrors HF _compute_yarn_parameters):
+        # high-frequency dims extrapolate (unscaled), low-frequency dims
+        # interpolate (positions effectively divided by ``factor``), with a
+        # linear ramp between the beta_fast/beta_slow correction bounds.
+        # cos/sin are scaled by the attention factor
+        # mscale(factor, mscale) / mscale(factor, mscale_all_dim) — 1.0 for
+        # every DeepSeek config (mscale == mscale_all_dim); the remaining
+        # mscale**2 lives in ModelConfig.attn_scale.
+        import math
+        factor, beta_fast, beta_slow, mscale, mscale_all_dim, orig_max = \
+            yarn_scaling
+
+        def corr_dim(n_rot):
+            return (rotary_dim
+                    * math.log(orig_max / (n_rot * 2 * math.pi))
+                    ) / (2 * math.log(theta))
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), rotary_dim - 1)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(rotary_dim // 2, dtype=jnp.float32) - low)
+            / (high - low), 0.0, 1.0)
+        extrapolation_factor = 1.0 - ramp
+        inv_freq = ((inv_freq / factor) * ramp
+                    + inv_freq * extrapolation_factor)
+        if mscale and mscale_all_dim:
+            attention_factor = (yarn_mscale(factor, mscale)
+                                / yarn_mscale(factor, mscale_all_dim))
+        else:
+            attention_factor = yarn_mscale(factor)
     if llama3_scaling is not None:
         factor, low_f, high_f, orig_ctx = llama3_scaling
         wavelen = 2.0 * jnp.pi / inv_freq
@@ -33,6 +75,9 @@ def rope_freqs(positions: jnp.ndarray, head_dim: int, theta: float,
             wavelen > orig_ctx / low_f, inv_freq / factor,
             jnp.where(wavelen < orig_ctx / high_f, inv_freq, interp))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    if attention_factor != 1.0:
+        return (jnp.cos(angles) * attention_factor,
+                jnp.sin(angles) * attention_factor)
     return jnp.cos(angles), jnp.sin(angles)
 
 

@@ -44,7 +44,12 @@ def _spec_for(path: str, cfg: ModelConfig) -> P:
     # column-parallel kernels: (in, out) with out sharded; int8 per-output
     # quantization scales follow the out axis like biases
     if any(k in path for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
-                               "up_proj", "fc1")):
+                               "up_proj", "fc1",
+                               # MLA per-head up-projections: outputs are
+                               # [heads x width], so they shard like q/k/v
+                               # (the a-projections produce the SHARED
+                               # latent and stay replicated via fallthrough)
+                               "q_b_proj", "kv_b_proj")):
         if path.endswith("kernel"):
             return P(None, AXIS_TP)
         if path.endswith("bias") or path.endswith("scale"):
@@ -90,7 +95,13 @@ def param_shardings(params, cfg: ModelConfig, mesh: Mesh):
 
 
 def cache_shardings(cfg: ModelConfig, mesh: Mesh, num_layers: int | None = None):
-    """Per-layer [{"k","v"}] shardings: kv-head axis over tp."""
+    """Per-layer [{"k","v"}] shardings: kv-head axis over tp.  MLA caches
+    a single latent "head" per layer (k-only), which cannot split by head
+    — it replicates over tp like MQA K/V would, while the per-head
+    up-projections (kv_b_proj) and queries still shard."""
+    if cfg.is_mla:
+        s = NamedSharding(mesh, P(None, None, None, None))
+        return [{"k": s} for _ in range(num_layers or cfg.num_layers)]
     s = NamedSharding(mesh, P(None, None, AXIS_TP, None))
     return [{"k": s, "v": s} for _ in range(num_layers or cfg.num_layers)]
 

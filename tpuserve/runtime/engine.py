@@ -203,6 +203,14 @@ class Engine:
         self.model_cfg = model_cfg or get_model_config(config.model)
         self.cache_cfg = config.cache
         self.attn_impl = config.resolve_attn_impl()
+        if self.model_cfg.is_mla and self.attn_impl == "pallas":
+            # MLA attends in latent space against a 1-head latent cache;
+            # the Pallas kernels assume materialised per-head K/V pages.
+            # The XLA reference path still gets the MLA win (the ~10x
+            # smaller cache IS the bandwidth saving).
+            logger.info("MLA model: attn_impl=pallas not supported yet; "
+                        "using the XLA reference attention path")
+            self.attn_impl = "reference"
         self.mesh = mesh
         from tpuserve.parallel.mesh import AXIS_PP
         self._pp = mesh.shape.get(AXIS_PP, 1) if mesh is not None else 1
@@ -279,6 +287,13 @@ class Engine:
                     "speculative decoding is not supported on the pipeline "
                     "engine (the verify window would serialise through "
                     "every stage)")
+            if self.model_cfg.is_mla or self.model_cfg.moe_first_k_dense:
+                raise ValueError(
+                    "pipeline parallelism is not supported for DeepSeek "
+                    "models yet: the staged trunk stacks homogeneous layer "
+                    "pytrees and materialised {'k','v'} pages, which MLA's "
+                    "latent cache and first_k_dense_replace's mixed layer "
+                    "structure both break — use tp instead")
             if self.attn_impl == "pallas":
                 logger.warning("pipeline engine runs reference attention; "
                                "Pallas-under-pp is future work")

@@ -41,11 +41,14 @@ class CacheConfig:
 
 def bytes_per_block(model_cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
     itemsize = jnp.dtype(cache_cfg.dtype).itemsize
-    per_vector = model_cfg.head_dim * itemsize
+    per_vector = model_cfg.cache_head_dim * itemsize
     if cache_cfg.quantized:
         per_vector += 4                 # one f32 scale per (token, head)
-    return (2 * model_cfg.num_layers * cache_cfg.block_size
-            * model_cfg.num_kv_heads * per_vector)
+    # MLA stores ONE latent array (no V pages) — that asymmetry is the
+    # ~10x cache-capacity win (models/transformer.py MLA section)
+    kv_arrays = 1 if model_cfg.is_mla else 2
+    return (kv_arrays * model_cfg.num_layers * cache_cfg.block_size
+            * model_cfg.cache_kv_heads * per_vector)
 
 
 def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
@@ -83,7 +86,7 @@ def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
     directly in its sharded layout — never materialised on one device first.
     """
     shape = (cache_cfg.num_blocks, cache_cfg.block_size,
-             model_cfg.num_kv_heads, model_cfg.head_dim)
+             model_cfg.cache_kv_heads, model_cfg.cache_head_dim)
     dtype = jnp.dtype(cache_cfg.dtype)
     scale_shape = shape[:3]             # one scale per (block, pos, head)
 
@@ -105,9 +108,19 @@ def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
         if shardings is None:
             k_sh = v_sh = None
         elif isinstance(shardings, list):
-            k_sh, v_sh = shardings[li]["k"], shardings[li]["v"]
+            k_sh = shardings[li]["k"]
+            v_sh = shardings[li].get("v")
         else:
             k_sh = v_sh = shardings
+        if model_cfg.is_mla:
+            # one latent array per layer; the decode path reads it as
+            # both K and V (transformer.py absorbed MLA attention)
+            entry = {"k": zeros(k_sh)}
+            if cache_cfg.quantized:
+                entry["ks"] = zeros(scale_sharding(k_sh), scale_shape,
+                                    jnp.float32)
+            cache.append(entry)
+            continue
         entry = {"k": zeros(k_sh), "v": zeros(v_sh)}
         if cache_cfg.quantized:
             entry["ks"] = zeros(scale_sharding(k_sh), scale_shape,
