@@ -9,13 +9,18 @@ line.  The baseline is the driver-defined north-star target of 2,000
 tok/s/chip on v5e (BASELINE.md); the reference itself publishes no numbers
 (SURVEY.md §6).
 
-A dead TPU tunnel is retried with capped backoff until a real deadline
-(default 4 h, env ``TPUSERVE_PROBE_DEADLINE_S``) — round-3 evidence shows
-the tunnel flaps for hours and then returns, so a short probe window turns
-a whole round of TPU work into a CPU number (VERDICT r3 weak #1).  Only
-after the deadline truly expires does the bench fall back to CPU, and then
-the JSON line carries a ``degraded`` field so a CPU number can never pass
-silently for a TPU result.
+A provisional degraded JSON line is printed BEFORE anything that can hang
+or be killed, and SIGTERM/SIGALRM re-flush the best line known so far —
+the driver's capture parses the last JSON line of stdout, and round 4
+proved an artifact can otherwise be empty (BENCH_r04: rc=124, parsed
+null).  A dead TPU tunnel is retried with capped backoff until a deadline
+(default 25 min, env ``TPUSERVE_PROBE_DEADLINE_S``; capped to 40% of
+``TPUSERVE_BENCH_BUDGET_S`` when the caller provides its budget) — the
+hours-long patient waiting that round-3 evidence motivated now lives in
+tools/tpu_watch.sh, which owns the capture window.  When the deadline
+expires the bench falls back to CPU, and the JSON line carries a
+``degraded`` field so a CPU number can never pass silently for a TPU
+result.
 
 Variants (all optional, main line unchanged without them):
   --spec K          speculative decoding (n-gram prompt lookup, k=K) on a
@@ -33,18 +38,81 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import time
 
 TARGET_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north-star target
 
-# Patient tunnel watcher: the capture window is the whole round, and the
-# axon tunnel's observed outages last hours, not minutes.  Probe with
-# capped backoff until the deadline; the old 7-minute courtesy check
-# produced three consecutive degraded BENCH captures while the chip was
-# reachable later the same day.
+# Driver contract (VERDICT r4 weak #1): the official capture runs this
+# script under an unknown, finite timeout and parses the LAST JSON line of
+# stdout.  Round 4's 4-hour patient probe blew through that budget and the
+# driver killed the process before ANY line was printed (BENCH_r04.json:
+# rc=124, parsed null).  Three defenses, so the artifact can never again
+# be empty:
+#   1. a PROVISIONAL degraded JSON line (with best_tpu_result carry +
+#      commit hash) is printed BEFORE the first probe;
+#   2. SIGTERM/SIGALRM handlers re-flush the best line known so far and
+#      exit, so `timeout`'s TERM produces a parsed artifact;
+#   3. probing is capped to a fraction of an env-provided budget
+#      (TPUSERVE_BENCH_BUDGET_S), default well under the observed driver
+#      kill point, leaving room for the degraded CPU fallback run.
+# The hours-long patient probe now belongs exclusively to the background
+# watcher (tools/tpu_watch.sh), which owns the waiting.
 PROBE_TIMEOUT_S = 120
-PROBE_DEADLINE_S = float(os.environ.get("TPUSERVE_PROBE_DEADLINE_S", 4 * 3600))
+BUDGET_S = float(os.environ.get("TPUSERVE_BENCH_BUDGET_S", 0) or 0)
+_DEFAULT_PROBE_DEADLINE = min(BUDGET_S * 0.4, 1500.0) if BUDGET_S else 1500.0
+PROBE_DEADLINE_S = float(os.environ.get("TPUSERVE_PROBE_DEADLINE_S",
+                                        _DEFAULT_PROBE_DEADLINE))
 PROBE_MAX_BACKOFF_S = 180.0
+
+# Best JSON line known so far: starts as the provisional line, upgraded to
+# the final measured line the moment it exists.  Signal handlers re-print
+# it so the driver's tail always ends in a parseable line.
+_FINAL: dict = {"line": None}
+
+
+def _emit(out: dict) -> None:
+    """Print a result line AND record it as the current best, atomically
+    enough that a signal landing between the two still flushes either the
+    old best or this line — never nothing."""
+    line = json.dumps(out)
+    _FINAL["line"] = line
+    print(line, flush=True)
+
+
+def _flush_and_exit(signum, frame) -> None:
+    """SIGTERM (driver timeout) / SIGALRM (self-imposed budget backstop):
+    re-flush the best known line so the tail parses, then exit.  Raw
+    os.write, not print(): a buffered print() from a handler raises
+    "reentrant call" when the signal lands mid-print on the main thread —
+    the highest-risk instant (final line half-written) is exactly when the
+    re-flush matters.  os._exit because the interpreter may be inside
+    jax/PJRT teardown-hostile code."""
+    if _FINAL["line"]:
+        try:
+            os.write(1, ("\n" + _FINAL["line"] + "\n").encode())
+        except OSError:
+            pass
+    os._exit(0)
+
+
+def _install_signal_flush() -> None:
+    try:
+        signal.signal(signal.SIGTERM, _flush_and_exit)
+        signal.signal(signal.SIGALRM, _flush_and_exit)
+        if BUDGET_S:
+            # Self-imposed backstop inside the driver's budget: flush the
+            # best line ~60 s before the driver would SIGKILL us.  The
+            # budget is measured from the FIRST invocation — the degraded
+            # CPU re-exec (os.execve) restarts this process but must not
+            # restart the clock, so the start stamp rides the env through.
+            start = float(os.environ.setdefault(
+                "TPUSERVE_BENCH_START_TS", repr(time.time())))
+            remaining = BUDGET_S - (time.time() - start)
+            signal.alarm(max(30, int(remaining) - 60))
+    except (ValueError, OSError):
+        pass        # non-main thread / exotic platform: provisional line
+                    # on stdout is still the floor
 
 
 def _git_commit() -> str:
@@ -127,12 +195,14 @@ def _ensure_live_backend(retry: bool = True) -> None:
     """The axon TPU tunnel, when unhealthy, hangs ANY jax backend init —
     even under JAX_PLATFORMS=cpu.  Probe it in a killable subprocess and
     keep probing with capped backoff until ``TPUSERVE_PROBE_DEADLINE_S``
-    (default 4 h) expires — the tunnel's observed outages are hours long
-    and it DOES come back, so the watcher must outlast the flap rather
-    than fall back while the capture window is still open.  Only when the
-    deadline truly expires does the bench re-exec on CPU, marked DEGRADED
-    in the output, so it always produces its JSON line instead of hanging
-    the driver.  ``retry=False`` (smoke runs, which are CPU-by-definition)
+    (default 25 min, capped to 40% of the driver budget when
+    ``TPUSERVE_BENCH_BUDGET_S`` is set) expires.  Hours-long waiting for a
+    flapping tunnel is tools/tpu_watch.sh's job, not this process's: the
+    driver that invokes bench.py has a finite timeout, so the probe must
+    leave room for the degraded CPU fallback to run and print.  When the
+    deadline expires the bench re-execs on CPU, marked DEGRADED in the
+    output, so it always produces its JSON line instead of hanging the
+    driver.  ``retry=False`` (smoke runs, which are CPU-by-definition)
     probes once and falls back immediately."""
     if os.environ.get("TPUSERVE_BENCH_REEXEC"):
         return
@@ -397,9 +467,18 @@ def _roofline(eng0, batch, prompt_len, gen_len, steps_s):
             "v5e_hbm_fraction": round(total / V5E_HBM_GBS, 3)}
 
 
+def _model_matches(row_model: str, wanted: str) -> bool:
+    """True when a recorded row's model names the same model as ``wanted``
+    — which may be a CLI alias ("qwen3-0.6b") while rows store the full
+    config name ("Qwen/Qwen3-0.6B").  Compare case-insensitively and
+    accept the alias as a path component / suffix of the full name."""
+    a, b = row_model.lower(), wanted.lower()
+    return a == b or a.endswith("/" + b) or b.endswith("/" + a)
+
+
 def _best_tpu_result(model):
     """Highest-throughput backend=tpu row for THIS model, from the live
-    sweep log or the committed round snapshot (bench_r03_tpu.jsonl) —
+    sweep log or the committed round snapshots (bench_r0N_tpu.jsonl) —
     prior chip evidence may not be passed off for a different model, and
     the row carries its own batch/prompt_len/gen_len so the workload it
     measured is explicit (a degraded run uses CPU-sized shapes, so shape
@@ -407,8 +486,8 @@ def _best_tpu_result(model):
     degraded path, whose one job is to always emit the JSON line."""
     root = os.path.dirname(os.path.abspath(__file__))
     best, n_rows, seen = None, 0, set()
-    for name in ("bench_r04_tpu.jsonl", "bench_sweep.jsonl",
-                 "bench_r03_tpu.jsonl"):
+    for name in ("bench_r05_tpu.jsonl", "bench_r04_tpu.jsonl",
+                 "bench_sweep.jsonl", "bench_r03_tpu.jsonl"):
         try:
             with open(os.path.join(root, name)) as f:
                 lines = f.readlines()
@@ -425,7 +504,7 @@ def _best_tpu_result(model):
             if (not isinstance(row, dict)
                     or row.get("backend") != "tpu"
                     or not isinstance(row.get("value"), (int, float))
-                    or row.get("model") != model):
+                    or not _model_matches(str(row.get("model", "")), model)):
                 continue
             n_rows += 1
             if best is None or row["value"] > best["value"]:
@@ -498,6 +577,31 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
+
+    _install_signal_flush()
+
+    # Provisional line FIRST (VERDICT r4 next #1): if the driver kills this
+    # process at ANY later point — mid-probe, mid-compile, mid-run, even
+    # with SIGKILL — the artifact still parses, carries the best prior
+    # on-chip evidence for this model, and says exactly what it is.
+    provisional = {
+        "metric": "decode_throughput",
+        "value": 0.0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+        "model": args.model if not args.smoke else "tiny-qwen3",
+        "backend": "none",
+        "provisional": ("bench still running when this line was read — "
+                        "placeholder flushed before backend probing so a "
+                        "driver kill cannot produce an empty artifact"),
+        "degraded": os.environ.get("TPUSERVE_BENCH_DEGRADED",
+                                   "no measurement completed yet"),
+        "commit": _git_commit(),
+    }
+    best_prior = _best_tpu_result(provisional["model"])
+    if best_prior:
+        provisional["best_tpu_result"] = best_prior
+    _emit(provisional)
 
     try:
         _ensure_live_backend(retry=not args.smoke)
@@ -738,7 +842,11 @@ def main(argv=None):
                             if decode_tok_s else 0.0,
         }
 
-    print(json.dumps(out))
+    _emit(out)
+    try:
+        signal.alarm(0)       # measured line is out; cancel the backstop
+    except (ValueError, OSError):
+        pass
 
 
 if __name__ == "__main__":

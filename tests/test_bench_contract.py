@@ -1,0 +1,87 @@
+"""bench.py driver contract (VERDICT r4 weak/next #1): the official
+capture runs `python bench.py` under a finite timeout and parses the LAST
+JSON line of stdout.  Round 4's artifact was EMPTY (rc=124, parsed null)
+because nothing had been printed when the driver killed the probe loop.
+These tests pin the three defenses: a provisional line before any probing,
+a SIGTERM re-flush, and prior-evidence carry that matches model aliases.
+
+The subprocess test simulates the failure exactly: a `jax` shim that hangs
+on import (the dead-axon-tunnel signature) keeps bench.py in its probe
+loop, and the test plays the driver — SIGTERM a few seconds in."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _last_json_line(text: str) -> dict:
+    lines = [l for l in text.splitlines() if l.strip()]
+    assert lines, f"no output at all:\n{text!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.fixture()
+def hanging_jax(tmp_path):
+    """A PYTHONPATH shim whose `import jax` blocks forever — what the dead
+    tunnel does to the real probe subprocess."""
+    (tmp_path / "jax.py").write_text(
+        "import time\nwhile True:\n    time.sleep(1)\n")
+    return str(tmp_path)
+
+
+def test_driver_kill_mid_probe_still_parses(hanging_jax):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = hanging_jax
+    env.pop("TPUSERVE_BENCH_REEXEC", None)
+    env["TPUSERVE_PROBE_DEADLINE_S"] = "600"       # stay in the probe loop
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, cwd=ROOT,
+        env=env, start_new_session=True)           # isolate group kills
+    try:
+        deadline = time.monotonic() + 30
+        # the provisional line must be out BEFORE the probe resolves —
+        # poll for it, then play the driver and SIGTERM the bench
+        first = proc.stdout.readline().decode()
+        assert time.monotonic() < deadline
+        prov = json.loads(first)
+        assert prov["provisional"]
+        assert prov["commit"] != "unknown"
+        assert prov["metric"] == "decode_throughput"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    last = _last_json_line(first + out.decode())
+    assert last["provisional"]                     # re-flushed, parseable
+
+
+def test_model_alias_matches_full_name():
+    import bench
+    assert bench._model_matches("Qwen/Qwen3-0.6B", "qwen3-0.6b")
+    assert bench._model_matches("qwen3-0.6b", "Qwen/Qwen3-0.6B")
+    assert bench._model_matches("qwen3-0.6b", "qwen3-0.6b")
+    assert not bench._model_matches("Qwen/Qwen3-0.6B", "llama3-8b")
+
+
+def test_best_tpu_result_finds_alias_rows(tmp_path, monkeypatch):
+    import bench
+    row = {"backend": "tpu", "value": 1234.5, "unit": "tok/s/chip",
+           "model": "Qwen/Qwen3-0.6B", "variant": "base"}
+    log = tmp_path / "bench_r05_tpu.jsonl"
+    log.write_text(json.dumps(row) + "\n")
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p, _d=os.path.dirname: str(tmp_path)
+                        if p == os.path.abspath(bench.__file__)
+                        else _d(p))
+    best = bench._best_tpu_result("qwen3-0.6b")
+    assert best and best["value"] == 1234.5

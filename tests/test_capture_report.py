@@ -1,4 +1,4 @@
-"""The post-capture analysis (tools/round4_report.py) must turn captured
+"""The post-capture analysis (tools/capture_report.py) must turn captured
 rows into the VERDICT-requested decisions even when the capture lands
 unattended."""
 
@@ -8,7 +8,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
-import round4_report as rr
+import capture_report as rr
 
 
 def _rows():

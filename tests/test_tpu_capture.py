@@ -1,4 +1,4 @@
-"""The round-4 capture runner's state machine (tools/tpu_round4.py): the
+"""The round-4 capture runner's state machine (tools/tpu_capture.py): the
 single most important artifact of the round is the TPU capture, and its
 resume/refund logic must survive tunnel flaps without losing variants or
 looping forever.  All device work is mocked; this tests ONLY the control
@@ -16,8 +16,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 
 @pytest.fixture()
 def runner(tmp_path, monkeypatch):
-    import tpu_round4
-    mod = importlib.reload(tpu_round4)
+    import tpu_capture
+    mod = importlib.reload(tpu_capture)
     monkeypatch.setattr(mod, "LOG", str(tmp_path / "r04.jsonl"))
     monkeypatch.setattr(mod, "SWEEP_LOG", str(tmp_path / "sweep.jsonl"))
     monkeypatch.setattr(mod, "ATTEMPTS", str(tmp_path / "attempts.json"))

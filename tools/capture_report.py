@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Roll the round-4 TPU capture (bench_r04_tpu.jsonl) into analysis +
+"""Roll the TPU capture log (bench_r05_tpu.jsonl) into analysis +
 decisions.
 
 The VERDICT asked for MEASURED verdicts, not levers: p50 TTFT vs the
@@ -11,7 +11,7 @@ one BENCHMARKS.md section — so even a capture that lands unattended (the
 watcher can fire at any hour) produces the analysis, and the runner calls
 it automatically when the priority list drains.
 
-Usage: python tools/round4_report.py [--log bench_r04_tpu.jsonl] [--no-md]
+Usage: python tools/capture_report.py [--log bench_r05_tpu.jsonl] [--no-md]
 """
 
 from __future__ import annotations
@@ -240,7 +240,7 @@ def write_section(report: str, md_path: str) -> None:
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--log", default=os.path.join(ROOT, "bench_r04_tpu.jsonl"))
+    ap.add_argument("--log", default=os.path.join(ROOT, "bench_r05_tpu.jsonl"))
     ap.add_argument("--md", default=os.path.join(ROOT, "BENCHMARKS.md"))
     ap.add_argument("--no-md", action="store_true")
     args = ap.parse_args(argv)

@@ -1,15 +1,15 @@
 #!/bin/bash
-# Background tunnel watcher for the round-4 TPU capture (VERDICT r3 weak
+# Background tunnel watcher for the TPU capture (VERDICT r3 weak
 # #1: the capture window is the round — probe until the chip answers, run
 # the moment it does).  Loops: quick killable probe; on success, run
-# tools/tpu_round4.py (which drains the priority measurement list and is
+# tools/tpu_capture.py (which drains the priority measurement list and is
 # resumable across flaps); exit when the runner reports the list complete
 # or the wall-clock budget expires.
 #
-# Usage: nohup bash tools/tpu_watch.sh >> tpu_round4.log 2>&1 &
+# Usage: nohup bash tools/tpu_watch.sh >> tpu_round5.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-DONE_MARKER=/tmp/round4_tpu_done
+DONE_MARKER=/tmp/round5_tpu_done
 BUDGET_S=${TPUSERVE_WATCH_BUDGET_S:-39600}   # 11 h default
 START=$(date +%s)
 
@@ -22,7 +22,7 @@ while true; do
     fi
     if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "[watch] tunnel UP at $(date -Is) — running capture"
-        python tools/tpu_round4.py
+        python tools/tpu_capture.py
         rc=$?
         if [ $rc -eq 0 ]; then
             touch "$DONE_MARKER"
