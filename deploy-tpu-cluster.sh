@@ -11,7 +11,7 @@ SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 cd "$SCRIPT_DIR"
 
 usage() {
-    echo "Usage: $0 {deploy|cleanup|test}"
+    echo "Usage: $0 {deploy|cleanup|test|e2e}"
     echo ""
     echo "  deploy   Provision a GKE TPU cluster, bootstrap it, deploy the"
     echo "           tpuserve engine + gateway, smoke-test the API, and set"
@@ -19,6 +19,9 @@ usage() {
     echo "  cleanup  Tear down every cluster recorded by tpu-inventory-*.ini"
     echo "           and delete the generated files."
     echo "  test     Re-run the API smoke tests against the latest cluster."
+    echo "  e2e      Live kind deploy + smoke + teardown when docker/kind"
+    echo "           exist; otherwise strict offline manifest validation"
+    echo "           across every topology (limitation printed)."
     echo ""
     echo "Config: set TPUSERVE_* env vars or pass a YAML file via"
     echo "        TPUSERVE_CONFIG (see tpuserve/provision/config.py)."
@@ -38,6 +41,10 @@ case "${1:-}" in
     test)
         [ $# -eq 1 ] || usage
         exec python -m tpuserve.provision ${TPUSERVE_CONFIG:+--config "$TPUSERVE_CONFIG"} test
+        ;;
+    e2e)
+        [ $# -eq 1 ] || usage
+        exec python -m tpuserve.provision ${TPUSERVE_CONFIG:+--config "$TPUSERVE_CONFIG"} e2e
         ;;
     *)
         usage

@@ -64,6 +64,23 @@ def test_run_variant_no_json_returns_none(tmp_path):
     assert sweep.run_variant("empty", [], timeout=60, bench_path=stub) is None
 
 
+def test_run_variant_ignores_provisional_placeholder(tmp_path):
+    """bench.py prints a provisional kill-insurance line before measuring;
+    a variant that crashes after it must count as 'no JSON' — recording
+    the 0.0 placeholder would crash format_row (no ttft_ms) and poison
+    the sweep log."""
+    sweep = _load_sweep()
+    stub = _stub_bench(tmp_path, """
+import json, sys
+print(json.dumps({"metric": "decode_throughput", "value": 0.0,
+                  "unit": "tok/s/chip", "vs_baseline": 0.0,
+                  "backend": "none", "provisional": "placeholder"}))
+sys.exit(1)          # crashed before any measurement
+""")
+    assert sweep.run_variant("crash", [], timeout=60,
+                             bench_path=stub) is None
+
+
 def test_append_markdown_creates_file_and_rows(tmp_path):
     sweep = _load_sweep()
     path = str(tmp_path / "BENCHMARKS.md")

@@ -1,0 +1,94 @@
+"""The environment-gated e2e command (provision/e2e.py): offline it must
+validate every topology's full manifest set and print the limitation; on
+a docker+kind host it must run the live kind pipeline.  The live branch
+is exercised with mocked tool detection + subprocess calls (no container
+runtime exists in CI — which is the point of the gate)."""
+
+import subprocess
+
+import pytest
+
+from tpuserve.provision import e2e
+from tpuserve.provision.config import DeployConfig
+from tpuserve.provision.runner import DryRunRunner
+
+
+def test_offline_validates_every_topology(capsys):
+    total = e2e.offline_validate()
+    out = capsys.readouterr().out
+    assert total > 100                       # full stacks, all topologies
+    for name in e2e.TOPOLOGIES:
+        assert name in out
+
+
+def test_run_e2e_offline_prints_limitation(monkeypatch, capsys):
+    monkeypatch.setattr(e2e, "detect_runtime",
+                        lambda: (False, "missing tools: docker"))
+    e2e.run_e2e(DeployConfig(), DryRunRunner())
+    out = capsys.readouterr().out
+    assert "LIMITATION" in out
+    assert "no live cluster exercised" in out
+
+
+class RecordingRunner(DryRunRunner):
+    """DryRunRunner that records argv — the live branch must route every
+    external command through the runner seam (a raw subprocess.run would
+    mutate real clusters under --dry-run)."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def run(self, argv, **kw):
+        self.calls.append(list(argv))
+        return super().run(argv, **kw)
+
+
+def test_run_e2e_live_branch_creates_and_tears_down(monkeypatch):
+    monkeypatch.setattr(e2e, "detect_runtime", lambda: (True, "mocked"))
+    deployed = []
+
+    from tpuserve.provision import cli
+    monkeypatch.setattr(cli, "deploy",
+                        lambda cfg, runner, workdir: deployed.append(cfg))
+    runner = RecordingRunner()
+    e2e.run_e2e(DeployConfig(), runner)
+    assert runner.calls[0][:3] == ["kind", "create", "cluster"]
+    assert runner.calls[-1][:3] == ["kind", "delete", "cluster"]
+    assert deployed and deployed[0].provider == "local"
+
+
+def test_live_branch_tears_down_on_deploy_failure(monkeypatch):
+    from tpuserve.provision import cli
+
+    def boom(cfg, runner, workdir):
+        raise RuntimeError("smoke failed")
+
+    monkeypatch.setattr(cli, "deploy", boom)
+    runner = RecordingRunner()
+    with pytest.raises(RuntimeError):
+        e2e.live_kind_e2e(DeployConfig(), runner)
+    assert runner.calls[-1][:3] == ["kind", "delete", "cluster"]
+
+
+def test_detect_runtime_reports_missing_tools(monkeypatch):
+    monkeypatch.setattr(e2e.shutil, "which", lambda t: None)
+    ok, reason = e2e.detect_runtime()
+    assert not ok and "missing tools" in reason
+
+
+def test_detect_runtime_requires_live_daemon(monkeypatch):
+    monkeypatch.setattr(e2e.shutil, "which", lambda t: "/usr/bin/" + t)
+
+    def fake_run(argv, capture_output=True, timeout=30):
+        return subprocess.CompletedProcess(
+            argv, 1, b"", b"Cannot connect to the Docker daemon")
+
+    monkeypatch.setattr(e2e.subprocess, "run", fake_run)
+    ok, reason = e2e.detect_runtime()
+    assert not ok and "daemon unreachable" in reason
+
+
+def test_cli_e2e_subcommand_wired():
+    from tpuserve.provision import cli
+    assert cli.main(["e2e"]) == 0            # offline env: validates + exits 0

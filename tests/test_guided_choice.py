@@ -220,3 +220,44 @@ def test_http_guided_choice_bad_list_is_400(server):
         except urllib.error.HTTPError as e:
             status = e.code
         assert status == 400, payload
+
+
+def test_suffix_plan_survives_sp_style_leading_marker(monkeypatch):
+    """A SentencePiece-flavored tokenizer prepends a space marker to any
+    standalone encode(), so the canonical suffix's first encoding fails
+    the in-context round-trip gate; the engine must retry with the
+    mid-text (anchored) tokenization instead of dropping the constraint
+    (ADVICE r4)."""
+    eng = _engine()
+
+    class SPLike:
+        """Wraps the engine's tokenizer; standalone encodes gain a
+        leading space, like SentencePiece's sequence-initial marker."""
+        def __init__(self, base):
+            self._base = base
+
+        def encode(self, s, add_bos=False):
+            return self._base.encode(" " + s, add_bos=add_bos)
+
+        def __getattr__(self, name):
+            return getattr(self._base, name)
+
+    monkeypatch.setattr(eng, "tokenizer", SPLike(eng.tokenizer))
+
+    class Choice:
+        in_string = False
+        can_finish = False
+
+        def allows(self, txt):
+            return False               # force the suffix-plan last resort
+
+        def viable_suffixes(self):
+            return ["yes"]
+
+    from tpuserve.runtime.request import Request, SamplingParams as SP
+    r = Request(request_id="t1", prompt_token_ids=eng.tokenizer.encode("q"),
+                params=SP(max_tokens=8))
+    tok = eng._guided_pick(r, Choice(), sampled=5, candidates=[])
+    plan = eng._guided_plan.get("t1", [])
+    got = eng.tokenizer.decode([tok] + plan)
+    assert got == "yes", got           # not " yes", and not dropped

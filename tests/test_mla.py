@@ -267,3 +267,58 @@ def test_tp_shards_mla_projections():
     assert _spec_for("layers.kv_a_proj.kernel", cfg) == P()
     assert _spec_for("layers.q_a_proj.kernel", cfg) == P()
     assert _spec_for("layers.router_bias.bias", cfg) == P()
+
+
+def test_int8_mla_per_slice_scales_survive_hot_rope_channel():
+    """ADVICE r4: one absmax scale over the 576-wide (latent ⊕ rope)
+    vector lets a large rope channel crush latent precision.  The cache
+    stores separate latent/rope scales; dequantized latents must stay
+    accurate even when a rope channel is 50x the latent magnitude, and
+    quantized decode must track the fp output."""
+    from tpuserve.ops import attention as attn_ops
+
+    cfg = _cfg()
+    split = cfg.mla_kv_lora_rank
+    cc = CacheConfig(block_size=4, num_blocks=8, max_blocks_per_seq=4,
+                     dtype="int8")
+    entry = create_kv_cache(cfg, cc)[0]
+    assert entry["ks"].shape == (8, 4, 2)          # latent + rope scales
+
+    rng = np.random.default_rng(0)
+    T = 8
+    latent = rng.normal(size=(1, T, cfg.mla_latent_dim)).astype(np.float32)
+    latent[..., split:] *= 3.0
+    latent[..., -1] = 50.0                          # hot rope channel
+    latent = jnp.asarray(latent)
+    slots = jnp.arange(T, dtype=jnp.int32)[None, :]
+    entry = attn_ops.write_mla_entry(entry, latent, slots,
+                                     latent_split=split)
+
+    sc = attn_ops.expand_slice_scales(
+        entry["ks"], (split, cfg.mla_qk_rope_head_dim))
+    deq = (entry["k"].astype(jnp.float32) * sc).reshape(
+        -1, cfg.mla_latent_dim)[:T]
+    ref = latent[0]
+    # latent slice precision must NOT be set by the 50.0 rope channel:
+    # absmax/127 quantization error is bounded by half a step
+    lat_err = jnp.max(jnp.abs(deq[:, :split] - ref[:, :split]))
+    lat_step = jnp.max(jnp.abs(ref[:, :split])) / 127.0
+    assert float(lat_err) <= float(lat_step) * 0.51 + 1e-6
+    rope_err = jnp.max(jnp.abs(deq[:, split:] - ref[:, split:]))
+    assert float(rope_err) <= 50.0 / 127.0 * 0.51 + 1e-6
+
+    # end-to-end: quantized decode attention tracks fp within tolerance
+    q = jnp.asarray(rng.normal(size=(1, cfg.num_heads, cfg.mla_latent_dim)),
+                    jnp.float32)
+    bt = jnp.arange(2, dtype=jnp.int32)[None, :]
+    fp_entry = {"k": jnp.zeros((8, 4, 1, cfg.mla_latent_dim), jnp.float32)}
+    fp_entry = attn_ops.write_mla_entry(fp_entry, latent, slots)
+    lens = jnp.array([T], jnp.int32)
+    out_q = attn_ops.paged_decode_attention(
+        q, entry["k"], entry["k"], bt, lens, cfg.attn_scale,
+        k_scale=entry["ks"], v_scale=entry["ks"],
+        scale_slices=(split, cfg.mla_qk_rope_head_dim))
+    out_fp = attn_ops.paged_decode_attention(
+        q, fp_entry["k"], fp_entry["k"], bt, lens, cfg.attn_scale)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(out_fp),
+                               atol=0.15, rtol=0.1)

@@ -390,6 +390,11 @@ def test_stream_options_include_usage(server):
     assert final["usage"]["prompt_tokens"] >= 1
     assert final["usage"]["total_tokens"] == (
         final["usage"]["prompt_tokens"] + 5)
+    # OpenAI contract (ADVICE r3): EVERY non-final chunk carries
+    # "usage": null — token chunks AND echo/role-style chunks alike
+    for ln in lines[:-1]:
+        chunk = json.loads(ln[6:])
+        assert "usage" in chunk and chunk["usage"] is None, chunk
     # without the option, no usage chunk appears
     status, raw2 = _post(server + "/v1/completions",
                          {"prompt": "hi", "max_tokens": 3, "temperature": 0,
@@ -397,6 +402,21 @@ def test_stream_options_include_usage(server):
     assert all("usage" not in json.loads(ln[6:])
                for ln in raw2.decode().splitlines()
                if ln.startswith("data: ") and not ln.endswith("[DONE]"))
+    # chat stream: the leading ROLE chunk is the one historically missing
+    # "usage": null (ADVICE r3)
+    status, raw3 = _post(server + "/v1/chat/completions",
+                         {"messages": [{"role": "user", "content": "hi"}],
+                          "max_tokens": 3, "temperature": 0,
+                          "ignore_eos": True, "stream": True,
+                          "stream_options": {"include_usage": True}},
+                         raw=True)
+    assert status == 200
+    chunks = [json.loads(ln[6:]) for ln in raw3.decode().splitlines()
+              if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    for chunk in chunks[:-1]:
+        assert "usage" in chunk and chunk["usage"] is None, chunk
+    assert chunks[-1]["usage"]["completion_tokens"] == 3
 
 
 def test_tokenize_detokenize_roundtrip(server):
@@ -409,9 +429,13 @@ def test_tokenize_detokenize_roundtrip(server):
     assert out2["prompt"] == "hello world"
     # malformed inputs -> 400
     import urllib.error
+    # out-of-vocab ids must 400, not 500 (HF decode can raise
+    # OverflowError / rust panics on them — ADVICE r3)
     for url, payload in ((server + "/tokenize", {"prompt": 5}),
                          (server + "/detokenize", {"tokens": ["x"]}),
-                         (server + "/detokenize", {"tokens": [True]})):
+                         (server + "/detokenize", {"tokens": [True]}),
+                         (server + "/detokenize", {"tokens": [2 ** 40]}),
+                         (server + "/detokenize", {"tokens": [-1]})):
         try:
             _post(url, payload)
             assert False, "expected 400"

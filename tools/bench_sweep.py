@@ -207,9 +207,20 @@ def run_variant(name: str, args: list[str], timeout: int,
     (tools/tpu_round4.py run_rows)."""
     cmd = [sys.executable, bench_path or os.path.join(ROOT, "bench.py")] + args
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
+    # Own session: kills must take the whole process GROUP — bench.py
+    # delegates to child probe subprocesses, and killing only the parent
+    # leaves orphans holding the TPU and the stdout/stderr pipes open
+    # (the drain threads then block until their join timeout).
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True, cwd=ROOT,
-                            env=env)
+                            env=env, start_new_session=True)
+
+    def _kill_tree():
+        import signal as _signal
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            proc.kill()
     import threading
     start = time.monotonic()
     win_t0, win_ticks = start, _cpu_ticks(proc.pid) or 0
@@ -229,7 +240,7 @@ def run_variant(name: str, args: list[str], timeout: int,
         t.start()
     while proc.poll() is None:
         if time.monotonic() - start > timeout:
-            proc.kill()
+            _kill_tree()
             print(f"--- {name}: TIMEOUT after {timeout}s", flush=True)
             proc.wait()
             return None
@@ -239,12 +250,17 @@ def run_variant(name: str, args: list[str], timeout: int,
             pass
         ticks = _cpu_ticks(proc.pid)
         if ticks is None:
-            break
+            # /proc unreadable (or racing the exit): if the process is
+            # still alive, keep looping on the plain wall-clock timeout —
+            # stall detection is simply unavailable, but breaking here
+            # would fall into an UNBOUNDED proc.wait() below.  If it
+            # exited, the loop condition ends things.
+            continue
         if ticks - win_ticks >= STALL_TICKS:
             win_t0, win_ticks = time.monotonic(), ticks
         elif time.monotonic() - win_t0 > STALL_WINDOW_S:
             stalled = True
-            proc.kill()
+            _kill_tree()
             print(f"--- {name}: STALLED ({ticks - win_ticks} CPU ticks in "
                   f"{STALL_WINDOW_S}s — tunnel-dead block); killed",
                   flush=True)
@@ -259,9 +275,16 @@ def run_variant(name: str, args: list[str], timeout: int,
         l = l.strip()
         if l.startswith("{") and '"metric"' in l:
             try:
-                result = json.loads(l)
+                row = json.loads(l)
             except json.JSONDecodeError:
                 continue
+            if isinstance(row, dict) and row.get("provisional"):
+                # bench.py's kill-insurance placeholder (printed before
+                # any measurement): never a sweep result — a variant that
+                # died after printing it must parse as "no JSON", not as
+                # a 0.0 row that crashes format_row downstream
+                continue
+            result = row
     if result is None:
         print(f"--- {name}: no JSON (rc={proc.returncode})\n"
               f"{bufs['err'][-2000:]}", flush=True)

@@ -424,8 +424,9 @@ def prefill(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # over the fresh prompt K/V — reference impl only; the Pallas
             # kernels assume materialised per-head K/V pages
             q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
-            new_cache.append(attn_ops.write_mla_entry(kv_cache[li], latent,
-                                                      slot_ids))
+            new_cache.append(attn_ops.write_mla_entry(
+                kv_cache[li], latent, slot_ids,
+                latent_split=cfg.mla_kv_lora_rank))
             out = _mla_prefill_out(q_nope, q_rope, latent, lp, cfg,
                                    prompt_lens, scale)
             out = out.reshape(B, T, cfg.num_heads * cfg.mla_v_head_dim)
@@ -624,13 +625,16 @@ def _chunk_trunk(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # MLA window: write the latent, attend ABSORBED against the
             # latent pages (k == v == latent; value = first kv_lora cols)
             q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
-            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids)
+            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids,
+                                             latent_split=cfg.mla_kv_lora_rank)
             new_cache.append(entry)
             q_eff = _mla_absorb_q(q_nope, q_rope, lp, cfg)
             out = attn_ops.chunked_prefill_attention(
                 q_eff, entry["k"], entry["k"], block_tables, ctx_lens,
                 chunk_lens, scale, k_scale=entry.get("ks"),
-                v_scale=entry.get("ks"))
+                v_scale=entry.get("ks"),
+                scale_slices=(cfg.mla_kv_lora_rank,
+                              cfg.mla_qk_rope_head_dim))
             out = _mla_unabsorb(out, lp, cfg)
             out = out.reshape(B, C, cfg.num_heads * cfg.mla_v_head_dim)
             h = h + _attn_residual(out, lp, cfg, ad)
@@ -743,12 +747,15 @@ def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # pages — the step reads mla_latent_dim bytes per cached token
             # instead of 2 * Hkv * head_dim (the ~10x KV-bandwidth win)
             q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
-            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids)
+            entry = attn_ops.write_mla_entry(kv_cache[li], latent, slot_ids,
+                                             latent_split=cfg.mla_kv_lora_rank)
             new_cache.append(entry)
             q_eff = _mla_absorb_q(q_nope, q_rope, lp, cfg)
             out = attn_ops.paged_decode_attention(
                 q_eff, entry["k"], entry["k"], block_tables, seq_lens,
-                scale, k_scale=entry.get("ks"), v_scale=entry.get("ks"))
+                scale, k_scale=entry.get("ks"), v_scale=entry.get("ks"),
+                scale_slices=(cfg.mla_kv_lora_rank,
+                              cfg.mla_qk_rope_head_dim))
             out = _mla_unabsorb(out, lp, cfg)
             out = out.reshape(B, cfg.num_heads * cfg.mla_v_head_dim)
             h = h + _attn_residual(out, lp, cfg, ad)

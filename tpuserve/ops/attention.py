@@ -67,14 +67,18 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            k_scale: jnp.ndarray | None = None,
                            v_scale: jnp.ndarray | None = None,
                            sliding_window: int | None = None,
-                           logit_softcap: float | None = None) -> jnp.ndarray:
+                           logit_softcap: float | None = None,
+                           scale_slices: tuple[int, ...] | None = None
+                           ) -> jnp.ndarray:
     """Single-token decode attention against a paged KV cache.
 
     q: (B, Hq, D); k_cache/v_cache: (num_blocks, block_size, Hkv, D);
     block_tables: (B, max_blocks) int32 physical block ids;
     seq_lens: (B,) total tokens in cache per sequence (including current).
     ``k_scale``/``v_scale``: (num_blocks, block_size, Hkv) dequantization
-    scales when the cache stores int8.  ``sliding_window``: attend only
+    scales when the cache stores int8 — or, with ``scale_slices`` set
+    (int8 MLA), (num_blocks, block_size, len(scale_slices)) per-slice
+    scales over the channel axis.  ``sliding_window``: attend only
     the last W cached positions.  Returns (B, Hq, D).
     """
     B, Hq, D = q.shape
@@ -84,7 +88,18 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # Gather pages: (B, max_blocks, block_size, Hkv, D) -> (B, S, Hkv, D)
     k = k_cache[block_tables].reshape(B, S, Hkv, D)
     v = v_cache[block_tables].reshape(B, S, Hkv, D)
-    if k_scale is not None:
+    if k_scale is not None and scale_slices is not None:
+        # per-slice channel scales (int8 MLA); k and v are usually the
+        # same latent pages and XLA CSEs the duplicate dequant
+        ksc = expand_slice_scales(
+            k_scale[block_tables].reshape(B, S, len(scale_slices)),
+            scale_slices)
+        vsc = expand_slice_scales(
+            v_scale[block_tables].reshape(B, S, len(scale_slices)),
+            scale_slices)
+        k = (k.astype(jnp.float32) * ksc).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vsc).astype(q.dtype)
+    elif k_scale is not None:
         k = dequantize_kv(k, k_scale[block_tables].reshape(B, S, Hkv), q.dtype)
         v = dequantize_kv(v, v_scale[block_tables].reshape(B, S, Hkv), q.dtype)
     n_rep = Hq // Hkv
@@ -109,7 +124,9 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                               k_scale: jnp.ndarray | None = None,
                               v_scale: jnp.ndarray | None = None,
                               sliding_window: int | None = None,
-                              logit_softcap: float | None = None) -> jnp.ndarray:
+                              logit_softcap: float | None = None,
+                              scale_slices: tuple[int, ...] | None = None
+                              ) -> jnp.ndarray:
     """Attention for one prefill CHUNK against the paged cache.
 
     The chunk's K/V must already be written into the cache (so keys live at
@@ -134,7 +151,17 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # transient than the cache itself at long context.
     k = k_cache[block_tables].reshape(B, S, Hkv, D)
     v = v_cache[block_tables].reshape(B, S, Hkv, D)
-    if k_scale is not None:
+    if k_scale is not None and scale_slices is not None:
+        # per-slice channel scales (int8 MLA latent ⊕ rope pages)
+        ksc = expand_slice_scales(
+            k_scale[block_tables].reshape(B, S, len(scale_slices)),
+            scale_slices)
+        vsc = expand_slice_scales(
+            v_scale[block_tables].reshape(B, S, len(scale_slices)),
+            scale_slices)
+        k = (k.astype(jnp.float32) * ksc).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vsc).astype(q.dtype)
+    elif k_scale is not None:
         # reference/CPU path: dequantize the gathered window up front (the
         # Pallas kernel dequantizes per-segment in VMEM instead)
         k = dequantize_kv(k, k_scale[block_tables].reshape(B, S, Hkv), q.dtype)
@@ -254,21 +281,48 @@ def write_kv_entry(entry: dict, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def write_mla_entry(entry: dict, latent: jnp.ndarray,
-                    slots: jnp.ndarray) -> dict:
+                    slots: jnp.ndarray,
+                    latent_split: int | None = None) -> dict:
     """Write MLA latent vectors into a k-only cache entry.
 
     MLA (DeepSeek) caches ONE (latent ⊕ roped-key) vector per token —
     the entry carries no "v" pages at all; the decode path reads the "k"
     pages as both K and V (models/transformer.py absorbed form).
     latent: (..., D) with no head axis; the cache stores it as a single
-    kv head.  int8 entries ("ks") quantize on write like write_kv_entry.
+    kv head.
+
+    int8 entries ("ks") quantize on write — with TWO absmax scales per
+    token, one for the rmsnorm'd latent slice (``:latent_split``) and one
+    for the roped-key slice (``latent_split:``).  The slices have
+    unrelated dynamic ranges (rope channels carry raw key-projection
+    magnitudes; the latent is rmsnorm'd), so a single shared scale lets a
+    large rope channel crush latent precision (ADVICE r4).  The paired
+    scale cache is (num_blocks, block_size, 2); readers expand it back to
+    channel granularity via ``scale_slices`` (:func:`expand_slice_scales`).
     """
     lat = latent[..., None, :]                     # add the 1-head axis
     if "ks" in entry:
-        q, s = quantize_kv(lat)
+        if latent_split is None:
+            raise ValueError("int8 MLA cache requires latent_split (the "
+                             "kv_lora_rank) for per-slice scales")
+        q1, s1 = quantize_kv(lat[..., :latent_split])
+        q2, s2 = quantize_kv(lat[..., latent_split:])
+        q = jnp.concatenate([q1, q2], axis=-1)
+        s = jnp.concatenate([s1, s2], axis=-1)     # (..., 2): latent, rope
         return {"k": write_kv_cache(entry["k"], q, slots),
                 "ks": write_kv_scales(entry["ks"], s, slots)}
     return {"k": write_kv_cache(entry["k"], lat, slots)}
+
+
+def expand_slice_scales(scales: jnp.ndarray,
+                        scale_slices: tuple[int, ...]) -> jnp.ndarray:
+    """(..., n_slices) per-slice scales -> (..., 1, D) channel scales,
+    D = sum(scale_slices), broadcastable against (..., Hkv=1, D) pages."""
+    per_chan = jnp.concatenate(
+        [jnp.broadcast_to(scales[..., i:i + 1],
+                          (*scales.shape[:-1], w))
+         for i, w in enumerate(scale_slices)], axis=-1)
+    return per_chan[..., None, :]
 
 
 def write_kv_cache(cache: jnp.ndarray, new: jnp.ndarray, slots: jnp.ndarray) -> jnp.ndarray:

@@ -1539,19 +1539,38 @@ class Engine:
         # char-level candidate above was rejected).
         suffixes = getattr(st, "viable_suffixes", None)
         if suffixes is not None:
+            anchor = None
             for s in suffixes():
-                ids = self.tokenizer.encode(s)
                 # strict IN-CONTEXT round-trip gate: the plan's tokens are
                 # emitted after ctx, so validate what they decode to THERE
                 # — a standalone decode(encode(s)) == s check would pass a
-                # SentencePiece-style tokenizer whose sequence-initial
-                # marker then surfaces as a stray leading space in context,
-                # failing the acceptor mid-plan.  Skip rather than corrupt.
-                if ids and self.tokenizer.decode(ctx + ids) == base + s:
-                    if len(ids) > 1:
-                        self._guided_plan[r.request_id] = ids[1:]
-                    self.stats.guided_plans += 1
-                    return ids[0]
+                # tokenizer whose sequence-initial marker then surfaces as
+                # a stray leading space in context, failing the acceptor
+                # mid-plan.  Skip rather than corrupt.
+                def _gated(ids):
+                    return (ids
+                            and self.tokenizer.decode(ctx + ids) == base + s)
+
+                ids = self.tokenizer.encode(s)
+                if not _gated(ids):
+                    # The wrapper's encode() is already special-token-free
+                    # (models/tokenizer.py), but a SentencePiece-style
+                    # tokenizer still prepends a sequence-initial space
+                    # marker the gate just rejected — retry with the
+                    # MID-TEXT tokenization of s (anchor trick) instead of
+                    # silently dropping the constraint (ADVICE r4).
+                    if anchor is None:
+                        anchor = self.tokenizer.encode("x")
+                    mid = self.tokenizer.encode("x" + s)
+                    ids = (mid[len(anchor):]
+                           if anchor and mid[:len(anchor)] == anchor
+                           else [])
+                    if not _gated(ids):
+                        continue
+                if len(ids) > 1:
+                    self._guided_plan[r.request_id] = ids[1:]
+                self.stats.guided_plans += 1
+                return ids[0]
         # nothing valid exists (pathological tokenizer): give up on the
         # constraint for this step rather than deadlock
         self.stats.guided_fallbacks += 1

@@ -43,7 +43,9 @@ def bytes_per_block(model_cfg: ModelConfig, cache_cfg: CacheConfig) -> int:
     itemsize = jnp.dtype(cache_cfg.dtype).itemsize
     per_vector = model_cfg.cache_head_dim * itemsize
     if cache_cfg.quantized:
-        per_vector += 4                 # one f32 scale per (token, head)
+        # one f32 scale per (token, head); MLA carries two per token
+        # (latent + rope slices)
+        per_vector += 8 if model_cfg.is_mla else 4
     # MLA stores ONE latent array (no V pages) — that asymmetry is the
     # ~10x cache-capacity win (models/transformer.py MLA section)
     kv_arrays = 1 if model_cfg.is_mla else 2
@@ -114,11 +116,14 @@ def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,
             k_sh = v_sh = shardings
         if model_cfg.is_mla:
             # one latent array per layer; the decode path reads it as
-            # both K and V (transformer.py absorbed MLA attention)
+            # both K and V (transformer.py absorbed MLA attention).
+            # int8 stores TWO scales per token — the rmsnorm'd latent
+            # slice and the raw roped-key slice have unrelated dynamic
+            # ranges (ops/attention.py write_mla_entry).
             entry = {"k": zeros(k_sh)}
             if cache_cfg.quantized:
-                entry["ks"] = zeros(scale_sharding(k_sh), scale_shape,
-                                    jnp.float32)
+                entry["ks"] = zeros(scale_sharding(k_sh),
+                                    (*scale_shape[:2], 2), jnp.float32)
             cache.append(entry)
             continue
         entry = {"k": zeros(k_sh), "v": zeros(v_sh)}
