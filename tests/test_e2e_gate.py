@@ -89,6 +89,10 @@ def test_detect_runtime_requires_live_daemon(monkeypatch):
     assert not ok and "daemon unreachable" in reason
 
 
-def test_cli_e2e_subcommand_wired():
+def test_cli_e2e_subcommand_wired(monkeypatch):
+    # force the offline branch: on a docker+kind host the live branch
+    # would otherwise create a REAL kind cluster inside the test suite
+    monkeypatch.setattr(e2e, "detect_runtime",
+                        lambda: (False, "hermetic test"))
     from tpuserve.provision import cli
-    assert cli.main(["e2e"]) == 0            # offline env: validates + exits 0
+    assert cli.main(["e2e"]) == 0            # offline: validates + exits 0

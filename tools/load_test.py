@@ -36,10 +36,30 @@ def _mk_engine(model: str):
                                   min_decode_bucket=2)))
 
 
+PROMPT_LEN = 8
+
+
 def _prompts(n: int, vocab: int):
     import numpy as np
     rng = np.random.default_rng(0)
-    return [rng.integers(1, vocab - 1, size=8).tolist() for _ in range(n)]
+    return [rng.integers(1, vocab - 1, size=PROMPT_LEN).tolist()
+            for _ in range(n)]
+
+
+def _warm_ladder(eng, clients: int) -> None:
+    """Compile every prefill/decode bucket a staggered HTTP burst can hit.
+
+    Staggered arrivals admit VARIABLE prefill batch sizes (whichever
+    requests happen to be queued when the engine loop picks work), so a
+    single warm burst leaves novel bucket shapes to compile inside later
+    timed bursts — seconds per shape on CPU, which round 4 misread as
+    85-97% "HTTP overhead" (BENCHMARKS.md 16:30/16:55; VERDICT r4 weak
+    #5: the engine did the same 36 steps per burst while step_sum fell
+    9.0s → 4.1s → 0.9s as shapes finished compiling).  bench.py's
+    arrival warm plan enumerates exactly this ladder."""
+    from bench import _warm_plan_arrivals
+    eng.warmup(sample_modes=("greedy",),
+               **_warm_plan_arrivals(eng, clients, PROMPT_LEN))
 
 
 def engine_only_tok_s(model: str, prompts, gen: int) -> float:
@@ -136,6 +156,8 @@ def main():
     gurls = [f"http://127.0.0.1:{g.start()}" for g in gateways]
 
     prompts = _prompts(args.clients, srv.engine.model_cfg.vocab_size)
+    for s in servers:
+        _warm_ladder(s.engine, args.clients)
     eng_rate = engine_only_tok_s(args.model, prompts, args.gen)
     http_rate = http_tok_s(url, prompts, args.gen)
     gw_rate = http_tok_s(gurls, prompts, args.gen)

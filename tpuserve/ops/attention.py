@@ -61,6 +61,29 @@ def prefill_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def _dequant_gathered(k, v, k_scale, v_scale, block_tables, B, S, Hkv,
+                      dtype, scale_slices):
+    """Dequantize gathered int8 pages (no-op when the cache is raw).
+
+    Plain entries carry one scale per (token, kv head); MLA int8 entries
+    carry per-slice scales over the channel axis (``scale_slices``, the
+    latent/rope split) that expand back to channel granularity here.  One
+    helper for both reference attention ops — the two call sites must
+    never drift (round-5 review)."""
+    if k_scale is None:
+        return k, v
+    if scale_slices is not None:
+        n = len(scale_slices)
+        ksc = expand_slice_scales(
+            k_scale[block_tables].reshape(B, S, n), scale_slices)
+        vsc = expand_slice_scales(
+            v_scale[block_tables].reshape(B, S, n), scale_slices)
+        return ((k.astype(jnp.float32) * ksc).astype(dtype),
+                (v.astype(jnp.float32) * vsc).astype(dtype))
+    return (dequantize_kv(k, k_scale[block_tables].reshape(B, S, Hkv), dtype),
+            dequantize_kv(v, v_scale[block_tables].reshape(B, S, Hkv), dtype))
+
+
 def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                            v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                            seq_lens: jnp.ndarray, scale: float,
@@ -88,20 +111,8 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # Gather pages: (B, max_blocks, block_size, Hkv, D) -> (B, S, Hkv, D)
     k = k_cache[block_tables].reshape(B, S, Hkv, D)
     v = v_cache[block_tables].reshape(B, S, Hkv, D)
-    if k_scale is not None and scale_slices is not None:
-        # per-slice channel scales (int8 MLA); k and v are usually the
-        # same latent pages and XLA CSEs the duplicate dequant
-        ksc = expand_slice_scales(
-            k_scale[block_tables].reshape(B, S, len(scale_slices)),
-            scale_slices)
-        vsc = expand_slice_scales(
-            v_scale[block_tables].reshape(B, S, len(scale_slices)),
-            scale_slices)
-        k = (k.astype(jnp.float32) * ksc).astype(q.dtype)
-        v = (v.astype(jnp.float32) * vsc).astype(q.dtype)
-    elif k_scale is not None:
-        k = dequantize_kv(k, k_scale[block_tables].reshape(B, S, Hkv), q.dtype)
-        v = dequantize_kv(v, v_scale[block_tables].reshape(B, S, Hkv), q.dtype)
+    k, v = _dequant_gathered(k, v, k_scale, v_scale, block_tables, B, S,
+                             Hkv, q.dtype, scale_slices)
     n_rep = Hq // Hkv
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
@@ -151,21 +162,10 @@ def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     # transient than the cache itself at long context.
     k = k_cache[block_tables].reshape(B, S, Hkv, D)
     v = v_cache[block_tables].reshape(B, S, Hkv, D)
-    if k_scale is not None and scale_slices is not None:
-        # per-slice channel scales (int8 MLA latent ⊕ rope pages)
-        ksc = expand_slice_scales(
-            k_scale[block_tables].reshape(B, S, len(scale_slices)),
-            scale_slices)
-        vsc = expand_slice_scales(
-            v_scale[block_tables].reshape(B, S, len(scale_slices)),
-            scale_slices)
-        k = (k.astype(jnp.float32) * ksc).astype(q.dtype)
-        v = (v.astype(jnp.float32) * vsc).astype(q.dtype)
-    elif k_scale is not None:
-        # reference/CPU path: dequantize the gathered window up front (the
-        # Pallas kernel dequantizes per-segment in VMEM instead)
-        k = dequantize_kv(k, k_scale[block_tables].reshape(B, S, Hkv), q.dtype)
-        v = dequantize_kv(v, v_scale[block_tables].reshape(B, S, Hkv), q.dtype)
+    # reference/CPU path: dequantize the gathered window up front (the
+    # Pallas kernel dequantizes per-segment in VMEM instead)
+    k, v = _dequant_gathered(k, v, k_scale, v_scale, block_tables, B, S,
+                             Hkv, q.dtype, scale_slices)
 
     seg = min(seg_size, S)
     n_seg = -(-S // seg)
