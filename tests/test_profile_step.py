@@ -1,0 +1,27 @@
+"""profile_step.py (step-time attribution) must keep producing its JSON
+contract on CPU — the chip capture records its rows unattended, so a rot
+here silently costs a round of attribution evidence."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_smoke_emits_attribution_row():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "profile_step.py"),
+         "--smoke", "--windows", "3"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""})
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "step_attribution"
+    assert row["window_wall_ms"] > 0
+    assert row["tok_s_implied"] > 0
+    assert row["weight_stream_gb_s"] > 0
+    # XLA cost analysis present on the CPU backend too
+    assert row.get("xla_bytes_accessed_per_window", 0) > 0
+    assert "residual_ms" in row

@@ -27,6 +27,7 @@ def runner(tmp_path, monkeypatch):
     # keep the test small: two engine variants, one serving row
     monkeypatch.setattr(mod, "PRIORITY", ["base", "int8"])
     monkeypatch.setattr(mod, "PRIORITY_B", [])
+    monkeypatch.setattr(mod, "PROFILE", [])
     monkeypatch.setattr(mod, "SERVING", [("serving-closed32", ["--clients", "32"])])
     monkeypatch.setattr(mod, "append_markdown", lambda r: None)
     return mod
@@ -124,3 +125,27 @@ def test_already_recorded_variants_skipped(runner, monkeypatch):
     monkeypatch.setattr(runner, "run_variant", fake_run)
     assert runner.main() == 0
     assert "base" not in calls
+
+
+def test_profile_rows_between_priority_and_serving(runner, monkeypatch):
+    """The attribution rows (profile_step.py) run after the engine
+    PRIORITY list and before serving — and their bench_path routes to the
+    profiler, not bench.py."""
+    monkeypatch.setattr(runner, "probe", lambda timeout_s=90: True)
+    monkeypatch.setattr(runner, "PROFILE", [("attrib-base", [])])
+    calls = []
+
+    def fake_run(name, args, timeout, env=None, bench_path=None):
+        calls.append((name, os.path.basename(bench_path or "bench.py")))
+        r = _ok_row(name)
+        if bench_path and "profile" in bench_path:
+            r["metric"] = "step_attribution"
+        elif bench_path:
+            r["metric"] = "serving_latency"
+        return r
+
+    monkeypatch.setattr(runner, "run_variant", fake_run)
+    assert runner.main() == 0
+    assert calls == [("base", "bench.py"), ("int8", "bench.py"),
+                     ("attrib-base", "profile_step.py"),
+                     ("serving-closed32", "bench_serving.py")]

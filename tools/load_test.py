@@ -57,9 +57,8 @@ def _warm_ladder(eng, clients: int) -> None:
     #5: the engine did the same 36 steps per burst while step_sum fell
     9.0s → 4.1s → 0.9s as shapes finished compiling).  bench.py's
     arrival warm plan enumerates exactly this ladder."""
-    from bench import _warm_plan_arrivals
-    eng.warmup(sample_modes=("greedy",),
-               **_warm_plan_arrivals(eng, clients, PROMPT_LEN))
+    from bench import _warm
+    _warm(eng, clients, PROMPT_LEN, arrivals=True)
 
 
 def engine_only_tok_s(model: str, prompts, gen: int) -> float:

@@ -79,6 +79,16 @@ PRIORITY_B = [
     "cold-cache",
 ]
 
+# Step-time attribution rows (tools/profile_step.py): measured window
+# wall vs XLA's own byte/flop model + weight-stream and RTT microbenches
+# — the VERDICT r4 next #3 "where does the time actually go" evidence
+# that explains the int8 +4% anomaly.
+PROFILE = [
+    ("attrib-base", []),
+    ("attrib-int8-kv8", ["--quant", "int8", "--kv-quant", "int8"]),
+    ("attrib-batch256-int8", ["--quant", "int8", "--batch", "256"]),
+]
+
 # Serving-path rows (tools/bench_serving.py): client-observed TTFT/ITL
 # through HTTP+SSE (VERDICT r3 next #4) and the S=32-vs-S=8 ITL decision
 # (ADVICE r3: the throughput default ships ~32-token bursts to streams).
@@ -232,6 +242,12 @@ def main() -> int:
     if rc is not None:
         return rc
 
+    profile_path = os.path.join(ROOT, "tools", "profile_step.py")
+    rc = run_rows([(n, a, {}, profile_path) for n, a in PROFILE],
+                  attempts, done, env_base)
+    if rc is not None:
+        return rc
+
     serving_path = os.path.join(ROOT, "tools", "bench_serving.py")
     rc = run_rows([(n, a, {}, serving_path) for n, a in SERVING],
                   attempts, done, env_base)
@@ -243,7 +259,7 @@ def main() -> int:
         return rc
 
     missing = ([n for n in PRIORITY + PRIORITY_B if n not in done]
-               + [n for n, _ in SERVING if n not in done])
+               + [n for n, _ in PROFILE + SERVING if n not in done])
     if missing:
         print(f"capture finished with permanently-skipped rows: {missing}",
               flush=True)
