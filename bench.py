@@ -231,13 +231,12 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
                   prefix_caching=False, multi_step=None, quantization=None,
                   prefill_split=1, kv_quant=None, interleave=False,
-                  adaptive_window=True):
+                  adaptive_window=True, block_size=32):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
 
     max_len = prompt_len + gen_len
-    block_size = 32
     blocks_per_seq = -(-max_len // block_size) + 1
     cache = CacheConfig(block_size=block_size,
                         num_blocks=batch * blocks_per_seq + 2 * batch,
@@ -542,6 +541,12 @@ def main(argv=None):
                     help="KV-cache quantization: int8 halves KV bytes per "
                          "decode step and doubles cache capacity "
                          "(per-token-per-head scales)")
+    ap.add_argument("--block-size", type=int, default=32,
+                    help="KV cache page size in tokens.  Bigger pages mean "
+                         "fewer, larger page DMAs per decode step — the "
+                         "lever that tests whether the paged kernel is "
+                         "DMA-latency bound (headline sits ~9x off the "
+                         "byte roofline while int8 bought only +4%)")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K draft tokens on a "
                          "repetitive-prompt workload")
@@ -658,7 +663,8 @@ def main(argv=None):
                            prefill_split=args.prefill_split,
                            kv_quant=args.kv_quant,
                            interleave=args.interleave_prefill,
-                           adaptive_window=not args.no_adaptive_window)
+                           adaptive_window=not args.no_adaptive_window,
+                           block_size=args.block_size)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -762,6 +768,7 @@ def main(argv=None):
         "multi_step": eng0._multi_step,
         "quantization": eng0.config.quantization,
         "kv_quant": args.kv_quant,
+        "block_size": args.block_size,
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -823,7 +830,8 @@ def main(argv=None):
                                      disagg=True, multi_step=args.multi_step,
                                      quantization=args.quant,
                                      prefill_split=args.prefill_split,
-                                     kv_quant=args.kv_quant)
+                                     kv_quant=args.kv_quant,
+                                     block_size=args.block_size)
             # same arrival process as the main run, or vs_colocated would
             # compare a poisson workload against a burst workload
             _warm(d_engine, batch, prompt_len, arrivals=poisson)

@@ -85,6 +85,14 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("batch128", ["--batch", "128"], {}),
     ("int8-batch128", ["--quant", "int8", "--batch", "128"], {}),
     ("int8-batch256", ["--quant", "int8", "--batch", "256"], {}),
+    # Page-size lever: fewer, larger page DMAs per decode step.  The
+    # headline sits ~9x off the byte roofline while int8 bought only +4%
+    # — if the paged kernel is DMA-LATENCY bound (64 seqs x ~5 pages x
+    # 28 layers of small transfers), bigger pages should move the number
+    # where byte-halving didn't.
+    ("block64", ["--block-size", "64"], {}),
+    ("block128", ["--block-size", "128"], {}),
+    ("int8-block64", ["--quant", "int8", "--block-size", "64"], {}),
     # int8 KV cache: halves the OTHER half of decode's HBM traffic (KV
     # reads rival weight reads at the headline shape — roofline in
     # BENCHMARKS.md); with int8 weights too, decode moves ~1/2 the bytes

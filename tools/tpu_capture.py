@@ -54,7 +54,11 @@ MAX_ATTEMPTS = 2          # per variant, across runner invocations
 PRIORITY = [
     "base",                                   # the headline number @ HEAD
     "poisson16-adaptive", "poisson32-adaptive", "poisson16-fixed",
-    "kv-int8", "int8", "int8-kv-int8", "batch128", "int8-batch128",
+    # DMA-latency hypothesis (the ~9x-off-roofline / int8-+4% anomaly):
+    # bigger pages + deeper page grouping = fewer, larger transfers
+    "block64", "block128", "pallas-ppg32",
+    "kv-int8", "int8", "int8-kv-int8", "int8-block64",
+    "batch128", "int8-batch128",
     "int8-batch256", "int8-kv-int8-batch256",
     "spec4", "disagg",
 ]
