@@ -65,7 +65,8 @@ def build_report(rows):
     say("")
     say("### p50 TTFT vs the 150 ms target")
     ttfts = {}
-    for name in ("base", "prefill-split2", "prefill-split4",
+    for name in ("base", "poisson16-adaptive", "poisson32-adaptive",
+                 "poisson16-fixed", "prefill-split2", "prefill-split4",
                  "single-request", "poisson16", "poisson32",
                  "poisson16-interleave", "flash-q64", "flash-k256"):
         r = rows.get(name)
@@ -92,7 +93,26 @@ def build_report(rows):
     elif ttfts:
         decisions.append(
             "TTFT: target NOT met in captured rows — p50s: "
-            + ", ".join(f"{n}={p}ms" for n, (p, _) in ttfts.items()) + ".")
+            + ", ".join(f"{n}={p}ms" for n, (p, _) in ttfts.items()
+                        if p is not None) + ".")
+
+    # ---- adaptive windows (the round-4 TTFT fix, first timed here) ----
+    # Only the explicit --no-adaptive-window row may stand in for
+    # "fixed": the plain poisson16 re-measure runs at HEAD defaults,
+    # i.e. adaptive too — comparing against it would judge the feature
+    # against itself (round-5 review).
+    adaptive = rows.get("poisson16-adaptive")
+    fixed = rows.get("poisson16-fixed")
+    if adaptive is not None and fixed is not None:
+        ap50 = adaptive.get("ttft_p50_ms")
+        fp50 = fixed.get("ttft_p50_ms")
+        if ap50 is not None and fp50 is not None:
+            verdict = ("KEEP ON by default" if ap50 < fp50
+                       else "does NOT beat fixed windows — investigate")
+            decisions.append(
+                f"Adaptive windows: p50 TTFT {ap50} ms vs {fp50} ms "
+                f"fixed at poisson16 ({adaptive.get('value')} vs "
+                f"{fixed.get('value')} tok/s) — {verdict}.")
 
     # ---- quantization / roofline progression --------------------------
     say("")
@@ -103,6 +123,71 @@ def build_report(rows):
         r = rows.get(name)
         if r is not None:
             say(f"- {name}: {fmt_row(r)}")
+
+    # ---- page-size / DMA-latency hypothesis ---------------------------
+    say("")
+    say("### Page size (DMA-latency hypothesis)")
+    for name in ("block64", "block128", "int8-block64", "pallas-ppg32"):
+        r = rows.get(name)
+        if r is not None:
+            say(f"- {name}: {fmt_row(r)}")
+    # Pure page-size variants only — pallas-ppg32 keeps 32-token pages
+    # (it deepens page GROUPING) and int8-block64 confounds weight quant
+    # with page size, so neither may drive the "adopt a larger page"
+    # remedy (round-5 review).
+    blk = max((rows[n] for n in ("block64", "block128") if n in rows
+               and isinstance(rows[n].get("value"), (int, float))),
+              key=lambda r: r["value"], default=None)
+    if (blk is not None and base is not None
+            and isinstance(base.get("value"), (int, float))
+            and base["value"] > 0):
+        ratio = blk["value"] / base["value"]
+        decisions.append(
+            f"Page size: best {blk['variant']} = {blk['value']} tok/s "
+            f"({ratio:.2f}x base) — "
+            + ("DMA latency was a real bottleneck; adopt the larger page "
+               "as the serving default." if ratio > 1.1 else
+               "page-DMA latency is NOT the limiter at this shape; the "
+               "attribution rows say where the time goes."))
+    ppg = rows.get("pallas-ppg32")
+    if (ppg is not None and base is not None
+            and isinstance(ppg.get("value"), (int, float))
+            and isinstance(base.get("value"), (int, float))
+            and base["value"] > 0
+            and ppg["value"] / base["value"] > 1.1):
+        decisions.append(
+            f"Page grouping: pallas-ppg32 = {ppg['value']} tok/s "
+            f"({ppg['value'] / base['value']:.2f}x base) — deeper DMA "
+            "grouping wins at unchanged page size; raise "
+            "TPUSERVE_PAGES_PER_GROUP's default.")
+
+    # ---- step-time attribution ----------------------------------------
+    attrib = [r for n, r in rows.items() if n.startswith("attrib-")]
+    if attrib:
+        say("")
+        say("### Step-time attribution (profile_step.py)")
+        for r in sorted(attrib, key=lambda r: r.get("variant", "")):
+            say(f"- {r.get('variant')}: window {r.get('window_wall_ms')} ms"
+                f" = roofline {r.get('roofline_window_ms')} ms + residual "
+                f"{r.get('residual_ms')} ms; achieved "
+                f"{r.get('achieved_gb_s_vs_xla_bytes')} GB/s "
+                f"({r.get('hbm_fraction')} of HBM), weight stream "
+                f"{r.get('weight_stream_gb_s')} GB/s, host RTT "
+                f"{r.get('host_rtt_ms')} ms")
+        a0 = rows.get("attrib-base") or sorted(
+            attrib, key=lambda r: r.get("variant", ""))[0]
+        res, wall_ms = a0.get("residual_ms"), a0.get("window_wall_ms")
+        if isinstance(res, (int, float)) and isinstance(wall_ms, (int, float)) \
+                and wall_ms > 0:
+            frac = res / wall_ms
+            decisions.append(
+                f"Attribution ({a0.get('variant')}): {frac:.0%} of the "
+                "window is residual (not HBM bytes at roofline) — "
+                + ("the bottleneck is compute/dispatch, not bandwidth; "
+                   "byte-halving levers (int8/kv-int8) cannot move it."
+                   if frac > 0.5 else
+                   "the window is mostly bandwidth-bound; byte-halving "
+                   "levers are the right ones."))
     best_q = max((r for n, r in rows.items()
                   if n.startswith(("int8", "kv-int8", "batch"))
                   and isinstance(r.get("value"), (int, float))),
@@ -215,7 +300,7 @@ def build_report(rows):
     return "\n".join(lines), decisions
 
 
-SECTION_HEAD = "## Round-4 TPU capture analysis @ "
+SECTION_HEAD = "## TPU capture analysis @ "
 
 
 def write_section(report: str, md_path: str) -> None:
