@@ -90,7 +90,7 @@ def build_report(rows):
             f"TTFT: met only below the throughput bar ({best}: p50 "
             f"{meeting[best][0]} ms at {meeting[best][1]} tok/s) — "
             "next lever: chunk-size tuning or split-by-default.")
-    elif ttfts:
+    elif any(p is not None for p, _ in ttfts.values()):
         decisions.append(
             "TTFT: target NOT met in captured rows — p50s: "
             + ", ".join(f"{n}={p}ms" for n, (p, _) in ttfts.items()
@@ -188,9 +188,12 @@ def build_report(rows):
                    if frac > 0.5 else
                    "the window is mostly bandwidth-bound; byte-halving "
                    "levers are the right ones."))
-    best_q = max((r for n, r in rows.items()
-                  if n.startswith(("int8", "kv-int8", "batch"))
-                  and isinstance(r.get("value"), (int, float))),
+    # explicit name set: int8-block64 confounds page size with quant and
+    # must not drive this verdict (it feeds the page-size section)
+    quant_rows = ("int8", "int8-batch128", "int8-batch256", "kv-int8",
+                  "int8-kv-int8", "int8-kv-int8-batch256", "batch128")
+    best_q = max((rows[n] for n in quant_rows if n in rows
+                  and isinstance(rows[n].get("value"), (int, float))),
                  key=lambda r: r["value"], default=None)
     if (best_q is not None and base is not None
             and isinstance(base.get("value"), (int, float))):

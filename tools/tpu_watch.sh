@@ -10,7 +10,7 @@
 set -u
 cd "$(dirname "$0")/.."
 DONE_MARKER=/tmp/round5_tpu_done
-BUDGET_S=${TPUSERVE_WATCH_BUDGET_S:-39600}   # 11 h default
+BUDGET_S=${TPUSERVE_WATCH_BUDGET_S:-45000}   # 12.5 h — outlive the round
 START=$(date +%s)
 
 while true; do
