@@ -61,7 +61,9 @@ def build_scenario(scenario):
     if scenario == "windows":
         # multi_step=3 exercises OP_DECODE_MULTI (fused windows with
         # in-window sampling), plus OP_PREFILL and greedy OP_SAMPLE from
-        # the prefill's first token
+        # the prefill's first token.  The top-p request drives the
+        # full-mode window — the protocol's two extra truncation-array
+        # broadcasts must stay in lockstep on both ranks.
         cfg = EngineConfig(
             model="tiny-qwen3",
             cache=CacheConfig(block_size=4, num_blocks=64,
@@ -70,8 +72,10 @@ def build_scenario(scenario):
                                       min_decode_bucket=2),
             attn_impl="reference", multi_step=3)
         prompts = [[5, 6, 7], [11, 12, 13, 14]]
-        params = SamplingParams(max_tokens=7, temperature=0.0,
-                                ignore_eos=True)
+        params = [SamplingParams(max_tokens=7, temperature=0.0,
+                                 ignore_eos=True),
+                  SamplingParams(max_tokens=7, temperature=0.8, top_p=0.9,
+                                 seed=5, ignore_eos=True)]
         return cfg, prompts, params
     if scenario == "chunked":
         # a 20-token prompt against chunk size 8 routes through

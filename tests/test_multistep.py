@@ -69,16 +69,36 @@ def test_mixed_greedy_and_sampled_batch():
     assert _ids(multi) == _ids(base)
 
 
-def test_truncation_request_falls_back_to_single_step():
-    # top-k needs the sorting sampler -> the window path must decline
-    # (return None pre-side-effect) and the single-step path serve it
+def test_truncation_stays_on_fused_window():
+    """top-k/top-p run INSIDE the window (window_sample mode="full") —
+    the common production sampling configs must keep fused-window
+    throughput — and the stream must be token-identical to the
+    single-step sorting sampler with the same seeds."""
     eng = _engine(multi_step=4)
     params = SamplingParams(max_tokens=6, temperature=0.9, top_k=5, seed=1,
                             ignore_eos=True)
     reqs = eng.generate(PROMPTS[:1], params)
     assert len(reqs[0].output_token_ids) == 6
+    # 6 tokens: 1 prefill + 5 decode; windowed = ceil(5/4)*4 = 8 device
+    # steps.  Single-step fallback would count exactly 5 — the overrun
+    # proves the WINDOW served the truncated request.
+    assert eng.stats.num_decode_steps == 8
     base = _engine(multi_step=1).generate(PROMPTS[:1], params)
     assert _ids(reqs) == _ids(base)
+
+
+def test_mixed_truncation_batch_window_matches_single_step():
+    params = [
+        SamplingParams(max_tokens=7, temperature=0.9, top_p=0.8, seed=11,
+                       ignore_eos=True),
+        SamplingParams(max_tokens=7, temperature=0.7, top_k=3, seed=12,
+                       ignore_eos=True),
+        SamplingParams(max_tokens=7, temperature=0.8, min_p=0.05, seed=13,
+                       ignore_eos=True),
+    ]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    multi = _engine(multi_step=4).generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
 
 
 def test_logprobs_request_falls_back():

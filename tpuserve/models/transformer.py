@@ -711,20 +711,29 @@ def window_slot(block_tables: jnp.ndarray, pos: jnp.ndarray,
 
 def window_sample(logits: jnp.ndarray, keys: jnp.ndarray,
                   temperature: jnp.ndarray, s: jnp.ndarray,
-                  mode: str) -> jnp.ndarray:
-    """One fused-window sampling step: greedy argmax or temperature
-    sampling with the per-row key's step word folded by +s (matching the
-    engine's host-side per-step key construction).  One source of truth
-    for both window implementations."""
+                  mode: str, top_k: jnp.ndarray | None = None,
+                  top_p: jnp.ndarray | None = None,
+                  min_p: jnp.ndarray | None = None) -> jnp.ndarray:
+    """One fused-window sampling step: greedy argmax, temperature, or
+    "full" (per-row top-k/top-p/min-p truncation — so the common
+    production sampling configs keep fused-window throughput instead of
+    falling to per-token dispatches).  The per-row key's step word folds
+    by +s, matching the engine's host-side per-step key construction.
+    One source of truth for both window implementations."""
     if mode == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     from tpuserve.ops import sampling as sampling_ops
     B = logits.shape[0]
     step_key = jnp.array([0, 1], jnp.uint32)[None, :]
+    stepped = keys + step_key * s.astype(jnp.uint32)
+    if mode == "temperature":
+        return sampling_ops.sample_tokens(
+            logits, stepped, temperature,
+            jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+            mode="temperature")
     return sampling_ops.sample_tokens(
-        logits, keys + step_key * s.astype(jnp.uint32), temperature,
-        jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
-        mode="temperature")
+        logits, stepped, temperature, top_k, top_p, min_p=min_p,
+        mode="full")
 
 def _decode_body(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  positions: jnp.ndarray, slot_ids: jnp.ndarray,
@@ -819,6 +828,9 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  keys: jnp.ndarray, temperature: jnp.ndarray,
                  kv_cache: list, ad: jnp.ndarray | None = None, *,
                  steps: int, mode: str = "greedy",
+                 top_k: jnp.ndarray | None = None,
+                 top_p: jnp.ndarray | None = None,
+                 min_p: jnp.ndarray | None = None,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -835,9 +847,11 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     never write KV); keys: (B, 2) uint32 per-row sampling keys whose second
     word is the row's step index (folded +s each iteration, matching the
     engine's per-step key construction); temperature: (B,).
-    ``mode``: "greedy" (argmax; keys/temperature ignored) or "temperature".
-    Cache slots for the whole window must be pre-reserved: slot ids are
-    computed on device from ``block_tables`` and the advancing positions.
+    ``mode``: "greedy" (argmax; keys/temperature ignored), "temperature",
+    or "full" (per-row ``top_k``/``top_p``/``min_p`` truncation inside the
+    window — ops/sampling.py sample_tokens semantics).  Cache slots for
+    the whole window must be pre-reserved: slot ids are computed on device
+    from ``block_tables`` and the advancing positions.
     Returns (tokens (B, steps) int32, kv_cache).
     """
     B = tokens.shape[0]
@@ -849,7 +863,8 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
                                      attn_impl, mesh, ad=ad)
-        nxt = window_sample(logits, keys, temperature, s, mode)
+        nxt = window_sample(logits, keys, temperature, s, mode,
+                            top_k=top_k, top_p=top_p, min_p=min_p)
         return (nxt, pos + 1, lens + 1, cache), nxt
 
     carry = (tokens, positions, seq_lens, kv_cache)

@@ -155,7 +155,8 @@ class MultihostCoordinator:
             attn_impl=eng.attn_impl, mesh=eng._attn_mesh)
 
     def _decode_multi(self, tokens, positions, block_tables, seq_lens,
-                      active, keys, temperature, *, steps, mode):
+                      active, keys, temperature, *, steps, mode,
+                      top_k=None, top_p=None, min_p=None):
         from tpuserve.models import transformer
         eng = self.engine
         B = tokens.shape[0]
@@ -170,12 +171,26 @@ class MultihostCoordinator:
         active = _broadcast(np.asarray(active, np.int32))
         keys = _broadcast(np.asarray(keys))
         temperature = _broadcast(np.asarray(temperature, np.float32))
+        tk = tp = None
+        if mode == "full":
+            # two extra arrays, mirrored by the follower's OP_DECODE_MULTI
+            # branch (the header already carries the mode).  min_p is
+            # DROPPED, not broadcast: it is rejected at the multihost API
+            # edge (SamplingParams.multihost_unsupported), so the engine's
+            # all-zero array here must not become a third broadcast — and
+            # every process must call decode_multi with min_p=None or the
+            # SPMD executables diverge and lockstep deadlocks.
+            tk = _broadcast(np.asarray(top_k, np.int32))
+            tp = _broadcast(np.asarray(top_p, np.float32))
         return transformer.decode_multi(
             eng.params, eng.model_cfg, jnp.asarray(tokens),
             jnp.asarray(positions), jnp.asarray(block_tables),
             jnp.asarray(seq_lens), jnp.asarray(np.asarray(active, bool)),
             jnp.asarray(keys), jnp.asarray(temperature), eng.kv_cache,
-            steps=steps, mode=mode, attn_impl=eng.attn_impl,
+            steps=steps, mode=mode,
+            top_k=None if tk is None else jnp.asarray(tk),
+            top_p=None if tp is None else jnp.asarray(tp),
+            attn_impl=eng.attn_impl,
             mesh=eng._attn_mesh, out_mesh=eng.mesh)
 
     def _sample(self, logits, keys, temperature, top_k, top_p, *,
@@ -256,6 +271,11 @@ def follower_loop(engine) -> None:
             active = _broadcast(np.zeros((B,), np.int32))
             keys = _broadcast(np.zeros((B, 2), np.uint32))
             temperature = _broadcast(np.zeros((B,), np.float32))
+            tk = tp = None
+            if mode == "full":
+                # mirrors the coordinator's extra full-mode broadcasts
+                tk = _broadcast(np.zeros((B,), np.int32))
+                tp = _broadcast(np.zeros((B,), np.float32))
             # sampling happens inside the window, so no OP_SAMPLE follows
             # a decode_multi; the replicated token matrix is discarded here
             _, engine.kv_cache = transformer.decode_multi(
@@ -264,7 +284,10 @@ def follower_loop(engine) -> None:
                 jnp.asarray(seq_lens),
                 jnp.asarray(np.asarray(active, bool)), jnp.asarray(keys),
                 jnp.asarray(temperature), engine.kv_cache, steps=steps,
-                mode=mode, attn_impl=engine.attn_impl,
+                mode=mode,
+                top_k=None if tk is None else jnp.asarray(tk),
+                top_p=None if tp is None else jnp.asarray(tp),
+                attn_impl=engine.attn_impl,
                 mesh=engine._attn_mesh, out_mesh=engine.mesh)
         elif op == OP_PREFILL_CHUNK:
             C, M = aux, mode_idx

@@ -294,6 +294,7 @@ def pp_decode_step(head, stages, cfg: ModelConfig, tokens, positions,
 def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
                     block_tables, seq_lens, active, keys, temperature,
                     stage_cache, *, mesh, steps: int, mode: str = "greedy",
+                    top_k=None, top_p=None, min_p=None,
                     num_microbatches: int = 0):
     """``steps`` fused decode+sample iterations through the staged trunk
     in ONE dispatch — transformer.decode_multi's contract over a pp mesh.
@@ -334,7 +335,8 @@ def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
                                _split_micro(slot, M), _split_micro(pos, M),
                                bt_mb, _split_micro(lens, M))
         logits = tf._unembed(head, cfg, out.reshape(B, -1))
-        nxt = tf.window_sample(logits, keys, temperature, s, mode)
+        nxt = tf.window_sample(logits, keys, temperature, s, mode,
+                               top_k=top_k, top_p=top_p, min_p=min_p)
         return (nxt, pos + 1, lens + 1, cache), nxt
 
     carry = (tokens, positions, seq_lens, stage_cache)

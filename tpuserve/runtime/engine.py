@@ -915,18 +915,19 @@ class Engine:
 
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
-                           ad=None):
+                           top_k=None, top_p=None, min_p=None, ad=None):
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
                 self._pp_head, self._pp_stages, self.model_cfg, tokens,
                 positions, block_tables, seq_lens, active, keys,
                 temperature, self.kv_cache, mesh=self.mesh, steps=steps,
-                mode=mode)
+                mode=mode, top_k=top_k, top_p=top_p, min_p=min_p)
         return transformer.decode_multi(
             self.params, self.model_cfg, tokens, positions, block_tables,
             seq_lens, active, keys, temperature, self.kv_cache, ad,
-            steps=steps, mode=mode, attn_impl=self.attn_impl,
+            steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
+            attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
@@ -1047,13 +1048,20 @@ class Engine:
         dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
 
         Returns None — before any side effect — when the batch needs
-        per-step host work (penalties, logprobs, top-k/top-p truncation);
-        falls back to the single-step path internally when cache capacity
-        can't cover the window.
+        per-step host work (penalties, logprobs, logit bias, guided,
+        active min_tokens); top-k/top-p/min-p truncation runs INSIDE the
+        window (window_sample mode="full").  Falls back to the
+        single-step path internally when cache capacity can't cover the
+        window.
         """
         S = self._window_steps()
+        # top-k/top-p/min-p truncation runs INSIDE the window
+        # (window_sample mode="full") — the common production sampling
+        # configs must not fall off the fused path to per-token
+        # dispatches.  Penalties/logprobs/bias/guided still need per-step
+        # host work.
         if any(r.params.needs_penalties or r.params.logprobs is not None
-               or r.params.needs_truncation or r.params.needs_logit_bias
+               or r.params.needs_logit_bias
                or r.params.guided is not None
                or (r.params.needs_min_tokens
                    and r.params.min_tokens_active(len(r.output_token_ids)))
@@ -1113,14 +1121,20 @@ class Engine:
             bt = self.block_manager.block_table(r.request_id)
             block_tables[i, :len(bt)] = bt
         mode = ("greedy" if all(r.params.greedy for r in reqs)
-                else "temperature")
+                else "temperature"
+                if not any(r.params.needs_truncation for r in reqs)
+                else "full")
+        kw = self._lora_kw(reqs, B)
+        if mode == "full":
+            top_k, top_p, min_p = self._truncation_arrays(reqs, B)
+            kw.update(top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p),
+                      min_p=jnp.asarray(min_p))
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
-        kw = self._lora_kw(reqs, B)
         toks, self.kv_cache = self._exec_decode_multi(
             tokens, jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
@@ -1651,19 +1665,10 @@ class Engine:
         mode = ("temperature"
                 if not any(r.params.needs_truncation for r in reqs) else "full")
         temperature = np.zeros((B,), np.float32)
-        top_k = np.zeros((B,), np.int32)
-        top_p = np.ones((B,), np.float32)
-        min_p = np.zeros((B,), np.float32)
+        top_k, top_p, min_p = self._truncation_arrays(reqs, B)
         keys = np.zeros((B, 2), np.uint32)
         for i, r in enumerate(reqs):
             temperature[i] = r.params.temperature
-            # clamp: vocab_size bounds the meaningful range and keeps
-            # direct-caller values inside the int32 array (a 2**40 here
-            # crashed the whole co-batched step — found by fuzzing)
-            top_k[i] = max(min(r.params.top_k,
-                               self.model_cfg.vocab_size), -1)
-            top_p[i] = r.params.top_p
-            min_p[i] = r.params.min_p
             keys[i] = self._row_key(
                 r, extra_step=1 if r.request_id in in_flight else 0)
         kw = {}
@@ -1672,6 +1677,24 @@ class Engine:
         return self._exec_sample(
             logits, jnp.asarray(keys), jnp.asarray(temperature),
             jnp.asarray(top_k), jnp.asarray(top_p), mode=mode, **kw)
+
+    def _truncation_arrays(self, reqs: list[Request], B: int):
+        """Per-row top_k/top_p/min_p for the "full" sampler — ONE home for
+        the clamps, shared by the per-step sampler and the fused-window
+        dispatch so the two paths cannot drift (their token-identical
+        parity is regression-tested)."""
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        min_p = np.zeros((B,), np.float32)
+        for i, r in enumerate(reqs):
+            # clamp: vocab_size bounds the meaningful range and keeps
+            # direct-caller values inside the int32 array (a 2**40 here
+            # crashed the whole co-batched step — found by fuzzing)
+            top_k[i] = max(min(r.params.top_k,
+                               self.model_cfg.vocab_size), -1)
+            top_p[i] = r.params.top_p
+            min_p[i] = r.params.min_p
+        return top_k, top_p, min_p
 
     def _greedy_dummies(self, B: int):
         """Per-bucket constant sampling inputs, created once.  Building these
@@ -2106,13 +2129,23 @@ class Engine:
                     sizes = {self._multi_step}
                     if self._adaptive_window:
                         sizes.add(self._min_multi_step)
-                    for mode in ("greedy", "temperature"):
+                    for mode in ("greedy", "temperature", "full"):
                         if mode != "greedy" and mode not in sample_modes:
                             continue
+                        mkw = dict(wkw)
+                        if mode == "full":
+                            # truncated sampling runs inside the window
+                            # too (window_sample mode="full") — its
+                            # executable must be warm or the first top-p
+                            # request stalls the loop on a compile
+                            mkw.update(
+                                top_k=jnp.zeros((B,), jnp.int32),
+                                top_p=jnp.ones((B,), jnp.float32),
+                                min_p=jnp.zeros((B,), jnp.float32))
                         for steps in sorted(sizes):
                             _, self.kv_cache = self._exec_decode_multi(
                                 tokens, positions, bt, seq_lens, active,
-                                keys, temp, steps=steps, mode=mode, **wkw)
+                                keys, temp, steps=steps, mode=mode, **mkw)
                 if self._pipeline_decode:
                     # the pipelined paths chain steps/windows through
                     # _select_tokens; left cold, its (tiny) compile stalls
