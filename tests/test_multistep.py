@@ -101,13 +101,44 @@ def test_mixed_truncation_batch_window_matches_single_step():
     assert _ids(multi) == _ids(base)
 
 
-def test_logprobs_request_falls_back():
+def test_logprobs_stay_on_fused_window_and_match_single_step():
+    """Sampled-token logprobs compute INSIDE the window (decode_multi
+    logprobs_n) — 1:1 with output tokens, same values/top-N as the
+    per-step recorder, and the window path must actually serve it."""
     eng = _engine(multi_step=4)
-    params = SamplingParams(max_tokens=5, temperature=0.0, logprobs=3,
+    params = SamplingParams(max_tokens=6, temperature=0.0, logprobs=3,
                             ignore_eos=True)
     reqs = eng.generate(PROMPTS[:1], params)
-    assert len(reqs[0].output_token_ids) == 5
-    assert len(reqs[0].logprobs) == 5
+    assert len(reqs[0].output_token_ids) == 6
+    assert len(reqs[0].logprobs) == 6
+    # 6 tokens: 1 prefill + 5 decode; windowed = ceil(5/4)*4 = 8 device
+    # steps, single-step fallback = exactly 5 — the overrun proves the
+    # WINDOW served the logprobs request
+    assert eng.stats.num_decode_steps == 8
+    base = _engine(multi_step=1).generate(PROMPTS[:1], params)
+    for w, b in zip(reqs[0].logprobs, base[0].logprobs):
+        assert w["token_id"] == b["token_id"]
+        assert abs(w["logprob"] - b["logprob"]) < 1e-5
+        assert [t for t, _ in w["top"]] == [t for t, _ in b["top"]]
+        for (_, wl), (_, bl) in zip(w["top"], b["top"]):
+            assert abs(wl - bl) < 1e-5
+
+
+def test_logprobs_with_sampling_and_eos_mid_window():
+    """Seeded temperature + logprobs on the window path, with a stream
+    finishing mid-window: entries stay 1:1 with consumed tokens and
+    match the single-step path."""
+    params = [SamplingParams(max_tokens=9, temperature=0.8, seed=4,
+                             logprobs=2, ignore_eos=True),
+              SamplingParams(max_tokens=3, temperature=0.0, logprobs=1,
+                             ignore_eos=True)]
+    base = _engine(multi_step=1).generate(PROMPTS[:2], params)
+    multi = _engine(multi_step=4).generate(PROMPTS[:2], params)
+    assert _ids(multi) == _ids(base)
+    for m, b in zip(multi, base):
+        assert len(m.logprobs) == len(m.output_token_ids)
+        assert [e["token_id"] for e in m.logprobs] == \
+               [e["token_id"] for e in b.logprobs]
 
 
 def test_window_counts_device_steps():

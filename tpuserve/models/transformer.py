@@ -819,8 +819,8 @@ def decode_step(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "steps", "mode", "attn_impl", "mesh",
-                          "out_mesh"),
+         static_argnames=("cfg", "steps", "mode", "logprobs_n", "attn_impl",
+                          "mesh", "out_mesh"),
          donate_argnames=("kv_cache",))
 def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  positions: jnp.ndarray, block_tables: jnp.ndarray,
@@ -831,6 +831,7 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  top_k: jnp.ndarray | None = None,
                  top_p: jnp.ndarray | None = None,
                  min_p: jnp.ndarray | None = None,
+                 logprobs_n: int = 0,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -865,11 +866,24 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                      attn_impl, mesh, ad=ad)
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
-        return (nxt, pos + 1, lens + 1, cache), nxt
+        ys = nxt
+        if logprobs_n:
+            # sampled-token + top-N logprobs computed in-window, so
+            # logprobs requests keep fused-window throughput (the engine
+            # previously dropped them to per-token dispatches)
+            from tpuserve.ops.sampling import compute_logprobs
+            ys = (nxt, compute_logprobs(logits, nxt, logprobs_n))
+        return (nxt, pos + 1, lens + 1, cache), ys
 
     carry = (tokens, positions, seq_lens, kv_cache)
     (_, _, _, kv_cache), outs = jax.lax.scan(
         one, carry, jnp.arange(steps, dtype=jnp.int32))
+    lp = None
+    if logprobs_n:
+        outs, (chosen_lp, top_ids, top_lps) = outs
+        lp = (jnp.swapaxes(chosen_lp, 0, 1),       # (B, steps)
+              jnp.swapaxes(top_ids, 0, 1),         # (B, steps, N)
+              jnp.swapaxes(top_lps, 0, 1))
     out = jnp.swapaxes(outs, 0, 1)                             # (B, steps)
     if out_mesh is not None:
         # Multi-host lockstep device_gets the window on the coordinator;
@@ -879,6 +893,8 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         from jax.sharding import NamedSharding, PartitionSpec
         out = jax.lax.with_sharding_constraint(
             out, NamedSharding(out_mesh, PartitionSpec()))
+    if logprobs_n:
+        return out, kv_cache, lp
     return out, kv_cache
 
 

@@ -180,6 +180,9 @@ class PendingWindow:
     reqs: list
     toks: jax.Array                  # (B, S) int32, device-resident
     steps: int
+    # in-window logprobs: (chosen_lp (B,S), top_ids (B,S,N), top_lps
+    # (B,S,N)) device arrays when the window computed them, else None
+    lp: tuple | None = None
 
 
 @jax.jit
@@ -915,8 +918,11 @@ class Engine:
 
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
-                           top_k=None, top_p=None, min_p=None, ad=None):
+                           top_k=None, top_p=None, min_p=None,
+                           logprobs_n=0, ad=None):
         if self._pp > 1:
+            # logprobs_n never reaches here: the window-eligibility guard
+            # keeps logprobs requests on the per-step path under pp
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
                 self._pp_head, self._pp_stages, self.model_cfg, tokens,
@@ -927,7 +933,7 @@ class Engine:
             self.params, self.model_cfg, tokens, positions, block_tables,
             seq_lens, active, keys, temperature, self.kv_cache, ad,
             steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
-            attn_impl=self.attn_impl,
+            logprobs_n=logprobs_n, attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
@@ -1055,12 +1061,14 @@ class Engine:
         window.
         """
         S = self._window_steps()
-        # top-k/top-p/min-p truncation runs INSIDE the window
-        # (window_sample mode="full") — the common production sampling
-        # configs must not fall off the fused path to per-token
-        # dispatches.  Penalties/logprobs/bias/guided still need per-step
-        # host work.
-        if any(r.params.needs_penalties or r.params.logprobs is not None
+        # top-k/top-p/min-p truncation AND sampled-token logprobs run
+        # INSIDE the window (window_sample mode="full" / decode_multi
+        # logprobs_n) — the common production sampling configs must not
+        # fall off the fused path to per-token dispatches.
+        # Penalties/bias/guided still need per-step host work; the pp
+        # trunk doesn't thread logprobs through its shard_map stages.
+        if any(r.params.needs_penalties
+               or (r.params.logprobs is not None and self._pp > 1)
                or r.params.needs_logit_bias
                or r.params.guided is not None
                or (r.params.needs_min_tokens
@@ -1129,17 +1137,31 @@ class Engine:
             top_k, top_p, min_p = self._truncation_arrays(reqs, B)
             kw.update(top_k=jnp.asarray(top_k), top_p=jnp.asarray(top_p),
                       min_p=jnp.asarray(min_p))
+        lp_n = 0
+        if any(r.params.logprobs is not None for r in reqs):
+            # FIXED at MAX_LOGPROBS, not the batch's max: logprobs_n is a
+            # static jit arg, so a per-batch value would compile a fresh
+            # window trunk per distinct N mid-serving (the 47 s stall
+            # class warmup exists to prevent); one variant per
+            # (mode, steps) instead, pre-warmed, sliced per request at
+            # flush
+            lp_n = self.MAX_LOGPROBS
+            kw["logprobs_n"] = lp_n
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
-        toks, self.kv_cache = self._exec_decode_multi(
+        res = self._exec_decode_multi(
             tokens, jnp.asarray(positions),
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(active), jnp.asarray(keys),
             jnp.asarray(temperature), steps=S, mode=mode, **kw)
+        if lp_n:
+            toks, self.kv_cache, window_lp = res
+        else:
+            (toks, self.kv_cache), window_lp = res, None
         self.stats.num_decode_steps += S
         if S < self._multi_step:
             # counted at the dispatch, not in _window_steps(): eligibility
@@ -1157,12 +1179,12 @@ class Engine:
             # flush.
             outputs += self._flush_window()
             self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
-                                                 steps=S)
+                                                 steps=S, lp=window_lp)
             return outputs
         # synchronous: flush the just-dispatched window immediately (one
         # code path for the KV-commit-before-emit and overrun invariants)
         self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
-                                             steps=S)
+                                             steps=S, lp=window_lp)
         return outputs + self._flush_window()
 
     def _flush_window(self) -> list[RequestOutput]:
@@ -1175,6 +1197,9 @@ class Engine:
         if p is None:
             return []
         toks_h = np.asarray(jax.device_get(p.toks))
+        lp_h = None
+        if p.lp is not None:
+            lp_h = tuple(np.asarray(x) for x in jax.device_get(p.lp))
         outputs: list[RequestOutput] = []
         # Commit written KV BEFORE emitting (finish frees blocks mid-loop);
         # zombie rows' blocks were already freed at the previous flush.
@@ -1186,6 +1211,15 @@ class Engine:
                 self.stats.window_overrun_tokens += p.steps
                 continue
             for s in range(p.steps):
+                if lp_h is not None and r.params.logprobs is not None:
+                    # recorded BEFORE emit (same order as the per-step
+                    # path: _record_logprobs then _append_and_emit), and
+                    # only for CONSUMED tokens — overrun rows break out
+                    # below before recording theirs
+                    chosen_lp, top_ids, top_lps = lp_h
+                    self._append_logprob_entry(
+                        r, int(toks_h[i, s]), chosen_lp[i, s],
+                        top_ids[i, s], top_lps[i, s])
                 out = self._emit_one(r, int(toks_h[i, s]))
                 outputs.append(out)
                 if out.finished:
@@ -1736,13 +1770,23 @@ class Engine:
         for i, r in enumerate(reqs):
             if r.params.logprobs is None:
                 continue
-            k = min(r.params.logprobs, top_n)
-            r.logprobs.append({
-                "token_id": int(toks[i]),
-                "logprob": float(chosen_lp[i]),
-                "top": [(int(t), float(l)) for t, l in
-                        zip(top_ids[i, :k], top_lps[i, :k])],
-            })
+            self._append_logprob_entry(r, int(toks[i]), chosen_lp[i],
+                                       top_ids[i], top_lps[i])
+
+    @staticmethod
+    def _append_logprob_entry(r: Request, tok: int, chosen_lp,
+                              top_ids, top_lps) -> None:
+        """ONE home for the per-token logprob record shape — shared by
+        the per-step recorder and the fused-window flush so the two
+        paths' response formats cannot drift.  ``top_ids``/``top_lps``
+        are 1-D, possibly wider than the request asked for."""
+        k = min(r.params.logprobs, len(top_ids))
+        r.logprobs.append({
+            "token_id": tok,
+            "logprob": float(chosen_lp),
+            "top": [(int(t), float(l)) for t, l in
+                    zip(top_ids[:k], top_lps[:k])],
+        })
 
     # ---- bookkeeping --------------------------------------------------
 
@@ -2065,7 +2109,8 @@ class Engine:
     def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] | None
                = None,
                decode_buckets: Sequence[int] = (),
-               sample_modes: Sequence[str] = ("greedy", "temperature", "full"),
+               sample_modes: Sequence[str] = ("greedy", "temperature",
+                                              "full", "logprobs"),
                chunk_buckets: Sequence[int] = (),
                embed_buckets: Sequence[tuple[int, int]] = (),
                ) -> None:
@@ -2142,10 +2187,26 @@ class Engine:
                                 top_k=jnp.zeros((B,), jnp.int32),
                                 top_p=jnp.ones((B,), jnp.float32),
                                 min_p=jnp.zeros((B,), jnp.float32))
+                        # in-window logprobs is one extra variant per
+                        # (mode, steps) — logprobs_n is FIXED at
+                        # MAX_LOGPROBS by the dispatch for exactly this
+                        # reason; cold, the first logprobs request
+                        # stalls on a full window-trunk compile
+                        lp_variants = ((0, self.MAX_LOGPROBS)
+                                       if self._pp == 1
+                                       and "logprobs" in sample_modes
+                                       else (0,))
                         for steps in sorted(sizes):
-                            _, self.kv_cache = self._exec_decode_multi(
-                                tokens, positions, bt, seq_lens, active,
-                                keys, temp, steps=steps, mode=mode, **mkw)
+                            for lp_n in lp_variants:
+                                lkw = (dict(mkw, logprobs_n=lp_n)
+                                       if lp_n else mkw)
+                                res = self._exec_decode_multi(
+                                    tokens, positions, bt, seq_lens,
+                                    active, keys, temp, steps=steps,
+                                    mode=mode, **lkw)
+                                self.kv_cache = res[1]
+                                if lp_n:
+                                    self._warm_tails.append(res[2])
                 if self._pipeline_decode:
                     # the pipelined paths chain steps/windows through
                     # _select_tokens; left cold, its (tiny) compile stalls
