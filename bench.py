@@ -347,14 +347,17 @@ def _warm_plan(eng, batch, prompt_len):
                 decode_buckets=decode)
 
 
-def _warm(engine, batch, prompt_len, arrivals=False):
+def _warm(engine, batch, prompt_len, arrivals=False,
+          modes=("greedy",)):
     """Pre-compile the exact bucket set the measured run will hit
-    (SURVEY.md §7: TTFT budget requires AOT warmup)."""
+    (SURVEY.md §7: TTFT budget requires AOT warmup).  ``modes``: the
+    sampler executables to warm — a sampled bench (--temperature /
+    --top-p) dispatches temperature/full windows, not greedy ones."""
     plan = _warm_plan_arrivals if arrivals else _warm_plan
     eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
-    eng.warmup(sample_modes=("greedy",), **plan(eng, batch, prompt_len))
+    eng.warmup(sample_modes=modes, **plan(eng, batch, prompt_len))
     if eng is not engine:
-        engine.decode.warmup(sample_modes=("greedy",),
+        engine.decode.warmup(sample_modes=modes,
                              **plan(engine.decode, batch, prompt_len))
 
 
@@ -541,6 +544,15 @@ def main(argv=None):
                     help="KV-cache quantization: int8 halves KV bytes per "
                          "decode step and doubles cache capacity "
                          "(per-token-per-head scales)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature (0 = greedy, the headline "
+                         "default).  Non-zero measures the in-window "
+                         "sampler's cost at the serving shape")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="nucleus sampling; <1 routes windows through the "
+                         "full sort-based sampler (window_sample "
+                         "mode='full') — measures what production "
+                         "sampling configs actually cost on chip")
     ap.add_argument("--block-size", type=int, default=32,
                     help="KV cache page size in tokens.  Bigger pages mean "
                          "fewer, larger page DMAs per decode step — the "
@@ -582,6 +594,11 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
+    if args.spec and args.temperature > 0.0:
+        # speculation only engages on all-greedy batches (engine gate);
+        # a sampled spec run would emit a spec block with 0 acceptance
+        # that LOOKS like a measured failure when speculation never ran
+        ap.error("--spec requires greedy sampling (temperature 0)")
 
     _install_signal_flush()
 
@@ -679,8 +696,9 @@ def main(argv=None):
     else:
         prompts = [rng.integers(1, vocab - 1, size=prompt_len).tolist()
                    for _ in range(batch)]
-    params = SamplingParams(max_tokens=gen_len, temperature=0.0,
-                            ignore_eos=True)
+    params = SamplingParams(max_tokens=gen_len,
+                            temperature=args.temperature,
+                            top_p=args.top_p, seed=0, ignore_eos=True)
 
     import contextlib
 
@@ -709,9 +727,16 @@ def main(argv=None):
             1.0 / args.arrival_rate, size=batch)
         arrival_offsets = np.cumsum(inter).tolist()
 
+    # derive from the REQUEST the run will actually send — the engine's
+    # own greedy/truncation predicates — so the warmed sampler executable
+    # can't drift from the dispatched one (e.g. temperature<=0 is greedy)
+    warm_modes = (("greedy",) if params.greedy
+                  else ("full",) if params.needs_truncation
+                  else ("temperature",))
     with tpu_guard("tpu run"):
         t_warm = time.perf_counter()
-        _warm(engine, batch, prompt_len, arrivals=poisson)
+        _warm(engine, batch, prompt_len, arrivals=poisson,
+              modes=warm_modes)
         warmup_s = time.perf_counter() - t_warm
         # Host<->device round-trip floor: every decode window and every
         # TTFT pays at least one of these.  On the tunnelled axon backend
@@ -769,6 +794,8 @@ def main(argv=None):
         "quantization": eng0.config.quantization,
         "kv_quant": args.kv_quant,
         "block_size": args.block_size,
+        "temperature": args.temperature,
+        "top_p": args.top_p,
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,
@@ -834,7 +861,8 @@ def main(argv=None):
                                      block_size=args.block_size)
             # same arrival process as the main run, or vs_colocated would
             # compare a poisson workload against a burst workload
-            _warm(d_engine, batch, prompt_len, arrivals=poisson)
+            _warm(d_engine, batch, prompt_len, arrivals=poisson,
+                  modes=warm_modes)
             dr = _run_workload(d_engine, prompts, params,
                                arrival_offsets=arrival_offsets)
         d_decode = dr["gen_tokens"] - batch

@@ -100,6 +100,12 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("int8-kv-int8", ["--quant", "int8", "--kv-quant", "int8"], {}),
     ("int8-kv-int8-batch256", ["--quant", "int8", "--kv-quant", "int8",
                                "--batch", "256"], {}),
+    # In-window sampler cost at the serving shape: "temperature" adds
+    # per-row Gumbel argmax; "full" adds the 151k-vocab sort every scan
+    # iteration (top-p is most clients' default — if the sort costs real
+    # throughput on chip, serving guidance must say so)
+    ("sampled-temp", ["--temperature", "0.8"], {}),
+    ("sampled-top-p", ["--temperature", "0.8", "--top-p", "0.95"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
     # Long-context path: prompts routed through chunked prefill (the

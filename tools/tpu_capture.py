@@ -60,6 +60,9 @@ PRIORITY = [
     "kv-int8", "int8", "int8-kv-int8", "int8-block64",
     "batch128", "int8-batch128",
     "int8-batch256", "int8-kv-int8-batch256",
+    # what production sampling configs cost on chip (in-window
+    # temperature / full top-p sampler vs the greedy headline)
+    "sampled-temp", "sampled-top-p",
     "spec4", "disagg",
 ]
 
