@@ -693,6 +693,36 @@ def decode_verify(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), new_cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "attn_impl", "mesh"),
+         donate_argnames=("kv_cache",))
+def decode_verify_sampled(params: Params, cfg: ModelConfig,
+                          tokens: jnp.ndarray, ctx_lens: jnp.ndarray,
+                          chunk_lens: jnp.ndarray, slot_ids: jnp.ndarray,
+                          block_tables: jnp.ndarray, kv_cache: list,
+                          keys: jnp.ndarray, temperature: jnp.ndarray,
+                          top_k: jnp.ndarray, top_p: jnp.ndarray,
+                          min_p: jnp.ndarray | None = None, *,
+                          attn_impl: str = "reference", mesh=None):
+    """Verify a speculative draft window under SAMPLING: same trunk as
+    :func:`decode_verify`, but instead of greedy argmax the full (B,K,V)
+    logits stay on device and run rejection-sampling acceptance
+    (ops/sampling.py spec_accept_sampled) — so speculation composes with
+    temperature/top-k/top-p instead of being greedy-only.  The draft
+    tokens being judged are the verify INPUT rows shifted by one
+    (``tokens[:, 1:]``).  temperature <= 0 rows degenerate to exact
+    greedy acceptance.  Returns (accept (B, K-1) bool, pred (B, K) int32,
+    kv_cache)."""
+    from tpuserve.ops.sampling import spec_accept_sampled
+    h, new_cache = _chunk_trunk(params, cfg, tokens, ctx_lens, chunk_lens,
+                                slot_ids, block_tables, kv_cache,
+                                attn_impl=attn_impl, mesh=mesh)
+    logits = _unembed(params, cfg, h)                       # (B, K, V)
+    accept, pred = spec_accept_sampled(logits, tokens[:, 1:], chunk_lens,
+                                       keys, temperature, top_k, top_p,
+                                       min_p)
+    return accept, pred, new_cache
+
+
 # --------------------------------------------------------------------------
 # Decode: one token per sequence against the paged cache
 # --------------------------------------------------------------------------

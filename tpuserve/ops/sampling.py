@@ -59,28 +59,58 @@ def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray, temperature: jnp.ndarr
         sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
         return jnp.where(temperature <= 0.0, greedy_tok, sampled)
 
-    # Full path: sort descending once, apply both truncations in sorted order.
+    # Full path: sort descending once, apply both truncations in sorted
+    # order; argmax there and map back through ONE gather (unsorting the
+    # whole vocab would cost a second argsort per step on the hot path).
+    masked_sorted, sort_idx = truncated_sorted_logits(scaled, top_k, top_p,
+                                                      min_p)
+    choice = jnp.argmax(masked_sorted + gumbel, axis=-1)     # sorted index
+    sampled = jnp.take_along_axis(sort_idx, choice[..., None],
+                                  axis=-1)[..., 0].astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+
+
+def truncated_sorted_logits(scaled: jnp.ndarray, top_k: jnp.ndarray,
+                            top_p: jnp.ndarray,
+                            min_p: jnp.ndarray | None = None):
+    """Apply top-k/top-p(/min-p) truncation to temperature-scaled logits.
+    Returns (masked logits in DESCENDING-sorted order with dropped tokens
+    at NEG_INF, sort_idx mapping sorted position -> vocab id).  One home
+    for the truncation semantics — the sampler and the speculative
+    rejection-acceptance op must agree on the kept set."""
+    V = scaled.shape[-1]
     sort_idx = jnp.argsort(-scaled, axis=-1)
     sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-    rank = jnp.arange(V)[None, :]
-    k = jnp.where(top_k <= 0, V, top_k)[:, None]
+    rank = jnp.arange(V)
+    k = jnp.where(top_k <= 0, V, top_k)[..., None]
     keep_k = rank < k
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumsum = jnp.cumsum(probs, axis=-1)
     # Keep tokens whose cumulative prob *before* them is < top_p (always keeps
     # the most-likely token).
-    keep_p = (cumsum - probs) < top_p[:, None]
+    keep_p = (cumsum - probs) < top_p[..., None]
     keep = keep_k & keep_p
     if min_p is not None:
-        # sorted descending, so probs[:, :1] is each row's max prob; the
+        # sorted descending, so probs[..., :1] is each row's max prob; the
         # clamp makes the most-likely token survive for ANY input (>1 or
         # NaN would mask every token and sample pure Gumbel noise)
         mp = jnp.clip(jnp.nan_to_num(min_p, nan=0.0), 0.0, 1.0)
-        keep &= probs >= mp[:, None] * probs[:, :1]
-    masked = jnp.where(keep, sorted_logits, NEG_INF)
-    choice = jnp.argmax(masked + gumbel, axis=-1)            # index into sorted
-    sampled = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
-    return jnp.where(temperature <= 0.0, greedy_tok, sampled)
+        keep &= probs >= mp[..., None] * probs[..., :1]
+    masked_sorted = jnp.where(keep, sorted_logits, NEG_INF)
+    return masked_sorted, sort_idx
+
+
+def truncated_scaled_logits(scaled: jnp.ndarray, top_k: jnp.ndarray,
+                            top_p: jnp.ndarray,
+                            min_p: jnp.ndarray | None = None) -> jnp.ndarray:
+    """:func:`truncated_sorted_logits` unsorted back to ORIGINAL vocab
+    order — for consumers that index by token id (the speculative
+    acceptance op); the sampler itself stays in sorted order to avoid
+    the extra argsort."""
+    masked_sorted, sort_idx = truncated_sorted_logits(scaled, top_k, top_p,
+                                                      min_p)
+    inv = jnp.argsort(sort_idx, axis=-1)
+    return jnp.take_along_axis(masked_sorted, inv, axis=-1)
 
 
 @jax.jit
@@ -132,3 +162,85 @@ def compute_logprobs(logits: jnp.ndarray, chosen: jnp.ndarray, top_n: int):
     chosen_lp = jnp.take_along_axis(lp, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
     top_lps, top_ids = jax.lax.top_k(lp, top_n)
     return chosen_lp, top_ids.astype(jnp.int32), top_lps
+
+
+def spec_accept_sampled(logits: jnp.ndarray, draft_next: jnp.ndarray,
+                        chunk_lens: jnp.ndarray, keys: jnp.ndarray,
+                        temperature: jnp.ndarray, top_k: jnp.ndarray,
+                        top_p: jnp.ndarray,
+                        min_p: jnp.ndarray | None = None):
+    """Rejection-sampling acceptance for speculative decoding under
+    temperature/top-k/top-p sampling (the vLLM/spec-sampling scheme,
+    specialised to DETERMINISTIC drafts — n-gram lookup and greedy draft
+    models propose with an implicit point-mass q, so draft token d is
+    accepted w.p. p̃(d) and a rejection resamples from p̃ with d's mass
+    removed; the emitted marginal is exactly p̃, the same truncated
+    distribution the per-step sampler draws from).
+
+    logits: (B, K, V) verify-pass logits (row j = after consuming row j);
+    draft_next: (B, K-1) int32, draft_next[:, j] = the draft token whose
+    acceptance row j's distribution decides (= verify input token j+1) —
+    positions at or past ``chunk_lens - 1`` are PADDING, not drafts, so
+    their token (id 0 from the engine's zero-fill) must NOT lose mass in
+    the bonus resample; keys: (B, 2) uint32 per-row PRNG keys (position
+    folded in here); temperature/top_k/top_p(/min_p): (B,), the same
+    truncation set the per-step sampler uses.  temperature <= 0
+    degenerates to exact
+    greedy acceptance: p̃ is a point mass at argmax, so accept[j] =
+    (draft == argmax) and every resample IS the argmax — byte-identical
+    to the greedy accept path.
+
+    Returns (accept (B, K-1) bool, pred (B, K) int32) where pred[:, j] is
+    the replacement token when draft j is rejected (j < K-1) and the
+    bonus token after a fully-accepted window (j = K-1 — and, for rows
+    whose draft list is shorter, at its own chunk end, which the host
+    indexes by its known draft length).
+    """
+    B, K, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # (B, K)
+    temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+    masked = truncated_scaled_logits(
+        logits.astype(jnp.float32) / temp,
+        jnp.broadcast_to(top_k[:, None], (B, K)),
+        jnp.broadcast_to(top_p[:, None], (B, K)),
+        None if min_p is None
+        else jnp.broadcast_to(min_p[:, None], (B, K)))           # (B, K, V)
+    p = jax.nn.softmax(masked, axis=-1)
+
+    # fold the row position into each key (window_sample's convention),
+    # then DISTINCT subkeys per (row, position) for the acceptance
+    # uniform and the resample gumbel — sharing one key would correlate
+    # the accept decision with the replacement draw
+    def row_keys(key):
+        return jax.vmap(lambda s: jax.random.fold_in(key, s))(jnp.arange(K))
+    keys2 = jax.vmap(row_keys)(keys)                             # (B, K, 2)
+    u_keys = jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, 0)))(keys2)
+    g_keys = jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, 1)))(keys2)
+    u = jax.vmap(jax.vmap(lambda k: jax.random.uniform(k, ())))(u_keys)
+    gumbel = -jnp.log(-jnp.log(jax.vmap(jax.vmap(
+        lambda k: jax.random.uniform(k, (V,), jnp.float32,
+                                     minval=1e-7, maxval=1.0)))(g_keys)))
+
+    # acceptance: u < p̃(d) at positions 0..K-2
+    d = draft_next.astype(jnp.int32)
+    p_draft = jnp.take_along_axis(p[:, :-1, :], d[..., None],
+                                  axis=-1)[..., 0]               # (B, K-1)
+    accept = u[:, :-1] < p_draft
+
+    # resample: p̃ with the draft token's mass removed — but ONLY at real
+    # draft positions (j < chunk_len-1).  Padding rows' zero-filled
+    # "draft" would otherwise zero token id 0's mass in the bonus
+    # distribution at every chunk end (round-5 review).  Gumbel-max over
+    # masked logits == categorical over the renormalised distribution.
+    is_draft = (jnp.arange(K - 1)[None, :]
+                < (chunk_lens - 1)[:, None])                     # (B, K-1)
+    drop = jnp.zeros((B, K, V), bool).at[
+        jnp.arange(B)[:, None], jnp.arange(K - 1)[None, :], d].set(
+        is_draft)
+    resample_logits = jnp.where(drop, NEG_INF, masked)
+    sampled = jnp.argmax(resample_logits + gumbel, axis=-1).astype(jnp.int32)
+    # degenerate rows: temperature <= 0 → greedy acceptance + greedy pred
+    greedy_row = (temperature <= 0.0)[:, None]
+    accept = jnp.where(greedy_row, d == greedy[:, :-1], accept)
+    pred = jnp.where(greedy_row, greedy, sampled)
+    return accept, pred

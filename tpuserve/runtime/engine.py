@@ -748,7 +748,7 @@ class Engine:
             outputs = self._run_prefill_chunk(batch)
         elif (self._spec is not None
               and self.stats.num_decode_steps >= self._spec_resume_step
-              and all(r.params.greedy and not r.params.needs_penalties
+              and all(not r.params.needs_penalties
                       and not r.params.needs_logit_bias
                       and not (r.params.needs_min_tokens
                                and r.params.min_tokens_active(
@@ -756,6 +756,10 @@ class Engine:
                       and r.params.logprobs is None
                       and r.params.guided is None
                       for r in batch.requests)):
+            # sampled batches speculate too: the verify pass runs
+            # rejection-sampling acceptance on device
+            # (decode_verify_sampled), so temperature/top-k/top-p keep
+            # the spec speedup instead of forcing per-token decode
             outputs = self._run_decode_spec(batch)
         else:
             outputs = None
@@ -907,6 +911,16 @@ class Engine:
         return transformer.decode_verify(
             self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
             slot_ids, block_tables, self.kv_cache)
+
+    def _exec_decode_verify_sampled(self, tokens, ctx_lens, chunk_lens,
+                                    slot_ids, block_tables, keys,
+                                    temperature, top_k, top_p, min_p):
+        # sampled-batch twin of _exec_decode_verify: rejection-sampling
+        # acceptance runs on device against the full verify logits
+        return transformer.decode_verify_sampled(
+            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+            slot_ids, block_tables, self.kv_cache, keys, temperature,
+            top_k, top_p, min_p)
 
     def _exec_draft_propose(self, tokens, lens, *, k):
         # Draft-model speculation is single-process only (gated with the
@@ -1379,16 +1393,39 @@ class Engine:
             slot_ids[i] = self._token_slots(r.request_id, base[i], K,
                                             block_table=bt)
             block_tables[i, :len(bt)] = bt
-        pred, self.kv_cache = self._exec_decode_verify(
-            jnp.asarray(tokens), jnp.asarray(ctx_lens),
-            jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
-            jnp.asarray(block_tables))
-        pred_h = np.asarray(jax.device_get(pred))
+        sampled = not all(r.params.greedy for r in reqs)
+        accept_h = None
+        if sampled:
+            keys = np.zeros((B, 2), np.uint32)
+            temperature = np.zeros((B,), np.float32)
+            for i, r in enumerate(reqs):
+                keys[i] = self._row_key(r)
+                temperature[i] = r.params.temperature
+            top_k, top_p, min_p = self._truncation_arrays(reqs, B)
+            accept, pred, self.kv_cache = self._exec_decode_verify_sampled(
+                jnp.asarray(tokens), jnp.asarray(ctx_lens),
+                jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
+                jnp.asarray(block_tables), jnp.asarray(keys),
+                jnp.asarray(temperature), jnp.asarray(top_k),
+                jnp.asarray(top_p), jnp.asarray(min_p))
+            # ONE round trip for both arrays — a tunneled backend pays
+            # tens of ms per host sync
+            accept_h, pred_h = (np.asarray(x) for x in
+                                jax.device_get((accept, pred)))
+        else:
+            pred, self.kv_cache = self._exec_decode_verify(
+                jnp.asarray(tokens), jnp.asarray(ctx_lens),
+                jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
+                jnp.asarray(block_tables))
+            pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
         step_proposed = step_accepted = 0
         for i, r in enumerate(reqs):
-            emitted = spec_mod.accept_greedy(drafts[i], pred_h[i])
+            emitted = (spec_mod.accept_greedy(drafts[i], pred_h[i])
+                       if accept_h is None else
+                       spec_mod.accept_sampled(drafts[i], accept_h[i],
+                                               pred_h[i]))
             step_proposed += len(drafts[i])
             step_accepted += len(emitted) - 1
             self.block_manager.advance(r.request_id, len(emitted))
@@ -2227,6 +2264,21 @@ class Engine:
                     _, self.kv_cache = self._exec_decode_verify(
                         vtok, jnp.zeros((B,), jnp.int32),
                         jnp.ones((B,), jnp.int32), vslots, bt)
+                    if any(m in sample_modes
+                           for m in ("temperature", "full")):
+                        # sampled batches verify through the
+                        # rejection-sampling twin — its executable must
+                        # be warm too
+                        acc, _, self.kv_cache = \
+                            self._exec_decode_verify_sampled(
+                                vtok, jnp.zeros((B,), jnp.int32),
+                                jnp.ones((B,), jnp.int32), vslots, bt,
+                                jnp.zeros((B, 2), jnp.uint32),
+                                jnp.zeros((B,), jnp.float32),
+                                jnp.zeros((B,), jnp.int32),
+                                jnp.ones((B,), jnp.float32),
+                                jnp.zeros((B,), jnp.float32))
+                        self._warm_tails.append(acc)
             chunk = self.scheduler.cfg.prefill_chunk_size
             chunk_set = set(chunk_buckets)
             if not self.scheduler.cfg.allow_chunked_prefill:

@@ -138,3 +138,17 @@ def accept_greedy(draft: list[int], pred) -> list[int]:
     while a < len(draft) and int(pred[a]) == draft[a]:
         a += 1
     return draft[:a] + [int(pred[a])]
+
+
+def accept_sampled(draft: list[int], accept_row, pred) -> list[int]:
+    """Host side of rejection-sampling acceptance
+    (ops/sampling.spec_accept_sampled): ``accept_row[j]`` says whether
+    draft token j was accepted against row j's sampled distribution;
+    ``pred[j]`` is the device-sampled replacement (on rejection) or
+    bonus (after a fully-accepted draft).  Same emitted-shape contract
+    as :func:`accept_greedy`: accepted prefix + exactly one sampled
+    token."""
+    a = 0
+    while a < len(draft) and bool(accept_row[a]):
+        a += 1
+    return draft[:a] + [int(pred[a])]
