@@ -124,6 +124,59 @@ def test_logprobs_stay_on_fused_window_and_match_single_step():
             assert abs(wl - bl) < 1e-5
 
 
+def test_penalties_stay_on_fused_window_and_match_single_step():
+    """Presence/frequency/repetition penalties run INSIDE the window via
+    the on-device count carry — token-identical to the per-step
+    penalizer (counts re-derived from host history each step)."""
+    params = [
+        SamplingParams(max_tokens=9, temperature=0.0, presence_penalty=0.8,
+                       frequency_penalty=0.5, ignore_eos=True),
+        SamplingParams(max_tokens=9, temperature=0.8, seed=6,
+                       repetition_penalty=1.3, top_p=0.9, ignore_eos=True),
+        SamplingParams(max_tokens=9, temperature=0.7, seed=7,
+                       frequency_penalty=1.1, ignore_eos=True),
+    ]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    eng = _engine(multi_step=4)
+    multi = eng.generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+    # 9 tokens: 1 prefill + 8 decode = two full 4-step windows per seq;
+    # the single-step fallback would count exactly 8 once... overrun-free
+    # here, so prove the window path via dispatch count: 8 device steps
+    # from 2 windows (a fallback would ALSO be 8) — instead assert via
+    # latency stats absence and window counters
+    assert eng.stats.num_decode_steps == 8
+
+
+def test_penalties_window_proof_by_overrun():
+    """max_tokens chosen so the window overruns — the overrun only
+    happens when the WINDOW served the penalized request."""
+    eng = _engine(multi_step=4)
+    p = SamplingParams(max_tokens=6, temperature=0.0, presence_penalty=0.9,
+                       ignore_eos=True)
+    reqs = eng.generate(PROMPTS[:1], p)
+    assert len(reqs[0].output_token_ids) == 6
+    assert eng.stats.num_decode_steps == 8     # ceil(5/4)*4, not 5
+    base = _engine(multi_step=1).generate(PROMPTS[:1], p)
+    assert _ids(reqs) == _ids(base)
+
+
+def test_penalties_under_pipelined_windows_not_stale():
+    """Pipelined decode chains window N+1 off window N's device tokens
+    BEFORE the host sees them — penalty counts built from host history
+    would miss a full window of the request's own tokens (round-5
+    review).  The engine must resolve the in-flight window first; the
+    stream must equal the unpipelined engine's."""
+    params = SamplingParams(max_tokens=12, temperature=0.0,
+                            presence_penalty=0.9, frequency_penalty=0.6,
+                            ignore_eos=True)
+    plain = _engine(multi_step=4,
+                    pipeline_decode=False).generate(PROMPTS[:2], params)
+    piped = _engine(multi_step=4,
+                    pipeline_decode=True).generate(PROMPTS[:2], params)
+    assert _ids(piped) == _ids(plain)
+
+
 def test_logprobs_with_sampling_and_eos_mid_window():
     """Seeded temperature + logprobs on the window path, with a stream
     finishing mid-window: entries stay 1:1 with consumed tokens and

@@ -862,6 +862,10 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  top_p: jnp.ndarray | None = None,
                  min_p: jnp.ndarray | None = None,
                  logprobs_n: int = 0,
+                 counts: jnp.ndarray | None = None,
+                 presence: jnp.ndarray | None = None,
+                 frequency: jnp.ndarray | None = None,
+                 repetition: jnp.ndarray | None = None,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -889,13 +893,23 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     block_size = kv_cache[0]["k"].shape[1]
 
     def one(carry, s):
-        toks, pos, lens, cache = carry
+        toks, pos, lens, cache, cnt = carry
         slot = window_slot(block_tables, pos, active, block_size)
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
                                      attn_impl, mesh, ad=ad)
+        if cnt is not None:
+            # presence/frequency/repetition from the on-device count
+            # carry — identical math to the per-step path (ONE home:
+            # ops/sampling.penalize_from_counts), ordered before
+            # sampling AND before logprobs like that path
+            from tpuserve.ops.sampling import penalize_from_counts
+            logits = penalize_from_counts(logits, cnt, presence,
+                                          frequency, repetition)
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
+        if cnt is not None:
+            cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
         ys = nxt
         if logprobs_n:
             # sampled-token + top-N logprobs computed in-window, so
@@ -903,10 +917,10 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # previously dropped them to per-token dispatches)
             from tpuserve.ops.sampling import compute_logprobs
             ys = (nxt, compute_logprobs(logits, nxt, logprobs_n))
-        return (nxt, pos + 1, lens + 1, cache), ys
+        return (nxt, pos + 1, lens + 1, cache, cnt), ys
 
-    carry = (tokens, positions, seq_lens, kv_cache)
-    (_, _, _, kv_cache), outs = jax.lax.scan(
+    carry = (tokens, positions, seq_lens, kv_cache, counts)
+    (_, _, _, kv_cache, _), outs = jax.lax.scan(
         one, carry, jnp.arange(steps, dtype=jnp.int32))
     lp = None
     if logprobs_n:

@@ -113,28 +113,51 @@ def truncated_scaled_logits(scaled: jnp.ndarray, top_k: jnp.ndarray,
     return jnp.take_along_axis(masked_sorted, inv, axis=-1)
 
 
-@jax.jit
-def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
-                          output_mask: jnp.ndarray,
-                          presence_penalty: jnp.ndarray,
-                          frequency_penalty: jnp.ndarray,
-                          repetition_penalty: jnp.ndarray) -> jnp.ndarray:
-    """OpenAI-style presence/frequency and HF-style repetition penalties.
+@partial(jax.jit, static_argnames=("vocab_size",))
+def token_counts(output_tokens: jnp.ndarray, output_mask: jnp.ndarray,
+                 vocab_size: int) -> jnp.ndarray:
+    """(B, T) token history (+ validity mask) -> (B, V) float32 counts.
+    A small T-bucketed executable of its own, so fixed-shape consumers
+    (the fused decode window) can take counts without recompiling per
+    history-length bucket."""
+    B = output_tokens.shape[0]
+    ids = jnp.where(output_mask, output_tokens, vocab_size)  # V = dropped
+    return jnp.zeros((B, vocab_size), jnp.float32).at[
+        jnp.arange(B)[:, None], ids].add(1.0, mode="drop")
 
-    logits: (B, V); output_tokens: (B, T) previously generated token ids with
-    ``output_mask`` (B, T) marking valid entries; penalties: (B,).
-    """
-    B, V = logits.shape
+
+def penalize_from_counts(logits: jnp.ndarray, counts: jnp.ndarray,
+                         presence_penalty: jnp.ndarray,
+                         frequency_penalty: jnp.ndarray,
+                         repetition_penalty: jnp.ndarray) -> jnp.ndarray:
+    """OpenAI-style presence/frequency and HF-style repetition penalties
+    from a (B, V) output-token count matrix.  ONE home for the math —
+    the per-step path derives counts from host history each step, the
+    fused window carries counts on device across iterations; both must
+    penalize identically."""
     logits = logits.astype(jnp.float32)
-    counts = jnp.zeros((B, V), jnp.float32)
-    ids = jnp.where(output_mask, output_tokens, V)           # V = dropped
-    counts = counts.at[jnp.arange(B)[:, None], ids].add(1.0, mode="drop")
     seen = counts > 0
     logits = logits - presence_penalty[:, None] * seen
     logits = logits - frequency_penalty[:, None] * counts
     rep = repetition_penalty[:, None]
     rep_logits = jnp.where(logits > 0, logits / rep, logits * rep)
     return jnp.where(seen, rep_logits, logits)
+
+
+@jax.jit
+def apply_logit_penalties(logits: jnp.ndarray, output_tokens: jnp.ndarray,
+                          output_mask: jnp.ndarray,
+                          presence_penalty: jnp.ndarray,
+                          frequency_penalty: jnp.ndarray,
+                          repetition_penalty: jnp.ndarray) -> jnp.ndarray:
+    """Per-step form: penalties straight from the (B, T) token history.
+
+    logits: (B, V); output_tokens: (B, T) previously generated token ids with
+    ``output_mask`` (B, T) marking valid entries; penalties: (B,).
+    """
+    counts = token_counts(output_tokens, output_mask, logits.shape[1])
+    return penalize_from_counts(logits, counts, presence_penalty,
+                                frequency_penalty, repetition_penalty)
 
 
 @jax.jit

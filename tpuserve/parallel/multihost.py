@@ -156,13 +156,16 @@ class MultihostCoordinator:
 
     def _decode_multi(self, tokens, positions, block_tables, seq_lens,
                       active, keys, temperature, *, steps, mode,
-                      top_k=None, top_p=None, min_p=None, logprobs_n=0):
-        if logprobs_n:
-            # logprobs is rejected at the multihost API edge
-            # (SamplingParams.multihost_unsupported); reaching here means
-            # that guard broke — fail loudly, don't desync the protocol
-            raise ValueError("in-window logprobs is not in the multihost "
-                             "lockstep protocol")
+                      top_k=None, top_p=None, min_p=None, logprobs_n=0,
+                      counts=None, presence=None, frequency=None,
+                      repetition=None):
+        if logprobs_n or counts is not None:
+            # logprobs and penalties are rejected at the multihost API
+            # edge (SamplingParams.multihost_unsupported); reaching here
+            # means that guard broke — fail loudly, don't desync the
+            # protocol
+            raise ValueError("in-window logprobs/penalties are not in the "
+                             "multihost lockstep protocol")
         from tpuserve.models import transformer
         eng = self.engine
         B = tokens.shape[0]

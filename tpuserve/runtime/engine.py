@@ -933,10 +933,12 @@ class Engine:
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
                            top_k=None, top_p=None, min_p=None,
-                           logprobs_n=0, ad=None):
+                           logprobs_n=0, counts=None, presence=None,
+                           frequency=None, repetition=None, ad=None):
         if self._pp > 1:
-            # logprobs_n never reaches here: the window-eligibility guard
-            # keeps logprobs requests on the per-step path under pp
+            # logprobs_n/counts never reach here: the window-eligibility
+            # guard keeps logprobs and penalized requests on the per-step
+            # path under pp
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
                 self._pp_head, self._pp_stages, self.model_cfg, tokens,
@@ -947,7 +949,9 @@ class Engine:
             self.params, self.model_cfg, tokens, positions, block_tables,
             seq_lens, active, keys, temperature, self.kv_cache, ad,
             steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
-            logprobs_n=logprobs_n, attn_impl=self.attn_impl,
+            logprobs_n=logprobs_n, counts=counts, presence=presence,
+            frequency=frequency, repetition=repetition,
+            attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
@@ -1075,14 +1079,16 @@ class Engine:
         window.
         """
         S = self._window_steps()
-        # top-k/top-p/min-p truncation AND sampled-token logprobs run
-        # INSIDE the window (window_sample mode="full" / decode_multi
-        # logprobs_n) — the common production sampling configs must not
-        # fall off the fused path to per-token dispatches.
-        # Penalties/bias/guided still need per-step host work; the pp
-        # trunk doesn't thread logprobs through its shard_map stages.
-        if any(r.params.needs_penalties
-               or (r.params.logprobs is not None and self._pp > 1)
+        # top-k/top-p/min-p truncation, sampled-token logprobs AND
+        # presence/frequency/repetition penalties all run INSIDE the
+        # window (window_sample mode="full" / decode_multi logprobs_n /
+        # the on-device count carry) — the common production sampling
+        # configs must not fall off the fused path to per-token
+        # dispatches.  Bias/guided still need per-step host work; the pp
+        # trunk threads neither logprobs nor penalties through its
+        # shard_map stages.
+        if any(((r.params.needs_penalties or r.params.logprobs is not None)
+                and self._pp > 1)
                or r.params.needs_logit_bias
                or r.params.guided is not None
                or (r.params.needs_min_tokens
@@ -1090,6 +1096,15 @@ class Engine:
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
+        if (self._pending_window is not None
+                and any(r.params.needs_penalties for r in batch.requests)):
+            # penalty counts come from HOST token history; under pipelined
+            # decode the in-flight window's tokens aren't in it yet, so a
+            # penalized window chained off the pending one would sample a
+            # whole window blind to its own previous tokens.  Resolve the
+            # window first — the same staleness rule the per-step path
+            # enforces (pipeline_ok in _run_decode).
+            outputs += self._flush_window()
         p = self._pending_window
         reqs = [r for r in batch.requests if not r.finished]
         pend_idx: dict[str, int] = {}
@@ -1161,6 +1176,20 @@ class Engine:
             # flush
             lp_n = self.MAX_LOGPROBS
             kw["logprobs_n"] = lp_n
+        if any(r.params.needs_penalties for r in reqs):
+            # counts are derived in a SMALL T-bucketed executable
+            # (token_counts) so the fixed-shape window trunk never
+            # recompiles per history-length bucket
+            from tpuserve.ops.sampling import token_counts
+            out_tokens, mask, presence, frequency, repetition = \
+                self._penalty_arrays(reqs, B)
+            kw.update(
+                counts=token_counts(jnp.asarray(out_tokens),
+                                    jnp.asarray(mask),
+                                    self.model_cfg.vocab_size),
+                presence=jnp.asarray(presence),
+                frequency=jnp.asarray(frequency),
+                repetition=jnp.asarray(repetition))
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -1778,7 +1807,10 @@ class Engine:
             self._greedy_cache[B] = d
         return d
 
-    def _apply_penalties(self, logits: jnp.ndarray, reqs: list[Request], B: int) -> jnp.ndarray:
+    def _penalty_arrays(self, reqs: list[Request], B: int):
+        """Per-row token history (T-bucketed) + penalty coefficient
+        arrays — shared by the per-step penalizer and the fused-window
+        dispatch so the two paths' inputs cannot drift."""
         from tpuserve.utils import next_power_of_2 as np2
         T = max(np2(max(len(r.output_token_ids) for r in reqs)), 8)
         out_tokens = np.zeros((B, T), np.int32)
@@ -1793,6 +1825,11 @@ class Engine:
             presence[i] = r.params.presence_penalty
             frequency[i] = r.params.frequency_penalty
             repetition[i] = r.params.repetition_penalty
+        return out_tokens, mask, presence, frequency, repetition
+
+    def _apply_penalties(self, logits: jnp.ndarray, reqs: list[Request], B: int) -> jnp.ndarray:
+        out_tokens, mask, presence, frequency, repetition = \
+            self._penalty_arrays(reqs, B)
         return sampling_ops.apply_logit_penalties(
             logits, jnp.asarray(out_tokens), jnp.asarray(mask),
             jnp.asarray(presence), jnp.asarray(frequency), jnp.asarray(repetition))
@@ -2147,7 +2184,8 @@ class Engine:
                = None,
                decode_buckets: Sequence[int] = (),
                sample_modes: Sequence[str] = ("greedy", "temperature",
-                                              "full", "logprobs"),
+                                              "full", "logprobs",
+                                              "penalties"),
                chunk_buckets: Sequence[int] = (),
                embed_buckets: Sequence[tuple[int, int]] = (),
                ) -> None:
@@ -2233,17 +2271,43 @@ class Engine:
                                        if self._pp == 1
                                        and "logprobs" in sample_modes
                                        else (0,))
+                        # every mode can carry penalties (greedy +
+                        # repetition_penalty is one of the most common
+                        # penalized configs) — a cold variant stalls the
+                        # loop on a window-trunk compile mid-serving
+                        pen_variants = ((False, True)
+                                        if self._pp == 1
+                                        and "penalties" in sample_modes
+                                        else (False,))
                         for steps in sorted(sizes):
                             for lp_n in lp_variants:
-                                lkw = (dict(mkw, logprobs_n=lp_n)
-                                       if lp_n else mkw)
-                                res = self._exec_decode_multi(
-                                    tokens, positions, bt, seq_lens,
-                                    active, keys, temp, steps=steps,
-                                    mode=mode, **lkw)
-                                self.kv_cache = res[1]
-                                if lp_n:
-                                    self._warm_tails.append(res[2])
+                                for pen in pen_variants:
+                                    if lp_n and pen:
+                                        # logprobs+penalties in one batch
+                                        # is rare — compile on demand
+                                        # rather than double warmup again
+                                        continue
+                                    lkw = dict(mkw)
+                                    if lp_n:
+                                        lkw["logprobs_n"] = lp_n
+                                    if pen:
+                                        V = self.model_cfg.vocab_size
+                                        lkw.update(
+                                            counts=jnp.zeros((B, V),
+                                                             jnp.float32),
+                                            presence=jnp.zeros((B,),
+                                                               jnp.float32),
+                                            frequency=jnp.zeros((B,),
+                                                                jnp.float32),
+                                            repetition=jnp.ones((B,),
+                                                                jnp.float32))
+                                    res = self._exec_decode_multi(
+                                        tokens, positions, bt, seq_lens,
+                                        active, keys, temp, steps=steps,
+                                        mode=mode, **lkw)
+                                    self.kv_cache = res[1]
+                                    if lp_n:
+                                        self._warm_tails.append(res[2])
                 if self._pipeline_decode:
                     # the pipelined paths chain steps/windows through
                     # _select_tokens; left cold, its (tiny) compile stalls
