@@ -161,6 +161,30 @@ def test_penalties_window_proof_by_overrun():
     assert _ids(reqs) == _ids(base)
 
 
+def test_logit_bias_stays_on_fused_window_and_matches():
+    """logit_bias rides the window as a dense per-row bias (same
+    executable family as penalties, zeros when only one is in play) —
+    token-identical to the per-step scatter path, including combined
+    bias+penalty batches."""
+    params = [
+        SamplingParams(max_tokens=6, temperature=0.0,
+                       logit_bias={5: 100.0}, ignore_eos=True),
+        SamplingParams(max_tokens=6, temperature=0.8, seed=9, top_p=0.9,
+                       logit_bias={7: 4.0, 11: -100.0}, ignore_eos=True),
+        SamplingParams(max_tokens=6, temperature=0.0,
+                       logit_bias={3: 2.5}, presence_penalty=0.7,
+                       ignore_eos=True),
+    ]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    eng = _engine(multi_step=4)
+    multi = eng.generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+    # +100 bias pins the greedy stream to token 5 — proves bias applied
+    assert all(t == 5 for t in multi[0].output_token_ids)
+    # overrun proves the WINDOW served it: 1 prefill + ceil(5/4)*4 = 8
+    assert eng.stats.num_decode_steps == 8
+
+
 def test_penalties_under_pipelined_windows_not_stale():
     """Pipelined decode chains window N+1 off window N's device tokens
     BEFORE the host sees them — penalty counts built from host history

@@ -866,6 +866,7 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  presence: jnp.ndarray | None = None,
                  frequency: jnp.ndarray | None = None,
                  repetition: jnp.ndarray | None = None,
+                 bias: jnp.ndarray | None = None,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -902,10 +903,16 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # presence/frequency/repetition from the on-device count
             # carry — identical math to the per-step path (ONE home:
             # ops/sampling.penalize_from_counts), ordered before
-            # sampling AND before logprobs like that path
+            # sampling AND before logprobs like that path.  ``bias`` (the
+            # dense per-row logit_bias, zeros when only penalties are in
+            # play) rides the same executable family: a (B, V) add is
+            # noise next to the trunk, and a separate static branch would
+            # double the warm set again.
             from tpuserve.ops.sampling import penalize_from_counts
             logits = penalize_from_counts(logits, cnt, presence,
                                           frequency, repetition)
+            if bias is not None:
+                logits = logits + bias
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
         if cnt is not None:

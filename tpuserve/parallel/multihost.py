@@ -158,7 +158,7 @@ class MultihostCoordinator:
                       active, keys, temperature, *, steps, mode,
                       top_k=None, top_p=None, min_p=None, logprobs_n=0,
                       counts=None, presence=None, frequency=None,
-                      repetition=None):
+                      repetition=None, bias=None):
         if logprobs_n or counts is not None:
             # logprobs and penalties are rejected at the multihost API
             # edge (SamplingParams.multihost_unsupported); reaching here

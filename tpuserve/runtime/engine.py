@@ -934,7 +934,8 @@ class Engine:
                            active, keys, temperature, *, steps, mode,
                            top_k=None, top_p=None, min_p=None,
                            logprobs_n=0, counts=None, presence=None,
-                           frequency=None, repetition=None, ad=None):
+                           frequency=None, repetition=None, bias=None,
+                           ad=None):
         if self._pp > 1:
             # logprobs_n/counts never reach here: the window-eligibility
             # guard keeps logprobs and penalized requests on the per-step
@@ -950,7 +951,7 @@ class Engine:
             seq_lens, active, keys, temperature, self.kv_cache, ad,
             steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
             logprobs_n=logprobs_n, counts=counts, presence=presence,
-            frequency=frequency, repetition=repetition,
+            frequency=frequency, repetition=repetition, bias=bias,
             attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
@@ -1072,30 +1073,30 @@ class Engine:
         dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
 
         Returns None — before any side effect — when the batch needs
-        per-step host work (penalties, logprobs, logit bias, guided,
-        active min_tokens); top-k/top-p/min-p truncation runs INSIDE the
-        window (window_sample mode="full").  Falls back to the
-        single-step path internally when cache capacity can't cover the
-        window.
+        per-step host work: guided decoding, active min_tokens, or (on
+        the pp engine only) penalties/logprobs/logit_bias.  Everything
+        else — top-k/top-p/min-p truncation, sampled-token logprobs,
+        presence/frequency/repetition penalties, logit_bias — runs
+        INSIDE the window.  Falls back to the single-step path
+        internally when cache capacity can't cover the window.
         """
         S = self._window_steps()
-        # top-k/top-p/min-p truncation, sampled-token logprobs AND
-        # presence/frequency/repetition penalties all run INSIDE the
-        # window (window_sample mode="full" / decode_multi logprobs_n /
-        # the on-device count carry) — the common production sampling
-        # configs must not fall off the fused path to per-token
-        # dispatches.  Bias/guided still need per-step host work; the pp
-        # trunk threads neither logprobs nor penalties through its
-        # shard_map stages.
-        if any(((r.params.needs_penalties or r.params.logprobs is not None)
-                and self._pp > 1)
-               or r.params.needs_logit_bias
+        # Truncated sampling, logprobs, penalties (on-device count carry)
+        # and logit_bias (dense per-row add) all run INSIDE the window —
+        # the common production sampling configs must not fall off the
+        # fused path to per-token dispatches.  Guided and active
+        # min_tokens still need per-step host work; the pp trunk threads
+        # none of the extras through its shard_map stages.
+        if any(((r.params.needs_penalties or r.params.logprobs is not None
+                 or r.params.needs_logit_bias) and self._pp > 1)
                or r.params.guided is not None
                or (r.params.needs_min_tokens
                    and r.params.min_tokens_active(len(r.output_token_ids)))
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
+        # logit_bias is static per request — safe under pipelining; only
+        # the COUNT-dependent penalties need the staleness flush below
         if (self._pending_window is not None
                 and any(r.params.needs_penalties for r in batch.requests)):
             # penalty counts come from HOST token history; under pipelined
@@ -1176,20 +1177,27 @@ class Engine:
             # flush
             lp_n = self.MAX_LOGPROBS
             kw["logprobs_n"] = lp_n
-        if any(r.params.needs_penalties for r in reqs):
-            # counts are derived in a SMALL T-bucketed executable
-            # (token_counts) so the fixed-shape window trunk never
-            # recompiles per history-length bucket
+        if any(r.params.needs_penalties or r.params.needs_logit_bias
+               for r in reqs):
+            # ONE executable family serves penalties AND logit_bias:
+            # counts/bias are derived in SMALL bucketed executables
+            # (token_counts / the bias scatter) so the fixed-shape window
+            # trunk never recompiles per history- or bias-width bucket;
+            # whichever of the two isn't in play rides along as zeros.
             from tpuserve.ops.sampling import token_counts
+            V = self.model_cfg.vocab_size
             out_tokens, mask, presence, frequency, repetition = \
                 self._penalty_arrays(reqs, B)
+            bias_ids, bias_vals = self._logit_bias_arrays(reqs, B, V)
             kw.update(
                 counts=token_counts(jnp.asarray(out_tokens),
-                                    jnp.asarray(mask),
-                                    self.model_cfg.vocab_size),
+                                    jnp.asarray(mask), V),
                 presence=jnp.asarray(presence),
                 frequency=jnp.asarray(frequency),
-                repetition=jnp.asarray(repetition))
+                repetition=jnp.asarray(repetition),
+                bias=sampling_ops.apply_logit_bias(
+                    jnp.zeros((B, V), jnp.float32),
+                    jnp.asarray(bias_ids), jnp.asarray(bias_vals)))
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -1713,9 +1721,9 @@ class Engine:
             self._guided_fallback_ids = ids
         return self._guided_fallback_ids
 
-    def _apply_logit_bias(self, logits: jnp.ndarray, reqs: list[Request],
-                          B: int) -> jnp.ndarray:
-        V = logits.shape[1]
+    def _logit_bias_arrays(self, reqs: list[Request], B: int, V: int):
+        """Per-row (ids, vals) scatter arrays for logit_bias — shared by
+        the per-step path and the fused-window dense-bias build."""
         K = next_power_of_2(max(len(r.params.logit_bias or {})
                                 for r in reqs) or 1)
         ids = np.full((B, K), V, np.int32)          # V = dropped by scatter
@@ -1724,6 +1732,11 @@ class Engine:
             for j, (tid, b) in enumerate(r.params.logit_bias_items()):
                 ids[i, j] = int(tid)
                 vals[i, j] = float(b)
+        return ids, vals
+
+    def _apply_logit_bias(self, logits: jnp.ndarray, reqs: list[Request],
+                          B: int) -> jnp.ndarray:
+        ids, vals = self._logit_bias_arrays(reqs, B, logits.shape[1])
         return sampling_ops.apply_logit_bias(
             logits, jnp.asarray(ids), jnp.asarray(vals))
 
@@ -2300,7 +2313,9 @@ class Engine:
                                             frequency=jnp.zeros((B,),
                                                                 jnp.float32),
                                             repetition=jnp.ones((B,),
-                                                                jnp.float32))
+                                                                jnp.float32),
+                                            bias=jnp.zeros((B, V),
+                                                           jnp.float32))
                                     res = self._exec_decode_multi(
                                         tokens, positions, bt, seq_lens,
                                         active, keys, temp, steps=steps,
