@@ -185,6 +185,41 @@ def test_logit_bias_stays_on_fused_window_and_matches():
     assert eng.stats.num_decode_steps == 8
 
 
+def test_min_tokens_floor_lifts_mid_window():
+    """min_tokens rides the window: the EOS/stop mask applies per scan
+    step while the row is below its floor and LIFTS on the exact step
+    it crosses (floor_remaining) — token-identical to the per-step
+    masked path, including floors that end mid-window."""
+    params = [
+        # floor 6 with window 4: crossing happens inside window 2
+        SamplingParams(max_tokens=10, temperature=0.0, min_tokens=6),
+        SamplingParams(max_tokens=10, temperature=0.8, seed=8, top_p=0.9,
+                       min_tokens=3, stop_token_ids=[9]),
+        SamplingParams(max_tokens=10, temperature=0.0),   # no floor
+    ]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    eng = _engine(multi_step=4)
+    multi = eng.generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+    for m in multi[:2]:
+        assert len(m.output_token_ids) >= 3   # floors respected
+
+
+def test_min_tokens_under_pipelined_windows_not_stale():
+    """Pipelined windows: floor_remaining is built from host lengths
+    that lag the in-flight window — the staleness flush (slack =
+    pending.steps) must resolve it first or the floor over-masks past
+    its end.  Stream must equal the unpipelined engine's."""
+    params = [SamplingParams(max_tokens=12, temperature=0.0, min_tokens=7),
+              SamplingParams(max_tokens=12, temperature=0.8, seed=2,
+                             min_tokens=6, stop_token_ids=[9])]
+    plain = _engine(multi_step=4,
+                    pipeline_decode=False).generate(PROMPTS[:2], params)
+    piped = _engine(multi_step=4,
+                    pipeline_decode=True).generate(PROMPTS[:2], params)
+    assert _ids(piped) == _ids(plain)
+
+
 def test_penalties_under_pipelined_windows_not_stale():
     """Pipelined decode chains window N+1 off window N's device tokens
     BEFORE the host sees them — penalty counts built from host history

@@ -867,6 +867,8 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  frequency: jnp.ndarray | None = None,
                  repetition: jnp.ndarray | None = None,
                  bias: jnp.ndarray | None = None,
+                 floor_bias: jnp.ndarray | None = None,
+                 floor_remaining: jnp.ndarray | None = None,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -913,6 +915,12 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                           frequency, repetition)
             if bias is not None:
                 logits = logits + bias
+            if floor_bias is not None:
+                # min_tokens: mask EOS/stop ids while the row is below
+                # its floor — the floor LIFTS mid-window as the row's
+                # output length (dispatch length + s) crosses min_tokens
+                logits = logits + jnp.where(
+                    (s < floor_remaining)[:, None], floor_bias, 0.0)
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
         if cnt is not None:

@@ -158,13 +158,19 @@ class MultihostCoordinator:
                       active, keys, temperature, *, steps, mode,
                       top_k=None, top_p=None, min_p=None, logprobs_n=0,
                       counts=None, presence=None, frequency=None,
-                      repetition=None, bias=None):
-        if logprobs_n or counts is not None:
-            # logprobs and penalties are rejected at the multihost API
-            # edge (SamplingParams.multihost_unsupported); reaching here
-            # means that guard broke — fail loudly, don't desync the
-            # protocol
-            raise ValueError("in-window logprobs/penalties are not in the "
+                      repetition=None, bias=None, floor_bias=None,
+                      floor_remaining=None):
+        if (logprobs_n or counts is not None or bias is not None
+                or floor_bias is not None):
+            # logprobs, penalties, logit_bias and min_tokens are rejected
+            # at the multihost API edge
+            # (SamplingParams.multihost_unsupported); reaching here means
+            # that guard broke — fail loudly naming the offender, don't
+            # desync the protocol
+            offender = ("logprobs" if logprobs_n else
+                        "penalties" if counts is not None else
+                        "logit_bias" if bias is not None else "min_tokens")
+            raise ValueError(f"in-window {offender} is not in the "
                              "multihost lockstep protocol")
         from tpuserve.models import transformer
         eng = self.engine

@@ -935,7 +935,7 @@ class Engine:
                            top_k=None, top_p=None, min_p=None,
                            logprobs_n=0, counts=None, presence=None,
                            frequency=None, repetition=None, bias=None,
-                           ad=None):
+                           floor_bias=None, floor_remaining=None, ad=None):
         if self._pp > 1:
             # logprobs_n/counts never reach here: the window-eligibility
             # guard keeps logprobs and penalized requests on the per-step
@@ -952,6 +952,7 @@ class Engine:
             steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
             logprobs_n=logprobs_n, counts=counts, presence=presence,
             frequency=frequency, repetition=repetition, bias=bias,
+            floor_bias=floor_bias, floor_remaining=floor_remaining,
             attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
@@ -1073,32 +1074,42 @@ class Engine:
         dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
 
         Returns None — before any side effect — when the batch needs
-        per-step host work: guided decoding, active min_tokens, or (on
-        the pp engine only) penalties/logprobs/logit_bias.  Everything
+        per-step host work: guided decoding, or (on the pp engine only)
+        penalties/logprobs/logit_bias/active-min_tokens.  Everything
         else — top-k/top-p/min-p truncation, sampled-token logprobs,
-        presence/frequency/repetition penalties, logit_bias — runs
+        presence/frequency/repetition penalties, logit_bias, and the
+        min_tokens floor (lifted mid-window by floor_remaining) — runs
         INSIDE the window.  Falls back to the single-step path
         internally when cache capacity can't cover the window.
         """
         S = self._window_steps()
-        # Truncated sampling, logprobs, penalties (on-device count carry)
-        # and logit_bias (dense per-row add) all run INSIDE the window —
-        # the common production sampling configs must not fall off the
-        # fused path to per-token dispatches.  Guided and active
-        # min_tokens still need per-step host work; the pp trunk threads
+        # Truncated sampling, logprobs, penalties (on-device count
+        # carry), logit_bias (dense per-row add) and the min_tokens
+        # floor (per-step lift via floor_remaining) all run INSIDE the
+        # window — the common production sampling configs must not fall
+        # off the fused path to per-token dispatches.  Only guided
+        # decoding still needs per-step host work; the pp trunk threads
         # none of the extras through its shard_map stages.
         if any(((r.params.needs_penalties or r.params.logprobs is not None
-                 or r.params.needs_logit_bias) and self._pp > 1)
+                 or r.params.needs_logit_bias
+                 or (r.params.needs_min_tokens
+                     and r.params.min_tokens_active(
+                         len(r.output_token_ids)))) and self._pp > 1)
                or r.params.guided is not None
-               or (r.params.needs_min_tokens
-                   and r.params.min_tokens_active(len(r.output_token_ids)))
                for r in batch.requests):
             return None
         outputs = self._flush_pending()
-        # logit_bias is static per request — safe under pipelining; only
-        # the COUNT-dependent penalties need the staleness flush below
+        # logit_bias is static per request — safe under pipelining; the
+        # COUNT-dependent penalties and the LENGTH-dependent min_tokens
+        # floor need the staleness flush below (host history/length lag
+        # the in-flight window)
         if (self._pending_window is not None
-                and any(r.params.needs_penalties for r in batch.requests)):
+                and any(r.params.needs_penalties
+                        or (r.params.needs_min_tokens
+                            and r.params.min_tokens_active(
+                                len(r.output_token_ids),
+                                slack=self._pending_window.steps))
+                        for r in batch.requests)):
             # penalty counts come from HOST token history; under pipelined
             # decode the in-flight window's tokens aren't in it yet, so a
             # penalized window chained off the pending one would sample a
@@ -1178,6 +1189,8 @@ class Engine:
             lp_n = self.MAX_LOGPROBS
             kw["logprobs_n"] = lp_n
         if any(r.params.needs_penalties or r.params.needs_logit_bias
+               or (r.params.needs_min_tokens
+                   and r.params.min_tokens_active(len(r.output_token_ids)))
                for r in reqs):
             # ONE executable family serves penalties AND logit_bias:
             # counts/bias are derived in SMALL bucketed executables
@@ -1198,6 +1211,12 @@ class Engine:
                 bias=sampling_ops.apply_logit_bias(
                     jnp.zeros((B, V), jnp.float32),
                     jnp.asarray(bias_ids), jnp.asarray(bias_vals)))
+            f_ids, f_vals, f_rem = self._min_tokens_arrays(reqs, B, V)
+            kw.update(
+                floor_bias=sampling_ops.apply_logit_bias(
+                    jnp.zeros((B, V), jnp.float32),
+                    jnp.asarray(f_ids), jnp.asarray(f_vals)),
+                floor_remaining=jnp.asarray(f_rem))
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -1740,20 +1759,24 @@ class Engine:
         return sampling_ops.apply_logit_bias(
             logits, jnp.asarray(ids), jnp.asarray(vals))
 
-    def _apply_min_tokens(self, logits: jnp.ndarray, reqs: list[Request],
-                          B: int) -> jnp.ndarray:
-        """vLLM min_tokens: mask every EOS id and per-request
-        stop_token_ids (-1e9, not -inf — a fully-masked row under
-        temperature softmax must not produce NaN) for rows that haven't
-        generated min_tokens yet.  Reuses the bias scatter."""
-        V = logits.shape[1]
+    def _min_tokens_arrays(self, reqs: list[Request], B: int, V: int):
+        """vLLM min_tokens scatter inputs: per-row masked ids (every EOS
+        id and per-request stop_token_ids at -1e9 — not -inf, a
+        fully-masked row under temperature softmax must not produce NaN)
+        for rows still below their floor, plus each row's REMAINING
+        token count (the fused window lifts the mask on the scan step
+        where the row crosses its floor).  Shared by the per-step mask
+        and the window dispatch."""
         eos = sorted(self._eos_ids)
         rows = {}
+        remaining = np.zeros((B,), np.int32)
         for i, r in enumerate(reqs):
             if (r.params.needs_min_tokens
                     and r.params.min_tokens_active(len(r.output_token_ids))):
                 rows[i] = (([] if r.params.ignore_eos else eos)
                            + list(r.params.stop_token_ids))
+                remaining[i] = (r.params.min_tokens
+                                - len(r.output_token_ids))
         # width over MASKED rows only — a past-floor row with many
         # stop_token_ids must not inflate the scatter bucket
         K = next_power_of_2(max((len(v) for v in rows.values()), default=1)
@@ -1763,6 +1786,11 @@ class Engine:
         for i, row in rows.items():
             ids[i, :len(row)] = row
             vals[i, :len(row)] = -1e9
+        return ids, vals, remaining
+
+    def _apply_min_tokens(self, logits: jnp.ndarray, reqs: list[Request],
+                          B: int) -> jnp.ndarray:
+        ids, vals, _ = self._min_tokens_arrays(reqs, B, logits.shape[1])
         return sampling_ops.apply_logit_bias(
             logits, jnp.asarray(ids), jnp.asarray(vals))
 
@@ -2198,7 +2226,8 @@ class Engine:
                decode_buckets: Sequence[int] = (),
                sample_modes: Sequence[str] = ("greedy", "temperature",
                                               "full", "logprobs",
-                                              "penalties"),
+                                              "penalties", "bias",
+                                              "min_tokens"),
                chunk_buckets: Sequence[int] = (),
                embed_buckets: Sequence[tuple[int, int]] = (),
                ) -> None:
@@ -2290,7 +2319,9 @@ class Engine:
                         # loop on a window-trunk compile mid-serving
                         pen_variants = ((False, True)
                                         if self._pp == 1
-                                        and "penalties" in sample_modes
+                                        and not {"penalties", "bias",
+                                                 "min_tokens"}.isdisjoint(
+                                            sample_modes)
                                         else (False,))
                         for steps in sorted(sizes):
                             for lp_n in lp_variants:
@@ -2315,7 +2346,11 @@ class Engine:
                                             repetition=jnp.ones((B,),
                                                                 jnp.float32),
                                             bias=jnp.zeros((B, V),
-                                                           jnp.float32))
+                                                           jnp.float32),
+                                            floor_bias=jnp.zeros(
+                                                (B, V), jnp.float32),
+                                            floor_remaining=jnp.zeros(
+                                                (B,), jnp.int32))
                                     res = self._exec_decode_multi(
                                         tokens, positions, bt, seq_lens,
                                         active, keys, temp, steps=steps,
