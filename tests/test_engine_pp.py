@@ -160,3 +160,38 @@ def test_pp_engine_rejects_speculation(pp_cfg):
     with pytest.raises(ValueError, match="speculative"):
         Engine(_cfg(speculative=SpecConfig(num_draft_tokens=2)),
                model_cfg=pp_cfg, mesh=make_mesh(MeshConfig(pp=2)))
+
+
+def test_pp_engine_window_extras_parity(pp_cfg):
+    """Penalties, logit_bias, min_tokens, truncated sampling and
+    logprobs all ride the pp fused window now (the pp trunk's logits
+    are replicated outside shard_map, so window_extras applies
+    identically) — streams and logprob entries must match the
+    single-device windowed engine."""
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(1, 500, size=6).tolist() for _ in range(3)]
+    params = [
+        SamplingParams(max_tokens=8, temperature=0.0, presence_penalty=0.8,
+                       frequency_penalty=0.4, ignore_eos=True),
+        SamplingParams(max_tokens=8, temperature=0.8, seed=5, top_p=0.9,
+                       logit_bias={7: 3.0}, ignore_eos=True),
+        SamplingParams(max_tokens=8, temperature=0.0, min_tokens=5,
+                       logprobs=2),
+    ]
+
+    def run(mesh):
+        eng = Engine(_cfg(multi_step=4), model_cfg=pp_cfg, mesh=mesh)
+        outs = {}
+        rids = [eng.add_request(prompt_token_ids=p, params=pr)
+                for p, pr in zip(prompts, params)]
+        while eng.has_work():
+            for o in eng.step():
+                outs.setdefault(o.request_id, []).extend(o.new_token_ids)
+        lps = [[e["token_id"] for e in eng.requests[r].logprobs]
+               if eng.requests[r].logprobs else None for r in rids]
+        return [outs[r] for r in rids], lps
+
+    golden, golden_lp = run(None)
+    got, got_lp = run(make_mesh(MeshConfig(pp=2)))
+    assert got == golden
+    assert got_lp == golden_lp

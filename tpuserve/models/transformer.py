@@ -739,6 +739,38 @@ def window_slot(block_tables: jnp.ndarray, pos: jnp.ndarray,
     return jnp.where(active, slot, attn_ops.PAD_SLOT)
 
 
+def window_extras(logits: jnp.ndarray, s: jnp.ndarray, cnt, presence,
+                  frequency, repetition, bias, floor_bias,
+                  floor_remaining):
+    """Apply the in-window sampling extras to one iteration's logits:
+    penalties from the (B, V) count carry, the dense per-row logit_bias,
+    and the min_tokens floor mask (lifted when the row's output length —
+    dispatch length + s — crosses its floor).  ONE home shared by
+    decode_multi and pp_decode_multi so the two fused-window
+    implementations cannot drift.  No-op when ``cnt`` is None (the
+    extras always travel together; unused ones are zeros)."""
+    if cnt is None:
+        return logits
+    from tpuserve.ops.sampling import penalize_from_counts
+    logits = penalize_from_counts(logits, cnt, presence, frequency,
+                                  repetition)
+    if bias is not None:
+        logits = logits + bias
+    if floor_bias is not None:
+        logits = logits + jnp.where(
+            (s < floor_remaining)[:, None], floor_bias, 0.0)
+    return logits
+
+
+def window_count_update(cnt, nxt):
+    """Fold the iteration's sampled tokens into the count carry (None
+    passes through) — the other half of the in-window penalties
+    contract, shared like :func:`window_extras`."""
+    if cnt is None:
+        return None
+    return cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
+
+
 def window_sample(logits: jnp.ndarray, keys: jnp.ndarray,
                   temperature: jnp.ndarray, s: jnp.ndarray,
                   mode: str, top_k: jnp.ndarray | None = None,
@@ -901,30 +933,16 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
                                      attn_impl, mesh, ad=ad)
-        if cnt is not None:
-            # presence/frequency/repetition from the on-device count
-            # carry — identical math to the per-step path (ONE home:
-            # ops/sampling.penalize_from_counts), ordered before
-            # sampling AND before logprobs like that path.  ``bias`` (the
-            # dense per-row logit_bias, zeros when only penalties are in
-            # play) rides the same executable family: a (B, V) add is
-            # noise next to the trunk, and a separate static branch would
-            # double the warm set again.
-            from tpuserve.ops.sampling import penalize_from_counts
-            logits = penalize_from_counts(logits, cnt, presence,
-                                          frequency, repetition)
-            if bias is not None:
-                logits = logits + bias
-            if floor_bias is not None:
-                # min_tokens: mask EOS/stop ids while the row is below
-                # its floor — the floor LIFTS mid-window as the row's
-                # output length (dispatch length + s) crosses min_tokens
-                logits = logits + jnp.where(
-                    (s < floor_remaining)[:, None], floor_bias, 0.0)
+        # extras ordered before sampling AND before logprobs, exactly
+        # like the per-step path (penalties -> bias -> floor); whichever
+        # features aren't in play ride along as zeros so one executable
+        # family covers them all
+        logits = window_extras(logits, s, cnt, presence, frequency,
+                               repetition, bias, floor_bias,
+                               floor_remaining)
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
-        if cnt is not None:
-            cnt = cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
+        cnt = window_count_update(cnt, nxt)
         ys = nxt
         if logprobs_n:
             # sampled-token + top-N logprobs computed in-window, so

@@ -288,13 +288,16 @@ def pp_decode_step(head, stages, cfg: ModelConfig, tokens, positions,
 
 
 @partial(jax.jit,
-         static_argnames=("cfg", "mesh", "steps", "mode",
+         static_argnames=("cfg", "mesh", "steps", "mode", "logprobs_n",
                           "num_microbatches"),
          donate_argnames=("stage_cache",))
 def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
                     block_tables, seq_lens, active, keys, temperature,
                     stage_cache, *, mesh, steps: int, mode: str = "greedy",
-                    top_k=None, top_p=None, min_p=None,
+                    top_k=None, top_p=None, min_p=None, logprobs_n: int = 0,
+                    counts=None, presence=None, frequency=None,
+                    repetition=None, bias=None, floor_bias=None,
+                    floor_remaining=None,
                     num_microbatches: int = 0):
     """``steps`` fused decode+sample iterations through the staged trunk
     in ONE dispatch — transformer.decode_multi's contract over a pp mesh.
@@ -325,23 +328,39 @@ def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
     bt_mb = _split_micro(block_tables, M)
 
     def one(carry, s):
-        toks, pos, lens, cache = carry
-        # slot derivation + window sampling shared with decode_multi
-        # (models/transformer.py window_slot/window_sample) — the two
-        # fused-window implementations must not drift
+        toks, pos, lens, cache, cnt = carry
+        # slot derivation + sampling + extras shared with decode_multi
+        # (models/transformer.py window_slot/window_sample/window_extras)
+        # — the two fused-window implementations must not drift.  The
+        # logits are replicated outside the shard_map region, so the
+        # extras apply exactly as on the single-device trunk.
         slot = tf.window_slot(block_tables, pos, active, block_size)
         h = tf._embed(head, cfg, toks, pos)
         out, cache = run_trunk(stages, cache, _split_micro(h, M),
                                _split_micro(slot, M), _split_micro(pos, M),
                                bt_mb, _split_micro(lens, M))
         logits = tf._unembed(head, cfg, out.reshape(B, -1))
+        logits = tf.window_extras(logits, s, cnt, presence, frequency,
+                                  repetition, bias, floor_bias,
+                                  floor_remaining)
         nxt = tf.window_sample(logits, keys, temperature, s, mode,
                                top_k=top_k, top_p=top_p, min_p=min_p)
-        return (nxt, pos + 1, lens + 1, cache), nxt
+        cnt = tf.window_count_update(cnt, nxt)
+        ys = nxt
+        if logprobs_n:
+            from tpuserve.ops.sampling import compute_logprobs
+            ys = (nxt, compute_logprobs(logits, nxt, logprobs_n))
+        return (nxt, pos + 1, lens + 1, cache, cnt), ys
 
-    carry = (tokens, positions, seq_lens, stage_cache)
-    (_, _, _, stage_cache), outs = jax.lax.scan(
+    carry = (tokens, positions, seq_lens, stage_cache, counts)
+    (_, _, _, stage_cache, _), outs = jax.lax.scan(
         one, carry, jnp.arange(steps, dtype=jnp.int32))
+    if logprobs_n:
+        outs, (chosen_lp, top_ids, top_lps) = outs
+        lp = (jnp.swapaxes(chosen_lp, 0, 1),
+              jnp.swapaxes(top_ids, 0, 1),
+              jnp.swapaxes(top_lps, 0, 1))
+        return jnp.swapaxes(outs, 0, 1), stage_cache, lp
     return jnp.swapaxes(outs, 0, 1), stage_cache
 
 

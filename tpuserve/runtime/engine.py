@@ -937,15 +937,15 @@ class Engine:
                            frequency=None, repetition=None, bias=None,
                            floor_bias=None, floor_remaining=None, ad=None):
         if self._pp > 1:
-            # logprobs_n/counts never reach here: the window-eligibility
-            # guard keeps logprobs and penalized requests on the per-step
-            # path under pp
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
                 self._pp_head, self._pp_stages, self.model_cfg, tokens,
                 positions, block_tables, seq_lens, active, keys,
                 temperature, self.kv_cache, mesh=self.mesh, steps=steps,
-                mode=mode, top_k=top_k, top_p=top_p, min_p=min_p)
+                mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
+                logprobs_n=logprobs_n, counts=counts, presence=presence,
+                frequency=frequency, repetition=repetition, bias=bias,
+                floor_bias=floor_bias, floor_remaining=floor_remaining)
         return transformer.decode_multi(
             self.params, self.model_cfg, tokens, positions, block_tables,
             seq_lens, active, keys, temperature, self.kv_cache, ad,
@@ -1073,9 +1073,8 @@ class Engine:
         request cannot use (EOS / max_tokens / stop string mid-window) are
         dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
 
-        Returns None — before any side effect — when the batch needs
-        per-step host work: guided decoding, or (on the pp engine only)
-        penalties/logprobs/logit_bias/active-min_tokens.  Everything
+        Returns None — before any side effect — only when the batch
+        needs guided decoding (host-FSM token validation).  Everything
         else — top-k/top-p/min-p truncation, sampled-token logprobs,
         presence/frequency/repetition penalties, logit_bias, and the
         min_tokens floor (lifted mid-window by floor_remaining) — runs
@@ -1086,17 +1085,11 @@ class Engine:
         # Truncated sampling, logprobs, penalties (on-device count
         # carry), logit_bias (dense per-row add) and the min_tokens
         # floor (per-step lift via floor_remaining) all run INSIDE the
-        # window — the common production sampling configs must not fall
-        # off the fused path to per-token dispatches.  Only guided
-        # decoding still needs per-step host work; the pp trunk threads
-        # none of the extras through its shard_map stages.
-        if any(((r.params.needs_penalties or r.params.logprobs is not None
-                 or r.params.needs_logit_bias
-                 or (r.params.needs_min_tokens
-                     and r.params.min_tokens_active(
-                         len(r.output_token_ids)))) and self._pp > 1)
-               or r.params.guided is not None
-               for r in batch.requests):
+        # window — on the single-device trunk AND the pp trunk (whose
+        # logits are replicated outside the shard_map region, so the
+        # extras apply identically).  Only guided decoding still needs
+        # per-step host work.
+        if any(r.params.guided is not None for r in batch.requests):
             return None
         outputs = self._flush_pending()
         # logit_bias is static per request — safe under pipelining; the
@@ -2310,17 +2303,15 @@ class Engine:
                         # reason; cold, the first logprobs request
                         # stalls on a full window-trunk compile
                         lp_variants = ((0, self.MAX_LOGPROBS)
-                                       if self._pp == 1
-                                       and "logprobs" in sample_modes
+                                       if "logprobs" in sample_modes
                                        else (0,))
                         # every mode can carry penalties (greedy +
                         # repetition_penalty is one of the most common
                         # penalized configs) — a cold variant stalls the
                         # loop on a window-trunk compile mid-serving
                         pen_variants = ((False, True)
-                                        if self._pp == 1
-                                        and not {"penalties", "bias",
-                                                 "min_tokens"}.isdisjoint(
+                                        if not {"penalties", "bias",
+                                                "min_tokens"}.isdisjoint(
                                             sample_modes)
                                         else (False,))
                         for steps in sorted(sizes):
