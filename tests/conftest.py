@@ -42,6 +42,21 @@ def _assert_cpu_mesh():
     yield
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_between_modules():
+    """Drop compiled-executable caches after every test module.
+
+    The full suite compiles 600+ distinct executables in one process;
+    around the ~590th test the XLA CPU compiler started SEGFAULTING
+    inside backend_compile_and_load (observed twice at the same spot,
+    never in isolation) — cumulative JIT code/arena exhaustion, not a
+    bug in the test that happens to be standing there when it tips
+    over.  Freeing the caches per module bounds the accumulation; each
+    module recompiles its own shapes anyway."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def fp32_tiny_qwen3():
     from tpuserve.models.config import get_model_config

@@ -771,6 +771,18 @@ def window_count_update(cnt, nxt):
     return cnt.at[jnp.arange(cnt.shape[0]), nxt].add(1.0)
 
 
+def window_unpack_lp(outs):
+    """Unpack a fused window's scan outputs when in-window logprobs rode
+    along: (tokens (B, steps), (chosen (B, steps), ids (B, steps, N),
+    lps (B, steps, N))).  Scan stacks along the STEP axis; the engine's
+    flush indexes [row, step], so everything swaps here — one home for
+    the layout, shared by decode_multi and pp_decode_multi."""
+    outs, (chosen_lp, top_ids, top_lps) = outs
+    return jnp.swapaxes(outs, 0, 1), (jnp.swapaxes(chosen_lp, 0, 1),
+                                      jnp.swapaxes(top_ids, 0, 1),
+                                      jnp.swapaxes(top_lps, 0, 1))
+
+
 def window_sample(logits: jnp.ndarray, keys: jnp.ndarray,
                   temperature: jnp.ndarray, s: jnp.ndarray,
                   mode: str, top_k: jnp.ndarray | None = None,
@@ -957,11 +969,9 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         one, carry, jnp.arange(steps, dtype=jnp.int32))
     lp = None
     if logprobs_n:
-        outs, (chosen_lp, top_ids, top_lps) = outs
-        lp = (jnp.swapaxes(chosen_lp, 0, 1),       # (B, steps)
-              jnp.swapaxes(top_ids, 0, 1),         # (B, steps, N)
-              jnp.swapaxes(top_lps, 0, 1))
-    out = jnp.swapaxes(outs, 0, 1)                             # (B, steps)
+        out, lp = window_unpack_lp(outs)
+    else:
+        out = jnp.swapaxes(outs, 0, 1)                         # (B, steps)
     if out_mesh is not None:
         # Multi-host lockstep device_gets the window on the coordinator;
         # force the small token matrix to be fully replicated/addressable.

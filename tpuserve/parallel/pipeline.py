@@ -356,11 +356,8 @@ def pp_decode_multi(head, stages, cfg: ModelConfig, tokens, positions,
     (_, _, _, stage_cache, _), outs = jax.lax.scan(
         one, carry, jnp.arange(steps, dtype=jnp.int32))
     if logprobs_n:
-        outs, (chosen_lp, top_ids, top_lps) = outs
-        lp = (jnp.swapaxes(chosen_lp, 0, 1),
-              jnp.swapaxes(top_ids, 0, 1),
-              jnp.swapaxes(top_lps, 0, 1))
-        return jnp.swapaxes(outs, 0, 1), stage_cache, lp
+        out, lp = tf.window_unpack_lp(outs)
+        return out, stage_cache, lp
     return jnp.swapaxes(outs, 0, 1), stage_cache
 
 
