@@ -115,6 +115,55 @@ def _install_signal_flush() -> None:
                     # on stdout is still the floor
 
 
+def _first_hand_facts() -> dict:
+    """First-hand, this-host facts for the provisional/degraded lines
+    (VERDICT r5 weak #7: a dead-tunnel round's artifact carried only
+    second-hand TPU history).  Two sources, both cheap and local:
+
+    - the most recent tier-1 suite log (the ROADMAP verify recipe tees
+      to ``/tmp/_t1.log``; override via ``TPUSERVE_TIER1_LOG``) — its
+      DOTS_PASSED counter and pytest pass/fail tallies;
+    - the latest committed ``MULTICHIP_r*.json`` dryrun status.
+
+    Anything unreadable is simply omitted — facts, not placeholders."""
+    import glob
+    import re as _re
+    facts: dict = {}
+    log = os.environ.get("TPUSERVE_TIER1_LOG", "/tmp/_t1.log")
+    try:
+        with open(log, "rb") as f:
+            txt = f.read().decode("utf-8", "replace")
+        tallies = {}
+        m = _re.findall(r"DOTS_PASSED=(\d+)", txt)
+        if m:
+            tallies["dots_passed"] = int(m[-1])
+        m = _re.findall(r"(\d+) passed", txt)
+        if m:
+            tallies["passed"] = int(m[-1])
+        m = _re.findall(r"(\d+) failed", txt)
+        if m:
+            tallies["failed"] = int(m[-1])
+        if tallies:
+            facts["tier1"] = tallies
+    except OSError:
+        pass
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rounds = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")))
+        if rounds:
+            with open(rounds[-1]) as f:
+                mc = json.load(f)
+            facts["multichip"] = {
+                "round": os.path.basename(rounds[-1]),
+                "ok": bool(mc.get("ok")),
+                "skipped": bool(mc.get("skipped")),
+                "n_devices": mc.get("n_devices"),
+            }
+    except (OSError, ValueError):
+        pass
+    return facts
+
+
 def _git_commit() -> str:
     """Short HEAD hash, stamped into every result row so carried evidence
     is explicit about which code it measured (ADVICE r3: a best_tpu_result
@@ -623,6 +672,9 @@ def main(argv=None):
     best_prior = _best_tpu_result(provisional["model"])
     if best_prior:
         provisional["best_tpu_result"] = best_prior
+    # tier-1 pass count + MULTICHIP dryrun status: first-hand facts in
+    # the artifact even when the chip never answers (VERDICT r5 weak #7)
+    provisional.update(_first_hand_facts())
     _emit(provisional)
 
     try:
@@ -828,6 +880,10 @@ def main(argv=None):
         probe_err = os.environ.get("TPUSERVE_BENCH_PROBE_ERROR")
         if probe_err:
             out["probe_error"] = probe_err
+        # a degraded (CPU) measurement is weak evidence on its own:
+        # carry the tier-1 pass count and MULTICHIP status so the line
+        # still reports first-hand repo state (VERDICT r5 weak #7)
+        out.update(_first_hand_facts())
         best_tpu = _best_tpu_result(eng0.model_cfg.name)
         if best_tpu:
             # the chip was reachable earlier: carry the round's best REAL

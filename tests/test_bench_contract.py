@@ -73,6 +73,27 @@ def test_model_alias_matches_full_name():
     assert not bench._model_matches("Qwen/Qwen3-0.6B", "llama3-8b")
 
 
+def test_first_hand_facts_carry_tier1_and_multichip(tmp_path, monkeypatch):
+    """Provisional/degraded lines carry the tier-1 pass count and the
+    latest MULTICHIP dryrun status (VERDICT r5 weak #7): a dead-tunnel
+    round's artifact reports first-hand repo facts, not only carried TPU
+    history.  Unreadable sources are omitted, never faked."""
+    import bench
+    log = tmp_path / "t1.log"
+    log.write_text("....\n312 passed, 2 failed in 400s\nDOTS_PASSED=312\n")
+    monkeypatch.setenv("TPUSERVE_TIER1_LOG", str(log))
+    facts = bench._first_hand_facts()
+    assert facts["tier1"]["dots_passed"] == 312
+    assert facts["tier1"]["passed"] == 312
+    assert facts["tier1"]["failed"] == 2
+    # the repo's committed MULTICHIP_r*.json is read from the real tree
+    assert facts["multichip"]["round"].startswith("MULTICHIP_r")
+    assert "ok" in facts["multichip"]
+    # missing log: tier1 omitted entirely
+    monkeypatch.setenv("TPUSERVE_TIER1_LOG", str(tmp_path / "absent.log"))
+    assert "tier1" not in bench._first_hand_facts()
+
+
 def test_best_tpu_result_finds_alias_rows(tmp_path, monkeypatch):
     import bench
     row = {"backend": "tpu", "value": 1234.5, "unit": "tok/s/chip",

@@ -89,6 +89,76 @@ def test_detect_runtime_requires_live_daemon(monkeypatch):
     assert not ok and "daemon unreachable" in reason
 
 
+def test_serving_e2e_gate_guided_rides_fused_windows():
+    """Serving-side e2e gate: every guided mode (json / regex / choice)
+    through the REAL HTTP surface — SSE included — against a
+    fused-window engine (multi_step=4).  The gate asserts both halves of
+    the contract: outputs satisfy their constraint end-to-end, AND the
+    engine's window counter proves the grammar-FSM path served them
+    (a silent per-step fallback would pass the old tests while giving
+    up the entire S>1 speedup this subsystem exists for)."""
+    import json as _json
+    import re as _re
+    import urllib.request
+
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SchedulerConfig)
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=32),
+        scheduler=SchedulerConfig(max_num_seqs=8, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        multi_step=4))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    base = f"http://127.0.0.1:{srv.start()}"
+
+    def post(path, body, stream=False):
+        req = urllib.request.Request(
+            base + path, data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as r:
+            raw = r.read()
+        if not stream:
+            return _json.loads(raw)
+        chunks = [_json.loads(ln[6:]) for ln in raw.decode().splitlines()
+                  if ln.startswith("data: ") and not ln.endswith("[DONE]")]
+        return chunks
+
+    try:
+        # guided json over chat, non-stream
+        body = post("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "json please"}],
+            "response_format": {"type": "json_object"},
+            "seed": 11, "max_tokens": 32})
+        text = body["choices"][0]["message"]["content"]
+        from tpuserve.runtime.guided import JsonStateMachine
+        JsonStateMachine().feed(text)
+        assert text.lstrip().startswith("{")
+        # guided regex over SSE
+        chunks = post("/v1/completions", {
+            "prompt": "x", "guided_regex": "(yes|no){1,2}", "seed": 4,
+            "temperature": 0.8, "max_tokens": 16, "stream": True},
+            stream=True)
+        text = "".join(c["choices"][0]["text"] for c in chunks)
+        assert _re.fullmatch("(yes|no){1,2}", text), text
+        # guided choice over SSE
+        chunks = post("/v1/completions", {
+            "prompt": "x", "guided_choice": ["alpha", "beta"], "seed": 8,
+            "temperature": 0.9, "max_tokens": 16, "stream": True},
+            stream=True)
+        text = "".join(c["choices"][0]["text"] for c in chunks)
+        assert text in ("alpha", "beta")
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        # the windows actually served all of it
+        assert eng.stats.guided_fsm_windows >= 3
+        assert eng.stats.guided_fsm_requests >= 3
+    finally:
+        srv.shutdown()
+
+
 def test_cli_e2e_subcommand_wired(monkeypatch):
     # force the offline branch: on a docker+kind host the live branch
     # would otherwise create a REAL kind cluster inside the test suite

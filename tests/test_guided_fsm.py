@@ -1,0 +1,386 @@
+"""Grammar-FSM guided decoding (runtime/grammar/): the token-level FSM
+compiler, the on-device masking path, and the distribution guarantees.
+
+Three layers:
+
+1. Compiler: determinized token FSMs must agree with the char-level
+   acceptors they were compiled from (walk equivalence), merge equal
+   states, and fail LOUDLY on specs they can't bound (the engine then
+   falls back to candidate substitution).
+2. Distribution: masked sampling's empirical marginal must match the
+   renormalized ground truth over the legal set (the mirror of the
+   spec-decode acceptance test, tests/test_spec_decode.py:120) — and the
+   legacy substitution scheme's distortion must be bounded by the
+   illegal probability mass, the statistical bound VERDICT r5 weak #4
+   asked for.
+3. Engine: guided requests RIDE fused multi-step windows token-identical
+   to the per-step (S=1) masked reference path on fixed seeds, for every
+   guided mode, greedy and sampled.
+"""
+
+import dataclasses
+import json
+import re
+
+import numpy as np
+import pytest
+
+from tpuserve.models.config import get_model_config
+from tpuserve.models.tokenizer import ByteTokenizer
+from tpuserve.runtime.engine import Engine, EngineConfig
+from tpuserve.runtime.grammar import (FsmCompileError, fsm_for_spec,
+                                      token_text_table, unpack_masks)
+from tpuserve.runtime.kv_cache import CacheConfig
+from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.scheduler import SchedulerConfig
+
+VOCAB = 512
+EOS = {1, 2}
+TOK = ByteTokenizer(VOCAB)
+
+
+def _tid(ch):
+    return TOK.encode(ch)[0]
+
+
+# ------------------------------------------------------------- compiler
+
+def test_choice_fsm_walk_and_finish():
+    fsm = fsm_for_spec("choice", json.dumps(["yes", "no", "maybe"]),
+                       TOK, VOCAB, EOS)
+    s = fsm.start
+    assert not fsm.can_finish[s]
+    for ch in "yes":
+        assert fsm.allowed(s)[_tid(ch)]
+        s = fsm.advance(s, _tid(ch))
+    assert fsm.can_finish[s] and fsm.complete[s]
+    term = fsm.advance(s, min(EOS))
+    assert term >= 0 and fsm.complete[term]
+    # off-choice char has no transition
+    assert fsm.advance(fsm.start, _tid("z")) == -1
+
+
+def test_choice_fsm_merges_shared_tails():
+    # "abX" and "cbX" share the "bX"/"X" tails: the suffix-set state key
+    # merges them, so the FSM is smaller than the naive prefix trie
+    fsm = fsm_for_spec("choice", json.dumps(["abX", "cbX"]),
+                       TOK, VOCAB, EOS)
+    # states: start, {bX}, {X}, {""}, terminal = 5
+    assert fsm.num_states == 5
+
+
+def test_regex_fsm_matches_reference_semantics():
+    fsm = fsm_for_spec("regex", "[ab]{2,3}X?", TOK, VOCAB, EOS)
+    pat = re.compile("[ab]{2,3}X?")
+
+    def walk(text):
+        s = fsm.start
+        for ch in text:
+            s = fsm.advance(s, _tid(ch))
+            if s < 0:
+                return None
+        return s
+
+    for text in ("ab", "aab", "abX", "bbbX", "a", "abab", "Xab", "abXX"):
+        s = walk(text)
+        if s is None:
+            # no prefix extension of text matches — re agrees nothing
+            # starting with text fully matches
+            assert not any(pat.fullmatch(text + tail) is not None
+                           for tail in ("", "a", "X", "aX", "aaX"))
+        else:
+            assert bool(fsm.can_finish[s]) == bool(pat.fullmatch(text)), text
+
+
+def test_json_fsm_accepts_document_and_tracks_completion():
+    fsm = fsm_for_spec("json", None, TOK, VOCAB, EOS)
+    s = fsm.start
+    for ch in '{"a": [1, true], "b": {"c": "hi"}}':
+        assert fsm.allowed(s)[_tid(ch)], ch
+        s = fsm.advance(s, _tid(ch))
+    assert fsm.complete[s]
+    # depth bound: the FSM simply never OFFERS a deeper '[' — the mask
+    # excludes it at max depth instead of compiling unbounded states
+    s = fsm.start
+    for ch in '{"a": [[[':
+        nxt = fsm.advance(s, _tid(ch))
+        if nxt < 0:
+            break
+        s = nxt
+    assert not fsm.allowed(s)[_tid("[")]
+
+
+def test_fsm_masks_agree_with_char_acceptor():
+    """Walk equivalence on the schema machine: at every state along a
+    real document, the FSM's allowed set must equal {token: acceptor
+    allows its text} over the usable vocabulary."""
+    from tpuserve.runtime.guided import (SchemaJsonStateMachine,
+                                         compile_schema)
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string"},
+                             "n": {"enum": [1, 2, 30]}},
+              "required": ["name"], "additionalProperties": False}
+    fsm = fsm_for_spec("json_schema", json.dumps(schema), TOK, VOCAB, EOS)
+    texts = token_text_table(TOK, VOCAB)
+    compiled = compile_schema(schema)
+    machine = SchemaJsonStateMachine(compiled)
+    s = fsm.start
+    for ch in '{"name": "x", "n": 30}':
+        allowed = fsm.allowed(s)
+        for t, txt in texts.items():
+            assert allowed[t] == machine.allows(txt), (ch, txt)
+        machine.feed(ch)
+        s = fsm.advance(s, _tid(ch))
+        assert s >= 0
+    assert fsm.complete[s]
+
+
+def test_unboundable_specs_fail_loudly():
+    # non-ASCII choice: ByteTokenizer spells it only via multi-token
+    # runes — the spellability pre-check routes it to the plan path
+    with pytest.raises(FsmCompileError):
+        fsm_for_spec("choice", json.dumps(["是"]), TOK, VOCAB, EOS)
+    # state budget: a schema whose numeric-bound prefixes explode
+    with pytest.raises(FsmCompileError):
+        fsm_for_spec("json", None, TOK, VOCAB, EOS, max_states=16)
+
+
+def test_packed_mask_roundtrip():
+    fsm = fsm_for_spec("choice", json.dumps(["ab"]), TOK, VOCAB, EOS)
+    dense = unpack_masks(fsm.masks, VOCAB)
+    for s in range(fsm.num_states):
+        np.testing.assert_array_equal(dense[s], fsm.allowed(s))
+
+
+# -------------------------------------------------- distribution bounds
+
+def _legal_mask_row(vocab, legal):
+    from tpuserve.runtime.grammar.fsm import pack_masks
+    allow = np.zeros((1, vocab), bool)
+    allow[0, list(legal)] = True
+    return pack_masks(allow)[0]
+
+
+def test_masked_sampling_marginal_is_renormalized_truth():
+    """The tentpole's distribution guarantee, mirroring the spec-decode
+    acceptance test (tests/test_spec_decode.py:120): sampling from
+    mask-before-truncation logits must reproduce the ground-truth
+    distribution renormalized over the LEGAL set — true logit masking is
+    distribution-correct by construction."""
+    import jax.numpy as jnp
+
+    from tpuserve.ops.sampling import apply_token_mask, sample_tokens
+    rng = np.random.default_rng(0)
+    V, N = 8, 4000
+    legal = [1, 3, 4, 6]
+    logits_row = rng.normal(size=(V,)).astype(np.float32) * 1.5
+    logits = jnp.asarray(np.tile(logits_row, (N, 1)))
+    packed = np.tile(_legal_mask_row(V, legal), (N, 1))
+    masked = apply_token_mask(logits, jnp.asarray(packed),
+                              jnp.ones((N,), bool))
+    keys = jnp.asarray(np.stack([np.arange(N, dtype=np.uint32),
+                                 np.full(N, 3, np.uint32)], axis=1))
+    toks = np.asarray(sample_tokens(
+        masked, keys, jnp.ones((N,), jnp.float32),
+        jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.float32),
+        mode="full"))
+    assert set(np.unique(toks)) <= set(legal)
+    p = np.exp(logits_row) / np.exp(logits_row).sum()
+    truth = np.zeros(V)
+    truth[legal] = p[legal] / p[legal].sum()
+    freq = np.bincount(toks, minlength=V) / N
+    np.testing.assert_allclose(freq, truth, atol=0.03)
+
+
+def test_candidate_substitution_distortion_bounded_by_illegal_mass():
+    """The legacy path's statistical bound (VERDICT r5 weak #4): greedy
+    substitution of illegal samples distorts the marginal by at most the
+    ILLEGAL probability mass in total variation — measured empirically
+    against the renormalized truth, alongside the masked path's ~0
+    distortion on the same distribution."""
+    rng = np.random.default_rng(1)
+    V, N = 8, 20000
+    legal = [1, 3, 4, 6]
+    logits_row = rng.normal(size=(V,)).astype(np.float32) * 1.5
+    p = np.exp(logits_row) / np.exp(logits_row).sum()
+    truth = np.zeros(V)
+    truth[legal] = p[legal] / p[legal].sum()
+    illegal_mass = p.sum() - p[legal].sum()
+    # simulate the engine's substitution: sample from the FULL
+    # distribution; replace an illegal draw with the most-probable legal
+    # token (the top-K scan in _guided_pick)
+    draws = rng.choice(V, size=N, p=p)
+    best_legal = max(legal, key=lambda t: p[t])
+    subst = np.where(np.isin(draws, legal), draws, best_legal)
+    freq = np.bincount(subst, minlength=V) / N
+    tv = 0.5 * np.abs(freq - truth).sum()
+    assert tv <= illegal_mass + 0.02
+    # the distortion is REAL (substitution piles illegal mass onto one
+    # token) — exactly what the masked path eliminates
+    assert tv > 0.05
+
+
+# ------------------------------------------------------- engine parity
+
+def _engine(multi_step=None, **eng_kw):
+    cfg = EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=32, dtype="float32"),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=4),
+        attn_impl="reference", multi_step=multi_step, **eng_kw)
+    mc = dataclasses.replace(get_model_config("tiny-qwen3"),
+                             dtype="float32")
+    return Engine(cfg, model_cfg=mc)
+
+
+PROMPTS = ["alpha", "beta"]
+
+
+def _ids(reqs):
+    return [r.output_token_ids for r in reqs]
+
+
+@pytest.mark.parametrize("temp", [0.0, 0.8])
+def test_guided_json_rides_window_token_identical(temp):
+    p = SamplingParams(max_tokens=24, temperature=temp, seed=5,
+                       guided="json")
+    base = _engine(multi_step=1).generate(PROMPTS, p)
+    eng = _engine(multi_step=4)
+    multi = eng.generate(PROMPTS, p)
+    assert _ids(multi) == _ids(base)
+    # the WINDOW actually served it, not a silent per-step fallback
+    assert eng.stats.guided_fsm_windows > 0
+    assert eng.stats.guided_fallbacks == 0
+    for r in multi:
+        assert r.output_text.lstrip().startswith("{")
+
+
+def test_guided_choice_and_regex_ride_windows():
+    pc = SamplingParams(max_tokens=16, temperature=0.9, seed=1,
+                        guided="choice",
+                        guided_schema=json.dumps(["yes", "no", "maybe"]))
+    pr = SamplingParams(max_tokens=16, temperature=0.7, seed=2,
+                        guided="regex", guided_schema="[ab]{3}X")
+    for p, check in ((pc, lambda t: t in ("yes", "no", "maybe")),
+                     (pr, lambda t: re.fullmatch("[ab]{3}X", t))):
+        eng = _engine(multi_step=4)
+        outs = eng.generate(PROMPTS, p)
+        assert eng.stats.guided_fsm_windows > 0
+        assert all(check(r.output_text) for r in outs), \
+            [r.output_text for r in outs]
+        base = _engine(multi_step=1).generate(PROMPTS, p)
+        assert _ids(outs) == _ids(base)
+
+
+def test_guided_schema_window_emits_schema_valid_json():
+    schema = {"type": "object",
+              "properties": {"name": {"type": "string"},
+                             "ok": {"type": "boolean"}},
+              "required": ["name", "ok"], "additionalProperties": False}
+    p = SamplingParams(max_tokens=48, temperature=0.6, seed=9,
+                       guided="json_schema",
+                       guided_schema=json.dumps(schema))
+    eng = _engine(multi_step=4)
+    outs = eng.generate(PROMPTS, p)
+    assert eng.stats.guided_fsm_windows > 0
+    for r in outs:
+        if r.finish_reason.value == "stop":
+            doc = json.loads(r.output_text)
+            assert set(doc) == {"name", "ok"}
+            assert isinstance(doc["ok"], bool)
+        else:
+            # length-capped mid-document: still a valid prefix
+            from tpuserve.runtime.guided import SchemaJsonStateMachine
+            m = SchemaJsonStateMachine(
+                __import__("tpuserve.runtime.guided",
+                           fromlist=["compile_schema"]
+                           ).compile_schema(schema))
+            m.feed(r.output_text)          # raises on violation
+
+
+def test_guided_mixed_with_unguided_batch_window():
+    """A window batching guided + unguided rows: the mask must only
+    touch the guided row, and both must match their S=1 streams."""
+    params = [SamplingParams(max_tokens=12, temperature=0.8, seed=3,
+                             guided="json"),
+              SamplingParams(max_tokens=12, temperature=0.8, seed=4,
+                             ignore_eos=True)]
+    base = _engine(multi_step=1).generate(PROMPTS, params)
+    eng = _engine(multi_step=4)
+    multi = eng.generate(PROMPTS, params)
+    assert _ids(multi) == _ids(base)
+    assert eng.stats.guided_fsm_windows > 0
+
+
+def test_guided_window_chaining_under_pipelined_decode():
+    """Pipelined windows chain the NEXT dispatch off the in-flight
+    window's device-resident final FSM states (PendingWindow.gstate via
+    _select_tokens) — the host mirror is p.steps stale at dispatch time.
+    CPU resolves pipeline_decode off by default, so force it on to
+    exercise the chaining path; streams must still be token-identical
+    to the synchronous S=1 reference."""
+    p = SamplingParams(max_tokens=24, temperature=0.8, seed=6,
+                       guided="json")
+    base = _engine(multi_step=1).generate(PROMPTS, p)
+    eng = _engine(multi_step=4, pipeline_decode=True)
+    multi = eng.generate(PROMPTS, p)
+    assert _ids(multi) == _ids(base)
+    assert eng.stats.guided_fsm_windows > 1     # chained dispatches ran
+    pr = SamplingParams(max_tokens=17, temperature=0.9, seed=2,
+                        guided="regex", guided_schema="[abc]{2,16}Z")
+    base = _engine(multi_step=1).generate(PROMPTS, pr)
+    eng = _engine(multi_step=4, pipeline_decode=True)
+    multi = eng.generate(PROMPTS, pr)
+    assert _ids(multi) == _ids(base)
+    for r in multi:
+        assert re.fullmatch("[abc]{2,16}Z", r.output_text), r.output_text
+
+
+def test_fsm_disabled_falls_back_to_substitution():
+    eng = _engine(multi_step=4, guided_fsm=False)
+    outs = eng.generate(PROMPTS[:1],
+                        SamplingParams(max_tokens=16, temperature=0.0,
+                                       guided="json"))
+    assert eng.stats.guided_fsm_windows == 0
+    assert eng.stats.guided_fsm_requests == 0
+    from tpuserve.runtime.guided import JsonStateMachine
+    m = JsonStateMachine()
+    m.feed(outs[0].output_text)            # still a valid prefix
+
+
+def test_uncompilable_spec_falls_back_per_request():
+    """A non-ASCII choice list can't FSM-compile under the byte
+    tokenizer: the request must still be served correctly by the
+    substitution path's canonical-suffix plans — in the SAME engine
+    where FSM-guided requests ride windows."""
+    eng = _engine(multi_step=4)
+    p_plan = SamplingParams(max_tokens=16, temperature=0.0,
+                            guided="choice",
+                            guided_schema=json.dumps(["是", "否"]))
+    p_fsm = SamplingParams(max_tokens=16, temperature=0.0,
+                           guided="choice",
+                           guided_schema=json.dumps(["yes", "no"]))
+    outs = eng.generate(PROMPTS, [p_plan, p_fsm])
+    assert outs[0].output_text in ("是", "否")
+    assert outs[1].output_text in ("yes", "no")
+    assert eng.stats.guided_fsm_requests == 1
+
+
+def test_fsm_compile_memoised_per_grammar(monkeypatch):
+    eng = _engine(multi_step=4)
+    import tpuserve.runtime.grammar as grammar
+    calls = {"n": 0}
+    orig = grammar.fsm_for_spec
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    # engine imports the symbol from the package at call time
+    monkeypatch.setattr("tpuserve.runtime.grammar.fsm_for_spec", counting)
+    p = SamplingParams(max_tokens=8, temperature=0.0, guided="json")
+    eng.generate(PROMPTS, p)
+    eng.generate(PROMPTS, p)
+    assert calls["n"] == 1                 # one compile, four requests

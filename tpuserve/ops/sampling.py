@@ -113,6 +113,29 @@ def truncated_scaled_logits(scaled: jnp.ndarray, top_k: jnp.ndarray,
     return jnp.take_along_axis(masked_sorted, inv, axis=-1)
 
 
+@jax.jit
+def apply_token_mask(logits: jnp.ndarray, packed: jnp.ndarray,
+                     enabled: jnp.ndarray) -> jnp.ndarray:
+    """Grammar-FSM logit masking: drop every disallowed token to NEG_INF
+    BEFORE any top-k/top-p truncation, so sampling renormalises over
+    exactly the legal set (distribution-correct guided decoding —
+    contrast the engine's legacy top-K candidate substitution, which
+    distorts the marginal; tests/test_guided_fsm.py bounds both).
+
+    logits: (B, V); packed: (B, ceil(V/32)) uint32 per-row allow bitmask
+    (bit t%32 of word t//32 = token t, runtime/grammar/fsm.py layout);
+    enabled: (B,) bool — False rows (unguided requests co-batched with
+    guided ones) pass through untouched.
+    """
+    B, V = logits.shape
+    ids = jnp.arange(V, dtype=jnp.int32)
+    words = jnp.take_along_axis(
+        packed, jnp.broadcast_to(ids // 32, (B, V)), axis=1)
+    allow = ((words >> (ids % 32).astype(jnp.uint32)) & 1).astype(bool)
+    allow = allow | ~enabled[:, None]
+    return jnp.where(allow, logits.astype(jnp.float32), NEG_INF)
+
+
 @partial(jax.jit, static_argnames=("vocab_size",))
 def token_counts(output_tokens: jnp.ndarray, output_mask: jnp.ndarray,
                  vocab_size: int) -> jnp.ndarray:

@@ -213,6 +213,12 @@ class DisaggregatedEngine:
             # the JSON acceptor follows the request, or guided decoding
             # silently stops at the pool boundary (and prefill leaks state)
             dst._guided[rid] = g
+        gf = self.prefill._guided_fsm.pop(rid, None)
+        if gf is not None:
+            # the grammar-FSM mirror state follows the same way (the fsm
+            # object is engine-agnostic host data; the decode engine
+            # uploads its own device tables on first window)
+            dst._guided_fsm[rid] = gf
         plan = self.prefill._guided_plan.pop(rid, None)
         if plan:
             # a committed canonical-suffix plan follows too — dropping it

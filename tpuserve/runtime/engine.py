@@ -107,6 +107,16 @@ class EngineConfig:
     adaptive_multi_step: bool = True
     min_multi_step: int = 4
     adaptive_window_hold_s: float = 0.5
+    # Grammar-FSM guided decoding (runtime/grammar/): compile guided
+    # specs to token-level FSMs whose per-state masks ride the fused
+    # decode window (true logit masking, distribution-correct), so
+    # guided requests keep multi_step throughput instead of pinning to
+    # S=1.  Specs the compiler can't bound (state/walk budgets,
+    # unspellable chars) fall back per-request to the legacy per-step
+    # candidate-substitution path.  The first guided window per
+    # (grammar-size bucket, mode, steps) compiles its executable on
+    # demand; the FSM itself compiles once per grammar at admission.
+    guided_fsm: bool = True
 
     def resolve_pipeline_decode(self) -> bool:
         # Multi-host lockstep serialises every device computation through the
@@ -152,6 +162,8 @@ class EngineStats:
     latency_windows: int = 0         # fused windows shrunk for arrivals
     guided_fallbacks: int = 0        # guided steps that left the top-K
     guided_plans: int = 0            # committed canonical-suffix completions
+    guided_fsm_requests: int = 0     # requests served by grammar-FSM masks
+    guided_fsm_windows: int = 0      # fused windows that carried FSM masks
     # multi-step windows: tokens computed past a request's stop point
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
@@ -183,6 +195,11 @@ class PendingWindow:
     # in-window logprobs: (chosen_lp (B,S), top_ids (B,S,N), top_lps
     # (B,S,N)) device arrays when the window computed them, else None
     lp: tuple | None = None
+    # grammar-FSM states after the window's last iteration ((B,) int32,
+    # -1 = unguided row) — the NEXT guided window chains off these on
+    # device, exactly like toks[:, -1] chains the input tokens; the host
+    # mirror advances at flush through the same table
+    gstate: jax.Array | None = None
 
 
 @jax.jit
@@ -367,6 +384,17 @@ class Engine:
         # the lazily-built structural fallback token set (runtime/guided.py)
         self._guided: dict[str, object] = {}
         self._guided_fallback_ids: Optional[list[int]] = None
+        # grammar-FSM guided decoding (runtime/grammar/): rid -> [TokenFSM,
+        # current state]; requests here are served by true logit masking
+        # (per-step AND inside fused windows) and never consult the
+        # substitution path.  _fsm_cache memoises compiles per grammar;
+        # _fsm_device holds the per-grammar device tables (masks /
+        # tok_class / class_next), padded to power-of-2 state/class
+        # buckets so the windowed executable count stays bounded.
+        self._guided_fsm: dict[str, list] = {}
+        self._fsm_cache: dict[tuple, object] = {}
+        self._fsm_device: dict[int, tuple] = {}
+        self._fsm_texts: Optional[dict] = None   # token -> text, lazy
         # committed canonical completions: when char-level substitution
         # can't spell the next legal char in single tokens (non-ASCII
         # choices under a byte-fallback vocab), _guided_pick encodes a
@@ -610,7 +638,16 @@ class Engine:
                 # the reported tokens would not match the emitted ones
                 raise ValueError(
                     "logprobs cannot be combined with response_format")
-            self._guided[request_id] = self._make_guided(params)
+            # the char acceptor compiles FIRST so spec errors (bad
+            # schema/pattern/choices) surface here as the documented
+            # ValueError, whether or not the FSM compile then succeeds
+            acceptor = self._make_guided(params)
+            fsm = self._fsm_for(params)
+            if fsm is not None:
+                self._guided_fsm[request_id] = [fsm, fsm.start]
+                self.stats.guided_fsm_requests += 1
+            else:
+                self._guided[request_id] = acceptor
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
                       params=params, prompt=prompt, adapter_idx=adapter_idx)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
@@ -623,6 +660,7 @@ class Engine:
             self.requests.pop(request_id, None)
             self._detok.pop(request_id, None)
             self._guided.pop(request_id, None)
+            self._guided_fsm.pop(request_id, None)
             self._guided_plan.pop(request_id, None)
             raise
         if self._adaptive_window and (self.scheduler.running
@@ -685,8 +723,18 @@ class Engine:
         first_text = detok.add(first_token)  # seed; text streamed prefill-side
         self._detok[request_id] = detok
         if params.guided is not None:
-            # cross-pod migration: rebuild the acceptor and advance it by
-            # the first token's text, mirroring what prefill emitted
+            # cross-pod migration: prefer the token-level FSM (advance by
+            # the first TOKEN — exact); a prefill pod that already left
+            # the FSM (suffix-plan bytes) falls back to the char acceptor
+            fsm = self._fsm_for(params)
+            if fsm is not None and not guided_plan:
+                ns = fsm.advance(fsm.start, first_token)
+                if ns >= 0:
+                    self._guided_fsm[request_id] = [fsm, ns]
+                    self.stats.guided_fsm_requests += 1
+        if params.guided is not None and request_id not in self._guided_fsm:
+            # rebuild the acceptor and advance it by the first token's
+            # text, mirroring what prefill emitted
             st = self._make_guided(params)
             try:
                 st.feed(first_text)
@@ -724,6 +772,7 @@ class Engine:
         self.block_manager.free(request_id, cache_blocks=not partial)
         self._detok.pop(request_id, None)
         self._guided.pop(request_id, None)
+        self._guided_fsm.pop(request_id, None)
         self._guided_plan.pop(request_id, None)
         return True
 
@@ -935,7 +984,9 @@ class Engine:
                            top_k=None, top_p=None, min_p=None,
                            logprobs_n=0, counts=None, presence=None,
                            frequency=None, repetition=None, bias=None,
-                           floor_bias=None, floor_remaining=None, ad=None):
+                           floor_bias=None, floor_remaining=None,
+                           gstate=None, gmasks=None, gclass=None,
+                           gnext=None, ad=None):
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
@@ -953,6 +1004,7 @@ class Engine:
             logprobs_n=logprobs_n, counts=counts, presence=presence,
             frequency=frequency, repetition=repetition, bias=bias,
             floor_bias=floor_bias, floor_remaining=floor_remaining,
+            gstate=gstate, gmasks=gmasks, gclass=gclass, gnext=gnext,
             attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
@@ -1074,24 +1126,51 @@ class Engine:
         dropped at emit — bounded overrun, the vLLM-TPU/JetStream tradeoff.
 
         Returns None — before any side effect — only when the batch
-        needs guided decoding (host-FSM token validation).  Everything
-        else — top-k/top-p/min-p truncation, sampled-token logprobs,
-        presence/frequency/repetition penalties, logit_bias, and the
-        min_tokens floor (lifted mid-window by floor_remaining) — runs
-        INSIDE the window.  Falls back to the single-step path
-        internally when cache capacity can't cover the window.
+        needs per-step host guided validation: a guided request whose
+        grammar didn't FSM-compile (candidate substitution), a
+        mixed-grammar batch, or guided rows on the pp / multi-host
+        trunks.  Everything else — top-k/top-p/min-p truncation,
+        sampled-token logprobs, presence/frequency/repetition penalties,
+        logit_bias, the min_tokens floor (lifted mid-window by
+        floor_remaining), and grammar-FSM guided masking (state carried
+        on device across iterations, runtime/grammar/) — runs INSIDE
+        the window.  Falls back to the single-step path internally when
+        cache capacity can't cover the window.
         """
         S = self._window_steps()
         # Truncated sampling, logprobs, penalties (on-device count
-        # carry), logit_bias (dense per-row add) and the min_tokens
-        # floor (per-step lift via floor_remaining) all run INSIDE the
-        # window — on the single-device trunk AND the pp trunk (whose
-        # logits are replicated outside the shard_map region, so the
-        # extras apply identically).  Only guided decoding still needs
-        # per-step host work.
-        if any(r.params.guided is not None for r in batch.requests):
+        # carry), logit_bias (dense per-row add), the min_tokens floor
+        # (per-step lift via floor_remaining) and grammar-FSM guided
+        # masking (runtime/grammar/ state carry) all run INSIDE the
+        # window.  Only guided requests WITHOUT a compiled FSM — specs
+        # the compiler couldn't bound — still need per-step host
+        # validation (candidate substitution).
+        if any(r.request_id in self._guided for r in batch.requests):
+            # substitution-path guided rows (spec didn't FSM-compile)
+            # need per-step host validation; a guided request in NEITHER
+            # dict dropped its constraint mid-stream and no longer gates
             return None
+        gset = [r for r in batch.requests
+                if r.request_id in self._guided_fsm]
+        if gset:
+            if self._pp > 1 or jax.process_count() > 1:
+                # the staged-trunk and lockstep-broadcast hook signatures
+                # don't carry the FSM tables yet — per-step fallback
+                return None
+            if len({id(self._guided_fsm[r.request_id][0])
+                    for r in gset}) > 1:
+                # one grammar table set per dispatch; mixed-grammar
+                # batches fall back to per-step (rare co-batching case)
+                return None
         outputs = self._flush_pending()
+        if (self._pending_window is not None
+                and self._pending_window.gstate is None
+                and any(r.request_id in self._guided_fsm
+                        for r in self._pending_window.reqs)):
+            # a guided row chained from a window that carried no FSM
+            # states (possible only across an adoption/config edge):
+            # resolve it first so this dispatch reads fresh host states
+            outputs += self._flush_window()
         # logit_bias is static per request — safe under pipelining; the
         # COUNT-dependent penalties and the LENGTH-dependent min_tokens
         # floor need the staleness flush below (host history/length lag
@@ -1142,6 +1221,7 @@ class Engine:
         active = np.zeros((B,), bool)
         keys = np.zeros((B, 2), np.uint32)
         temperature = np.zeros((B,), np.float32)
+        gstate_host = np.full((B,), -1, np.int32)
         block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
         for i, r in enumerate(reqs):
@@ -1160,6 +1240,11 @@ class Engine:
             active[i] = True
             keys[i] = self._row_key(r, extra_step=extra)
             temperature[i] = r.params.temperature
+            gent = self._guided_fsm.get(r.request_id)
+            if gent is not None:
+                # chained rows overwrite this with the device gstate via
+                # the same use_host/gather select as their input tokens
+                gstate_host[i] = gent[1]
             bt = self.block_manager.block_table(r.request_id)
             block_tables[i, :len(bt)] = bt
         mode = ("greedy" if all(r.params.greedy for r in reqs)
@@ -1210,6 +1295,21 @@ class Engine:
                     jnp.zeros((B, V), jnp.float32),
                     jnp.asarray(f_ids), jnp.asarray(f_vals)),
                 floor_remaining=jnp.asarray(f_rem))
+        gfsm = next((self._guided_fsm[r.request_id][0] for r in reqs
+                     if r.request_id in self._guided_fsm), None)
+        if gfsm is not None:
+            gm, gc, gn = self._fsm_device_tables(gfsm)
+            if p is not None and p.gstate is not None:
+                # chained rows' FSM states live on device (the in-flight
+                # window's final carry) — select them exactly like the
+                # input tokens; fresh rows take the host mirror
+                gstate_in = _select_tokens(p.gstate, jnp.asarray(gather),
+                                           jnp.asarray(gstate_host),
+                                           jnp.asarray(use_host))
+            else:
+                gstate_in = jnp.asarray(gstate_host)
+            kw.update(gstate=gstate_in, gmasks=gm, gclass=gc, gnext=gn)
+            self.stats.guided_fsm_windows += 1
         if p is not None:
             tokens = _select_tokens(p.toks[:, -1], jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -1221,10 +1321,13 @@ class Engine:
             jnp.asarray(block_tables), jnp.asarray(seq_lens),
             jnp.asarray(active), jnp.asarray(keys),
             jnp.asarray(temperature), steps=S, mode=mode, **kw)
+        toks, self.kv_cache = res[0], res[1]
+        ri = 2
+        window_lp = None
         if lp_n:
-            toks, self.kv_cache, window_lp = res
-        else:
-            (toks, self.kv_cache), window_lp = res, None
+            window_lp = res[ri]
+            ri += 1
+        gstate_out = res[ri] if gfsm is not None else None
         self.stats.num_decode_steps += S
         if S < self._multi_step:
             # counted at the dispatch, not in _window_steps(): eligibility
@@ -1242,12 +1345,14 @@ class Engine:
             # flush.
             outputs += self._flush_window()
             self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
-                                                 steps=S, lp=window_lp)
+                                                 steps=S, lp=window_lp,
+                                                 gstate=gstate_out)
             return outputs
         # synchronous: flush the just-dispatched window immediately (one
         # code path for the KV-commit-before-emit and overrun invariants)
         self._pending_window = PendingWindow(reqs=list(reqs), toks=toks,
-                                             steps=S, lp=window_lp)
+                                             steps=S, lp=window_lp,
+                                             gstate=gstate_out)
         return outputs + self._flush_window()
 
     def _flush_window(self) -> list[RequestOutput]:
@@ -1565,11 +1670,16 @@ class Engine:
                and r.params.min_tokens_active(len(r.output_token_ids))
                for r in reqs):
             logits = self._apply_min_tokens(logits, reqs, B)
+        if any(r.request_id in self._guided_fsm for r in reqs):
+            # grammar-FSM rows: TRUE logit masking before sampling — the
+            # sampled token is legal by construction, no substitution
+            logits = self._apply_fsm_mask(logits, reqs, B)
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
         toks_np = np.asarray(jax.device_get(toks))[:n].copy()
-        if any(r.params.guided is not None for r in reqs):
+        if any(r.request_id in self._guided for r in reqs):
+            # legacy substitution path: only rows WITHOUT a compiled FSM
             toks_np = self._apply_guided(logits, toks_np, reqs)
         return toks_np
 
@@ -1598,6 +1708,87 @@ class Engine:
             return ChoiceStateMachine(
                 compile_choices(_json.loads(params.guided_schema)))
         return JsonStateMachine()
+
+    MAX_FSM_CACHE = 64
+
+    def _fsm_for(self, params):
+        """Token-level FSM for the request's grammar, compiled once per
+        (mode, spec) and memoised — None when disabled or the spec can't
+        be bounded (the request then runs the per-step substitution
+        path).  Compile failures memoise as None too, so a hard spec
+        doesn't pay the failed walk on every admission.  The memo evicts
+        FIFO one entry at a time (with its device tables), so a
+        grammar-heavy workload never wipes every hot grammar at once."""
+        if not self.config.guided_fsm:
+            return None
+        key = (params.guided, params.guided_schema)
+        if key in self._fsm_cache:
+            return self._fsm_cache[key]
+        from tpuserve.runtime.grammar import (FsmCompileError, fsm_for_spec,
+                                              token_text_table)
+        if self._fsm_texts is None:
+            # token id -> standalone text depends only on the tokenizer:
+            # computed ONCE per engine, not per grammar (a production
+            # vocab makes this loop the dominant fixed compile cost)
+            self._fsm_texts = token_text_table(self.tokenizer,
+                                               self.model_cfg.vocab_size)
+        try:
+            fsm = fsm_for_spec(params.guided, params.guided_schema,
+                               self.tokenizer, self.model_cfg.vocab_size,
+                               self._eos_ids, texts=self._fsm_texts)
+        except (FsmCompileError, ValueError) as e:
+            logger.info("guided spec not FSM-compilable (%s); using the "
+                        "per-step substitution path", e)
+            fsm = None
+        if len(self._fsm_cache) >= self.MAX_FSM_CACHE:
+            old = self._fsm_cache.pop(next(iter(self._fsm_cache)))
+            if old is not None:
+                self._fsm_device.pop(id(old), None)
+        self._fsm_cache[key] = fsm
+        return fsm
+
+    def _fsm_device_tables(self, fsm):
+        """Device-resident (masks, tok_class, class_next) for ``fsm``,
+        uploaded once per grammar and padded to power-of-2 state/class
+        buckets so repeat window dispatches over same-sized grammars
+        share one executable.  Each entry keeps a STRONG reference to
+        its fsm: while the entry lives, ``id(fsm)`` cannot be recycled
+        onto a new grammar and served these tables by accident.  The
+        table cache is FIFO-bounded like the compile memo; an in-flight
+        request whose entry gets evicted just re-uploads next window."""
+        ent = self._fsm_device.get(id(fsm))
+        if ent is None:
+            n, vw = fsm.masks.shape
+            c = fsm.class_next.shape[1]
+            np_, cp = next_power_of_2(n), next_power_of_2(c)
+            masks = np.zeros((np_, vw), np.uint32)
+            masks[:n] = fsm.masks
+            nxt = np.full((np_, cp), -1, np.int32)
+            nxt[:n, :c] = fsm.class_next
+            if len(self._fsm_device) >= self.MAX_FSM_CACHE:
+                self._fsm_device.pop(next(iter(self._fsm_device)))
+            ent = (fsm, jnp.asarray(masks), jnp.asarray(fsm.tok_class),
+                   jnp.asarray(nxt))
+            self._fsm_device[id(fsm)] = ent
+        return ent[1:]
+
+    def _apply_fsm_mask(self, logits: jnp.ndarray, reqs: list[Request],
+                        B: int) -> jnp.ndarray:
+        """Per-step grammar-FSM logit masking: gather each FSM row's
+        packed allow bitmask by its host-tracked state and drop illegal
+        tokens before sampling.  This is the S=1 reference semantics the
+        fused window reproduces on device — applied after penalties /
+        bias / min_tokens, like window_guided_mask in the scan."""
+        vw = (self.model_cfg.vocab_size + 31) // 32
+        packed = np.zeros((B, vw), np.uint32)
+        enabled = np.zeros((B,), bool)
+        for i, r in enumerate(reqs):
+            ent = self._guided_fsm.get(r.request_id)
+            if ent is not None:
+                packed[i] = ent[0].mask_row(ent[1])
+                enabled[i] = True
+        return sampling_ops.apply_token_mask(
+            logits, jnp.asarray(packed), jnp.asarray(enabled))
 
     def _apply_guided(self, logits: jnp.ndarray, toks_np: np.ndarray,
                       reqs: list[Request]) -> np.ndarray:
@@ -1920,6 +2111,26 @@ class Engine:
         else:
             req.output_text += delta
         if req.params.guided is not None:
+            ent = self._guided_fsm.get(req.request_id)
+            if ent is not None:
+                # grammar-FSM path: advance the host mirror state by the
+                # TOKEN through the same table the device window used —
+                # host and device cannot drift.  EOS finishes via
+                # check_stop below, keeping the legacy finish_reason.
+                fsm, gs = ent
+                ns = fsm.advance(gs, tok)
+                if ns < 0:
+                    # off-grammar token (only possible if masking was
+                    # bypassed): drop the constraint rather than keep
+                    # validating against a corrupt state
+                    self._guided_fsm.pop(req.request_id, None)
+                else:
+                    ent[1] = ns
+                    if (fsm.complete[ns] and reason is None
+                            and tok not in self._eos_ids):
+                        # grammar closed (JSON root / inextensible match):
+                        # stop like OpenAI json mode does
+                        reason = FinishReason.STOP
             st = self._guided.get(req.request_id)
             if st is not None:
                 if raw_delta:
@@ -1954,6 +2165,7 @@ class Engine:
             self.stats.requests_finished += 1
             self._detok.pop(req.request_id, None)
             self._guided.pop(req.request_id, None)
+            self._guided_fsm.pop(req.request_id, None)
             self._guided_plan.pop(req.request_id, None)
         return RequestOutput(
             request_id=req.request_id, new_token_ids=[tok], new_text=delta,

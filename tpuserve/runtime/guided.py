@@ -72,6 +72,14 @@ class JsonStateMachine:
     def complete(self) -> bool:
         return self.mode == "done"
 
+    def state_key(self):
+        """Hashable state identity: two machines with equal keys accept
+        identical futures.  Consumed by the grammar-FSM determinizer
+        (runtime/grammar/compile.py), which dedupes walked clones on it —
+        every field that influences a future transition must appear."""
+        return (tuple(self.stack), self.mode, self.esc, self.uni,
+                self.num, self.lit, self.ws_run)
+
     @property
     def can_finish(self) -> bool:
         """EOS is legal here (the engine's _guided_pick gate).  For JSON
@@ -565,6 +573,26 @@ class SchemaJsonStateMachine(JsonStateMachine):
         if self.mode == "string":
             return self.enum_cands is None
         return False
+
+    def state_key(self):
+        """Schema-aware state identity (see JsonStateMachine.state_key).
+        Schema nodes are keyed by ``id()`` — sound because every machine
+        a grammar-FSM compile walks shares ONE compiled tree (the
+        factory in runtime/grammar/compile.py builds it once).  Falsy
+        val_schema ({} or None) collapses to 0: both mean
+        "unconstrained" to every hook, and ``node.get(...) or {}`` sites
+        mint fresh empty dicts whose ids would otherwise explode the
+        state count."""
+        frames = tuple(
+            (f["kind"], id(f["node"]),
+             frozenset(f["seen"]) if "seen" in f else f["count"],
+             f.get("key"))
+            for f in self.frames)
+        return (super().state_key(), frames,
+                id(self.val_schema) if self.val_schema else 0,
+                self.val_text, self.val_kind,
+                tuple(self.enum_cands)
+                if self.enum_cands is not None else None)
 
     def clone(self):
         c = SchemaJsonStateMachine.__new__(SchemaJsonStateMachine)

@@ -114,6 +114,14 @@ class ChoiceStateMachine:
             return False
         return True
 
+    def state_key(self):
+        """Hashable state identity for the grammar-FSM determinizer
+        (runtime/grammar/compile.py): the multiset of REMAINING suffixes,
+        not (pos, viable) — states that accept the same futures merge
+        even when reached at different depths (shared choice tails)."""
+        return tuple(sorted(self.choices[i][self.pos:]
+                            for i in self.viable))
+
     def viable_suffixes(self) -> list[str]:
         """Remaining text of every still-viable choice, shortest first —
         the engine's escape hatch when token-level substitution can't

@@ -420,6 +420,15 @@ class RegexStateMachine:
         c.states = self.states
         return c
 
+    def state_key(self):
+        """Hashable state identity for the grammar-FSM determinizer
+        (runtime/grammar/compile.py): the NFA state SET itself — the
+        textbook subset construction, reusing the Thompson NFA as-is.
+        _State hashes by identity and every machine over one
+        CompiledRegex shares the same state objects, so equal sets mean
+        equal futures."""
+        return self.states
+
     @property
     def can_finish(self) -> bool:
         return any(s.accept for s in self.states)

@@ -61,8 +61,10 @@ class SamplingParams:
     truncate_prompt_tokens: Optional[int] = None
     # Structured output (OpenAI response_format): "json" constrains
     # generation to one valid JSON object, "json_schema" additionally to
-    # ``guided_schema`` — both via per-step candidate validation
-    # (runtime/guided.py); runs on the single-step decode path
+    # ``guided_schema``.  Grammar-FSM-compilable specs run as true logit
+    # masks inside fused multi-step windows (runtime/grammar/); specs the
+    # compiler can't bound fall back to per-step candidate validation
+    # (runtime/guided.py) on the single-step decode path
     guided: Optional[str] = None
     # canonical JSON text of the compiled schema ("json_schema" mode);
     # kept as text so SamplingParams stays hash/replace-friendly

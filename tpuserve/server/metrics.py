@@ -108,6 +108,17 @@ class ServerMetrics:
             "grammatically invalid and a structural fallback token was "
             "substituted — the signal that the constraint is fighting "
             "the model (runtime/engine.py _guided_pick)")
+        self.guided_fsm_requests = counter(
+            "tpuserve_guided_fsm_requests",
+            "Guided requests served by compiled grammar-FSM logit masks "
+            "(runtime/grammar/) — the distribution-correct path that "
+            "rides fused windows; guided traffic NOT counted here ran "
+            "the per-step substitution fallback")
+        self.guided_fsm_windows = counter(
+            "tpuserve_guided_fsm_windows",
+            "Fused multi-step windows that carried grammar-FSM masks — "
+            "zero under guided load means constraints are pinning "
+            "decode to per-step dispatches")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -307,7 +307,11 @@ class AsyncEngineRunner:
                                  ("latency_windows",
                                   self.metrics.latency_windows),
                                  ("guided_fallbacks",
-                                  self.metrics.guided_fallbacks)):
+                                  self.metrics.guided_fallbacks),
+                                 ("guided_fsm_requests",
+                                  self.metrics.guided_fsm_requests),
+                                 ("guided_fsm_windows",
+                                  self.metrics.guided_fsm_windows)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
 
