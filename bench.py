@@ -280,7 +280,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   pipeline=None, spec_k=0, disagg=False,
                   prefix_caching=False, multi_step=None, quantization=None,
                   prefill_split=1, kv_quant=None, interleave=False,
-                  adaptive_window=True, block_size=32):
+                  adaptive_window=True, block_size=32, mixed=False,
+                  mixed_budget=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -303,7 +304,10 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                             max_prefill_tokens=max(
                                 8192 // max(1, prefill_split),
                                 seqs_per_batch * prompt_len),
-                            interleave_batched_prefill=interleave)
+                            interleave_batched_prefill=interleave,
+                            mixed_batching=mixed,
+                            **({"mixed_token_budget": mixed_budget}
+                               if mixed_budget else {}))
     spec = None
     if spec_k:
         from tpuserve.runtime.spec import SpecConfig
@@ -404,7 +408,14 @@ def _warm(engine, batch, prompt_len, arrivals=False,
     --top-p) dispatches temperature/full windows, not greedy ones."""
     plan = _warm_plan_arrivals if arrivals else _warm_plan
     eng = getattr(engine, "prefill", engine)      # disagg: warm both halves
-    eng.warmup(sample_modes=modes, **plan(eng, batch, prompt_len))
+    kw = plan(eng, batch, prompt_len)
+    if eng.scheduler.cfg.mixed_batching:
+        # Engine.warmup auto-derives the mixed flat-token ladder AND the
+        # full decode ladder (staggered admission staggers finishes into
+        # partial tail buckets) when these are left unpinned — so drop
+        # the plan's single decode bucket and let the engine own it
+        kw.pop("decode_buckets", None)
+    eng.warmup(sample_modes=modes, **kw)
     if eng is not engine:
         engine.decode.warmup(sample_modes=modes,
                              **plan(engine.decode, batch, prompt_len))
@@ -444,6 +455,13 @@ def _run_workload(engine, prompts, params, arrival_offsets=None):
     t_start = time.perf_counter()
     t_start_mono = time.monotonic()
     prefill_time = decode_time = 0.0
+    # client-observed inter-token latency: wall gap between consecutive
+    # token emissions per stream (the p99 of this is what mixed batching
+    # exists to bound — strict prefill-priority stalls every stream for a
+    # whole admission burst).  A re-prefill after preemption resets the
+    # clock (its gap is queue+recompute, not ITL — RequestOutput doc).
+    last_tok: dict = {}
+    itls: list = []
     while True:
         if pending:
             now = time.perf_counter() - t_start
@@ -464,6 +482,15 @@ def _run_workload(engine, prompts, params, arrival_offsets=None):
         t0 = time.perf_counter()
         outs = engine.step()
         dt = time.perf_counter() - t0
+        t_emit = time.perf_counter()
+        for o in outs:
+            if o.from_prefill and o.num_output_tokens > 1:
+                last_tok[o.request_id] = t_emit      # re-prefill: reset
+                continue
+            prev = last_tok.get(o.request_id)
+            if prev is not None:
+                itls.append(t_emit - prev)
+            last_tok[o.request_id] = t_emit
         # A drain step that only flushes the last pipelined window runs no
         # NEW decode steps (d0 unchanged) but blocks on a full window of
         # decode compute — classify by what the step emitted, not just by
@@ -486,8 +513,125 @@ def _run_workload(engine, prompts, params, arrival_offsets=None):
     deltas = {k: getattr(stats, k) - v for k, v in before.items()}
     return {"total_s": total, "prefill_s": prefill_time,
             "decode_s": decode_time, "gen_tokens": gen,
-            "ttfts_ms": ttfts_ms, "stats": stats, "pstats": pstats,
+            "ttfts_ms": ttfts_ms,
+            "itls_ms": sorted(1000.0 * x for x in itls),
+            "stats": stats, "pstats": pstats,
             **deltas}
+
+
+def _pct(sorted_ms, q):
+    if not sorted_ms:
+        return 0.0
+    return sorted_ms[min(len(sorted_ms) - 1, int(len(sorted_ms) * q))]
+
+
+def _compare_mixed(args, model, batch, prompt_len, gen_len, on_tpu, *,
+                   attn_impl, pipeline, vocab, warm_modes):
+    """A/B: phase-split vs mixed ragged batching (ISSUE 3 acceptance).
+
+    Rows sweep the prefill:decode ratio under the SAME fixed-seed Poisson
+    arrival sample path, reporting client-observed p50/p99 inter-token
+    latency — the quantity strict prefill-priority lets admission bursts
+    blow up and mixed batching bounds at one step.  A pure-decode burst
+    row guards the trade: with no admissible prefill, mixed mode must
+    fall through to the plain decode path (fused windows intact), so its
+    throughput must stay ~1.0x of phase-split."""
+    import numpy as np
+
+    from tpuserve.runtime.request import SamplingParams
+
+    # ratio sweep shapes scale with the main workload's sizes; arrivals
+    # must keep landing while early streams decode (sustained admission),
+    # so the CPU rate is far higher than the TPU default — tiny-model CPU
+    # steps are ~5 ms, and an arrival every 60 ms would never contend
+    rate = args.arrival_rate if on_tpu else max(args.arrival_rate, 150.0)
+    n_req = batch if on_tpu else max(batch, 32)
+    budget = args.mixed_budget or 256
+    ratios = [(prompt_len * 2, max(gen_len // 2, 4)),
+              (prompt_len, gen_len),
+              (max(prompt_len // 2, 4), gen_len * 2)]
+    rows = []
+
+    def run_one(mixed, prompts, params, offsets, pl_, repeat=1):
+        eng = _build_engine(model, n_req, pl_, params.max_tokens,
+                            attn_impl=attn_impl, pipeline=pipeline,
+                            multi_step=args.multi_step,
+                            quantization=args.quant,
+                            kv_quant=args.kv_quant,
+                            block_size=args.block_size, mixed=mixed,
+                            mixed_budget=budget)
+        _warm(eng, n_req, pl_, arrivals=offsets is not None,
+              modes=warm_modes)
+        runs = [_run_workload(eng, prompts, params,
+                              arrival_offsets=offsets)
+                for _ in range(repeat)]
+
+        def _rate(x):
+            return ((x["gen_tokens"] - len(prompts)) / x["decode_s"]
+                    if x["decode_s"] else 0.0)
+
+        r = sorted(runs, key=_rate)[len(runs) // 2]
+        return {
+            "p50_itl_ms": round(_pct(r["itls_ms"], 0.50), 2),
+            "p99_itl_ms": round(_pct(r["itls_ms"], 0.99), 2),
+            "decode_tok_s": round(_rate(r), 1),
+            "e2e_tok_s": round(r["gen_tokens"] / r["total_s"], 1),
+            "ttft_p50_ms": round(_pct(r["ttfts_ms"], 0.50), 1),
+            "padding_efficiency": round(
+                eng.stats.actual_tokens_total
+                / max(eng.stats.padded_tokens_total, 1), 3),
+            "mixed_steps": eng.stats.num_mixed_steps,
+        }
+
+    for pl_, gl_ in ratios:
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(1, vocab - 1, size=pl_).tolist()
+                   for _ in range(n_req)]
+        offsets = np.cumsum(np.random.default_rng(7).exponential(
+            1.0 / rate, size=n_req)).tolist()
+        params = SamplingParams(max_tokens=gl_, temperature=0.0, seed=0,
+                                ignore_eos=True)
+        base = run_one(False, prompts, params, offsets, pl_)
+        mix = run_one(True, prompts, params, offsets, pl_)
+        rows.append({
+            "prompt_len": pl_, "gen_len": gl_,
+            "phase_split": base, "mixed": mix,
+            "p99_itl_improvement": round(
+                base["p99_itl_ms"] / mix["p99_itl_ms"], 2)
+                if mix["p99_itl_ms"] else 0.0,
+        })
+
+    # pure-decode guard: short-prompt burst + long generation, so
+    # admission is over within a step or two and >95% of decode-
+    # classified time is TRUE decode steps for both engines (with no
+    # admissible prefill, mixed mode falls through to the plain decode
+    # path — fused windows and all).  A long-prompt burst would instead
+    # measure mixed ADMISSION against batched prefill: mixed admission
+    # steps carry decode rows, get classified as decode time, and would
+    # masquerade as a decode regression.  Median-of-5: shared-host CPU
+    # step-time noise is ~±7%, well above the ~2% structural cost
+    # (mixed's budget-staggered admission staggers finishes, adding a
+    # couple of partial-bucket tail steps).
+    pl_p, gl_p = min(prompt_len, 16), max(2 * gen_len, 128)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, vocab - 1, size=pl_p).tolist()
+               for _ in range(n_req)]
+    params = SamplingParams(max_tokens=gl_p, temperature=0.0, seed=0,
+                            ignore_eos=True)
+    base = run_one(False, prompts, params, None, pl_p, repeat=5)
+    mix = run_one(True, prompts, params, None, pl_p, repeat=5)
+    return {
+        "arrival_rate_req_s": rate,
+        "num_requests": n_req,
+        "mixed_token_budget": budget,
+        "rows": rows,
+        "pure_decode": {
+            "phase_split_tok_s": base["decode_tok_s"],
+            "mixed_tok_s": mix["decode_tok_s"],
+            "ratio": round(mix["decode_tok_s"]
+                           / max(base["decode_tok_s"], 1e-9), 3),
+        },
+    }
 
 
 V5E_HBM_GBS = 819.0   # v5e HBM bandwidth (BENCHMARKS.md roofline analysis)
@@ -614,6 +758,24 @@ def main(argv=None):
     ap.add_argument("--compare-disagg", action="store_true",
                     help="also measure the disaggregated prefill/decode "
                          "engine on the same workload")
+    ap.add_argument("--mixed", action="store_true",
+                    help="ragged mixed prefill+decode batching "
+                         "(SchedulerConfig.mixed_batching): every step "
+                         "with admissible prefill work runs ONE flat-"
+                         "token dispatch carrying all decode rows plus "
+                         "prefill-chunk tokens — no phase split")
+    ap.add_argument("--mixed-budget", type=int, default=None, metavar="N",
+                    help="mixed-mode flat-token budget per step (Sarathi "
+                         "chunk sizing; default: SchedulerConfig's 512 "
+                         "for --mixed, 256 for the --compare-mixed A/B "
+                         "engines — the p50-ITL vs admission-latency "
+                         "knob)")
+    ap.add_argument("--compare-mixed", action="store_true",
+                    help="A/B phase-split vs mixed ragged batching under "
+                         "Poisson arrivals across a prefill:decode ratio "
+                         "sweep (p50/p99 client-observed ITL), plus a "
+                         "pure-decode burst throughput guard; adds a "
+                         "'mixed_ab' sub-object")
     def _positive(v):
         v = int(v)
         if v < 1:
@@ -733,7 +895,8 @@ def main(argv=None):
                            kv_quant=args.kv_quant,
                            interleave=args.interleave_prefill,
                            adaptive_window=not args.no_adaptive_window,
-                           block_size=args.block_size)
+                           block_size=args.block_size, mixed=args.mixed,
+                           mixed_budget=args.mixed_budget)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -863,6 +1026,7 @@ def main(argv=None):
         "host_rtt_ms": round(host_rtt_ms, 2),
         "runs_tok_s": runs_tok_s,
         "compile_cache": "warm" if cache_entries_before else "cold",
+        "scheduler": "mixed" if args.mixed else "phase_split",
         "commit": _git_commit(),
         "roofline": _roofline(
             eng0, batch, prompt_len, gen_len,
@@ -906,6 +1070,12 @@ def main(argv=None):
                 decode_tokens / r["num_decode_steps"], 2)
                           if r["num_decode_steps"] else 0.0,
         }
+    if args.compare_mixed:
+        with tpu_guard("mixed comparison"):
+            out["mixed_ab"] = _compare_mixed(
+                args, model, batch, prompt_len, gen_len, on_tpu,
+                attn_impl=attn_impl, pipeline=pipeline, vocab=vocab,
+                warm_modes=warm_modes)
     if args.compare_disagg:
         with tpu_guard("disagg comparison"):
             d_engine = _build_engine(model, batch, prompt_len, gen_len,

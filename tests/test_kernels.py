@@ -384,3 +384,182 @@ def test_paged_window_sliding_window(W):
     for b in range(B):
         n = int(chunk[b])
         np.testing.assert_allclose(o[b, :n], r[b, :n], atol=2e-5)
+
+
+# --------------------------------------------------------------------------
+# Ragged mixed prefill+decode kernel (ops/pallas_ragged_attention.py):
+# tier-1 interpret-mode parity so the mixed path gates without a chip.
+# --------------------------------------------------------------------------
+
+def _ragged_case(rng, n_dec, chunk_shapes, blk, Hq=4, Hkv=2, D=16, page=4,
+                 nb=64, mp=8, int8=False, max_kv=None):
+    """Build a mixed flat layout (decode rows first, blk-aligned prefill
+    chunks) + descriptors, the way engine._run_mixed packs them.  Returns
+    everything both the kernel and the reference need, plus the valid-row
+    mask (padding rows are unspecified by contract)."""
+    from tpuserve.ops.attention import quantize_kv
+    max_kv = max_kv or page * mp
+    kc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((nb, page, Hkv, D)), jnp.float32)
+    scales = {}
+    if int8:
+        kc, ks = quantize_kv(kc)
+        vc, vs = quantize_kv(vc)
+        scales = dict(k_scale=ks, v_scale=vs)
+    kv_dec = rng.integers(1, max_kv + 1, size=n_dec)
+    B = n_dec + len(chunk_shapes)
+    starts, cursor = [], -(-n_dec // blk) * blk if n_dec else 0
+    for ql, _ in chunk_shapes:
+        starts.append(cursor)
+        cursor += -(-ql // blk) * blk
+    T = max(-(-max(cursor, 1) // blk) * blk, blk)
+    bt = jnp.asarray(rng.integers(0, nb, (max(B, 1), mp)), jnp.int32)
+    kv_lens = np.zeros((max(B, 1),), np.int32)
+    q_starts = np.full((max(B, 1),), T, np.int32)
+    q_lens = np.zeros((max(B, 1),), np.int32)
+    row_seq = np.zeros((T,), np.int32)
+    row_pos = np.zeros((T,), np.int32)
+    valid = np.zeros((T,), bool)
+    for i in range(n_dec):
+        kv_lens[i] = kv_dec[i]
+        q_starts[i] = i
+        q_lens[i] = 1
+        row_seq[i] = i
+        row_pos[i] = kv_dec[i] - 1
+        valid[i] = True
+    blk_seq = np.full((T // blk,), -1, np.int32)
+    for si, ((ql, kl), st) in enumerate(zip(chunk_shapes, starts),
+                                        start=n_dec):
+        kv_lens[si] = kl
+        q_starts[si] = st
+        q_lens[si] = ql
+        row_seq[st:st + ql] = si
+        row_pos[st:st + ql] = kl - ql + np.arange(ql)
+        valid[st:st + ql] = True
+        blk_seq[st // blk:(st + -(-ql // blk) * blk) // blk] = si
+    q = jnp.asarray(rng.standard_normal((T, Hq, D)), jnp.float32)
+    meta = jnp.asarray([n_dec, -(-n_dec // blk) if n_dec else 0], jnp.int32)
+    return dict(q=q, kc=kc, vc=vc, bt=bt, kv_lens=jnp.asarray(kv_lens),
+                q_starts=jnp.asarray(q_starts), q_lens=jnp.asarray(q_lens),
+                meta=meta, blk_seq=jnp.asarray(blk_seq),
+                row_seq=row_seq, row_pos=row_pos, valid=valid,
+                scale=D ** -0.5, scales=scales)
+
+
+def _ragged_ref(c, sliding_window=None):
+    kw = dict(c["scales"])
+    if sliding_window is not None:
+        kw["sliding_window"] = sliding_window
+    return ref_ops.ragged_attention(
+        c["q"], c["kc"], c["vc"],
+        c["bt"][np.clip(c["row_seq"], 0, c["bt"].shape[0] - 1)],
+        jnp.asarray(c["row_pos"] + 1), c["scale"], seg_size=8, **kw)
+
+
+def _ragged_out(c, blk, ppg=2, sliding_window=None):
+    from tpuserve.ops.pallas_ragged_attention import ragged_paged_attention
+    kw = dict(c["scales"])
+    if sliding_window is not None:
+        kw["sliding_window"] = sliding_window
+    return ragged_paged_attention(
+        c["q"], c["kc"], c["vc"], c["bt"], c["kv_lens"], c["q_starts"],
+        c["q_lens"], c["meta"], c["blk_seq"], c["scale"], interpret=True,
+        blk_q=blk, pages_per_group=ppg, **kw)
+
+
+@pytest.mark.parametrize("n_dec,chunks,blk", [
+    (3, [(5, 9), (12, 12)], 8),      # mixed: decode rows + two chunks
+    (8, [], 4),                      # pure decode, exact block multiple
+    (0, [(13, 20)], 8),              # pure prefill, deep cached context
+    (5, [(7, 7)], 4),                # fresh prompt chunk (ctx 0)
+])
+def test_ragged_kernel_matches_reference(n_dec, chunks, blk):
+    rng = np.random.default_rng(n_dec * 31 + len(chunks))
+    c = _ragged_case(rng, n_dec, chunks, blk)
+    ref = _ragged_ref(c)
+    out = _ragged_out(c, blk)
+    np.testing.assert_allclose(np.asarray(out)[c["valid"]],
+                               np.asarray(ref)[c["valid"]], atol=2e-5)
+
+
+def test_ragged_kernel_matches_phase_split_kernels():
+    """The fused kernel must agree with the two kernels it replaces,
+    composed: paged decode over the decode rows, the chunked-prefill
+    window kernel over each chunk."""
+    from tpuserve.ops.pallas_chunked_prefill import paged_window_attention
+    rng = np.random.default_rng(77)
+    n_dec, chunks, blk = 3, [(6, 14), (9, 9)], 8
+    c = _ragged_case(rng, n_dec, chunks, blk)
+    out = np.asarray(_ragged_out(c, blk))
+    dec = paged_decode_attention(c["q"][:n_dec], c["kc"], c["vc"],
+                                 c["bt"][:n_dec], c["kv_lens"][:n_dec],
+                                 c["scale"], interpret=True)
+    np.testing.assert_allclose(out[:n_dec], np.asarray(dec), atol=2e-5)
+    si = n_dec
+    for ql, kl in chunks:
+        st = int(c["q_starts"][si])
+        win = paged_window_attention(
+            c["q"][None, st:st + ql], c["kc"], c["vc"], c["bt"][si:si + 1],
+            jnp.asarray([kl - ql], jnp.int32), jnp.asarray([ql], jnp.int32),
+            c["scale"], interpret=True, blk_q=blk)
+        np.testing.assert_allclose(out[st:st + ql], np.asarray(win[0]),
+                                   atol=2e-5)
+        si += 1
+
+
+def test_ragged_kernel_multi_group():
+    """Page-group online-softmax accumulation in both kernel parts
+    (pages_per_group=1 forces many groups per sequence)."""
+    rng = np.random.default_rng(91)
+    c = _ragged_case(rng, 4, [(10, 26)], 8, page=4, mp=8)
+    ref = _ragged_ref(c)
+    out = _ragged_out(c, 8, ppg=1)
+    np.testing.assert_allclose(np.asarray(out)[c["valid"]],
+                               np.asarray(ref)[c["valid"]], atol=2e-5)
+
+
+def test_ragged_kernel_int8():
+    """int8 KV: pages DMA as int8 with per-page scale blocks, dequantized
+    in VMEM — both the decode and prefill parts."""
+    rng = np.random.default_rng(101)
+    c = _ragged_case(rng, 3, [(6, 11)], 8, D=128, page=8, int8=True)
+    ref = _ragged_ref(c)
+    out = _ragged_out(c, 8)
+    np.testing.assert_allclose(np.asarray(out)[c["valid"]],
+                               np.asarray(ref)[c["valid"]], atol=2e-5)
+
+
+@pytest.mark.parametrize("W", [5, 16])
+def test_ragged_kernel_sliding_window(W):
+    """Sliding-window page-skip carries over: decode rows skip pages
+    before their window, prefill rows mask per-row."""
+    rng = np.random.default_rng(W * 7)
+    c = _ragged_case(rng, 4, [(7, 25)], 8, page=4, mp=12, max_kv=40)
+    ref = _ragged_ref(c, sliding_window=W)
+    out = _ragged_out(c, 8, sliding_window=W)
+    np.testing.assert_allclose(np.asarray(out)[c["valid"]],
+                               np.asarray(ref)[c["valid"]], atol=2e-5)
+
+
+def test_ragged_reference_degenerates_to_phase_split_refs():
+    """ops/attention.ragged_attention == paged_decode_attention on
+    decode rows and chunked_prefill_attention on chunk rows — the
+    semantic spec of the mixed path."""
+    rng = np.random.default_rng(7)
+    n_dec, chunks, blk = 3, [(5, 9), (12, 12)], 8
+    c = _ragged_case(rng, n_dec, chunks, blk)
+    ref = np.asarray(_ragged_ref(c))
+    dec = ref_ops.paged_decode_attention(
+        c["q"][:n_dec], c["kc"], c["vc"], c["bt"][:n_dec],
+        c["kv_lens"][:n_dec], c["scale"])
+    np.testing.assert_allclose(ref[:n_dec], np.asarray(dec), atol=2e-5)
+    si = n_dec
+    for ql, kl in chunks:
+        st = int(c["q_starts"][si])
+        ck = ref_ops.chunked_prefill_attention(
+            c["q"][None, st:st + ql], c["kc"], c["vc"], c["bt"][si:si + 1],
+            jnp.asarray([kl - ql], jnp.int32), jnp.asarray([ql], jnp.int32),
+            c["scale"])
+        np.testing.assert_allclose(ref[st:st + ql], np.asarray(ck[0]),
+                                   atol=2e-5)
+        si += 1

@@ -217,3 +217,165 @@ def test_admission_backpressure_cap():
     # auto default: 4x max_num_seqs; negative disables
     assert SchedulerConfig(max_num_seqs=8).resolve_max_waiting() == 32
     assert SchedulerConfig(max_waiting=-1).resolve_max_waiting() >= 1 << 29
+
+
+# --------------------------------------------------------------------------
+# Mixed ragged batching (SchedulerConfig.mixed_batching)
+# --------------------------------------------------------------------------
+
+def mk_mixed(**kw):
+    cfg = SchedulerConfig(**{**dict(max_num_seqs=8, mixed_batching=True,
+                                    mixed_token_budget=16,
+                                    min_decode_bucket=2), **kw})
+    bm = BlockManager(num_blocks=128, block_size=4,
+                      enable_prefix_caching=False)
+    return Scheduler(cfg, bm, max_model_len=256), bm
+
+
+def _drive_mixed(sched, bm, batch):
+    """Engine-side transitions for a mixed batch: allocate first chunks,
+    advance prefill progress, requeue continuations / promote finishers."""
+    for req, n in batch.prefill_chunks:
+        if req.num_prefilled == 0:
+            bm.allocate(req.request_id, req.prompt_token_ids)
+        req.num_prefilled += n
+        if req.num_prefilled < req.num_tokens:
+            sched.waiting.appendleft(req)
+        else:
+            sched.mark_running([req])
+
+
+def test_mixed_includes_all_decode_rows():
+    sched, bm = mk_mixed()
+    running = mk_req("r", 4)
+    bm.allocate("r", running.prompt_token_ids)
+    sched.mark_running([running])
+    sched.add(mk_req("w", 6))
+    batch = sched.schedule()
+    assert batch.kind == "mixed"
+    assert batch.requests == [running]            # decode row rides
+    assert [(r.request_id, n) for r, n in batch.prefill_chunks] == [("w", 6)]
+
+
+def test_mixed_budget_chunks_long_prompt():
+    """A prompt longer than the budget runs as budget-sized chunks over
+    several mixed steps; decode rows ride every one of them."""
+    sched, bm = mk_mixed(mixed_token_budget=8)
+    running = mk_req("r", 4)
+    bm.allocate("r", running.prompt_token_ids)
+    sched.mark_running([running])
+    sched.add(mk_req("long", 20))
+    takes = []
+    for _ in range(3):
+        batch = sched.schedule()
+        assert batch.kind == "mixed" and batch.requests == [running]
+        takes.append(batch.prefill_chunks[0][1])
+        _drive_mixed(sched, bm, batch)
+    assert takes == [7, 7, 6]        # budget 8 minus the decode row, tail
+    # prompt fully admitted: the next cycle is a plain (fused-window-
+    # capable) decode step over both streams
+    assert sched.schedule().kind == "decode"
+
+
+def test_mixed_falls_back_to_decode_when_no_prefill():
+    sched, bm = mk_mixed()
+    r = mk_req("r", 4)
+    bm.allocate("r", r.prompt_token_ids)
+    sched.mark_running([r])
+    batch = sched.schedule()
+    assert batch.kind == "decode"     # fused windows / spec keep working
+
+
+def test_mixed_respects_seats_and_blocks():
+    sched, bm = mk_mixed(max_num_seqs=2, mixed_token_budget=64)
+    a, b = mk_req("a", 4), mk_req("b", 4)
+    for r in (a, b):
+        bm.allocate(r.request_id, r.prompt_token_ids)
+    sched.mark_running([a, b])
+    sched.add(mk_req("c", 4))
+    batch = sched.schedule()
+    assert batch.kind == "decode"     # no seat for c yet
+    sched.finish(a)
+    batch = sched.schedule()
+    assert batch.kind == "mixed"
+    assert [r.request_id for r, _ in batch.prefill_chunks] == ["c"]
+
+
+def test_mixed_continuation_resumes_from_any_queue_position():
+    """A preemption victim appendlefted ahead of a mid-prefill request
+    must not starve it (same livelock rule as _schedule_prefill)."""
+    sched, bm = mk_mixed(mixed_token_budget=8)
+    sched.add(mk_req("long", 20))
+    batch = sched.schedule()
+    _drive_mixed(sched, bm, batch)                # long is now mid-prefill
+    sched.waiting.appendleft(mk_req("victim", 4))
+    batch = sched.schedule()
+    assert batch.kind == "mixed"
+    ids = [r.request_id for r, _ in batch.prefill_chunks]
+    assert ids[0] == "long"           # continuation admitted first
+
+
+def test_no_stream_starves_under_sustained_admission():
+    """Fairness property (the reason mixed batching exists): under
+    sustained admission, no running stream goes more than N scheduler
+    cycles without a decode token.  Strict prefill-priority with
+    interleave off violates any bound — each cycle admits the newest
+    arrival instead of decoding; mixed mode serves the decode row every
+    cycle (gap 1)."""
+    N = 3
+
+    def max_decode_gap(cfg_kw):
+        bm = BlockManager(num_blocks=512, block_size=4,
+                          enable_prefix_caching=False)
+        sched = Scheduler(SchedulerConfig(
+            max_num_seqs=64, max_prefill_seqs=1, min_prefill_bucket=4,
+            **cfg_kw), bm, max_model_len=256)
+        stream = mk_req("stream", 4)
+        bm.allocate("stream", stream.prompt_token_ids)
+        sched.mark_running([stream])
+        gap = worst = 0
+        for i in range(24):
+            sched.add(mk_req(f"new{i}", 6))       # sustained arrivals
+            batch = sched.schedule()
+            assert batch is not None
+            decoded = (batch.kind == "decode"
+                       or (batch.kind == "mixed" and stream in batch.requests))
+            gap = 0 if decoded else gap + 1
+            worst = max(worst, gap)
+            if batch.kind == "prefill":
+                for r in batch.requests:
+                    bm.allocate(r.request_id, r.prompt_token_ids)
+                sched.mark_running(batch.requests)
+            elif batch.kind == "mixed":
+                _drive_mixed(sched, bm, batch)
+        return worst
+
+    assert max_decode_gap(dict(interleave_batched_prefill=False)) > N
+    assert max_decode_gap(dict(mixed_batching=True,
+                               mixed_token_budget=32)) <= 1
+
+
+def test_mixed_budget_charges_aligned_rows():
+    """With a ragged alignment (the engine passes its kernel block), the
+    budget charges each chunk's PADDED row span — a burst of tiny
+    prompts must not blow the flat-token bucket past the warmed ladder
+    (review finding: 64 six-token prompts at align 128 would have packed
+    an 8192-row dispatch against a 512-token budget)."""
+    cfg = SchedulerConfig(max_num_seqs=16, mixed_batching=True,
+                          mixed_token_budget=32)
+    bm = BlockManager(num_blocks=128, block_size=4,
+                      enable_prefix_caching=False)
+    sched = Scheduler(cfg, bm, max_model_len=256, ragged_align=8)
+    for i in range(10):
+        sched.add(mk_req(f"t{i}", 3))          # 3 tokens -> 8 aligned rows
+    batch = sched.schedule()
+    assert batch.kind == "mixed"
+    # 32-row budget / 8 aligned rows per tiny chunk = 4 admitted, not 10
+    assert len(batch.prefill_chunks) == 4
+    # engine layout: 4 chunks x 8 rows = 32 flat rows = exactly the budget
+    # decode-row region charges aligned too
+    r = mk_req("run", 4)
+    bm.allocate("run", r.prompt_token_ids)
+    sched.mark_running([r])                    # 1 decode row -> 8 rows
+    batch = sched.schedule()
+    assert len(batch.prefill_chunks) == 3      # (32 - 8) / 8

@@ -108,6 +108,14 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("sampled-top-p", ["--temperature", "0.8", "--top-p", "0.95"], {}),
     ("spec4", ["--spec", "4"], {}),
     ("disagg", ["--compare-disagg"], {}),
+    # Ragged mixed prefill+decode batching (scheduler mixed mode, the
+    # Pallas ragged kernel on chip): the headline-shape main line under
+    # mixed scheduling, the sustained-admission Poisson row, and the
+    # phase-split-vs-mixed A/B (p99 ITL ratio sweep + pure-decode guard)
+    ("mixed", ["--mixed"], {}),
+    ("mixed-poisson16", ["--mixed", "--arrival", "poisson",
+                         "--arrival-rate", "16"], {}),
+    ("compare-mixed", ["--compare-mixed"], {}),
     # Long-context path: prompts routed through chunked prefill (the
     # Pallas windowed kernel) — the framework's long-context story on
     # silicon, not just in interpret-mode tests
@@ -323,6 +331,12 @@ def format_row(r: dict) -> str:
     if "disagg" in r:
         notes.append(f"disagg={r['disagg']['decode_tok_s']} "
                      f"({r['disagg']['vs_colocated']}x)")
+    if "mixed_ab" in r:
+        ab = r["mixed_ab"]
+        improv = max((row.get("p99_itl_improvement", 0)
+                      for row in ab.get("rows", [])), default=0)
+        notes.append(f"p99-ITL up to {improv}x better mixed; "
+                     f"pure-decode {ab['pure_decode']['ratio']}x")
     return (f"| {r['variant']} | {r['backend']} | {r['value']} | "
             f"{r['vs_baseline']} | {r['ttft_ms']} | {r['attn_impl']} "
             f"| {r.get('multi_step')} | {r.get('quantization') or '-'}"

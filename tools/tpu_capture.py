@@ -64,6 +64,13 @@ PRIORITY = [
     # temperature / full top-p sampler vs the greedy headline)
     "sampled-temp", "sampled-top-p",
     "spec4", "disagg",
+    # Ragged mixed prefill+decode batching (NEW this round; CPU A/B in
+    # BENCHMARKS.md measured p99 ITL up to 33x better under Poisson
+    # mixed load with pure-decode parity — these rows answer whether the
+    # Pallas ragged kernel holds that on silicon): the A/B first (it
+    # carries both engines), then mixed mode under the headline shape
+    # and under sustained Poisson admission.
+    "compare-mixed", "mixed", "mixed-poisson16",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

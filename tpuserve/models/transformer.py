@@ -1049,6 +1049,132 @@ def decode_multi(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return res
 
 
+# --------------------------------------------------------------------------
+# Ragged mixed prefill+decode: one flat token stream, no phase split
+# --------------------------------------------------------------------------
+
+def _ragged_reference_attn(q, ck, cv, block_tables, row_seq, row_lens,
+                           blk_seq, meta, blk: int, scale, ks, vs, sw,
+                           softcap, scale_slices=None):
+    """Reference (non-Pallas) ragged attention for one mixed layer:
+
+    - prefill-chunk blocks take the BLOCK-gather path (one KV gather per
+      ``blk`` rows — attn_ops.ragged_blocked_attention; the gather is
+      what dominates a pure-JAX mixed step);
+    - decode rows (the first ``meta[0]`` rows, always within the first
+      ``max_num_seqs`` rows) are overlaid with the per-row DENSE paged
+      decode attention — the exact math of the phase-split decode trunk,
+      so decode-row logits are bit-identical between mixed and
+      phase-split (the seeded-sampling token-identity contract).
+    """
+    T = q.shape[0]
+    out = attn_ops.ragged_blocked_attention(
+        q, ck, cv, block_tables[jnp.clip(blk_seq, 0, None)], row_lens,
+        blk, scale, k_scale=ks, v_scale=vs, sliding_window=sw,
+        logit_softcap=softcap, scale_slices=scale_slices)
+    # static head slice: decode rows r < meta[0] are rows r themselves,
+    # and meta[0] <= max_num_seqs <= block_tables.shape[0]
+    Bc = min(block_tables.shape[0], T)
+    head = attn_ops.paged_decode_attention(
+        q[:Bc], ck, cv, block_tables[row_seq[:Bc]], row_lens[:Bc], scale,
+        k_scale=ks, v_scale=vs, sliding_window=sw, logit_softcap=softcap,
+        scale_slices=scale_slices)
+    head = jnp.pad(head, ((0, T - Bc), (0, 0), (0, 0)))
+    is_dec = (jnp.arange(T) < meta[0])[:, None, None]
+    return jnp.where(is_dec, head, out)
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "ragged_blk", "attn_impl"),
+         donate_argnames=("kv_cache",))
+def forward_ragged(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                   positions: jnp.ndarray, slot_ids: jnp.ndarray,
+                   row_seq: jnp.ndarray, block_tables: jnp.ndarray,
+                   kv_lens: jnp.ndarray, q_starts: jnp.ndarray,
+                   q_lens: jnp.ndarray, meta: jnp.ndarray,
+                   blk_seq: jnp.ndarray, last_rows: jnp.ndarray,
+                   kv_cache: list, ad: jnp.ndarray | None = None, *,
+                   ragged_blk: int = 8, attn_impl: str = "reference"):
+    """One MIXED prefill+decode step over a flat token stream.
+
+    The phase-split engine runs prefill batches and decode steps as
+    separate dispatches with separate (batch x length) padding grids;
+    this trunk serves decode rows (q_len 1) and prefill chunks (q_len
+    > 1) from ONE (T,) token stream in one dispatch ("Ragged Paged
+    Attention", PAPERS.md) — bucketing collapses to the single flat-token
+    dimension T.
+
+    tokens/positions/slot_ids/row_seq: (T,) — per-row token id, global
+    sequence position (drives per-row rope), flat cache slot (PAD_SLOT on
+    padding rows), owning-sequence index.  block_tables (B, max_blocks) /
+    kv_lens / q_starts / q_lens: (B,) per-sequence descriptors (kv_lens
+    INCLUDES this window's tokens); meta (2,) [num_decode_rows,
+    num_decode_blocks] and blk_seq (T // ragged_blk,) describe the Pallas
+    kernel's block layout (ops/pallas_ragged_attention.py — ignored on
+    the reference path); last_rows: (B,) flat row of each sequence's last
+    valid token, where the logits are taken (meaningful for decode rows
+    and for a prompt's final chunk — exactly the prefill_chunk contract).
+
+    Semantics per row are exactly the cache-relative window semantics:
+    each row's KV is written first, then the row attends its own
+    sequence's cached keys at positions ``<= position``.  Returns
+    (last_logits (B, V), kv_cache).
+    """
+    T = tokens.shape[0]
+    h = _embed(params, cfg, tokens, positions)                 # (T, H)
+    scale = cfg.attn_scale
+    row_lens = positions + 1
+    new_cache = []
+    for li, lp in enumerate(params["layers"]):
+        sw = cfg.layer_window(li)
+        hn = _norm(h, lp["attn_norm"], cfg)
+        if cfg.is_mla:
+            # MLA: absorbed attention against the latent pages, like the
+            # chunk/decode trunks (reference path only — the Pallas
+            # kernels assume materialised per-head pages, same gate as
+            # the rest of the engine)
+            q_nope, q_rope, latent = _mla_proj(hn, lp, cfg, positions, ad)
+            entry = attn_ops.write_mla_entry(
+                kv_cache[li], latent, slot_ids,
+                latent_split=cfg.mla_kv_lora_rank)
+            new_cache.append(entry)
+            q_eff = _mla_absorb_q(q_nope, q_rope, lp, cfg)
+            out = _ragged_reference_attn(
+                q_eff, entry["k"], entry["k"], block_tables, row_seq,
+                row_lens, blk_seq, meta, ragged_blk, scale,
+                entry.get("ks"), entry.get("ks"), None, None,
+                scale_slices=(cfg.mla_kv_lora_rank,
+                              cfg.mla_qk_rope_head_dim))
+            out = _mla_unabsorb(out, lp, cfg)
+            out = out.reshape(T, cfg.num_heads * cfg.mla_v_head_dim)
+            h = h + _attn_residual(out, lp, cfg, ad)
+            h = h + _mlp_residual(h, lp, cfg, ad)
+            continue
+        q, k, v = _qkv(hn, lp, cfg, positions, li, ad)    # (T, H*, D)
+        entry = attn_ops.write_kv_entry(kv_cache[li], k, v, slot_ids)
+        new_cache.append(entry)
+        ck, cv = entry["k"], entry["v"]
+        ks, vs = entry.get("ks"), entry.get("vs")
+        if attn_impl == "pallas":
+            from tpuserve.ops.pallas_ragged_attention import \
+                ragged_paged_attention
+            out = ragged_paged_attention(
+                q, ck, cv, block_tables, kv_lens, q_starts, q_lens,
+                meta, blk_seq, scale, blk_q=ragged_blk, k_scale=ks,
+                v_scale=vs, sliding_window=sw,
+                logit_softcap=cfg.attn_logit_softcapping)
+        else:
+            out = _ragged_reference_attn(
+                q, ck, cv, block_tables, row_seq, row_lens, blk_seq,
+                meta, ragged_blk, scale, ks, vs, sw,
+                cfg.attn_logit_softcapping)
+        out = out.reshape(T, cfg.q_size)
+        h = h + _attn_residual(out, lp, cfg, ad)
+        h = h + _mlp_residual(h, lp, cfg, ad)
+    h_sel = h[last_rows]                                       # (B, H)
+    return _unembed(params, cfg, h_sel), new_cache
+
+
 @partial(jax.jit, static_argnames=("cfg", "k"))
 def draft_propose(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   lens: jnp.ndarray, *, k: int):

@@ -128,6 +128,131 @@ def paged_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def ragged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, row_block_tables: jnp.ndarray,
+                     row_lens: jnp.ndarray, scale: float, *,
+                     seg_size: int = 512,
+                     k_scale: jnp.ndarray | None = None,
+                     v_scale: jnp.ndarray | None = None,
+                     sliding_window: int | None = None,
+                     logit_softcap: float | None = None,
+                     scale_slices: tuple[int, ...] | None = None
+                     ) -> jnp.ndarray:
+    """Flat-token ragged attention against the paged cache (reference).
+
+    One query row per FLAT token — decode rows and prefill-chunk rows
+    alike, no phase split and no (batch, length) padding grid: the mixed
+    scheduler packs everything into one (T,) stream ("Ragged Paged
+    Attention", PAPERS.md).  Every row's KV (including its own) must
+    already be written to the cache; row ``t`` attends keys at sequence
+    positions ``< row_lens[t]`` of its OWN sequence.
+
+    q: (T, Hq, D); row_block_tables: (T, max_blocks) — each row carries
+    its sequence's block table (callers gather ``block_tables[row_seq]``);
+    row_lens: (T,) = the row's global position + 1.  Keys stream in
+    ``seg_size`` page-table segments with an online softmax, so the
+    transient is (T, Hq, seg) — the dense (T, Hq, S) form would be GBs at
+    long context.  For a decode row this degenerates to exactly
+    :func:`paged_decode_attention`'s math; for prefill-chunk rows to
+    :func:`chunked_prefill_attention`'s.  Returns (T, Hq, D).
+    """
+    T, Hq, D = q.shape
+    _, bs, Hkv, Dk = k_cache.shape
+    mb = row_block_tables.shape[1]
+    G = Hq // Hkv
+    pg = max(1, seg_size // bs)                # pages per segment
+    n_seg = -(-mb // pg)
+    pad = n_seg * pg - mb
+    bt = row_block_tables
+    if pad:
+        # padded columns index block 0 but their key positions are
+        # >= mb*bs >= any row_lens, so the mask drops them
+        bt = jnp.pad(bt, ((0, 0), (0, pad)))
+    bt = bt.reshape(T, n_seg, pg).transpose(1, 0, 2)     # (n_seg, T, pg)
+
+    q_r = (q.astype(jnp.float32) * scale).reshape(T, Hkv, G, D)
+
+    def body(carry, bt_seg):
+        o, m, l, c0 = carry
+        R = pg * bs
+        k = k_cache[bt_seg].reshape(T, R, Hkv, Dk)
+        v = v_cache[bt_seg].reshape(T, R, Hkv, Dk)
+        k, v = _dequant_gathered(k, v, k_scale, v_scale, bt_seg, T, R,
+                                 Hkv, q.dtype, scale_slices)
+        scores = jnp.einsum("thgd,tkhd->thgk", q_r, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores.reshape(T, Hq, R)
+        scores = _softcap(scores, logit_softcap)
+        j = c0 * bs + jnp.arange(R)[None, :]             # key positions
+        mask = j < row_lens[:, None]
+        if sliding_window is not None:
+            mask &= j >= row_lens[:, None] - sliding_window
+        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.where(mask[:, None, :],
+                      jnp.exp(scores - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("thgk,tkhd->thgd",
+                        p.reshape(T, Hkv, G, R).astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        o = o * alpha[..., None] + pv.reshape(T, Hq, Dk)
+        return (o, m_new, l, c0 + pg), None
+
+    o0 = jnp.zeros((T, Hq, Dk), jnp.float32)
+    m0 = jnp.full((T, Hq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((T, Hq), jnp.float32)
+    (o, _, l, _), _ = jax.lax.scan(body, (o0, m0, l0, jnp.int32(0)), bt)
+    out = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    return out.astype(q.dtype)
+
+
+def ragged_blocked_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                             v_cache: jnp.ndarray, blk_bt: jnp.ndarray,
+                             row_lens: jnp.ndarray, blk: int, scale: float,
+                             *, k_scale: jnp.ndarray | None = None,
+                             v_scale: jnp.ndarray | None = None,
+                             sliding_window: int | None = None,
+                             logit_softcap: float | None = None,
+                             scale_slices: tuple[int, ...] | None = None
+                             ) -> jnp.ndarray:
+    """Block-gather ragged attention: valid ONLY for rows whose ``blk``-row
+    block belongs to a single sequence (the mixed layout's prefill-chunk
+    blocks — engine._run_mixed aligns chunks to ``blk``).
+
+    Same per-row semantics as :func:`ragged_attention`, but the KV gather
+    happens once per BLOCK (``blk_bt``: (T/blk, max_blocks), each block's
+    owning-sequence block-table row) instead of once per row — 1/blk the
+    gather traffic, which dominates the pure-JAX mixed step.  Decode-region
+    and padding blocks may carry a clamped/garbage table row: their output
+    is finite but unspecified, and ``forward_ragged`` overlays the per-row
+    dense result for decode rows (bit-identical to the decode trunk).
+    """
+    T, Hq, D = q.shape
+    _, bs, Hkv, Dk = k_cache.shape
+    nblk = T // blk
+    S = blk_bt.shape[1] * bs
+    G = Hq // Hkv
+    k = k_cache[blk_bt].reshape(nblk, S, Hkv, Dk)
+    v = v_cache[blk_bt].reshape(nblk, S, Hkv, Dk)
+    k, v = _dequant_gathered(k, v, k_scale, v_scale, blk_bt, nblk, S,
+                             Hkv, q.dtype, scale_slices)
+    q_r = q.reshape(nblk, blk, Hkv, G, D)
+    scores = jnp.einsum("nbhgd,nkhd->nhgbk", q_r, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = _softcap(scores, logit_softcap)
+    j = jnp.arange(S)[None, None, :]                  # key positions
+    lens = row_lens.reshape(nblk, blk)[:, :, None]    # (nblk, blk, 1)
+    mask = j < lens
+    if sliding_window is not None:
+        mask &= j >= lens - sliding_window
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("nhgbk,nkhd->nbhgd", probs.astype(v.dtype), v)
+    return out.reshape(T, Hq, Dk).astype(q.dtype)
+
+
 def chunked_prefill_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                               v_cache: jnp.ndarray, block_tables: jnp.ndarray,
                               ctx_lens: jnp.ndarray, chunk_lens: jnp.ndarray,

@@ -26,6 +26,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+from tpuserve.ops.pallas_paged_attention import _COMPILER_PARAMS
+
+
 # Target K rows per compute iteration (same rationale as the decode kernel:
 # deep enough to amortise relayout/loop overhead, small enough that the
 # double-buffered K+V scratch stays well inside VMEM).
@@ -276,7 +279,7 @@ def paged_window_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

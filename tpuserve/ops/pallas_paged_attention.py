@@ -54,6 +54,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 logger = logging.getLogger("tpuserve.ops.paged_attention")
 
+# jax has renamed TPUCompilerParams <-> CompilerParams across releases;
+# use whichever this build provides (0.4.x ships only TPUCompilerParams).
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+
 NEG_INF = -1e30
 
 # VMEM is ~16 MiB/core on v5e; budget 12 MiB for this kernel's buffers and
@@ -415,7 +421,7 @@ def _paged_decode_attention(q, k_cache, v_cache, block_tables, seq_lens,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((Bp, Hq, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -184,7 +184,13 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
            # cold-start TTFT budget (BASELINE.md <=150ms p50; jax reads
            # this env natively).
            {"name": "JAX_COMPILATION_CACHE_DIR",
-            "value": "/models/.jax-compile-cache"}]
+            "value": "/models/.jax-compile-cache"},
+           # Persistent grammar-FSM compile cache on the same PVC
+           # (runtime/grammar/cache.py): a production-vocab guided spec
+           # compiles once per fleet; every later pod/request loads the
+           # .npz tables instead of walking 151k token texts inline.
+           {"name": "TPUSERVE_FSM_CACHE_DIR",
+            "value": "/models/.fsm-cache"}]
     if cfg.provider != "gke":
         env.append({"name": "JAX_PLATFORMS", "value": "cpu"})
     if cfg.chat_template:

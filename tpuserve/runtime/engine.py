@@ -150,6 +150,17 @@ class EngineConfig:
 class EngineStats:
     num_prefill_steps: int = 0
     num_decode_steps: int = 0
+    # ragged mixed prefill+decode dispatches (scheduler mixed mode); each
+    # also counts once in num_decode_steps when it carried decode rows
+    num_mixed_steps: int = 0
+    # padding-waste observability (the bucketing win is invisible without
+    # it): the LAST dispatch's token count including padding vs its real
+    # tokens (exported as the tpuserve_step_padded/actual_tokens gauges),
+    # plus running totals for before/after efficiency ratios
+    step_padded_tokens: int = 0
+    step_actual_tokens: int = 0
+    padded_tokens_total: int = 0
+    actual_tokens_total: int = 0
     prompt_tokens: int = 0
     generated_tokens: int = 0
     preemptions: int = 0
@@ -362,6 +373,14 @@ class Engine:
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=prefix_caching)
         sched_cfg = config.scheduler
+        if sched_cfg.mixed_batching and (self._pp > 1
+                                         or jax.process_count() > 1):
+            # the ragged trunk is neither stage-stacked nor in the
+            # lockstep broadcast protocol — phase-split scheduling there
+            logger.warning("mixed ragged batching is single-process, "
+                           "non-pp only; falling back to phase-split "
+                           "scheduling")
+            sched_cfg = dataclasses.replace(sched_cfg, mixed_batching=False)
         if self._pp > 1 and sched_cfg.allow_chunked_prefill:
             # the pipelined trunk has no chunked-prefill path; the flag
             # closes ALL chunk routes (length, prefix-hit-by-choice,
@@ -369,8 +388,38 @@ class Engine:
             # at a big bucket instead of crashing _exec_prefill_chunk
             sched_cfg = dataclasses.replace(sched_cfg,
                                             allow_chunked_prefill=False)
+        # Ragged mixed batching: flat-row block granularity (the Pallas
+        # kernel's grid block AND the host packing alignment — one source
+        # of truth, ops/pallas_ragged_attention.ragged_block) and the
+        # FIXED descriptor width, so the flat-token bucket is the ONLY
+        # varying dimension across mixed executables.
+        from tpuserve.ops.pallas_ragged_attention import ragged_block
+        self._ragged_blk = ragged_block()
+        self._ragged_seqs = next_power_of_2(sched_cfg.max_num_seqs)
+        # Pallas-under-tp runs the phase-split kernels via shard_map
+        # (ops/pallas_tp.py); the ragged kernel has no tp wrapper yet, so
+        # mixed steps fall back to the reference ragged attention there
+        # (GSPMD partitions the einsums on its own).
+        self._ragged_attn = ("reference" if self._attn_mesh is not None
+                             else self.attn_impl)
+        if sched_cfg.mixed_batching:
+            # the row budget must cover the full decode region PLUS at
+            # least one aligned chunk, or a full decode batch would
+            # starve admissions forever (mixed cycles returning None
+            # schedule no prefill at all)
+            blk = self._ragged_blk
+            floor = -(-sched_cfg.max_num_seqs // blk) * blk + blk
+            if sched_cfg.mixed_token_budget < floor:
+                logger.warning(
+                    "mixed_token_budget %d cannot cover max_num_seqs %d "
+                    "decode rows plus one %d-row chunk; raising to %d",
+                    sched_cfg.mixed_token_budget, sched_cfg.max_num_seqs,
+                    blk, floor)
+                sched_cfg = dataclasses.replace(sched_cfg,
+                                                mixed_token_budget=floor)
         self.scheduler = Scheduler(sched_cfg, self.block_manager,
-                                   max_model_len=self.cache_cfg.max_model_len)
+                                   max_model_len=self.cache_cfg.max_model_len,
+                                   ragged_align=self._ragged_blk)
         self.stats = EngineStats()
         # device outputs of warmup-only executables (samplers, token
         # select) whose producer chains the end-of-warmup sync must drain
@@ -395,6 +444,7 @@ class Engine:
         self._fsm_cache: dict[tuple, object] = {}
         self._fsm_device: dict[int, tuple] = {}
         self._fsm_texts: Optional[dict] = None   # token -> text, lazy
+        self._fsm_tok_fp: Optional[str] = None   # disk-cache key half, lazy
         # committed canonical completions: when char-level substitution
         # can't spell the next legal char in single tokens (non-ASCII
         # choices under a byte-fallback vocab), _guided_pick encodes a
@@ -795,6 +845,8 @@ class Engine:
             outputs = self._run_prefill(batch)
         elif batch.kind == "prefill_chunk":
             outputs = self._run_prefill_chunk(batch)
+        elif batch.kind == "mixed":
+            outputs = self._run_mixed(batch)
         elif (self._spec is not None
               and self.stats.num_decode_steps >= self._spec_resume_step
               and all(not r.params.needs_penalties
@@ -844,6 +896,16 @@ class Engine:
             if r.num_prefilled > 0:
                 self.stats.released_blocks += bm.release_out_of_window(
                     r.request_id, max(0, r.num_prefilled - W))
+
+    def _note_step_tokens(self, actual: int, padded: int) -> None:
+        """Record one dispatch's real vs padded token counts (the
+        padding-waste observability behind the
+        ``tpuserve_step_padded/actual_tokens`` gauges) — ONE home so the
+        phase-split and mixed paths count identically."""
+        self.stats.step_actual_tokens = actual
+        self.stats.step_padded_tokens = padded
+        self.stats.actual_tokens_total += actual
+        self.stats.padded_tokens_total += padded
 
     def _next_key(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -1008,6 +1070,21 @@ class Engine:
             attn_impl=self.attn_impl,
             mesh=self._attn_mesh, out_mesh=self.mesh)
 
+    def _exec_forward_ragged(self, tokens, positions, slot_ids, row_seq,
+                             block_tables, kv_lens, q_starts, q_lens,
+                             meta, blk_seq, last_rows, ad=None):
+        # mixed batching is gated single-process/non-pp in __init__, so
+        # no coordinator wraps this hook; it exists for the AST coverage
+        # test's "no direct transformer calls" line (_exec_decode_verify
+        # precedent).  No mesh arg: under tp _ragged_attn is forced to
+        # "reference" (the ragged kernel has no shard_map wrapper yet)
+        # and GSPMD partitions the reference einsums on its own.
+        return transformer.forward_ragged(
+            self.params, self.model_cfg, tokens, positions, slot_ids,
+            row_seq, block_tables, kv_lens, q_starts, q_lens, meta,
+            blk_seq, last_rows, self.kv_cache, ad,
+            ragged_blk=self._ragged_blk, attn_impl=self._ragged_attn)
+
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
                      min_p=None, mode):
         return sampling_ops.sample_tokens(
@@ -1036,6 +1113,7 @@ class Engine:
             jnp.asarray(slot_ids), **kw)
         self.scheduler.mark_running(reqs)
         self.stats.num_prefill_steps += 1
+        self._note_step_tokens(int(prompt_lens[:len(reqs)].sum()), B * L)
         new_tokens = self._sample(logits, reqs, B)
         now = time.monotonic()
         for req in reqs:
@@ -1102,6 +1180,7 @@ class Engine:
             jnp.asarray(slot_ids), jnp.asarray(block_tables), **kw)
         req.num_prefilled = done + n
         self.stats.num_prefill_steps += 1
+        self._note_step_tokens(n, C)
         if req.num_prefilled < len(ids):
             # more chunks to go: back to the head of the queue
             self.scheduler.waiting.appendleft(req)
@@ -1114,6 +1193,177 @@ class Engine:
             self.stats.ttft_sum += now - req.arrival_time
             self.stats.ttft_count += 1
         return self._append_and_emit([req], new_tokens, from_prefill=True)
+
+    # ---- mixed ragged prefill+decode ----------------------------------
+
+    def _run_mixed(self, batch: ScheduledBatch) -> list[RequestOutput]:
+        """One ragged mixed step (scheduler mixed mode): every running
+        stream's decode row plus the scheduled prefill-chunk tokens run
+        as ONE flat token batch through the ragged trunk
+        (models/transformer.forward_ragged) — no phase split, so decode
+        streams get a token on every cycle even while prompts are being
+        admitted, and the executable set is bucketed on the single
+        flat-token dimension.
+
+        Synchronous by design: any in-flight window/step resolves first
+        (the flat layout needs host-known last tokens), so mixed steps
+        slot cleanly BETWEEN pipelined fused decode windows — the
+        prefill-free cycles around them keep PendingWindow pipelining.
+
+        Row layout (the Pallas kernel's host contract,
+        ops/pallas_ragged_attention.py): decode rows first, densely
+        packed (flat row == sequence index), the decode region padded to
+        the ragged block, each prefill chunk starting block-aligned;
+        sequences are ordered decode -> completing prefills -> continuing
+        prefills so the rows that sample a token this step are a prefix
+        and the per-step ``_sample`` (penalties, logprobs, guided — all
+        host-side, identical to the phase-split paths) applies unchanged.
+        """
+        outputs = self._flush_pending() + self._flush_window()
+        decode_reqs = [r for r in batch.requests if not r.finished]
+        # decode rows each append one KV slot — the same reserve-then-
+        # append preemption discipline as _run_decode (no pending here:
+        # both pipelines were just flushed)
+        while (sum(self.block_manager.needs_new_block(r.request_id)
+                   for r in decode_reqs)
+               > self.block_manager.num_free_blocks):
+            victim = self.scheduler.preempt_last()
+            self.stats.preemptions += 1
+            if victim is None:
+                raise MemoryError("KV cache exhausted with a single "
+                                  "sequence")
+            decode_reqs = [r for r in decode_reqs if r is not victim]
+        slots = [self.block_manager.append_slot(r.request_id)
+                 for r in decode_reqs]
+        # prefill chunks: first chunk allocates (with prefix-cache
+        # compute skip — prefill_chunk semantics); a request whose blocks
+        # no longer fit (decode appends ate them) goes back to the head
+        chunks = []                       # (req, ids, done, take)
+        for req, n in batch.prefill_chunks:
+            ids = self._prefill_tokens(req)
+            if req.num_prefilled == 0:
+                try:
+                    shared, cached = self.block_manager.lookup_prefix(ids)
+                    self.block_manager.allocate(req.request_id, ids,
+                                                shared_blocks=shared)
+                except MemoryError:
+                    self.scheduler.waiting.appendleft(req)
+                    continue
+                req.num_prefilled = cached
+            done = req.num_prefilled
+            take = min(n, len(ids) - done)
+            chunks.append((req, ids, done, take))
+        if not decode_reqs and not chunks:
+            return outputs
+        # completing chunks sample this step; order them before
+        # continuing ones so the sampled rows form a prefix
+        comp = [c for c in chunks if c[2] + c[3] == len(c[1])]
+        cont = [c for c in chunks if c[2] + c[3] < len(c[1])]
+        blk = self._ragged_blk
+        n_dec = len(decode_reqs)
+        cursor = -(-n_dec // blk) * blk if n_dec else 0
+        n_dec_blocks = cursor // blk
+        starts = []
+        for _, _, _, take in comp + cont:
+            starts.append(cursor)
+            cursor += -(-take // blk) * blk
+        total_rows = max(cursor, 1)
+        T = max(next_power_of_2(total_rows), blk)
+        B = self._ragged_seqs
+        mb = self.cache_cfg.max_blocks_per_seq
+        tokens = np.zeros((T,), np.int32)
+        positions = np.zeros((T,), np.int32)
+        slot_ids = np.full((T,), PAD_SLOT, np.int32)
+        row_seq = np.zeros((T,), np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        q_starts = np.full((B,), T, np.int32)
+        q_lens = np.zeros((B,), np.int32)
+        last_rows = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, mb), np.int32)
+        for i, r in enumerate(decode_reqs):
+            nt = r.num_tokens
+            tokens[i] = r.output_token_ids[-1]
+            positions[i] = nt - 1
+            slot_ids[i] = slots[i]
+            row_seq[i] = i
+            kv_lens[i] = nt
+            q_starts[i] = i
+            q_lens[i] = 1
+            last_rows[i] = i
+            bt = self.block_manager.block_table(r.request_id)
+            block_tables[i, :len(bt)] = bt
+        blk_seq = np.full((T // blk,), -1, np.int32)
+        for si, ((req, ids, done, take), start) in enumerate(
+                zip(comp + cont, starts), start=n_dec):
+            chunk = ids[done:done + take]
+            rows = slice(start, start + take)
+            tokens[rows] = chunk
+            positions[rows] = done + np.arange(take)
+            bt = self.block_manager.block_table(req.request_id)
+            slot_ids[rows] = self._token_slots(req.request_id, done, take,
+                                               block_table=bt)
+            row_seq[rows] = si
+            kv_lens[si] = done + take
+            q_starts[si] = start
+            q_lens[si] = take
+            last_rows[si] = start + take - 1
+            block_tables[si, :len(bt)] = bt
+            blk_seq[start // blk:(start + -(-take // blk) * blk) // blk] = si
+        meta = np.asarray([n_dec, n_dec_blocks], np.int32)
+        kw = {}
+        if self._lora_names:
+            # per-ROW one-hot adapter weights: the ragged trunk applies
+            # LoRA on the flat (T, H) stream, so each VALID row carries
+            # its sequence's adapter; padding rows are filled explicitly
+            # all-zero (= base model) rather than gathered through
+            # row_seq, whose padding value of 0 would hand them sequence
+            # 0's adapter
+            ad_rows = np.zeros((T, len(self._lora_names)), np.float32)
+            for i, r in enumerate(decode_reqs):
+                if r.adapter_idx is not None:
+                    ad_rows[i, r.adapter_idx] = 1.0
+            for (req, _, _, take), start in zip(comp + cont, starts):
+                if req.adapter_idx is not None:
+                    ad_rows[start:start + take, req.adapter_idx] = 1.0
+            kw["ad"] = jnp.asarray(ad_rows)
+        logits, self.kv_cache = self._exec_forward_ragged(
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(slot_ids), jnp.asarray(row_seq),
+            jnp.asarray(block_tables), jnp.asarray(kv_lens),
+            jnp.asarray(q_starts), jnp.asarray(q_lens),
+            jnp.asarray(meta), jnp.asarray(blk_seq),
+            jnp.asarray(last_rows), **kw)
+        self.stats.num_mixed_steps += 1
+        if decode_reqs:
+            self.stats.num_decode_steps += 1
+        if chunks:
+            self.stats.num_prefill_steps += 1
+        actual = n_dec + sum(c[3] for c in chunks)
+        self._note_step_tokens(actual, T)
+        # bookkeeping: chunk progress, requeue continuations, promote
+        # completions to running BEFORE sampling/emit (finish() removes
+        # from running; same order as _run_prefill_chunk)
+        for req, _, done, take in chunks:
+            req.num_prefilled = done + take
+        for req, _, _, _ in reversed(cont):
+            self.scheduler.waiting.appendleft(req)
+        comp_reqs = [c[0] for c in comp]
+        if comp_reqs:
+            self.scheduler.mark_running(comp_reqs)
+        emit_reqs = decode_reqs + comp_reqs
+        if not emit_reqs:
+            return outputs
+        new_tokens = self._sample(logits, emit_reqs, B)
+        now = time.monotonic()
+        for req in comp_reqs:
+            if req.first_token_time is None:
+                req.first_token_time = now
+                self.stats.ttft_sum += now - req.arrival_time
+                self.stats.ttft_count += 1
+        outputs += self._append_and_emit(decode_reqs, new_tokens[:n_dec])
+        outputs += self._append_and_emit(comp_reqs, new_tokens[n_dec:],
+                                         from_prefill=True)
+        return outputs
 
     # ---- decode -------------------------------------------------------
 
@@ -1329,6 +1579,7 @@ class Engine:
             ri += 1
         gstate_out = res[ri] if gfsm is not None else None
         self.stats.num_decode_steps += S
+        self._note_step_tokens(len(reqs) * S, B * S)
         if S < self._multi_step:
             # counted at the dispatch, not in _window_steps(): eligibility
             # bailouts above return before any window actually shrinks
@@ -1488,6 +1739,7 @@ class Engine:
             tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
             jnp.asarray(block_tables), jnp.asarray(seq_lens), **kw)
         self.stats.num_decode_steps += 1
+        self._note_step_tokens(len(reqs), B)
         if pipeline_ok:
             if any(r.params.needs_logit_bias for r in reqs):
                 # static per request (no host token history), so safe on
@@ -1574,6 +1826,7 @@ class Engine:
             pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
+        self._note_step_tokens(int(chunk_lens[:len(reqs)].sum()), B * K)
         step_proposed = step_accepted = 0
         for i, r in enumerate(reqs):
             emitted = (spec_mod.accept_greedy(drafts[i], pred_h[i])
@@ -1725,7 +1978,27 @@ class Engine:
         if key in self._fsm_cache:
             return self._fsm_cache[key]
         from tpuserve.runtime.grammar import (FsmCompileError, fsm_for_spec,
-                                              token_text_table)
+                                              load_fsm, resolve_cache_dir,
+                                              save_fsm, token_text_table,
+                                              tokenizer_fingerprint)
+        # Persistent disk cache keyed by (spec hash, tokenizer hash) —
+        # the model-PVC path in production (runtime/grammar/cache.py), so
+        # a production-vocab grammar compiles ONCE per fleet, not once
+        # per pod per grammar.  A hit skips both the determinizing walk
+        # AND the token-text-table build below.
+        disk_dir = resolve_cache_dir(self.config.checkpoint_dir)
+        tok_fp = None
+        if disk_dir is not None:
+            if self._fsm_tok_fp is None:
+                self._fsm_tok_fp = tokenizer_fingerprint(
+                    self.tokenizer, self.model_cfg.vocab_size,
+                    self._eos_ids)
+            tok_fp = self._fsm_tok_fp
+            fsm = load_fsm(disk_dir, params.guided, params.guided_schema,
+                           tok_fp)
+            if fsm is not None:
+                self._memoise_fsm(key, fsm)
+                return fsm
         if self._fsm_texts is None:
             # token id -> standalone text depends only on the tokenizer:
             # computed ONCE per engine, not per grammar (a production
@@ -1740,12 +2013,23 @@ class Engine:
             logger.info("guided spec not FSM-compilable (%s); using the "
                         "per-step substitution path", e)
             fsm = None
+        if fsm is not None and disk_dir is not None:
+            # failures are NOT persisted: they depend on the walk/state
+            # budgets, which are env-tunable per deployment
+            save_fsm(disk_dir, params.guided, params.guided_schema,
+                     tok_fp, fsm)
+        self._memoise_fsm(key, fsm)
+        return fsm
+
+    def _memoise_fsm(self, key, fsm) -> None:
+        """FIFO-bounded in-memory memo (with its device tables) — shared
+        by the compile and disk-hit paths so eviction policy can't
+        drift."""
         if len(self._fsm_cache) >= self.MAX_FSM_CACHE:
             old = self._fsm_cache.pop(next(iter(self._fsm_cache)))
             if old is not None:
                 self._fsm_device.pop(id(old), None)
         self._fsm_cache[key] = fsm
-        return fsm
 
     def _fsm_device_tables(self, fsm):
         """Device-resident (masks, tok_class, class_next) for ``fsm``,
@@ -2435,6 +2719,7 @@ class Engine:
                                               "min_tokens"),
                chunk_buckets: Sequence[int] = (),
                embed_buckets: Sequence[tuple[int, int]] = (),
+               mixed_buckets: Sequence[int] | None = None,
                ) -> None:
         """Pre-compile executables.  ``prefill_buckets`` entries are either a
         padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
@@ -2450,8 +2735,38 @@ class Engine:
             prefill_buckets = [self.config.scheduler.min_prefill_bucket]
         else:
             prefill_buckets = list(prefill_buckets)
-        decode_buckets = list(decode_buckets) or [
-            self.config.scheduler.min_decode_bucket]
+        decode_buckets = list(decode_buckets)
+        scfg = self.scheduler.cfg
+        if scfg.mixed_batching:
+            # Mixed mode's executable family is derivable from config, so
+            # the engine warms it itself (callers were duplicating — and
+            # drifting — this ladder logic).  mixed_buckets=None = auto:
+            # the flat-token ladder up to the budget (the row-charged
+            # scheduler guarantees no dispatch ever exceeds it); cold, a
+            # bucket compiles inside a measured/served ITL.  And because
+            # budget-staggered admission staggers FINISHES, the decode
+            # tail shrinks through partial buckets even on a burst
+            # workload — warm the whole decode ladder unless the caller
+            # pinned one.
+            if mixed_buckets is None:
+                top = next_power_of_2(scfg.mixed_token_budget)
+                t, ladder = self._ragged_blk, []
+                while t <= top:
+                    ladder.append(t)
+                    t *= 2
+                mixed_buckets = ladder
+            if not decode_buckets:
+                decode_buckets = sorted(
+                    {self.scheduler.decode_bucket(n)
+                     for n in range(1, scfg.max_num_seqs + 1)})
+            # the mixed scheduler only ever dispatches "mixed"/"decode":
+            # batched-prefill and prefill_chunk executables are
+            # unreachable dead weight (seconds of XLA compile each at
+            # production size)
+            prefill_buckets = []
+            chunk_buckets = ()
+        mixed_buckets = list(mixed_buckets or ())
+        decode_buckets = decode_buckets or [scfg.min_decode_bucket]
         logits = None
         # Two rounds: round 1 compiles each executable against the cache
         # layouts it happens to see; the kv_cache arrays that come OUT may
@@ -2601,7 +2916,8 @@ class Engine:
             if not self.scheduler.cfg.allow_chunked_prefill:
                 chunk_set = set()     # no chunk route exists (pp engine)
             if (self.max_seq_len > chunk
-                    and self.scheduler.cfg.allow_chunked_prefill):
+                    and self.scheduler.cfg.allow_chunked_prefill
+                    and not self.scheduler.cfg.mixed_batching):
                 # long prompts hit the chunked path; the full-chunk
                 # executable must be warm or the first long request stalls
                 # the loop on a compile.  chunk_buckets adds the padded
@@ -2616,6 +2932,32 @@ class Engine:
                 logits, self.kv_cache = self._exec_prefill_chunk(
                     tokens, jnp.zeros((1,), jnp.int32),
                     jnp.ones((1,), jnp.int32), slots, bt, **ckw)
+                self._warm_sampling(logits, sample_modes)
+            for Tm in sorted(set(mixed_buckets)):
+                # ragged mixed trunk: one executable per flat-token
+                # bucket (the whole point — no (batch x length) grid);
+                # left cold, the first admission-under-load mixed step
+                # stalls the loop on its compile
+                blkm = self._ragged_blk
+                Tm = -(-Tm // blkm) * blkm
+                Bm = self._ragged_seqs
+                mbm = self.cache_cfg.max_blocks_per_seq
+                mkw = {}
+                if self._lora_names:
+                    mkw["ad"] = jnp.zeros((Tm, len(self._lora_names)),
+                                          jnp.float32)
+                logits, self.kv_cache = self._exec_forward_ragged(
+                    jnp.zeros((Tm,), jnp.int32),
+                    jnp.zeros((Tm,), jnp.int32),
+                    jnp.full((Tm,), PAD_SLOT, jnp.int32),
+                    jnp.zeros((Tm,), jnp.int32),
+                    jnp.zeros((Bm, mbm), jnp.int32),
+                    jnp.zeros((Bm,), jnp.int32),
+                    jnp.full((Bm,), Tm, jnp.int32),
+                    jnp.zeros((Bm,), jnp.int32),
+                    jnp.zeros((2,), jnp.int32),
+                    jnp.full((Tm // blkm,), -1, jnp.int32),
+                    jnp.zeros((Bm,), jnp.int32), **mkw)
                 self._warm_sampling(logits, sample_modes)
         if embed_buckets:
             if self._pp > 1:

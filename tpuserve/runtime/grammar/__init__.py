@@ -24,6 +24,9 @@ candidate-substitution path, whose distortion is now statistically
 bounded by tests (tests/test_guided_fsm.py).
 """
 
+from tpuserve.runtime.grammar.cache import (load_fsm, resolve_cache_dir,
+                                            save_fsm,
+                                            tokenizer_fingerprint)
 from tpuserve.runtime.grammar.compile import (FsmCompileError,
                                               compile_token_fsm,
                                               fsm_for_spec,
@@ -34,4 +37,5 @@ __all__ = [
     "TokenFSM", "pack_masks", "unpack_masks",
     "FsmCompileError", "compile_token_fsm", "fsm_for_spec",
     "token_text_table",
+    "load_fsm", "save_fsm", "resolve_cache_dir", "tokenizer_fingerprint",
 ]

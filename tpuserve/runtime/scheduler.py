@@ -48,33 +48,56 @@ class SchedulerConfig:
         if self.max_waiting < 0:
             return 1 << 30
         return self.max_waiting or 4 * self.max_num_seqs
-    # Also run one decode step after every BATCHED prefill (not just
-    # chunked ones): under sustained arrivals, strict prefill-priority
-    # stalls every running stream for the whole admission burst — this
-    # bounds their inter-token latency at the cost of slightly later
-    # admission for the tail of the burst.  Off by default: the
-    # worst-case-burst benchmark favors draining admissions first; flip
-    # on for latency-sensitive serving.
+    # SUPERSEDED by mixed_batching (kept as a compat shim for configs
+    # that set it): run one decode step after every BATCHED prefill, so
+    # running streams get at most one admission batch between tokens.
+    # Mixed batching subsumes this — decode rows ride EVERY step — and
+    # bounds ITL tighter; prefer it for latency-sensitive serving.
     interleave_batched_prefill: bool = False
+    # Mixed ragged batching ("Ragged Paged Attention", PAPERS.md; Sarathi
+    # token-budget fill): each step with admissible prefill work is ONE
+    # flat-token batch — every running decode row first, then
+    # prefill-chunk tokens up to mixed_token_budget — served by the
+    # ragged trunk (models/transformer.forward_ragged) in one dispatch.
+    # No phase split: in-flight streams get a token every scheduling
+    # cycle even mid-admission-burst, and bucketing collapses to the one
+    # flat-token dimension.  Cycles with no admissible prefill stay on
+    # the decode path (fused multi-step windows, speculation).
+    mixed_batching: bool = False
+    # flat-token budget per mixed step; decode rows charge 1 token each,
+    # prefill chunks fill the remainder (Sarathi-style chunk sizing)
+    mixed_token_budget: int = 512
 
 
 @dataclasses.dataclass
 class ScheduledBatch:
-    kind: str                            # "prefill" | "prefill_chunk" | "decode"
+    kind: str            # "prefill" | "prefill_chunk" | "decode" | "mixed"
     requests: list[Request]
     # prefill only: padded token length all prompts in the batch share
     # (for prefill_chunk: the fixed chunk size)
     padded_len: int = 0
     # decode only: padded batch size
     padded_batch: int = 0
+    # mixed only: (request, token budget this step) prefill rows — the
+    # flat batch is ``requests`` (decode rows, one token each) plus these
+    # chunks; the engine owns the flat-bucket/alignment padding and
+    # recounts actual tokens itself (chunks can shrink at run time via
+    # the prefix-cache skip)
+    prefill_chunks: list = dataclasses.field(default_factory=list)
 
 
 class Scheduler:
     def __init__(self, cfg: SchedulerConfig, block_manager: BlockManager,
-                 max_model_len: int):
+                 max_model_len: int, ragged_align: int = 1):
         self.cfg = cfg
         self.block_manager = block_manager
         self.max_model_len = max_model_len
+        # Mixed mode: the engine pads the decode region and every prefill
+        # chunk to this flat-row block (the ragged kernel's grid
+        # granularity) — the token budget must charge those PADDED rows,
+        # or a burst of tiny prompts would blow the flat bucket far past
+        # the warmed ladder (one XLA compile stall per novel bucket).
+        self.ragged_align = max(1, ragged_align)
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
         # Set after scheduling a chunked-prefill step: the next cycle runs a
@@ -161,7 +184,22 @@ class Scheduler:
         (keeps TTFT low and the decode batch full), then decode.  Exception:
         directly after a chunked-prefill step, one decode step runs first so
         a long prompt's multi-step admission cannot starve in-flight streams
-        (bounded inter-token latency)."""
+        (bounded inter-token latency).
+
+        Mixed mode (cfg.mixed_batching) replaces the phase split: any
+        cycle with admissible prefill work returns ONE kind="mixed" flat
+        batch carrying every running decode row plus prefill-chunk
+        tokens; prefill-free cycles fall through to the plain decode path
+        so fused windows/speculation keep pure-decode throughput."""
+        if self.cfg.mixed_batching:
+            batch = self._schedule_mixed()
+            if batch is not None:
+                return batch
+            if self.running:
+                return ScheduledBatch(
+                    kind="decode", requests=list(self.running),
+                    padded_batch=self.decode_bucket(len(self.running)))
+            return None
         if self._interleave_decode and self.running:
             self._interleave_decode = False
             return ScheduledBatch(
@@ -243,6 +281,83 @@ class Scheduler:
         if not picked:
             return None
         return ScheduledBatch(kind="prefill", requests=picked, padded_len=bucket)
+
+    def _schedule_mixed(self) -> Optional[ScheduledBatch]:
+        """Token-budget mixed batch: all running decode rows ride first
+        (1 token each — no running stream EVER waits out an admission
+        burst, the fairness property tests/test_scheduler.py pins), then
+        prefill-chunk tokens fill the remaining budget.  Partially
+        prefilled requests anywhere in the queue continue first (the same
+        block-drain livelock rule as _schedule_prefill); fresh admissions
+        are FIFO from the head and stop at the first one whose blocks
+        don't fit.  Returns None when nothing prefill-side is admissible
+        — the caller then runs a plain decode step."""
+        if not self.waiting:
+            return None
+        align = self.ragged_align
+
+        def rows(n: int) -> int:
+            # flat rows a chunk of n tokens actually occupies in the
+            # engine's block-aligned layout (engine._run_mixed)
+            return -(-n // align) * align
+
+        # budget is in FLAT ROWS (padding included): decode rows occupy
+        # one align-padded region, each chunk its own aligned span — so
+        # the dispatched bucket T never exceeds
+        # next_power_of_2(mixed_token_budget), which is exactly what
+        # warmup pre-compiles
+        budget = self.cfg.mixed_token_budget - rows(len(self.running))
+        seats = self.cfg.max_num_seqs - len(self.running)
+        if budget < align or seats <= 0:
+            return None
+
+        def take(remaining: int) -> int:
+            # largest admissible chunk: whole remainder if its aligned
+            # span fits the row budget, else the biggest aligned span
+            if rows(remaining) <= budget:
+                return remaining
+            return (budget // align) * align
+
+        # each decode row may append into a fresh block this step — leave
+        # them headroom before reserving for admissions
+        free = self.block_manager.num_free_blocks - len(self.running)
+        chunks: list = []
+        for req in list(self.waiting):
+            if budget < align or seats <= 0:
+                break
+            if req.num_prefilled > 0:
+                n = take(req.num_tokens - req.num_prefilled)
+                if n <= 0:
+                    break
+                self.waiting.remove(req)
+                chunks.append((req, n))
+                budget -= rows(n)
+                seats -= 1
+        while self.waiting and budget >= align and seats > 0:
+            head = self.waiting[0]
+            need = self.block_manager.blocks_needed(head.num_tokens) + 1
+            if need > free:
+                break                        # wait for blocks to free up
+            cached = 0
+            if self.block_manager.enable_prefix_caching:
+                # compute-skip: the engine starts this chunk at the
+                # cached offset (prefill_chunk semantics), so only the
+                # uncached tail charges the token budget
+                _, cached = self.block_manager.lookup_prefix(
+                    head.prompt_token_ids + head.output_token_ids,
+                    count_stats=False)
+            n = take(head.num_tokens - cached)
+            if n <= 0:
+                break
+            self.waiting.popleft()
+            chunks.append((head, n))
+            free -= need
+            budget -= rows(n)
+            seats -= 1
+        if not chunks:
+            return None
+        return ScheduledBatch(kind="mixed", requests=list(self.running),
+                              prefill_chunks=chunks)
 
     # ---- state transitions (driven by the engine) -----------------------
 

@@ -114,6 +114,31 @@ class ServerMetrics:
             "(runtime/grammar/) — the distribution-correct path that "
             "rides fused windows; guided traffic NOT counted here ran "
             "the per-step substitution fallback")
+        self.step_padded_tokens = gauge(
+            "tpuserve_step_padded_tokens",
+            "Tokens dispatched by the engine's last step INCLUDING "
+            "bucket/alignment padding — compare against "
+            "tpuserve_step_actual_tokens to see what the static-shape "
+            "buckets cost.  Mixed ragged batching collapses the "
+            "(batch x length) grid to one flat-token bucket, which is "
+            "exactly the gap these two gauges make observable")
+        self.step_actual_tokens = gauge(
+            "tpuserve_step_actual_tokens",
+            "Real (non-padding) tokens computed by the engine's last "
+            "step")
+        self.padded_tokens_total = counter(
+            "tpuserve_padded_tokens_total",
+            "Cumulative dispatched tokens including padding; with "
+            "tpuserve_actual_tokens_total this gives the live padding "
+            "efficiency ratio for before/after bucketing comparisons")
+        self.actual_tokens_total = counter(
+            "tpuserve_actual_tokens_total",
+            "Cumulative real tokens computed across all engine steps")
+        self.mixed_steps = counter(
+            "tpuserve_mixed_steps",
+            "Ragged mixed prefill+decode dispatches (scheduler mixed "
+            "mode) — zero under admission load means the engine is "
+            "phase-splitting")
         self.guided_fsm_windows = counter(
             "tpuserve_guided_fsm_windows",
             "Fused multi-step windows that carried grammar-FSM masks — "

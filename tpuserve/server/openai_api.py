@@ -293,7 +293,9 @@ class OpenAIServer:
             self.tpu_exporter.start()
         if warmup and hasattr(self.engine, "warmup"):
             # embed buckets opt-in: each costs a full trunk compile at
-            # startup, wasted on deployments that never call /v1/embeddings
+            # startup, wasted on deployments that never call /v1/embeddings.
+            # (Mixed-batching engines derive their flat-token bucket
+            # ladder themselves — Engine.warmup mixed_buckets=None auto.)
             self.engine.warmup(embed_buckets=self.config.warmup_embed)
         server = self
 
@@ -1459,6 +1461,22 @@ def main(argv=None):
                     help="admission backpressure: reject (HTTP 503) new "
                          "requests beyond this many waiting (0 = auto, "
                          "4x max-num-seqs; -1 disables)")
+    ap.add_argument("--mixed-batching", action="store_true",
+                    help="ragged mixed prefill+decode batching: every "
+                         "step with admissible prefill work runs ONE "
+                         "flat-token dispatch carrying all running "
+                         "decode rows plus prefill-chunk tokens — no "
+                         "phase split, so no stream waits out an "
+                         "admission burst (supersedes "
+                         "--interleave-batched-prefill)")
+    ap.add_argument("--mixed-token-budget", type=int, default=512,
+                    help="flat-token budget per mixed step (Sarathi "
+                         "chunk sizing; decode rows charge 1 each) — "
+                         "the p50-ITL vs admission-latency knob")
+    ap.add_argument("--interleave-batched-prefill", action="store_true",
+                    help="compat shim (superseded by --mixed-batching): "
+                         "one decode step between prefill admission "
+                         "batches")
     ap.add_argument("--attn-impl", default="auto")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor parallel degree (0 = no mesh)")
@@ -1587,8 +1605,12 @@ def main(argv=None):
                           num_blocks=args.num_blocks,
                           max_blocks_per_seq=args.max_blocks_per_seq,
                           dtype=args.kv_cache_dtype),
-        scheduler=SchedulerConfig(max_num_seqs=args.max_num_seqs,
-                                  max_waiting=args.max_waiting),
+        scheduler=SchedulerConfig(
+            max_num_seqs=args.max_num_seqs,
+            max_waiting=args.max_waiting,
+            mixed_batching=args.mixed_batching,
+            mixed_token_budget=args.mixed_token_budget,
+            interleave_batched_prefill=args.interleave_batched_prefill),
         attn_impl=args.attn_impl, speculative=spec,
         multi_step=args.multi_step, pipeline_decode=args.pipeline,
         adaptive_multi_step=not args.no_adaptive_window,

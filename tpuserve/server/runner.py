@@ -311,9 +311,23 @@ class AsyncEngineRunner:
                                  ("guided_fsm_requests",
                                   self.metrics.guided_fsm_requests),
                                  ("guided_fsm_windows",
-                                  self.metrics.guided_fsm_windows)):
+                                  self.metrics.guided_fsm_windows),
+                                 ("padded_tokens_total",
+                                  self.metrics.padded_tokens_total),
+                                 ("actual_tokens_total",
+                                  self.metrics.actual_tokens_total),
+                                 ("num_mixed_steps",
+                                  self.metrics.mixed_steps)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
+            # last-step padding-waste gauges (the bucketing win's live
+            # observability; sums across disagg halves like kv_usage)
+            self.metrics.step_padded_tokens.set(
+                sum(getattr(s, "step_padded_tokens", 0)
+                    for s in stats_objs))
+            self.metrics.step_actual_tokens.set(
+                sum(getattr(s, "step_actual_tokens", 0)
+                    for s in stats_objs))
 
     def _loop(self) -> None:
         logger.info("engine loop started")
