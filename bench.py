@@ -281,7 +281,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   prefix_caching=False, multi_step=None, quantization=None,
                   prefill_split=1, kv_quant=None, interleave=False,
                   adaptive_window=True, block_size=32, mixed=False,
-                  mixed_budget=None):
+                  mixed_budget=None, faults=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -316,7 +316,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                        attn_impl=attn_impl, enable_prefix_caching=prefix_caching,
                        pipeline_decode=pipeline, speculative=spec,
                        multi_step=multi_step, quantization=quantization,
-                       adaptive_multi_step=adaptive_window)
+                       adaptive_multi_step=adaptive_window,
+                       faults=faults)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -517,6 +518,31 @@ def _run_workload(engine, prompts, params, arrival_offsets=None):
             "itls_ms": sorted(1000.0 * x for x in itls),
             "stats": stats, "pstats": pstats,
             **deltas}
+
+
+def _runner_workload(engine, prompts, params, timeout=600.0):
+    """Drive the workload through AsyncEngineRunner — the crash-only
+    salvage path lives in the runner, so a faulted engine must be measured
+    behind it, not via bare engine.step() (where an injected fault would
+    just crash the bench).  Returns (wall_s, failed_requests)."""
+    from tpuserve.server.runner import AsyncEngineRunner
+    runner = AsyncEngineRunner(engine)
+    runner.start()
+    t0 = time.perf_counter()
+    subs = [runner.submit(prompt_token_ids=p, params=params)
+            for p in prompts]
+    failed = 0
+    for rid, q in subs:
+        while True:
+            item = q.get(timeout=timeout)
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                failed += 1
+        getattr(engine, "requests", {}).pop(rid, None)
+    wall = time.perf_counter() - t0
+    runner.shutdown()
+    return wall, failed
 
 
 def _pct(sorted_ms, q):
@@ -802,6 +828,13 @@ def main(argv=None):
                     help="run one decode step between prefill admission "
                          "batches (bounds running streams' ITL during "
                          "arrival bursts; trades tail-of-burst TTFT)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="recovery-overhead A/B (runtime/faults.py): after "
+                         "the clean run, repeat the workload on an engine "
+                         "with this chaos spec armed (e.g. "
+                         "'decode_dispatch:raise:0.02'), driven through "
+                         "the salvage-capable runner; reports wall-clock "
+                         "overhead + salvage/poison/watchdog counters")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -1102,6 +1135,42 @@ def main(argv=None):
             "transfer_s": round(d_engine.stats.transfer_time_s, 3),
             "vs_colocated": round(d_tok_s / decode_tok_s, 3)
                             if decode_tok_s else 0.0,
+        }
+
+    if args.faults:
+        # Recovery-overhead A/B (crash-only engine): same workload, same
+        # config, behind AsyncEngineRunner with and without the chaos spec
+        # armed.  The clean pass reuses the already-warm main engine so
+        # the ratio isolates salvage/replay cost, not compile noise.
+        with tpu_guard("faults comparison"):
+            clean_s, clean_failed = _runner_workload(engine, prompts,
+                                                     params)
+            f_engine = _build_engine(
+                model, batch, prompt_len, gen_len, attn_impl=attn_impl,
+                pipeline=pipeline, spec_k=args.spec,
+                multi_step=args.multi_step,
+                quantization=args.quant, prefill_split=args.prefill_split,
+                kv_quant=args.kv_quant,
+                interleave=args.interleave_prefill,
+                block_size=args.block_size,
+                mixed=args.mixed, mixed_budget=args.mixed_budget,
+                adaptive_window=not args.no_adaptive_window,
+                faults=args.faults)
+            _warm(f_engine, batch, prompt_len, modes=warm_modes)
+            faulted_s, failed = _runner_workload(f_engine, prompts, params)
+        fstats = f_engine.stats
+        out["faults"] = {
+            "spec": args.faults,
+            "clean_s": round(clean_s, 3),
+            "faulted_s": round(faulted_s, 3),
+            "recovery_overhead_x": round(faulted_s / clean_s, 3)
+                                   if clean_s else 0.0,
+            "requests_failed": failed,
+            "requests_failed_clean": clean_failed,
+            "salvaged": fstats.requests_salvaged,
+            "poisoned": fstats.requests_poisoned,
+            "watchdog_trips": fstats.watchdog_trips,
+            "engine_restarts": fstats.engine_restarts,
         }
 
     _emit(out)

@@ -210,6 +210,85 @@ def test_gateway_connect_failover():
         srv.shutdown()
 
 
+def test_gateway_ejects_backend_on_consecutive_5xx():
+    """A backend answering connects but 5xx-ing every request (engine loop
+    down, process alive) is ejected after eject_after_failures consecutive
+    failures — not only connect failures count — and readmitted by the
+    health probe loop once /healthz passes again."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Flaky(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            # /healthz PASSES: the process is alive — only its request
+            # path is broken, exactly the case connect-failure-only
+            # ejection misses
+            body = b'{"status":"ok"}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(length)
+            body = b'{"error":{"message":"engine down","type":"server_error"}}'
+            self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    flaky_httpd = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    threading.Thread(target=flaky_httpd.serve_forever, daemon=True).start()
+    flaky_url = f"http://127.0.0.1:{flaky_httpd.server_address[1]}"
+    srv, live_url = _mk_server()
+    gw = Gateway([flaky_url, live_url],
+                 GatewayConfig(host="127.0.0.1", port=0,
+                               health_interval_s=3600,  # probes by hand below
+                               eject_after_failures=2))
+    gport = gw.start()
+    try:
+        flaky = next(b for b in gw.backends if b.url == flaky_url)
+        # varied prompts spread rendezvous affinity over both backends;
+        # every 5xx the flaky one serves counts against it
+        saw_error = 0
+        for i in range(16):
+            try:
+                _post(f"http://127.0.0.1:{gport}/v1/completions",
+                      {"model": "tiny-qwen3", "prompt": f"probe-{i}",
+                       "max_tokens": 2, "temperature": 0,
+                       "ignore_eos": True})
+            except urllib.error.HTTPError as e:
+                assert e.code == 500         # relayed backend error
+                saw_error += 1
+            if not flaky.healthy:
+                break
+        assert saw_error >= 2
+        assert not flaky.healthy             # ejected on consecutive 5xx
+        assert flaky.consecutive_failures >= 2
+        # ejected: new traffic routes to the live backend only
+        for i in range(4):
+            status, _body = _post(
+                f"http://127.0.0.1:{gport}/v1/completions",
+                {"model": "tiny-qwen3", "prompt": f"after-eject-{i}",
+                 "max_tokens": 2, "temperature": 0, "ignore_eos": True})
+            assert status == 200
+        # readmit via the health probe loop's own round: /healthz passes,
+        # so the backend re-enters the pool with a clean failure count
+        gw.probe_backends_once()
+        assert flaky.healthy
+        assert flaky.consecutive_failures == 0
+    finally:
+        gw.shutdown()
+        flaky_httpd.shutdown()
+        srv.shutdown()
+
+
 def test_gateway_all_backends_unreachable():
     gw = Gateway(["http://127.0.0.1:1", "http://127.0.0.1:2"],
                  GatewayConfig(host="127.0.0.1", port=0,

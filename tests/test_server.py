@@ -609,11 +609,14 @@ def test_graceful_drain_finishes_inflight_and_rejects_new():
         if srv.draining:
             break
         time.sleep(0.01)
-    # new work is rejected while the old stream keeps running
+    # new work is rejected while the old stream keeps running — with a
+    # Retry-After header, so K8s-fronted clients/gateways back off onto
+    # another replica instead of treating the drain 503 as terminal
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(base + "/v1/completions", {"model": "tiny-qwen3",
                                          "prompt": "x", "max_tokens": 2})
     assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") == "1"
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(base + "/readyz")
     assert ei.value.code == 503
@@ -871,3 +874,75 @@ def test_stop_spans_min_tokens_boundary(server):
     assert c["finish_reason"] == "stop"
     # the first A streamed under the floor; stored text honours the stop
     assert len(c["text"]) <= 1
+
+
+def test_request_timeout_aborts_nonstream():
+    """request_timeout_s (ISSUE 4 satellite): a non-streaming request
+    exceeding the deadline is aborted IN THE ENGINE (blocks freed, no
+    generation to max_tokens) and the client gets a 504 — not a hang."""
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=256,
+                          max_blocks_per_seq=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        # pace decode so the deadline ALWAYS lands mid-generation: with a
+        # warm in-process compile cache the tiny model would otherwise
+        # race through its clamped token budget before the timeout fires
+        faults="decode_dispatch:delay:1.0:delay_s=0.05"))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0,
+                                         request_timeout_s=0.2))
+    port = srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"http://127.0.0.1:{port}/v1/completions", {
+                "model": "tiny-qwen3", "prompt": [5, 9, 12],
+                "max_tokens": 4096, "temperature": 0, "ignore_eos": True})
+        assert ei.value.code == 504
+        # the abort reached the engine: no request keeps decoding and its
+        # KV blocks drain back to the pool
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                eng.has_work() or eng.block_manager.num_seqs()):
+            time.sleep(0.02)
+        assert eng.block_manager.num_seqs() == 0
+        assert not eng.scheduler.has_work()
+    finally:
+        srv.shutdown()
+
+
+def test_request_timeout_aborts_stream():
+    """Streaming twin: past the deadline the client receives an error
+    chunk + [DONE] (headers are already out), the engine aborts the
+    request, and its blocks are freed."""
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=256,
+                          max_blocks_per_seq=64),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        # pace decode (see the non-stream twin above)
+        faults="decode_dispatch:delay:1.0:delay_s=0.05"))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0,
+                                         request_timeout_s=0.5))
+    port = srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({
+                "model": "tiny-qwen3", "prompt": [5, 9, 12],
+                "max_tokens": 4096, "temperature": 0, "ignore_eos": True,
+                "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            raw = r.read().decode()
+        assert "timed out" in raw           # error chunk, not silence
+        assert raw.rstrip().endswith("data: [DONE]")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                eng.has_work() or eng.block_manager.num_seqs()):
+            time.sleep(0.02)
+        assert eng.block_manager.num_seqs() == 0
+        assert not eng.scheduler.has_work()
+    finally:
+        srv.shutdown()

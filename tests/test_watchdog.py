@@ -1,0 +1,115 @@
+"""Hang watchdog (AsyncEngineRunner): a dispatch that BLOCKS — the
+realistic TPU failure mode, where the device call never returns instead of
+raising — is detected within step_watchdog_s, counted as a watchdog trip,
+and failed the same way an exception would be (salvage path), never a
+stuck client."""
+
+import threading
+import time
+
+import pytest
+
+from tpuserve.runtime import CacheConfig, Engine, EngineConfig, SamplingParams, SchedulerConfig
+from tpuserve.server.runner import AsyncEngineRunner
+
+PARAMS = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+
+def _mk(faults=None, watchdog=0.4):
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=128,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=8, min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        multi_step=4, pipeline_decode=True,
+        faults=faults, step_watchdog_s=watchdog, seed=0))
+    runner = AsyncEngineRunner(eng)
+    runner.start()
+    return eng, runner
+
+
+def _precompile(runner):
+    """One request end-to-end so later steps are compile-free and the
+    warmup-scaled watchdog threshold can be dropped to the real one."""
+    runner.generate_sync(prompt_token_ids=[1, 2, 3], params=PARAMS,
+                         timeout=120)
+    runner.WATCHDOG_WARMUP_STEPS = 0      # past warmup: real threshold
+
+
+def test_injected_hang_trips_watchdog_and_salvages():
+    """ACCEPTANCE: an injected one-shot hang in a decode dispatch is
+    detected within step_watchdog_s, surfaces as a watchdog trip, and the
+    stream completes (salvaged + replayed) — not a stuck client."""
+    eng, runner = _mk(
+        faults="decode_dispatch:hang:1.0:count=1:match=hangme:max_hang_s=60")
+    _precompile(runner)
+    t0 = time.monotonic()
+    rid, q = runner.submit(prompt_token_ids=[5, 6, 7], params=PARAMS,
+                           request_id="hangme-0")
+    toks = []
+    while True:
+        item = q.get(timeout=60)
+        if item is None:
+            break
+        assert not isinstance(item, Exception), item
+        toks.extend(item.new_token_ids)
+    elapsed = time.monotonic() - t0
+    runner.shutdown()
+    assert len(toks) == PARAMS.max_tokens      # the client got its stream
+    assert eng.stats.watchdog_trips >= 1
+    assert eng.stats.requests_salvaged >= 1
+    # detected at ~step_watchdog_s and recovered — nowhere near the 60 s
+    # the hang would have lasted without a watchdog
+    assert elapsed < 20
+
+
+def test_unreleasable_hang_fails_clients_not_strands_them():
+    """A REAL hang (a blocked call the injector cannot release): stage-2
+    watchdog fails the waiting clients with an error instead of stranding
+    them, and counts an engine restart."""
+    eng, runner = _mk(watchdog=0.3)
+    _precompile(runner)
+    release = threading.Event()
+    orig_multi, orig_single = eng._exec_decode_multi, eng._exec_decode
+
+    def wedged(*a, **k):
+        release.wait(timeout=60)        # a device call that never returns
+        raise RuntimeError("wedged dispatch released")
+
+    eng._exec_decode_multi = wedged
+    eng._exec_decode = wedged
+    try:
+        rid, q = runner.submit(prompt_token_ids=[5, 6, 7], params=PARAMS)
+        t0 = time.monotonic()
+        got_error = None
+        while True:
+            item = q.get(timeout=30)
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                got_error = item
+        elapsed = time.monotonic() - t0
+        assert got_error is not None, "client stranded behind a wedged step"
+        assert "watchdog" in str(got_error) or "stuck" in str(got_error)
+        assert elapsed < 20                  # 2x watchdog + slack, not 60 s
+        assert eng.stats.watchdog_trips >= 1
+        assert eng.stats.engine_restarts >= 1
+    finally:
+        release.set()                        # let the loop thread return
+        eng._exec_decode_multi = orig_multi
+        eng._exec_decode = orig_single
+    # the loop reconciles once the stuck call returns: serving resumes
+    outs, _ = runner.generate_sync(prompt_token_ids=[9, 10, 11],
+                                   params=PARAMS, timeout=120)
+    assert sum(len(o.new_token_ids) for o in outs) == PARAMS.max_tokens
+    runner.shutdown()
+
+
+def test_watchdog_disabled_by_default():
+    eng, runner = _mk(watchdog=0.0)
+    assert runner._watchdog_thread is None
+    runner.generate_sync(prompt_token_ids=[1, 2, 3], params=PARAMS,
+                         timeout=120)
+    assert eng.stats.watchdog_trips == 0
+    runner.shutdown()

@@ -59,6 +59,14 @@ class DeployConfig:
     lora_modules: Optional[dict] = None
     # Admission backpressure cap (server --max-waiting); 0 = auto
     max_waiting: int = 0
+    # Hang watchdog threshold (server --step-watchdog-s): a dispatch
+    # blocking past this is failed + salvaged like an exception instead
+    # of stranding clients behind a wedged device call.  0 disables.
+    step_watchdog_s: float = 0.0
+    # Chaos drills: fault-injection spec exported as TPUSERVE_FAULTS to
+    # the engine pods (runtime/faults.py), e.g.
+    # "decode_dispatch:raise:0.02".  None = no injection (production).
+    faults: Optional[str] = None
     # Graceful-drain budget on SIGTERM (server --drain-timeout); the
     # emitted pod spec's terminationGracePeriodSeconds is derived from
     # this (+35 s headroom) so K8s never SIGKILLs mid-drain
@@ -129,6 +137,13 @@ class DeployConfig:
             raise ValueError("multi_step must be >= 1 when set")
         if self.pipeline_parallel < 1:
             raise ValueError("pipeline_parallel must be >= 1")
+        if self.step_watchdog_s < 0:
+            raise ValueError("step_watchdog_s must be >= 0 (0 disables)")
+        if self.faults:
+            # parse at deploy time: a typo'd chaos spec must fail HERE,
+            # not as an in-cluster CrashLoopBackOff
+            from tpuserve.runtime.faults import FaultInjector
+            FaultInjector.from_spec(self.faults)
         if self.pipeline_parallel > 1 and self.tensor_parallel > 1:
             raise ValueError("pipeline_parallel and tensor_parallel are "
                              "mutually exclusive (the server rejects "

@@ -170,6 +170,11 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
                                       in cfg.lora_modules.items()]
     if cfg.max_waiting:
         args += ["--max-waiting", str(cfg.max_waiting)]
+    if cfg.step_watchdog_s:
+        # hang watchdog: fail+salvage a wedged dispatch instead of waiting
+        # for the liveness probe to kill the whole pod (which loses every
+        # stream the salvage path exists to save)
+        args += ["--step-watchdog-s", str(cfg.step_watchdog_s)]
     # always emitted: the config value and the pod's grace period are
     # derived together — relying on the server's CLI default here would
     # let the two skew if that default ever moves
@@ -191,6 +196,11 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
            # .npz tables instead of walking 151k token texts inline.
            {"name": "TPUSERVE_FSM_CACHE_DIR",
             "value": "/models/.fsm-cache"}]
+    if cfg.faults:
+        # chaos drill: arm the engine's deterministic fault-injection
+        # layer (runtime/faults.py) so recovery claims are verified
+        # in-cluster under seeded chaos, not just in unit tests
+        env.append({"name": "TPUSERVE_FAULTS", "value": cfg.faults})
     if cfg.provider != "gke":
         env.append({"name": "JAX_PLATFORMS", "value": "cpu"})
     if cfg.chat_template:

@@ -107,6 +107,20 @@ class EngineConfig:
     adaptive_multi_step: bool = True
     min_multi_step: int = 4
     adaptive_window_hold_s: float = 0.5
+    # Deterministic fault injection (runtime/faults.py): a chaos spec
+    # string like "decode_dispatch:raise:0.02" arms named injection sites
+    # in the hot path.  None = read TPUSERVE_FAULTS from the environment
+    # (the manifests wire it through for chaos drills); empty/absent =
+    # disabled, and the checks cost two attribute loads per dispatch.
+    faults: Optional[str] = None
+    # Hang watchdog (server/runner.py): a dispatch that blocks longer than
+    # this is declared stuck — the realistic TPU failure mode, where the
+    # device call never returns instead of raising.  The runner scales the
+    # threshold up during the first steps (compiles legitimately take
+    # longer) and fails a stuck step the same way an exception would.
+    # 0 disables (the CPU-test default: interpreted kernels have no hang
+    # bound worth enforcing).
+    step_watchdog_s: float = 0.0
     # Grammar-FSM guided decoding (runtime/grammar/): compile guided
     # specs to token-level FSMs whose per-state masks ride the fused
     # decode window (true logit masking, distribution-correct), so
@@ -179,6 +193,15 @@ class EngineStats:
     # (EOS / max_tokens mid-window) and dropped at emit — the cost of the
     # fused window, worth watching when tuning multi_step
     window_overrun_tokens: int = 0
+    # crash-only recovery (server/runner.py salvage path + watchdog):
+    # requests re-queued through the preemption re-prefill path after a
+    # faulted/stuck step; requests isolated as poison (or out of salvage
+    # budget) and failed individually; watchdog trips on stuck dispatches;
+    # whole-engine fail-all fallbacks (the pre-salvage behaviour)
+    requests_salvaged: int = 0
+    requests_poisoned: int = 0
+    watchdog_trips: int = 0
+    engine_restarts: int = 0
     ttft_sum: float = 0.0
     ttft_count: int = 0
     # recent per-token latencies (decode step wall time / batch)
@@ -421,6 +444,18 @@ class Engine:
                                    max_model_len=self.cache_cfg.max_model_len,
                                    ragged_align=self._ragged_blk)
         self.stats = EngineStats()
+        # Chaos layer (runtime/faults.py): disabled unless EngineConfig
+        # .faults or TPUSERVE_FAULTS arms it.  Every _exec_* hook plus the
+        # KV-allocation and window-flush points run through
+        # self.faults.check(site, rids); _dispatch_rids names the requests
+        # in the dispatch being built, which is also what the runner's
+        # salvage path charges fault budgets against.
+        import os as _os
+        from tpuserve.runtime.faults import FaultInjector
+        spec = (config.faults if config.faults is not None
+                else _os.environ.get("TPUSERVE_FAULTS"))
+        self.faults = FaultInjector.from_spec(spec, seed=config.seed)
+        self._dispatch_rids: tuple = ()
         # device outputs of warmup-only executables (samplers, token
         # select) whose producer chains the end-of-warmup sync must drain
         # individually — see warmup()
@@ -811,7 +846,22 @@ class Engine:
     def abort_request(self, request_id: str) -> bool:
         req = self.scheduler.abort(request_id)
         if req is None:
-            return False
+            # A request orphaned by a faulted prefill dispatch (popped from
+            # waiting, never marked running) is in neither scheduler queue
+            # but may still hold KV blocks; without this fallback every
+            # fail-all/fail-request path leaks them permanently.  Their
+            # contents are suspect, so never park them in the prefix cache.
+            req = self.requests.get(request_id)
+            if req is None or req.finished:
+                return False
+            req.state = RequestState.FINISHED
+            req.finish_reason = FinishReason.ABORT
+            self.block_manager.free(request_id, cache_blocks=False)
+            self._detok.pop(request_id, None)
+            self._guided.pop(request_id, None)
+            self._guided_fsm.pop(request_id, None)
+            self._guided_plan.pop(request_id, None)
+            return True
         # A mid-prefill chunked request (holds blocks but isn't RUNNING yet)
         # has later blocks with no KV written: freeing them into the
         # prefix-cache pool would serve garbage to the next identical
@@ -826,6 +876,42 @@ class Engine:
         self._guided_plan.pop(request_id, None)
         return True
 
+    def salvage_requeue(self) -> list[str]:
+        """Crash-only salvage after a faulted/stuck step (server/runner.py):
+        drop every piece of in-flight device state and re-queue every live
+        request through the existing preemption re-prefill path.  Requests
+        carry prompt + generated tokens, so greedy/seeded replays continue
+        token-identically; KV is recomputed from scratch — freed blocks are
+        NOT parked in the prefix cache (``cache_blocks=False``), because a
+        faulted dispatch leaves their contents suspect.
+
+        Also rescues requests ORPHANED by the fault: a prefill batch's
+        requests are popped from the waiting queue before the dispatch and
+        only marked running after it, so a mid-prefill fault leaves them in
+        neither queue (the old fail-all path leaked their blocks).
+
+        Returns the re-queued request ids (queue-head first)."""
+        self._pending = None
+        self._pending_window = None
+        cohort = list(self.scheduler.running)
+        self.scheduler.running.clear()
+        seen = ({r.request_id for r in cohort}
+                | {r.request_id for r in self.scheduler.waiting})
+        cohort += [r for r in self.requests.values()
+                   if not r.finished and r.request_id not in seen]
+        for r in cohort:
+            self.block_manager.free(r.request_id, cache_blocks=False)
+            r.state = RequestState.PREEMPTED
+            r.num_prefilled = 0
+        for r in self.scheduler.waiting:
+            if r.num_prefilled > 0:
+                # mid-chunk prompts hold blocks whose KV is now suspect too
+                self.block_manager.free(r.request_id, cache_blocks=False)
+                r.num_prefilled = 0
+        for r in reversed(cohort):
+            self.scheduler.waiting.appendleft(r)
+        return [r.request_id for r in cohort]
+
     def has_work(self) -> bool:
         return (self.scheduler.has_work() or self._pending is not None
                 or self._pending_window is not None)
@@ -836,6 +922,7 @@ class Engine:
 
     def step(self) -> list[RequestOutput]:
         """Run one engine iteration (one prefill batch or one decode step)."""
+        self._dispatch_rids = ()
         batch = self.scheduler.schedule()
         if batch is None:
             # nothing schedulable but a decode result may still be in flight
@@ -978,6 +1065,7 @@ class Engine:
         return {"ad": self._lora_ad(reqs, B)}
 
     def _exec_prefill(self, tokens, prompt_lens, slot_ids, ad=None):
+        self.faults.check("prefill_dispatch", self._dispatch_rids)
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_prefill
             return pp_prefill(self._pp_head, self._pp_stages, self.model_cfg,
@@ -990,6 +1078,7 @@ class Engine:
 
     def _exec_decode(self, tokens, positions, slot_ids, block_tables,
                      seq_lens, ad=None):
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_decode_step
             return pp_decode_step(self._pp_head, self._pp_stages,
@@ -1003,6 +1092,7 @@ class Engine:
 
     def _exec_prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables, ad=None):
+        self.faults.check("prefill_dispatch", self._dispatch_rids)
         if self._pp > 1:            # unreachable: gated at add_request
             raise RuntimeError("chunked prefill is not supported on the "
                                "pipeline engine")
@@ -1013,6 +1103,7 @@ class Engine:
 
     def _exec_decode_verify(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables):
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         # Speculative decoding is single-process only (gated in __init__),
         # so no coordinator wraps this hook; it exists so the AST coverage
         # test can hold the "no direct transformer calls" line everywhere.
@@ -1026,6 +1117,7 @@ class Engine:
     def _exec_decode_verify_sampled(self, tokens, ctx_lens, chunk_lens,
                                     slot_ids, block_tables, keys,
                                     temperature, top_k, top_p, min_p):
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         # sampled-batch twin of _exec_decode_verify: rejection-sampling
         # acceptance runs on device against the full verify logits
         return transformer.decode_verify_sampled(
@@ -1034,6 +1126,7 @@ class Engine:
             top_k, top_p, min_p)
 
     def _exec_draft_propose(self, tokens, lens, *, k):
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         # Draft-model speculation is single-process only (gated with the
         # rest of speculation in __init__); the hook exists so the AST
         # coverage test can hold the "no direct transformer calls" line
@@ -1049,6 +1142,7 @@ class Engine:
                            floor_bias=None, floor_remaining=None,
                            gstate=None, gmasks=None, gclass=None,
                            gnext=None, ad=None):
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         if self._pp > 1:
             from tpuserve.parallel.pipeline import pp_decode_multi
             return pp_decode_multi(
@@ -1073,6 +1167,7 @@ class Engine:
     def _exec_forward_ragged(self, tokens, positions, slot_ids, row_seq,
                              block_tables, kv_lens, q_starts, q_lens,
                              meta, blk_seq, last_rows, ad=None):
+        self.faults.check("mixed_dispatch", self._dispatch_rids)
         # mixed batching is gated single-process/non-pp in __init__, so
         # no coordinator wraps this hook; it exists for the AST coverage
         # test's "no direct transformer calls" line (_exec_decode_verify
@@ -1087,6 +1182,9 @@ class Engine:
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
                      min_p=None, mode):
+        # sampling executables ride the decode site: they are part of the
+        # same device round-trip a dispatch failure would take down
+        self.faults.check("decode_dispatch", self._dispatch_rids)
         return sampling_ops.sample_tokens(
             logits, keys, temperature, top_k, top_p, min_p=min_p, mode=mode)
 
@@ -1094,6 +1192,7 @@ class Engine:
 
     def _run_prefill(self, batch: ScheduledBatch) -> list[RequestOutput]:
         reqs = batch.requests
+        self._dispatch_rids = tuple(r.request_id for r in reqs)
         L = batch.padded_len
         B = next_power_of_2(len(reqs))
         tokens = np.zeros((B, L), np.int32)
@@ -1101,6 +1200,7 @@ class Engine:
         prompt_lens = np.ones((B,), np.int32)
         for i, req in enumerate(reqs):
             ids = self._prefill_tokens(req)
+            self.faults.check("kv_alloc", (req.request_id,))
             shared, _cached = self.block_manager.lookup_prefix(ids)
             self.block_manager.allocate(req.request_id, ids, shared_blocks=shared)
             tokens[i, :len(ids)] = ids
@@ -1148,9 +1248,11 @@ class Engine:
         any prompt length.  The request re-enters the waiting queue until
         its last chunk, which samples the first token."""
         req = batch.requests[0]
+        self._dispatch_rids = (req.request_id,)
         C = batch.padded_len
         ids = self._prefill_tokens(req)
         if req.num_prefilled == 0:
+            self.faults.check("kv_alloc", (req.request_id,))
             shared, cached = self.block_manager.lookup_prefix(ids)
             self.block_manager.allocate(req.request_id, ids,
                                         shared_blocks=shared)
@@ -1221,6 +1323,7 @@ class Engine:
         """
         outputs = self._flush_pending() + self._flush_window()
         decode_reqs = [r for r in batch.requests if not r.finished]
+        self._dispatch_rids = tuple(r.request_id for r in decode_reqs)
         # decode rows each append one KV slot — the same reserve-then-
         # append preemption discipline as _run_decode (no pending here:
         # both pipelines were just flushed)
@@ -1233,6 +1336,7 @@ class Engine:
                 raise MemoryError("KV cache exhausted with a single "
                                   "sequence")
             decode_reqs = [r for r in decode_reqs if r is not victim]
+        self.faults.check("kv_alloc", self._dispatch_rids)
         slots = [self.block_manager.append_slot(r.request_id)
                  for r in decode_reqs]
         # prefill chunks: first chunk allocates (with prefix-cache
@@ -1242,6 +1346,7 @@ class Engine:
         for req, n in batch.prefill_chunks:
             ids = self._prefill_tokens(req)
             if req.num_prefilled == 0:
+                self.faults.check("kv_alloc", (req.request_id,))
                 try:
                     shared, cached = self.block_manager.lookup_prefix(ids)
                     self.block_manager.allocate(req.request_id, ids,
@@ -1255,6 +1360,9 @@ class Engine:
             chunks.append((req, ids, done, take))
         if not decode_reqs and not chunks:
             return outputs
+        self._dispatch_rids = tuple(
+            [r.request_id for r in decode_reqs]
+            + [c[0].request_id for c in chunks])
         # completing chunks sample this step; order them before
         # continuing ones so the sampled rows form a prefix
         comp = [c for c in chunks if c[2] + c[3] == len(c[1])]
@@ -1454,6 +1562,8 @@ class Engine:
                         and r.num_tokens + p.steps < self.max_seq_len)]
         if not reqs:
             return outputs + self._flush_window()
+        self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self.faults.check("kv_alloc", self._dispatch_rids)
         # Rows continuing from the in-flight window need p.steps extra KV
         # slots (its advance hasn't run yet); reserving the conservative
         # bound for every row over-reserves fresh rows by p.steps slots,
@@ -1615,6 +1725,12 @@ class Engine:
         p, self._pending_window = self._pending_window, None
         if p is None:
             return []
+        # fault site: the device->host sync that resolves a window is its
+        # own failure point (dead tunnel / wedged transfer).  The window is
+        # already detached above, so a fault here drops it orphaned —
+        # exactly what the salvage path expects to find.
+        self.faults.check("window_flush",
+                          tuple(r.request_id for r in p.reqs))
         toks_h = np.asarray(jax.device_get(p.toks))
         lp_h = None
         if p.lp is not None:
@@ -1683,6 +1799,7 @@ class Engine:
                         and r.num_tokens + 1 < self.max_seq_len)]
         if not reqs:
             return outputs + self._flush_pending()
+        self._dispatch_rids = tuple(r.request_id for r in reqs)
         # Reserve capacity up front (preempting if needed), THEN append —
         # append_slot mutates per-seq state, so it must not fail mid-batch.
         while (sum(self.block_manager.needs_new_block(r.request_id) for r in reqs)
@@ -1704,6 +1821,8 @@ class Engine:
             reqs = [r for r in reqs if r is not victim]
             if not reqs:
                 return outputs
+        self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self.faults.check("kv_alloc", self._dispatch_rids)
         slots = [self.block_manager.append_slot(r.request_id) for r in reqs]
         B = self.scheduler.decode_bucket(len(reqs))
         host_tokens = np.zeros((B,), np.int32)
@@ -1766,6 +1885,7 @@ class Engine:
         reqs = [r for r in batch.requests if not r.finished]
         if not reqs:
             return outputs
+        self._dispatch_rids = tuple(r.request_id for r in reqs)
         k = self._spec.num_draft_tokens
         K = k + 1
         if self._draft_params is not None:
@@ -2381,6 +2501,9 @@ class Engine:
     def _emit_one(self, req: Request, tok: int,
                   from_prefill: bool = False) -> RequestOutput:
         req.output_token_ids.append(tok)
+        # progress resets the salvage budget: the budget bounds CONSECUTIVE
+        # faulted attempts, not total faults a long stream lives through
+        req.num_salvages = 0
         self.stats.generated_tokens += 1
         raw_delta = self._detok[req.request_id].add(tok)
         delta = raw_delta
@@ -2710,17 +2833,25 @@ class Engine:
     # SURVEY.md §7 "TTFT ≤150 ms requires compile-cache warmup at startup")
     # ------------------------------------------------------------------
 
-    def warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] | None
-               = None,
-               decode_buckets: Sequence[int] = (),
-               sample_modes: Sequence[str] = ("greedy", "temperature",
-                                              "full", "logprobs",
-                                              "penalties", "bias",
-                                              "min_tokens"),
-               chunk_buckets: Sequence[int] = (),
-               embed_buckets: Sequence[tuple[int, int]] = (),
-               mixed_buckets: Sequence[int] | None = None,
-               ) -> None:
+    def warmup(self, *args, **kwargs) -> None:
+        """Fault-suspended wrapper over :meth:`_warmup`: warmup runs the
+        same ``_exec_*`` hooks as serving, and an armed chaos spec firing
+        during startup compiles would fail the pod before it ever served —
+        not the failure mode the injector exists to test."""
+        with self.faults.suspended():
+            return self._warmup(*args, **kwargs)
+
+    def _warmup(self, prefill_buckets: Sequence[int | tuple[int, int]] | None
+                = None,
+                decode_buckets: Sequence[int] = (),
+                sample_modes: Sequence[str] = ("greedy", "temperature",
+                                               "full", "logprobs",
+                                               "penalties", "bias",
+                                               "min_tokens"),
+                chunk_buckets: Sequence[int] = (),
+                embed_buckets: Sequence[tuple[int, int]] = (),
+                mixed_buckets: Sequence[int] | None = None,
+                ) -> None:
         """Pre-compile executables.  ``prefill_buckets`` entries are either a
         padded prompt length L (compiled at batch 1) or a ``(batch, L)`` pair
         — _run_prefill pads the batch to a power of two, so warming only

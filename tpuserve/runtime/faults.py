@@ -1,0 +1,197 @@
+"""Deterministic fault injection for the serving engine (chaos layer).
+
+The reference gets failure "testing" for free from K8s restart semantics —
+kill a vLLM pod and watch it come back (SURVEY.md §5) — which exercises
+recovery only at pod granularity and only by hand.  This layer makes
+device-level failure a first-class, *seeded* test input: named injection
+sites inside the engine's hot path can raise, hang, or delay at a
+configured per-site probability, so every robustness claim in this repo
+(runner salvage, poison-batch bisection, the hang watchdog) is
+mechanically checkable under controlled chaos instead of anecdotally
+checkable under real outages.
+
+Sites (see the ``_exec_*`` hooks and allocation points in
+``runtime/engine.py``):
+
+- ``prefill_dispatch`` — batched/chunked prefill device calls
+- ``decode_dispatch``  — decode steps, fused windows, spec verify, samplers
+- ``mixed_dispatch``   — ragged mixed prefill+decode dispatches
+- ``kv_alloc``         — KV block allocation / append / window reserve
+- ``window_flush``     — resolving an in-flight pipelined window
+
+Configured by a spec string (``EngineConfig.faults`` or the
+``TPUSERVE_FAULTS`` env var, wired into the deploy manifests for chaos
+drills): comma-separated rules of the form ``site:mode:prob`` with
+optional ``key=value`` suffixes::
+
+    decode_dispatch:raise:0.02                    # 2% of decode dispatches
+    prefill_dispatch:hang:1.0:count=1             # one-shot hang
+    decode_dispatch:raise:1.0:match=poison        # only dispatches carrying
+                                                  # a request id containing
+                                                  # "poison"
+    kv_alloc:delay:0.1:delay_s=0.2                # 10% allocations +200ms
+    seed=7                                        # global RNG seed item
+
+Modes: ``raise`` (InjectedFault), ``hang`` (block until the watchdog
+releases it or ``max_hang_s`` passes, then raise — a realistic TPU hang is
+a device call that never returns, and the raise is how a *released* hang
+re-enters the normal fault path), ``delay`` (sleep ``delay_s``, continue).
+``count=N`` caps total fires per rule; ``match=S`` restricts a rule to
+dispatches carrying a request id containing S — the deterministic "poison
+request" primitive the bisection tests are built on.
+
+Disabled (no rules) the injector is a no-op: ``check()`` is two attribute
+loads and a truth test, so production pays nothing for the hooks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import threading
+import time
+from typing import Optional, Sequence
+
+SITES = ("prefill_dispatch", "decode_dispatch", "mixed_dispatch",
+         "kv_alloc", "window_flush")
+MODES = ("raise", "hang", "delay")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a chaos injection site — the in-process analog of a device
+    dispatch failing (or, for released hangs, never returning)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    mode: str                      # "raise" | "hang" | "delay"
+    prob: float
+    count: Optional[int] = None    # max fires; None = unlimited
+    match: Optional[str] = None    # only dispatches carrying a matching rid
+    delay_s: float = 0.05
+    max_hang_s: float = 30.0
+    fired: int = 0
+
+
+class FaultInjector:
+    """Seeded per-site fault source.  One instance per engine; every draw
+    comes from one ``random.Random(seed)``, so a fixed seed plus a fixed
+    call order reproduces the exact fault sequence."""
+
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._hang_release = threading.Event()
+        self._suspended = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.rules)
+
+    def check(self, site: str, rids: Sequence[str] = ()) -> None:
+        """Run the injection point named ``site`` for a dispatch carrying
+        request ids ``rids``.  May raise InjectedFault, block (hang), or
+        sleep (delay); no-op when disabled or suspended."""
+        if not self.rules or self._suspended:
+            return
+        for rule in self.rules:
+            if rule.site != site:
+                continue
+            if rule.count is not None and rule.fired >= rule.count:
+                continue
+            if rule.match is not None and not any(
+                    rule.match in rid for rid in rids):
+                continue
+            if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                continue
+            rule.fired += 1
+            if rule.mode == "delay":
+                time.sleep(rule.delay_s)
+                continue
+            if rule.mode == "hang":
+                # Block like a wedged device call; the runner's watchdog
+                # releases us (release_hangs), at which point the hang
+                # becomes an ordinary fault and rides the salvage path.
+                # The timeout is a backstop so an injector without a
+                # watchdog can't wedge a test run forever.
+                self._hang_release.clear()
+                released = self._hang_release.wait(timeout=rule.max_hang_s)
+                raise InjectedFault(
+                    f"injected hang at {site} "
+                    + ("(released by watchdog)" if released
+                       else f"(timed out after {rule.max_hang_s}s)"))
+            raise InjectedFault(f"injected fault at {site}")
+
+    def release_hangs(self) -> None:
+        """Unblock any thread currently parked in an injected hang (called
+        by the watchdog on trip; the hang then raises InjectedFault)."""
+        self._hang_release.set()
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """No faults inside this context — warmup runs the same ``_exec_*``
+        hooks as serving, and a fault during startup compiles would fail
+        the pod before it ever served (not the failure mode under test)."""
+        self._suspended += 1
+        try:
+            yield
+        finally:
+            self._suspended -= 1
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str], seed: int = 0) -> "FaultInjector":
+        """Parse a spec string (see module docstring).  None/"" disables.
+        Raises ValueError on malformed rules — a chaos drill with a typo'd
+        spec must fail loudly, not silently inject nothing."""
+        if not spec:
+            return cls((), seed)
+        rules: list[FaultRule] = []
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if item.startswith("seed="):
+                seed = int(item[len("seed="):])
+                continue
+            parts = item.split(":")
+            if len(parts) < 3:
+                raise ValueError(
+                    f"bad fault rule {item!r}: want site:mode:prob"
+                    "[:key=value...]")
+            site, mode, prob_s = parts[0], parts[1], parts[2]
+            if site not in SITES:
+                raise ValueError(f"unknown fault site {site!r}; "
+                                 f"known: {SITES}")
+            if mode not in MODES:
+                raise ValueError(f"unknown fault mode {mode!r}; "
+                                 f"known: {MODES}")
+            try:
+                prob = float(prob_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault probability {prob_s!r} in {item!r}") from None
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(f"fault probability must be in (0, 1], "
+                                 f"got {prob}")
+            rule = FaultRule(site=site, mode=mode, prob=prob)
+            for kv in parts[3:]:
+                key, sep, val = kv.partition("=")
+                if not sep:
+                    raise ValueError(f"bad fault option {kv!r} in {item!r}: "
+                                     "want key=value")
+                if key == "count":
+                    rule.count = int(val)
+                elif key == "match":
+                    rule.match = val
+                elif key == "delay_s":
+                    rule.delay_s = float(val)
+                elif key == "max_hang_s":
+                    rule.max_hang_s = float(val)
+                else:
+                    raise ValueError(f"unknown fault option {key!r} in "
+                                     f"{item!r} (count/match/delay_s/"
+                                     "max_hang_s)")
+            rules.append(rule)
+        return cls(rules, seed)

@@ -159,6 +159,13 @@ class Request:
     # multi-LoRA: index into the engine's loaded adapter stack
     # (weights.load_lora_stack); None = base model
     adapter_idx: Optional[int] = None
+    # crash-only salvage: CONSECUTIVE faulted engine steps this request was
+    # dispatched in without emitting a token since (reset on every emission
+    # — engine._emit_one).  The runner's per-request fault budget
+    # (AsyncEngineRunner.max_salvages) fails the request once this exceeds
+    # it, bounding retry loops without punishing long streams that merely
+    # coexist with sporadic chaos.
+    num_salvages: int = 0
 
     @property
     def num_prompt_tokens(self) -> int:

@@ -100,6 +100,11 @@ class Scheduler:
         self.ragged_align = max(1, ragged_align)
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
+        # Fault-salvage bisection (server/runner.py): when set, only these
+        # request ids may be ADMITTED from the waiting queue — suspect
+        # groups are probed in isolation to find a poison request.  Running
+        # requests are unaffected; None lifts the restriction.
+        self.admission_filter: Optional[set[str]] = None
         # Set after scheduling a chunked-prefill step: the next cycle runs a
         # decode step first (if anything is running) so in-flight streams get
         # a token between chunks — without this, a 32k prompt at the 2048
@@ -179,7 +184,31 @@ class Scheduler:
         return min(max(next_power_of_2(n), self.cfg.min_decode_bucket),
                    next_power_of_2(self.cfg.max_num_seqs))
 
+    def set_admission_filter(self, allowed) -> None:
+        """Restrict admission from the waiting queue to ``allowed`` request
+        ids (None lifts).  The crash-only salvage path uses this to replay
+        bisected suspect groups one at a time; everything held back keeps
+        its queue position and admits normally once the filter lifts."""
+        self.admission_filter = set(allowed) if allowed is not None else None
+
     def schedule(self) -> Optional[ScheduledBatch]:
+        """Admission-filter wrapper over :meth:`_schedule`: held-back
+        requests are lifted out of the waiting queue for the duration of
+        one scheduling decision and restored in order, so the policy code
+        below never has to reason about the filter."""
+        if self.admission_filter is None:
+            return self._schedule()
+        held = [r for r in self.waiting
+                if r.request_id not in self.admission_filter]
+        for r in held:
+            self.waiting.remove(r)
+        try:
+            return self._schedule()
+        finally:
+            for r in reversed(held):
+                self.waiting.appendleft(r)
+
+    def _schedule(self) -> Optional[ScheduledBatch]:
         """Pick the next batch.  Prefill-priority: admit waiting work first
         (keeps TTFT low and the decode batch full), then decode.  Exception:
         directly after a chunked-prefill step, one decode step runs first so

@@ -77,6 +77,12 @@ class GatewayConfig:
     # what a prefix-cache hit saves.
     affinity_load_slack: int = 8
     upstream_timeout_s: float = 600.0
+    # Eject a backend after this many CONSECUTIVE failures — 5xx responses
+    # count, not only connect failures: a backend whose engine loop is
+    # fail-all-ing every request answers connects just fine.  An ejected
+    # backend stops receiving new traffic until the health probe loop
+    # sees its /healthz pass again (auto-readmit).
+    eject_after_failures: int = 2
 
 
 class Gateway:
@@ -143,34 +149,54 @@ class Gateway:
             return chosen
 
     def release(self, backend: Backend, ok: bool) -> None:
+        """Return a backend after a request.  ``ok=False`` covers BOTH
+        connect failures and 5xx responses (the HTTPError relay path
+        passes ``ok=e.code < 500``); enough consecutive failures eject
+        the backend until the health loop readmits it."""
         with self._lock:
             backend.outstanding = max(backend.outstanding - 1, 0)
             if ok:
                 backend.consecutive_failures = 0
             else:
                 backend.consecutive_failures += 1
-                if backend.consecutive_failures >= 2:
+                if (backend.consecutive_failures
+                        >= self.config.eject_after_failures):
+                    if backend.healthy:
+                        logger.warning(
+                            "ejecting backend %s after %d consecutive "
+                            "failures (readmit via health probe)",
+                            backend.url, backend.consecutive_failures)
                     backend.healthy = False
 
     # ---- health checking ------------------------------------------------
 
+    def probe_backends_once(self) -> None:
+        """One health-probe round: readmits ejected backends whose
+        /healthz passes again (resetting their failure count) and ejects
+        ones that stopped answering.  The background loop below is just
+        this on a timer."""
+        for b in self.backends:
+            try:
+                with urllib.request.urlopen(
+                        b.url + "/healthz",
+                        timeout=self.config.health_timeout_s) as resp:
+                    ok = resp.status == 200
+            except Exception:
+                ok = False
+            with self._lock:
+                if ok:
+                    if not b.healthy:
+                        logger.info("readmitting backend %s (health probe "
+                                    "passed)", b.url)
+                    b.healthy = True
+                    b.consecutive_failures = 0
+                else:
+                    b.healthy = False
+                b.last_checked = time.monotonic()
+
     def _health_loop(self):
         while not self._stop.wait(self.config.health_interval_s):
-            for b in self.backends:
-                try:
-                    with urllib.request.urlopen(
-                            b.url + "/healthz",
-                            timeout=self.config.health_timeout_s) as resp:
-                        ok = resp.status == 200
-                except Exception:
-                    ok = False
-                with self._lock:
-                    if ok:
-                        b.healthy = True
-                        b.consecutive_failures = 0
-                    else:
-                        b.healthy = False
-                    b.last_checked = time.monotonic()
+            self.probe_backends_once()
 
     # ---- lifecycle -------------------------------------------------------
 

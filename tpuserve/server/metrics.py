@@ -144,6 +144,30 @@ class ServerMetrics:
             "Fused multi-step windows that carried grammar-FSM masks — "
             "zero under guided load means constraints are pinning "
             "decode to per-step dispatches")
+        self.requests_salvaged = counter(
+            "tpuserve_requests_salvaged_total",
+            "Requests re-queued through the preemption re-prefill path "
+            "after a faulted/stuck engine step and replayed "
+            "token-identically (crash-only salvage, server/runner.py) — "
+            "each count is a stream that would have died under the "
+            "reference's pod-restart-only recovery")
+        self.requests_poisoned = counter(
+            "tpuserve_requests_poisoned_total",
+            "Requests isolated as poison by fault bisection (or out of "
+            "salvage budget) and failed with a per-request error while "
+            "the rest of their batch resumed — a poisoned batch costs "
+            "one request, not a batch")
+        self.watchdog_trips = counter(
+            "tpuserve_engine_watchdog_trips",
+            "Engine dispatches declared stuck by the hang watchdog "
+            "(past step_watchdog_s) — the realistic TPU failure mode, "
+            "where the device call blocks instead of raising")
+        self.engine_restarts = counter(
+            "tpuserve_engine_restarts",
+            "Whole-engine fail-all fallbacks: fault storms past the "
+            "salvage window, unrecoverable hangs, or engines without "
+            "the salvage hook — each count failed every in-flight "
+            "stream (the pre-salvage crash-only behaviour)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

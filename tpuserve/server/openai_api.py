@@ -61,6 +61,11 @@ class ServerConfig:
     # POST /internal/migrate (parallel/disagg_net.py).  Off unless the pod
     # is started with --role decode.
     allow_kv_migration: bool = False
+    # Retry-After seconds on the drain-time 503 — short: the K8s Service
+    # stopped routing here when readyz flipped, so an immediate retry
+    # lands on another replica; the header exists so well-behaved clients
+    # back off at all instead of treating the 503 as terminal.
+    drain_retry_after_s: int = 1
 
 
 def _num(body: dict, key: str, default, cast):
@@ -478,16 +483,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- helpers -------------------------------------------------------
 
-    def _json(self, code: int, payload: dict) -> None:
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, code: int, message: str, etype: str = "invalid_request_error") -> None:
-        self._json(code, {"error": {"message": message, "type": etype}})
+    def _error(self, code: int, message: str,
+               etype: str = "invalid_request_error",
+               headers: Optional[dict] = None) -> None:
+        self._json(code, {"error": {"message": message, "type": etype}},
+                   headers=headers)
 
     def _read_body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -588,9 +599,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.ctx.draining:
                 # graceful drain: in-flight streams keep running;
-                # everything new gets a retryable 503
+                # everything new gets a retryable 503 WITH Retry-After so
+                # K8s-fronted clients/gateways back off instead of
+                # hammering a pod that is seconds from termination
                 self._error(503, "server is draining; retry another "
-                                 "replica", "server_error")
+                                 "replica", "server_error",
+                            headers={"Retry-After": str(
+                                self.ctx.config.drain_retry_after_s)})
                 return
             self._do_post_inner()
         finally:
@@ -1562,6 +1577,18 @@ def main(argv=None):
                          "auto — on on TPU, off on CPU")
     ap.add_argument("--no-pipeline", dest="pipeline", action="store_false",
                     help="force synchronous decode")
+    ap.add_argument("--step-watchdog-s", type=float, default=0.0,
+                    help="hang watchdog: a dispatch blocking longer than "
+                         "this is declared stuck — in-flight requests are "
+                         "salvaged (re-queued + replayed) the same way an "
+                         "exception would trigger, instead of clients "
+                         "hanging forever on a wedged device call "
+                         "(0 disables; scaled up for early compile steps)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="deterministic fault injection for chaos drills "
+                         "(runtime/faults.py), e.g. "
+                         "'decode_dispatch:raise:0.02'; equivalent to the "
+                         "TPUSERVE_FAULTS env var")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=25.0,
                     help="graceful-drain budget on SIGTERM, seconds; keep "
@@ -1615,7 +1642,8 @@ def main(argv=None):
         multi_step=args.multi_step, pipeline_decode=args.pipeline,
         adaptive_multi_step=not args.no_adaptive_window,
         min_multi_step=args.min_multi_step,
-        quantization=args.quantization)
+        quantization=args.quantization,
+        faults=args.faults, step_watchdog_s=args.step_watchdog_s)
     mesh = None
     if args.pp > 1 and args.tp > 1:
         ap.error("--pp and --tp are mutually exclusive (tp-within-stage "

@@ -13,10 +13,11 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from typing import Optional, Sequence, Union
 
 from tpuserve.runtime.engine import Engine
-from tpuserve.runtime.request import RequestOutput, SamplingParams
+from tpuserve.runtime.request import RequestOutput, RequestState, SamplingParams
 
 logger = logging.getLogger("tpuserve.server")
 
@@ -47,6 +48,18 @@ class _Abort:
 
 
 @dataclasses.dataclass
+class _SalvageState:
+    """Poison-batch bisection in progress: suspect request groups are
+    replayed in isolation (scheduler admission filter) until the dispatch
+    that faults shrinks to a single request — the poison — which is then
+    failed with a clean per-request error while everyone else resumes."""
+    groups: deque                 # deque[set[str]] groups still to probe
+    cleared: set                  # rids that survived a probe (run freely)
+    active: Optional[set] = None  # group currently being probed
+    ok_steps: int = 0             # successful steps since the probe started
+
+
+@dataclasses.dataclass
 class _InjectPrefilled:
     """Cross-pod disaggregation: a sequence prefilled on another pod, to be
     adopted into this engine's decode batch (parallel/disagg_net.py)."""
@@ -65,6 +78,22 @@ class AsyncEngineRunner:
     both Engine and DisaggregatedEngine.
     """
 
+    # crash-only tuning knobs (instance attrs so tests/operators can adjust)
+    MAX_SALVAGES = 12            # consecutive faulted attempts per request;
+    #                              must exceed ~2+log2(batch) so an innocent
+    #                              sharing bisection rounds with a poison
+    #                              request never exhausts it first
+    PROBE_OK_STEPS = 3           # fault-free steps before a group is cleared
+    POISON_CONFIRM = 3           # consecutive SINGLETON-probe faults before
+    #                              a request is declared poison — transient
+    #                              chaos that happened to fault a singleton
+    #                              probe once must not kill an innocent
+    #                              stream; a real poison re-faults every probe
+    MAX_FAULTS_PER_WINDOW = 20   # whole-engine faults inside FAULT_WINDOW_S
+    FAULT_WINDOW_S = 30.0        # before falling back to fail-all
+    WATCHDOG_WARMUP_STEPS = 10   # early steps may include XLA compiles:
+    WATCHDOG_WARMUP_SCALE = 20.0  # scale the hang threshold up for them
+
     def __init__(self, engine, metrics=None):
         self.engine = engine
         self.metrics = metrics
@@ -80,6 +109,21 @@ class AsyncEngineRunner:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpuserve-engine-loop")
         self._started = False
+        # crash-only recovery state (salvage + bisection + watchdog)
+        self.max_salvages = self.MAX_SALVAGES
+        self.probe_ok_steps = self.PROBE_OK_STEPS
+        self.poison_confirm = self.POISON_CONFIRM
+        self._singleton_faults: dict[str, int] = {}
+        self.step_watchdog_s = float(getattr(
+            getattr(engine, "config", None), "step_watchdog_s", 0.0) or 0.0)
+        self._fault_times: list[float] = []
+        self._salvage: Optional[_SalvageState] = None
+        self._steps_done = 0
+        self._step_seq = 0
+        self._step_started: Optional[tuple[int, float]] = None
+        self._hard_trip_seq: Optional[int] = None
+        self._fail_lock = threading.Lock()
+        self._watchdog_thread: Optional[threading.Thread] = None
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -87,6 +131,11 @@ class AsyncEngineRunner:
         if not self._started:
             self._started = True
             self._thread.start()
+            if self.step_watchdog_s > 0:
+                self._watchdog_thread = threading.Thread(
+                    target=self._watchdog_loop, daemon=True,
+                    name="tpuserve-engine-watchdog")
+                self._watchdog_thread.start()
 
     def idle(self) -> bool:
         """No engine work and no undelivered outputs — safe to stop.
@@ -258,6 +307,272 @@ class AsyncEngineRunner:
                     self._out_queues.pop(out.request_id, None)
                     q.put(None)
 
+    # ---- crash-only recovery: salvage, bisection, watchdog --------------
+
+    def _inner_engines(self) -> list:
+        eng = self.engine
+        inners = [e for e in (getattr(eng, "prefill", None),
+                              getattr(eng, "decode", None)) if e is not None]
+        return inners or [eng]
+
+    def _bump_stat(self, name: str, n: int = 1) -> None:
+        """Count a recovery event on the engine's stats object (exported by
+        _update_gauges); disagg facades carry stats on their inner
+        engines — charge the first one so the counter still surfaces."""
+        for e in self._inner_engines():
+            stats = getattr(e, "stats", None)
+            if stats is not None and hasattr(stats, name):
+                setattr(stats, name, getattr(stats, name) + n)
+                return
+
+    def _set_admission_filter(self, allowed) -> None:
+        for e in self._inner_engines():
+            sched = getattr(e, "scheduler", None)
+            if sched is not None and hasattr(sched, "set_admission_filter"):
+                sched.set_admission_filter(allowed)
+
+    def _fail_all(self, message: str, engine_side: bool = True) -> None:
+        """The pre-salvage crash-only fallback: fail every in-flight stream
+        and drain the engine so nothing re-raises in a tight loop.
+
+        ``engine_side=False`` is the watchdog-thread variant: only the
+        client queues (thread-safe) are touched, because the loop thread
+        may still be INSIDE the stuck dispatch and scheduler/block-manager
+        state must not be mutated under it — `_consume_hard_trip` does the
+        engine-side cleanup on the loop thread if the call ever returns."""
+        with self._fail_lock:
+            for rid, q in list(self._out_queues.items()):
+                if engine_side:
+                    try:
+                        self.engine.abort_request(rid)
+                    except Exception:
+                        pass
+                    getattr(self.engine, "requests", {}).pop(rid, None)
+                q.put(RuntimeError(message))
+                q.put(None)
+            self._out_queues.clear()
+            self._req_started.clear()
+            self._last_token_time.clear()
+            self._singleton_faults.clear()
+
+    def _fail_request(self, rid: str, message: str,
+                      poisoned: bool = False) -> None:
+        """Fail ONE stream with a clean per-request error — the whole point
+        of salvage: a poisoned batch costs one request, not a batch."""
+        try:
+            self.engine.abort_request(rid)
+        except Exception:
+            pass
+        getattr(self.engine, "requests", {}).pop(rid, None)
+        self._req_started.pop(rid, None)
+        self._last_token_time.pop(rid, None)
+        q = self._out_queues.pop(rid, None)
+        if q is not None:
+            q.put(RuntimeError(message))
+            q.put(None)
+        if poisoned:
+            self._bump_stat("requests_poisoned")
+        logger.warning("request %s failed: %s", rid, message)
+
+    def _handle_step_fault(self, exc: Exception) -> None:
+        """Salvage instead of mass-fail: requeue every in-flight request
+        through the engine's preemption re-prefill path and replay; a
+        cohort that faults AGAIN is bisected until the poison request(s)
+        are isolated and failed individually.  Engines without the salvage
+        hook, and fault storms past MAX_FAULTS_PER_WINDOW, fall back to
+        the old fail-all (+ tpuserve_engine_restarts)."""
+        now = time.monotonic()
+        self._fault_times = [t for t in self._fault_times
+                             if now - t < self.FAULT_WINDOW_S]
+        self._fault_times.append(now)
+        eng = self.engine
+        salvage = getattr(eng, "salvage_requeue", None)
+        if (salvage is None
+                or len(self._fault_times) > self.MAX_FAULTS_PER_WINDOW):
+            self._bump_stat("engine_restarts")
+            self._salvage = None
+            self._set_admission_filter(None)
+            self._fail_all(f"engine failure: {exc}")
+            return
+        salvage()
+        # charge the fault against the requests that were actually in the
+        # faulted dispatch (engine._dispatch_rids); a fault outside any
+        # dispatch (window flush at an idle step) charges everyone live
+        dispatched = set(getattr(eng, "_dispatch_rids", ()) or ())
+        requests = getattr(eng, "requests", {})
+        cohort = []
+        for rid in list(self._out_queues):
+            req = requests.get(rid)
+            if req is None or req.finished:
+                continue
+            if dispatched and rid not in dispatched:
+                continue
+            req.num_salvages += 1
+            if req.num_salvages > self.max_salvages:
+                self._fail_request(
+                    rid, f"request failed {req.num_salvages} consecutive "
+                         f"faulted engine steps (salvage budget "
+                         f"{self.max_salvages} exhausted): {exc}",
+                    poisoned=True)
+            else:
+                cohort.append(rid)
+                self._bump_stat("requests_salvaged")
+        if not cohort:
+            self._salvage = None
+            self._set_admission_filter(None)
+            return
+        if self._salvage is None:
+            # first fault: replay the whole cohort as one probe group — a
+            # transient fault salvages everyone with no bisection at all
+            self._salvage = _SalvageState(groups=deque([set(cohort)]),
+                                          cleared=set())
+        else:
+            st = self._salvage
+            suspect = set(st.active if st.active else cohort) & set(cohort)
+            st.active = None
+            st.ok_steps = 0
+            if len(suspect) <= 1:
+                for rid in suspect:
+                    n = self._singleton_faults.get(rid, 0) + 1
+                    self._singleton_faults[rid] = n
+                    if n >= self.poison_confirm:
+                        self._singleton_faults.pop(rid, None)
+                        self._fail_request(
+                            rid, "poison request isolated by fault "
+                                 f"bisection ({n} consecutive solo "
+                                 f"faults): {exc}", poisoned=True)
+                    else:
+                        # could still be transient chaos that landed on a
+                        # solo probe: re-probe before condemning it
+                        st.groups.appendleft({rid})
+            else:
+                # the probed group faulted again: bisect and probe halves
+                ordered = sorted(suspect)
+                half = len(ordered) // 2
+                st.groups.appendleft(set(ordered[half:]))
+                st.groups.appendleft(set(ordered[:half]))
+        self._advance_salvage()
+
+    def _advance_salvage(self) -> None:
+        """Arm the next probe group (admission filter = cleared ∪ active);
+        lift the filter when nothing is left to probe."""
+        st = self._salvage
+        if st is None:
+            self._set_admission_filter(None)
+            return
+        while st.active is None and st.groups:
+            group = {rid for rid in st.groups.popleft()
+                     if rid in self._out_queues}
+            if group:
+                st.active = group
+                st.ok_steps = 0
+        if st.active is None:
+            self._salvage = None
+            self._set_admission_filter(None)
+            return
+        self._set_admission_filter(st.cleared | st.active)
+
+    def _note_salvage_progress(self) -> None:
+        """Called after every successful engine step while a probe is
+        armed: a group that ran PROBE_OK_STEPS fault-free dispatches (or
+        finished outright) is cleared, and the next suspect group probes."""
+        st = self._salvage
+        if st is None or st.active is None:
+            return
+        live = {rid for rid in st.active if rid in self._out_queues}
+        if live:
+            requests = getattr(self.engine, "requests", {})
+            if not all(getattr(requests.get(rid), "state", None)
+                       == RequestState.RUNNING for rid in live):
+                return          # probe group not fully (re-)admitted yet
+            st.ok_steps += 1
+            if st.ok_steps < self.probe_ok_steps:
+                return
+        for rid in st.active:
+            # a clean solo probe exonerates: reset its poison evidence
+            self._singleton_faults.pop(rid, None)
+        st.cleared |= st.active
+        st.active = None
+        self._advance_salvage()
+
+    # ---- hang watchdog ---------------------------------------------------
+
+    def _fault_injectors(self) -> list:
+        return [f for f in (getattr(e, "faults", None)
+                            for e in self._inner_engines()) if f is not None]
+
+    def _watchdog_threshold(self) -> float:
+        if self._steps_done < self.WATCHDOG_WARMUP_STEPS:
+            # early steps legitimately include multi-second XLA compiles
+            return self.step_watchdog_s * self.WATCHDOG_WARMUP_SCALE
+        return self.step_watchdog_s
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: engine.step() entries are stamped by the loop;
+        a step past the threshold is declared stuck.  Stage 1 (trip):
+        count it and release injected hangs, which then raise into the
+        normal salvage path.  Stage 2 (a REAL hang, still stuck past 2x):
+        fail the waiting clients from here — crash-only, the loop thread
+        may never come back — so a wedged device call never strands
+        clients behind a silent server."""
+        poll = max(0.005, min(0.05, self.step_watchdog_s / 5))
+        tripped_seq = None
+        while not self._stop.wait(poll):
+            cur = self._step_started
+            if cur is None:
+                continue
+            seq, t0 = cur
+            threshold = self._watchdog_threshold()
+            running_s = time.monotonic() - t0
+            if running_s < threshold:
+                continue
+            if self._step_started != cur:
+                # the step completed between the stamp read and now: a
+                # healthy (if slow) dispatch, not a hang — don't trip
+                continue
+            if tripped_seq != seq:
+                tripped_seq = seq
+                self._bump_stat("watchdog_trips")
+                logger.warning(
+                    "engine step stuck for %.2fs (watchdog %.2fs): "
+                    "releasing injected hangs, failing the dispatch",
+                    running_s, threshold)
+                for inj in self._fault_injectors():
+                    inj.release_hangs()
+            elif (running_s > 2 * threshold
+                    and self._hard_trip_seq != seq):
+                # nothing released it: a real wedged dispatch.  Fail the
+                # clients now; the loop thread reconciles engine state if
+                # and when the stuck call ever returns.
+                self._hard_trip_seq = seq
+                self._bump_stat("engine_restarts")
+                logger.error("engine step still stuck after %.2fs: failing "
+                             "all in-flight clients (crash-only restart)",
+                             running_s)
+                # clients only: the loop thread is wedged inside the
+                # dispatch, so engine state is reconciled loop-side by
+                # _consume_hard_trip, never mutated from this thread
+                self._fail_all("engine step stuck (watchdog)",
+                               engine_side=False)
+
+    def _consume_hard_trip(self, seq: int) -> bool:
+        """Loop-side reconciliation after a stage-2 watchdog trip: the
+        clients are already failed, so drop the step's outcome and reset
+        engine-side request state."""
+        if self._hard_trip_seq != seq:
+            return False
+        self._hard_trip_seq = None
+        eng = self.engine
+        for rid in list(getattr(eng, "requests", {})):
+            try:
+                eng.abort_request(rid)
+            except Exception:
+                pass
+            eng.requests.pop(rid, None)
+        self._salvage = None
+        self._set_admission_filter(None)
+        return True
+
     def _update_gauges(self) -> None:
         if not self.metrics:
             return
@@ -317,7 +632,15 @@ class AsyncEngineRunner:
                                  ("actual_tokens_total",
                                   self.metrics.actual_tokens_total),
                                  ("num_mixed_steps",
-                                  self.metrics.mixed_steps)):
+                                  self.metrics.mixed_steps),
+                                 ("requests_salvaged",
+                                  self.metrics.requests_salvaged),
+                                 ("requests_poisoned",
+                                  self.metrics.requests_poisoned),
+                                 ("watchdog_trips",
+                                  self.metrics.watchdog_trips),
+                                 ("engine_restarts",
+                                  self.metrics.engine_restarts)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
             # last-step padding-waste gauges (the bucketing win's live
@@ -338,29 +661,30 @@ class AsyncEngineRunner:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            self._step_seq += 1
+            seq = self._step_seq
             step_start = time.monotonic()
+            self._step_started = (seq, step_start)
             try:
                 outputs = self.engine.step()
                 if self.on_step_time is not None:
                     self.on_step_time(time.monotonic() - step_start)
-            except Exception:
+            except Exception as e:
+                self._step_started = None
                 logger.exception("engine step failed")
-                # Fail all in-flight requests AND drain them from the engine:
-                # leaving them scheduled would re-raise every iteration in a
-                # tight loop.
-                for rid, q in list(self._out_queues.items()):
-                    try:
-                        self.engine.abort_request(rid)
-                    except Exception:
-                        pass
-                    getattr(self.engine, "requests", {}).pop(rid, None)
-                    q.put(RuntimeError("engine failure"))
-                    q.put(None)
-                self._out_queues.clear()
-                self._req_started.clear()
-                self._last_token_time.clear()
-                time.sleep(0.1)
+                if self._consume_hard_trip(seq):
+                    continue
+                # Crash-only salvage: requeue in-flight requests through
+                # the preemption re-prefill path and replay (bisecting on
+                # repeat faults) instead of mass-failing every stream.
+                self._handle_step_fault(e)
+                time.sleep(0.05)
                 continue
+            self._step_started = None
+            self._steps_done += 1
+            if self._consume_hard_trip(seq):
+                continue
+            self._note_salvage_progress()
             self._route_outputs(outputs)
             self._update_gauges()
         logger.info("engine loop stopped")
