@@ -838,6 +838,17 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
+    if args.faults:
+        # Validate the chaos spec BEFORE any engine work: a typo'd site
+        # name must not surface as a ValueError after the clean pass has
+        # already burned minutes of warmup.  Same site registry
+        # (runtime/faults.SITES) that tpulint's unknown-fault-site rule
+        # checks statically.
+        from tpuserve.runtime.faults import FaultInjector
+        try:
+            FaultInjector.from_spec(args.faults, seed=0)
+        except ValueError as e:
+            ap.error(f"--faults: {e}")
     if args.spec and args.temperature > 0.0:
         # speculation only engages on all-greedy batches (engine gate);
         # a sampled spec run would emit a spec block with 0 acceptance

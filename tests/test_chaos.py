@@ -15,6 +15,15 @@ pytestmark = pytest.mark.chaos
 PARAMS = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
 
 
+@pytest.fixture(autouse=True)
+def _strict_blocks(monkeypatch):
+    """Chaos runs with the block-refcount cross-check armed
+    (runtime/block_manager.py check_integrity): sustained fault rates
+    exercise every recovery path, and a leak fails in-cycle, not in a
+    later soak."""
+    monkeypatch.setenv("TPUSERVE_STRICT_BLOCKS", "1")
+
+
 def _mk(faults=None):
     eng = Engine(EngineConfig(
         model="tiny-qwen3",

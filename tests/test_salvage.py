@@ -20,6 +20,14 @@ PARAMS = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
 PROMPTS = [[5, 6, 7], [9, 10, 11], [12, 13, 14], [20, 21, 22]]
 
 
+@pytest.fixture(autouse=True)
+def _strict_blocks(monkeypatch):
+    """Salvage tests run with the block-refcount cross-check armed
+    (runtime/block_manager.py check_integrity): a recovery path that
+    leaks or double-frees KV blocks fails the cycle it happens."""
+    monkeypatch.setenv("TPUSERVE_STRICT_BLOCKS", "1")
+
+
 def _mk(faults=None, **over):
     cfg = dict(multi_step=4, pipeline_decode=True,
                scheduler=SchedulerConfig(max_num_seqs=8,

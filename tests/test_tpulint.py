@@ -1,0 +1,611 @@
+"""tpulint in tier-1: the shipped tree lints clean, and each of the five
+passes provably catches a seeded violation of its bug class — including a
+re-introduction of the PR-3 watchdog cross-thread mutation and a seeded
+KV-block leak (the acceptance criteria's two named regressions).
+
+Fixtures run through ``run_lint_sources`` — the exact pipeline the CLI
+uses, suppression handling included — so a fixture that stops firing
+means the shipping analyzer regressed, not a test double.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.tpulint import PASS_NAMES
+from tools.tpulint.core import (FAULT_SITES, Config, DEFAULT_CONFIG,
+                                find_repo_root, load_config, run_lint,
+                                run_lint_sources)
+from tools.tpulint.metrics_consistency import (documented_families,
+                                               registry_from_source,
+                                               table_families)
+
+REPO = find_repo_root(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))) + "/tpuserve")
+
+
+def lint_snippet(src, passes=None, path="tpuserve/fixture.py", extra=None):
+    cfg_data = dict(DEFAULT_CONFIG)
+    if extra:
+        cfg_data = {**cfg_data, **extra}
+    return run_lint_sources({path: textwrap.dedent(src)}, Config(cfg_data),
+                            repo_root=REPO, passes=passes)
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------
+# the shipped tree lints clean (the tier-1 gate)
+# ---------------------------------------------------------------------
+
+def test_tree_lints_clean():
+    findings = run_lint([os.path.join(REPO, "tpuserve")],
+                        config=load_config(REPO), repo_root=REPO)
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, "tpulint findings on the shipped tree:\n" + \
+        "\n".join(f.render() for f in errors)
+
+
+def test_cli_exits_zero_on_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "tpuserve", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout) == []
+
+
+def test_cli_lists_passes():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--list-passes"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0
+    assert set(r.stdout.split()) == set(PASS_NAMES)
+
+
+# ---------------------------------------------------------------------
+# P1 host-sync
+# ---------------------------------------------------------------------
+
+def test_p1_flags_device_get_in_jit_body():
+    findings = lint_snippet("""
+        import jax
+
+        @jax.jit
+        def step(tokens):
+            host = jax.device_get(tokens)
+            return host
+    """, passes=["host-sync"])
+    assert "host-sync-in-jit" in rules(findings)
+
+
+def test_p1_flags_item_and_asarray_in_scan_body():
+    findings = lint_snippet("""
+        import jax
+        import numpy as np
+
+        def window(carry, xs):
+            bad = np.asarray(carry)
+            worse = carry.item()
+            return carry, xs
+
+        def run(carry0, xs):
+            return jax.lax.scan(window, carry0, xs)
+    """, passes=["host-sync"])
+    assert rules(findings).count("host-sync-in-jit") == 2
+
+
+def test_p1_flags_traced_truthiness_not_static_bools():
+    findings = lint_snippet("""
+        import jax
+
+        @jax.jit
+        def decode(tokens, gstate):
+            guided = gstate is not None       # static: not flagged
+            if guided:
+                tokens = tokens + 1
+            if tokens:                        # traced: flagged
+                tokens = tokens * 2
+            return tokens
+    """, passes=["host-sync"])
+    assert rules(findings) == ["host-sync-in-jit"]
+
+
+def test_p1_respects_static_argnames():
+    findings = lint_snippet("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def decode(tokens, mode):
+            if mode:                          # static argname: fine
+                tokens = tokens + 1
+            return tokens
+    """, passes=["host-sync"])
+    assert findings == []
+
+
+def test_p1_flags_sync_in_dispatch_path_and_accepts_sync_ok():
+    src = """
+        import jax
+        import numpy as np
+
+        class Engine:
+            def _run_decode_multi(self, p):
+                toks = jax.device_get(p.toks)
+                return toks
+    """
+    findings = lint_snippet(src, passes=["host-sync"],
+                            path="tpuserve/runtime/engine.py")
+    assert "sync-in-dispatch-path" in rules(findings)
+    ok = src.replace(
+        "toks = jax.device_get(p.toks)",
+        "toks = jax.device_get(p.toks)  "
+        "# tpulint: sync-ok(fixture designated sync)")
+    findings = lint_snippet(ok, passes=["host-sync"],
+                            path="tpuserve/runtime/engine.py")
+    assert findings == []
+
+
+def test_p1_unknown_fault_site():
+    findings = lint_snippet("""
+        class Engine:
+            def _exec_prefill(self):
+                self.faults.check("prefil_dispatch", ())
+    """, passes=["host-sync"])
+    assert "unknown-fault-site" in rules(findings)
+    # and the registry names themselves pass
+    findings = lint_snippet(f"""
+        class Engine:
+            def _exec_prefill(self):
+                self.faults.check({FAULT_SITES[0]!r}, ())
+    """, passes=["host-sync"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------
+# P2 thread-ownership — incl. the PR-3 watchdog regression, re-introduced
+# ---------------------------------------------------------------------
+
+PR3_WATCHDOG_REGRESSION = """
+    import threading
+
+    class AsyncEngineRunner:
+        def __init__(self, engine):
+            self.engine = engine
+            self._thread = threading.Thread(target=self._loop)
+            self._watchdog = threading.Thread(target=self._watchdog_loop)
+
+        def _loop(self):
+            self.engine.step()                 # loop thread: fine
+
+        def _watchdog_loop(self):
+            # the exact PR-3 bug: engine mutated under the loop's feet
+            self.engine.abort_request("r1")
+            self.engine.scheduler.running.clear()
+"""
+
+
+def test_p2_catches_reintroduced_pr3_watchdog_mutation():
+    findings = lint_snippet(PR3_WATCHDOG_REGRESSION,
+                            passes=["thread-ownership"],
+                            path="tpuserve/server/runner.py")
+    assert rules(findings).count("cross-thread-mutation") == 2
+    lines = {f.line for f in findings}
+    src = textwrap.dedent(PR3_WATCHDOG_REGRESSION).splitlines()
+    assert any("abort_request" in src[l - 1] for l in lines)
+    assert any("running.clear" in src[l - 1] for l in lines)
+
+
+def test_p2_loop_thread_mutations_are_fine():
+    findings = lint_snippet(PR3_WATCHDOG_REGRESSION.replace(
+        "def _watchdog_loop(self):",
+        "def _watchdog_loop(self):\n            return\n\n"
+        "        def _unreachable(self):"),
+        passes=["thread-ownership"], path="tpuserve/server/runner.py")
+    assert findings == []
+
+
+def test_p2_transitive_reachability_and_setattr():
+    findings = lint_snippet("""
+        import threading
+
+        class Runner:
+            def __init__(self, engine):
+                self.engine = engine
+                threading.Thread(target=self._health_loop).start()
+
+            def _health_loop(self):
+                self._helper()
+
+            def _helper(self):
+                setattr(self.engine.stats, "trips", 1)
+                self.engine.requests.pop("x", None)
+    """, passes=["thread-ownership"])
+    got = rules(findings)
+    assert "cross-thread-setattr" in got
+    assert "cross-thread-mutation" in got
+
+
+def test_p2_thread_ok_suppression():
+    findings = lint_snippet("""
+        import threading
+
+        class Runner:
+            def __init__(self, engine):
+                self.engine = engine
+                threading.Thread(target=self._wd).start()
+
+            def _wd(self):
+                # tpulint: thread-ok(fixture: guarded by a lock)
+                self.engine.requests.pop("x", None)
+    """, passes=["thread-ownership"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------
+# P3 kv-leak — incl. the seeded KV-block leak
+# ---------------------------------------------------------------------
+
+SEEDED_KV_LEAK = """
+    class Engine:
+        def adopt(self, request_id, ids, pages):
+            alloc = self.block_manager.allocate(request_id, ids)
+            self.kv_cache = self.scatter(pages, alloc.blocks)  # can raise
+            self.requests[request_id] = ids
+"""
+
+
+def test_p3_catches_seeded_kv_block_leak():
+    findings = lint_snippet(SEEDED_KV_LEAK, passes=["kv-leak"])
+    assert rules(findings) == ["kv-alloc-leak-on-exception"]
+
+
+def test_p3_try_finally_free_is_clean():
+    findings = lint_snippet("""
+        class Engine:
+            def adopt(self, request_id, ids, pages):
+                alloc = self.block_manager.allocate(request_id, ids)
+                try:
+                    self.kv_cache = self.scatter(pages, alloc.blocks)
+                except Exception:
+                    self.block_manager.free(request_id, cache_blocks=False)
+                    raise
+                self.requests[request_id] = ids
+    """, passes=["kv-leak"])
+    assert findings == []
+
+
+def test_p3_never_released():
+    findings = lint_snippet("""
+        class Engine:
+            def leak(self, rid, ids):
+                self.block_manager.allocate(rid, ids)
+    """, passes=["kv-leak"])
+    assert rules(findings) == ["kv-alloc-never-released"]
+
+
+def test_p3_owned_elsewhere_requests_are_engine_scope():
+    # allocate(req.request_id): the request is registered with the
+    # engine's salvage/abort recovery — no local obligation
+    findings = lint_snippet("""
+        class Engine:
+            def _run_prefill(self, batch):
+                for req in batch.requests:
+                    self.block_manager.allocate(req.request_id, req.ids)
+                return self._exec_prefill(batch)
+    """, passes=["kv-leak"])
+    assert findings == []
+
+
+def test_p3_return_transfers_ownership():
+    findings = lint_snippet("""
+        def helper(bm, rid, ids):
+            alloc = bm.allocate(rid, ids)
+            return alloc
+    """, passes=["kv-leak"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------
+# P4 pallas contracts
+# ---------------------------------------------------------------------
+
+def test_p4_index_map_arity():
+    findings = lint_snippet("""
+        import jax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(bt_ref, q_ref, o_ref):
+            o_ref[...] = q_ref[...]
+
+        def call(q, bt):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda p: (p, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda p, bt: (p, 0)),
+            )
+            return pl.pallas_call(_k, grid_spec=grid_spec,
+                                  out_shape=q)(bt, q)
+    """, passes=["pallas"])
+    # in_specs lambda takes 1 param; grid rank 1 + 1 scalar-prefetch = 2
+    assert rules(findings).count("pallas-index-map-arity") == 1
+
+
+def test_p4_kernel_arity():
+    findings = lint_snippet("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(q_ref, o_ref):            # missing the scalar-prefetch ref
+            o_ref[...] = q_ref[...]
+
+        def call(q, bt):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda p, bt: (p, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda p, bt: (p, 0)),
+            )
+            return pl.pallas_call(_k, grid_spec=grid_spec,
+                                  out_shape=q)(bt, q)
+    """, passes=["pallas"])
+    assert "pallas-kernel-arity" in rules(findings)
+
+
+def test_p4_call_arity():
+    findings = lint_snippet("""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(bt_ref, q_ref, o_ref):
+            o_ref[...] = q_ref[...]
+
+        def call(q, bt, extra):
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda p, bt: (p, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda p, bt: (p, 0)),
+            )
+            return pl.pallas_call(_k, grid_spec=grid_spec,
+                                  out_shape=q)(bt, q, extra)
+    """, passes=["pallas"])
+    assert "pallas-call-arity" in rules(findings)
+
+
+def test_p4_dtype_rules():
+    findings = lint_snippet("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _decode_kernel(q_ref, k_ref, o_ref):
+            k = dequantize_kv(k_ref[...], None, jnp.float32)
+            sc = jax.lax.dot_general(q_ref[...].astype(jnp.float32), k,
+                                     (((1,), (1,)), ((0,), (0,))))
+            o_ref[...] = sc
+    """, passes=["pallas"])
+    got = rules(findings)
+    assert "pallas-dot-accum" in got            # no preferred_element_type
+    assert "pallas-upcast-before-dot" in got
+    assert "pallas-dequant-dtype" in got
+
+
+def test_p4_vmem_budget():
+    findings = lint_snippet("""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _k(q_ref, o_ref, scr):
+            o_ref[...] = q_ref[...]
+
+        def call(q):
+            return pl.pallas_call(
+                _k,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                scratch_shapes=[pltpu.VMEM((2, 64, 512, 128), jnp.float32)],
+                out_shape=q,
+            )(q)
+    """, passes=["pallas"])
+    # 2*64*512*128*4 = 32 MiB > 16 MiB budget
+    assert "pallas-vmem-budget" in rules(findings)
+
+
+def test_p4_real_kernel_shapes_pass():
+    # the shipped kernels (conditional in_specs/scratch, partial-wrapped
+    # kernels, Name-assigned grids) must parse clean — regression-pinned
+    # here so analyzer changes can't silently skip them
+    ops = os.path.join(REPO, "tpuserve", "ops")
+    findings = run_lint([ops], config=load_config(REPO), repo_root=REPO,
+                        passes=["pallas"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------
+# P5 metrics consistency + the shared registry fixture
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def metric_registry():
+    """The shared fixture: P5's own parse of server/metrics.py, consumed
+    by both the lint test and the doc-sync test below."""
+    path = os.path.join(REPO, "tpuserve", "server", "metrics.py")
+    with open(path) as f:
+        return registry_from_source(f.read())
+
+
+def test_p5_registry_parses_all_families(metric_registry):
+    fams = {m.family for m in metric_registry}
+    assert "vllm_request_total" in fams
+    assert "tpuserve_requests_salvaged_total" in fams
+    assert len(metric_registry) >= 30
+    kinds = {m.kind for m in metric_registry}
+    assert kinds == {"counter", "gauge", "histogram"}
+
+
+def test_p5_flags_unused_and_undocumented_metric():
+    reg = """
+        from prometheus_client import Counter
+
+        class ServerMetrics:
+            def __init__(self):
+                self.ghost = Counter("tpuserve_ghost_metric", "doc",
+                                     registry=None)
+    """
+    findings = run_lint_sources(
+        {"tpuserve/server/metrics.py": textwrap.dedent(reg)},
+        Config(dict(DEFAULT_CONFIG)), repo_root=REPO, passes=["metrics"])
+    got = rules(findings)
+    assert "metric-never-updated" in got
+    assert "metric-undocumented" in got
+
+
+def test_p5_getattr_fed_metric_is_a_use():
+    """A metric fed only via getattr(self.metrics, "attr") with a
+    constant name is fed — it must not be flagged never-updated."""
+    reg = """
+        from prometheus_client import Counter
+
+        class ServerMetrics:
+            def __init__(self):
+                self.spec_pauses = Counter(
+                    "tpuserve_spec_adaptive_pauses_total", "doc",
+                    registry=None)
+    """
+    feeder = """
+        def publish(self):
+            getattr(self.metrics, "spec_pauses").inc()
+    """
+    findings = run_lint_sources(
+        {"tpuserve/server/metrics.py": textwrap.dedent(reg),
+         "tpuserve/server/feeder.py": textwrap.dedent(feeder)},
+        Config(dict(DEFAULT_CONFIG)), repo_root=REPO, passes=["metrics"])
+    assert "metric-never-updated" not in rules(findings)
+
+
+def test_default_config_tracks_pyproject():
+    """core.DEFAULT_CONFIG (fixture/no-pyproject fallback) must not
+    drift WEAKER than the shipped [tool.tpulint] block: a dispatch path
+    listed only in pyproject would silently go unchecked by any
+    DEFAULT_CONFIG consumer."""
+    cfg = load_config(REPO).data
+    assert set(cfg["passes"]) == set(DEFAULT_CONFIG["passes"])
+    assert set(cfg["suppression_allowlist"]) == \
+        set(DEFAULT_CONFIG["suppression_allowlist"])
+    assert set(cfg["host_sync"]["dispatch_paths"]) <= \
+        set(DEFAULT_CONFIG["host_sync"]["dispatch_paths"])
+
+
+def test_p5_counter_total_suffix_normalisation():
+    m = registry_from_source(textwrap.dedent("""
+        from prometheus_client import Counter, Gauge
+
+        class ServerMetrics:
+            def __init__(self):
+                self.a = Counter("tpuserve_things", "d", registry=None)
+                self.b = Counter("tpuserve_done_total", "d", registry=None)
+                self.c = Gauge("tpuserve_level", "d", registry=None)
+    """))
+    assert [x.exported for x in m] == [
+        "tpuserve_things_total", "tpuserve_done_total", "tpuserve_level"]
+
+
+def test_readme_and_registry_cannot_drift(metric_registry):
+    """The doc-sync satellite: every registered family is documented in
+    README.md and every family named in a README table exists — consuming
+    the same fixture as P5, so 'registry' can't mean two things."""
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    documented = documented_families(readme)
+    for m in metric_registry:
+        assert m.exported in documented or m.family in documented, \
+            f"{m.exported} registered but not documented in README.md"
+    real = {m.exported for m in metric_registry} | {
+        m.family for m in metric_registry}
+    for fam in table_families(readme):
+        assert fam in real, f"README documents nonexistent metric {fam}"
+
+
+# ---------------------------------------------------------------------
+# suppression discipline
+# ---------------------------------------------------------------------
+
+def test_suppression_without_reason_is_an_error():
+    findings = lint_snippet("""
+        import jax
+
+        @jax.jit
+        def step(tokens):
+            return jax.device_get(tokens)  # tpulint: sync-ok
+    """, passes=["host-sync"])
+    got = rules(findings)
+    assert "suppression-missing-reason" in got
+    assert "host-sync-in-jit" in got      # reasonless tag suppresses nothing
+
+
+def test_unused_suppression_is_an_error():
+    findings = lint_snippet("""
+        x = 1  # tpulint: sync-ok(nothing here needs suppressing)
+    """, passes=["host-sync"])
+    assert rules(findings) == ["unused-suppression"]
+
+
+def test_subset_run_skips_other_passes_suppressions():
+    """--passes kv-leak must not condemn sync-ok comments the skipped
+    host-sync pass would have consumed (they are unused only because
+    their owner never ran)."""
+    findings = lint_snippet("""
+        import jax
+
+        @jax.jit
+        def step(tokens):
+            # tpulint: sync-ok(designated sync point)
+            return jax.device_get(tokens)
+    """, passes=["kv-leak"])
+    assert rules(findings) == []
+    # but a malformed or off-allowlist tag is still an error in any run
+    findings = lint_snippet("""
+        x = 1  # tpulint: sync-ok
+        y = 2  # tpulint: yolo-ok(fake)
+    """, passes=["kv-leak"])
+    assert sorted(rules(findings)) == ["suppression-missing-reason",
+                                       "suppression-not-allowed"]
+
+
+def test_cli_subset_run_exits_zero_on_tree():
+    """The confirmed regression: a --passes subset over engine.py used to
+    report every other pass's suppression as stale."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--passes", "kv-leak",
+         "tpuserve/runtime"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_off_allowlist_suppression_is_an_error():
+    findings = lint_snippet("""
+        x = 1  # tpulint: yolo-ok(not a real tag)
+    """, passes=["host-sync"])
+    assert rules(findings) == ["suppression-not-allowed"]
+
+
+def test_fault_site_registry_matches_engine():
+    # the registry tpulint checks IS the one the engine parses specs with
+    from tpuserve.runtime.faults import SITES
+    assert tuple(FAULT_SITES) == tuple(SITES)

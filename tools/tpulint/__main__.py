@@ -1,0 +1,65 @@
+"""CLI: ``python -m tools.tpulint [paths...] [--json] [--passes ...]``.
+
+Exit status: 0 = clean, 1 = findings at severity error, 2 = usage error.
+Findings at severity "warning" (per-pass via ``[tool.tpulint.severity]``)
+print but do not fail the run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.tpulint import PASS_NAMES
+from tools.tpulint.core import find_repo_root, load_config, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="repo-native static analysis for tpuserve engine "
+                    "invariants (host-sync, thread-ownership, KV leaks, "
+                    "Pallas contracts, metrics consistency)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: tpuserve/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON findings on stdout")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of passes to run "
+                         f"(available: {', '.join(PASS_NAMES)})")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in PASS_NAMES:
+            print(p)
+        return 0
+
+    paths = args.paths or ["tpuserve"]
+    repo_root = find_repo_root(paths[0])
+    config = load_config(repo_root)
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = [p for p in passes if p not in PASS_NAMES]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}; available: "
+                  f"{', '.join(PASS_NAMES)}", file=sys.stderr)
+            return 2
+    findings = run_lint(paths, config=config, repo_root=repo_root,
+                        passes=passes)
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        print(f"tpulint: {n_err} error(s), {n_warn} warning(s) over "
+              f"{len(paths)} path(s)")
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

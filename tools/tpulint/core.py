@@ -1,0 +1,348 @@
+"""tpulint core: findings, suppressions, config, and the pass runner.
+
+Passes are modules exposing ``NAME`` (pass id), ``TAG`` (suppression tag,
+e.g. ``sync-ok``) and ``run(files, config) -> list[Finding]`` where
+``files`` maps repo-relative posix paths to ``(source, ast.Module)``.
+Cross-file checks (metrics consistency, thread roots) get the whole map.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+from typing import Optional
+
+# The fault-site registry shared with the engine and bench.py --faults
+# validation: one source of truth, so a site renamed in runtime/faults.py
+# breaks the lint fixture AND the bench flag in the same commit.
+from tpuserve.runtime.faults import SITES as FAULT_SITES  # noqa: F401
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*([a-z][a-z0-9-]*-ok)\s*(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    file: str                  # repo-relative posix path
+    line: int
+    rule: str                  # e.g. "host-sync-in-jit"
+    message: str
+    pass_name: str             # owning pass id ("host-sync", ...)
+    severity: str = "error"    # "error" | "warning"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.pass_name}/{self.rule}] "
+                f"{self.severity}: {self.message}")
+
+
+@dataclasses.dataclass
+class Suppression:
+    file: str
+    line: int
+    tag: str                   # "sync-ok", "thread-ok", ...
+    reason: str
+    used: bool = False
+
+
+DEFAULT_CONFIG: dict = {
+    "passes": ["host-sync", "thread-ownership", "kv-leak", "pallas",
+               "metrics"],
+    # suppression tags that may appear in the tree at all
+    "suppression_allowlist": ["sync-ok", "thread-ok", "leak-ok",
+                              "pallas-ok", "metric-ok"],
+    "severity": {},            # pass id -> "error" | "warning"
+    "host_sync": {
+        # the pipelined dispatch path: methods where ANY host sync must be
+        # an explicitly designated (sync-ok) point — this is the code that
+        # owns the one-sync-per-window property
+        "dispatch_paths": [
+            "tpuserve/runtime/engine.py::Engine.step",
+            "tpuserve/runtime/engine.py::Engine._step_inner",
+            "tpuserve/runtime/engine.py::Engine._run_*",
+            "tpuserve/runtime/engine.py::Engine._flush_*",
+            "tpuserve/runtime/engine.py::Engine._exec_*",
+            "tpuserve/runtime/engine.py::Engine._sample*",
+            "tpuserve/runtime/engine.py::Engine._apply_*",
+            "tpuserve/runtime/engine.py::Engine._draft_propose",
+            "tpuserve/runtime/engine.py::Engine._append_and_emit",
+            "tpuserve/runtime/engine.py::Engine._emit_one",
+            "tpuserve/runtime/engine.py::Engine._record_logprobs",
+        ],
+    },
+    "thread_ownership": {
+        # thread entry points that ARE the engine loop (mutations fine)
+        "loop_roots": [
+            "tpuserve/server/runner.py::AsyncEngineRunner._loop",
+        ],
+        # per-class engine-loop-owned attributes; "engine" is always owned
+        "owned_attrs": {
+            "AsyncEngineRunner": ["engine", "_out_queues", "_req_started",
+                                  "_last_token_time", "_salvage",
+                                  "_singleton_faults"],
+        },
+        # methods on owned state that are safe from any thread
+        "safe_methods": ["release_hangs", "get", "items", "keys", "values",
+                         "empty", "qsize"],
+    },
+    "kv_leak": {
+        # substrings identifying a block-manager receiver
+        "receivers": ["block_manager", "bm"],
+        # self.<sink>[seq_id] = ... transfers ownership (abort_request's
+        # orphan fallback frees via this record)
+        "ownership_sinks": ["requests"],
+    },
+    "pallas": {
+        "vmem_budget_mb": 16,      # ~VMEM/core on v5e (pallas guide)
+    },
+    "metrics": {
+        "registry": "tpuserve/server/metrics.py",
+        "readme": "README.md",
+    },
+}
+
+
+@dataclasses.dataclass
+class Config:
+    data: dict
+
+    def passes(self) -> list[str]:
+        return list(self.data.get("passes", DEFAULT_CONFIG["passes"]))
+
+    def severity_for(self, pass_name: str) -> str:
+        return self.data.get("severity", {}).get(pass_name, "error")
+
+    def section(self, name: str) -> dict:
+        base = dict(DEFAULT_CONFIG.get(name, {}))
+        base.update(self.data.get(name, {}))
+        return base
+
+    def allowlist(self) -> list[str]:
+        return list(self.data.get("suppression_allowlist",
+                                  DEFAULT_CONFIG["suppression_allowlist"]))
+
+
+def _load_toml(path: str) -> Optional[dict]:
+    try:
+        import tomllib as toml_mod          # py >= 3.11
+    except ModuleNotFoundError:
+        try:
+            import tomli as toml_mod        # the backport this image ships
+        except ModuleNotFoundError:
+            return None
+    with open(path, "rb") as f:
+        return toml_mod.load(f)
+
+
+def find_repo_root(start: str) -> str:
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def load_config(repo_root: str) -> Config:
+    """[tool.tpulint] from pyproject.toml, defaults when absent (or when
+    no TOML parser is available — the config is an overlay, never a
+    requirement)."""
+    data: dict = {}
+    pyproject = os.path.join(repo_root, "pyproject.toml")
+    if os.path.exists(pyproject):
+        parsed = _load_toml(pyproject)
+        if parsed:
+            data = parsed.get("tool", {}).get("tpulint", {}) or {}
+    merged = dict(DEFAULT_CONFIG)
+    merged.update(data)
+    return Config(merged)
+
+
+def collect_files(paths: list[str], repo_root: str) -> dict:
+    """{repo-relative posix path: (source, ast.Module)} for every .py file
+    under ``paths``.  Unparseable files become a finding downstream (the
+    runner reports them), not a crash."""
+    out: dict = {}
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files += [os.path.join(dirpath, f) for f in filenames
+                          if f.endswith(".py")]
+        for f in sorted(files):
+            rel = os.path.relpath(f, repo_root).replace(os.sep, "/")
+            with open(f, "r", encoding="utf-8") as fh:
+                out[rel] = fh.read()
+    return out
+
+
+def parse_sources(sources: dict) -> tuple[dict, list[Finding]]:
+    files: dict = {}
+    errors: list[Finding] = []
+    for rel, src in sources.items():
+        try:
+            files[rel] = (src, ast.parse(src))
+        except SyntaxError as e:
+            errors.append(Finding(
+                file=rel, line=e.lineno or 1, rule="syntax-error",
+                message=f"cannot parse: {e.msg}", pass_name="core"))
+    return files, errors
+
+
+def collect_suppressions(sources: dict) -> list[Suppression]:
+    sups: list[Suppression] = []
+    for rel, src in sources.items():
+        for i, line in enumerate(src.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                sups.append(Suppression(file=rel, line=i, tag=m.group(1),
+                                        reason=(m.group(2) or "").strip()))
+    return sups
+
+
+def apply_suppressions(findings: list[Finding], sups: list[Suppression],
+                       tag_for_pass: dict, allowlist: list[str],
+                       active_tags: Optional[set] = None) -> list[Finding]:
+    """Drop findings covered by a matching suppression on the same line or
+    the line directly above; emit findings for malformed suppressions
+    (missing reason, unknown tag, unused).
+
+    ``active_tags``: tags whose owning pass actually ran this invocation.
+    Staleness (unused-suppression) is only judged for those — a subset
+    run (``--passes kv-leak``) must not condemn the sync-ok comments the
+    skipped host-sync pass would have consumed.  None means all ran."""
+    by_loc: dict = {}
+    for s in sups:
+        by_loc.setdefault((s.file, s.tag), []).append(s)
+    kept: list[Finding] = []
+    for f in findings:
+        tag = tag_for_pass.get(f.pass_name)
+        hit = None
+        for s in by_loc.get((f.file, tag), ()):
+            if s.line in (f.line, f.line - 1) and s.reason:
+                hit = s
+                break
+        if hit is not None:
+            hit.used = True
+        else:
+            kept.append(f)
+    for s in sups:
+        if not s.reason:
+            kept.append(Finding(
+                file=s.file, line=s.line, rule="suppression-missing-reason",
+                message=f"tpulint suppression '{s.tag}' has no reason "
+                        "string — every suppression must explain itself: "
+                        f"# tpulint: {s.tag}(why this is safe)",
+                pass_name="core"))
+        elif s.tag not in allowlist:
+            kept.append(Finding(
+                file=s.file, line=s.line, rule="suppression-not-allowed",
+                message=f"suppression tag '{s.tag}' is not in "
+                        "[tool.tpulint] suppression_allowlist",
+                pass_name="core"))
+        elif not s.used and (active_tags is None or s.tag in active_tags):
+            kept.append(Finding(
+                file=s.file, line=s.line, rule="unused-suppression",
+                message=f"suppression '{s.tag}' matches no finding — "
+                        "stale suppressions hide future regressions; "
+                        "remove it", pass_name="core"))
+    return kept
+
+
+def _pass_modules() -> dict:
+    from tools.tpulint import (host_sync, kv_leak, metrics_consistency,
+                               pallas_contracts, thread_ownership)
+    mods = (host_sync, thread_ownership, kv_leak, pallas_contracts,
+            metrics_consistency)
+    return {m.NAME: m for m in mods}
+
+
+def run_lint_sources(sources: dict, config: Config,
+                     repo_root: str = ".",
+                     passes: Optional[list[str]] = None) -> list[Finding]:
+    """Lint in-memory sources ({relpath: source}).  The entry point both
+    the CLI and the fixture tests share, so fixtures exercise the exact
+    shipping pipeline (suppression handling included)."""
+    mods = _pass_modules()
+    enabled = [p for p in (passes or config.passes()) if p in mods]
+    files, findings = parse_sources(sources)
+    for name in enabled:
+        mod = mods[name]
+        sev = config.severity_for(name)
+        for f in mod.run(files, config, repo_root):
+            f.severity = sev
+            findings.append(f)
+    tag_for_pass = {name: mods[name].TAG for name in mods}
+    sups = collect_suppressions(sources)
+    findings = apply_suppressions(findings, sups, tag_for_pass,
+                                  config.allowlist(),
+                                  active_tags={mods[p].TAG
+                                               for p in enabled})
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+def run_lint(paths: list[str], config: Optional[Config] = None,
+             repo_root: Optional[str] = None,
+             passes: Optional[list[str]] = None) -> list[Finding]:
+    repo_root = repo_root or find_repo_root(paths[0] if paths else ".")
+    config = config or load_config(repo_root)
+    sources = collect_files(paths, repo_root)
+    return run_lint_sources(sources, config, repo_root, passes=passes)
+
+
+# ---- shared AST helpers ------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted source form of an expression ('self.engine.x',
+    'jax.device_get', 'getattr(self.engine, ...)' -> 'self.engine')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        # getattr(x, "a") chains count as x for ownership purposes
+        if isinstance(node.func, ast.Name) and node.func.id == "getattr" \
+                and node.args:
+            return dotted(node.args[0])
+        return dotted(node.func)
+    if isinstance(node, ast.Subscript):
+        return dotted(node.value)
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    return dotted(node.func)
+
+
+def qual_match(relpath: str, qualname: str, patterns: list[str]) -> bool:
+    """'tpuserve/runtime/engine.py::Engine._run_*'-style matching."""
+    for pat in patterns:
+        if "::" in pat:
+            fpat, qpat = pat.split("::", 1)
+        else:
+            fpat, qpat = "*", pat
+        if fnmatch.fnmatch(relpath, fpat) and fnmatch.fnmatch(qualname, qpat):
+            return True
+    return False
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,210 @@
+"""P3: KV-block leak lint.
+
+A ``block_manager.allocate(seq_id, ...)`` call creates a resource whose
+blocks are only reclaimed by ``free(seq_id)`` (or by the engine's
+abort/salvage machinery once the sequence is *registered* where those
+paths can find it — ``self.requests[seq_id] = ...``).  The window between
+the allocate and that registration is the leak window: any statement in
+it that can raise exits the function with blocks that no recovery path
+will ever free (the PR-3 post-review bug class: requests orphaned
+mid-prefill leaked their blocks permanently).
+
+Path rules, per allocate site:
+
+- ``kv-alloc-leak-on-exception``: a potentially-raising statement sits
+  between the allocate and its release (free / ownership transfer /
+  return-to-caller) without an enclosing ``try`` whose handler or
+  ``finally`` frees the same sequence.
+- ``kv-alloc-never-released``: no release exists on any path after the
+  allocate.
+
+Scope discipline keeps this precise instead of noisy: an allocate whose
+seq-id is an *attribute* of a parameter (``req.request_id`` with ``req``
+scheduled in) belongs to a request that is already registered in
+``self.requests`` — its exception edges are owned by the engine-level
+salvage/abort machinery, which tier-1 tests cover — so only allocates
+binding a *locally-created or parameter* identity carry a local
+obligation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Config, Finding, dotted
+
+NAME = "kv-leak"
+TAG = "leak-ok"
+
+
+def _is_alloc_call(node: ast.Call, receivers: list) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in ("allocate", "fork"):
+        return False
+    recv = dotted(node.func.value)
+    leaf = recv.split(".")[-1]
+    return any(r == leaf or r in recv for r in receivers)
+
+
+def _is_free_call(node: ast.Call, seq_src: str, receivers: list) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr != "free":
+        return False
+    recv = dotted(node.func.value)
+    leaf = recv.split(".")[-1]
+    if not any(r == leaf or r in recv for r in receivers):
+        return False
+    return bool(node.args) and ast.unparse(node.args[0]) == seq_src
+
+
+# calls that cannot realistically raise — bookkeeping between an
+# allocate and its release shouldn't force a try block
+_NO_RAISE = {"time.monotonic", "time.time", "time.perf_counter", "len",
+             "id", "repr"}
+
+
+def _stmt_can_raise(stmt: ast.stmt, alloc_call: ast.Call) -> bool:
+    """Any call other than the allocate itself can raise; so can explicit
+    raises and subscript reads."""
+    if isinstance(stmt, ast.Raise):
+        return True
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and node is not alloc_call \
+                and dotted(node.func) not in _NO_RAISE:
+            return True
+    return False
+
+
+def _transfers_ownership(stmt: ast.stmt, seq_src: str, alloc_targets: set,
+                         sinks: list) -> bool:
+    """self.<sink>[seq] = ... registers the sequence where abort/salvage
+    recovery can free it; returning the alloc/seq hands it to the caller."""
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript):
+                base = dotted(t.value)
+                if any(base == f"self.{s}" or base.endswith(f".{s}")
+                       for s in sinks):
+                    try:
+                        idx = ast.unparse(t.slice)
+                    except Exception:
+                        idx = ""
+                    if idx == seq_src:
+                        return True
+    if isinstance(stmt, ast.Return) and stmt.value is not None:
+        names = {n.id for n in ast.walk(stmt.value)
+                 if isinstance(n, ast.Name)}
+        if (alloc_targets & names) or seq_src in names:
+            return True
+    return False
+
+
+def _try_protects(stack: list, seq_src: str, receivers: list) -> bool:
+    """True when an enclosing Try's handlers or finally free the seq (or
+    a bare re-raising handler exists that frees first)."""
+    for try_node in stack:
+        bodies = [h for handler in try_node.handlers
+                  for h in handler.body] + list(try_node.finalbody)
+        for stmt in bodies:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and _is_free_call(
+                        node, seq_src, receivers):
+                    return True
+    return False
+
+
+def _linear_stmts(fn) -> list:
+    """Flatten the function body into (stmt, try_stack) in source order;
+    loop/branch bodies are visited in place (conservative: statements in
+    any branch count as 'after' the allocate if they appear later)."""
+    out = []
+
+    def walk(stmts, stack):
+        for s in stmts:
+            out.append((s, list(stack)))
+            if isinstance(s, ast.Try):
+                walk(s.body, stack + [s])
+                for h in s.handlers:
+                    walk(h.body, stack)
+                walk(s.orelse, stack)
+                walk(s.finalbody, stack)
+            elif isinstance(s, (ast.If,)):
+                walk(s.body, stack)
+                walk(s.orelse, stack)
+            elif isinstance(s, (ast.For, ast.While)):
+                walk(s.body, stack)
+                walk(s.orelse, stack)
+            elif isinstance(s, (ast.With,)):
+                walk(s.body, stack)
+    walk(fn.body, [])
+    return out
+
+
+def run(files: dict, config: Config, repo_root: str) -> list:
+    findings: list = []
+    sec = config.section("kv_leak")
+    receivers = sec.get("receivers", ["block_manager", "bm"])
+    sinks = sec.get("ownership_sinks", ["requests"])
+    for rel, (_src, tree) in files.items():
+        for fn in [n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            _scan_function(rel, fn, receivers, sinks, findings)
+    return findings
+
+
+def _scan_function(rel, fn, receivers, sinks, findings):
+    stmts = _linear_stmts(fn)
+    for i, (stmt, stack) in enumerate(stmts):
+        allocs = [n for n in ast.walk(stmt)
+                  if isinstance(n, ast.Call) and _is_alloc_call(n, receivers)]
+        for alloc in allocs:
+            if not alloc.args:
+                continue
+            seq = alloc.args[0]
+            # attribute identities (req.request_id) belong to requests
+            # already registered with the engine's recovery paths
+            if isinstance(seq, ast.Attribute):
+                continue
+            seq_src = ast.unparse(seq)
+            targets: set = set()
+            if isinstance(stmt, ast.Assign):
+                targets = {n.id for t in stmt.targets
+                           for n in ast.walk(t) if isinstance(n, ast.Name)}
+            _check_alloc(rel, fn, alloc, seq_src, targets,
+                         stmts[i + 1:], stack, receivers, sinks, findings)
+
+
+def _check_alloc(rel, fn, alloc, seq_src, alloc_targets, rest, alloc_stack,
+                 receivers, sinks, findings):
+    risky_line = None
+    for stmt, stack in rest:
+        freed = any(isinstance(n, ast.Call)
+                    and _is_free_call(n, seq_src, receivers)
+                    for n in ast.walk(stmt))
+        # a free inside an except/finally of a try enclosing the allocate
+        # is the protection pattern, not the happy-path release; skip it
+        # when deciding the release point but note the protection
+        if freed or _transfers_ownership(stmt, seq_src, alloc_targets,
+                                         sinks):
+            if risky_line is not None and not _try_protects(
+                    stack or alloc_stack, seq_src, receivers):
+                findings.append(Finding(
+                    file=rel, line=alloc.lineno,
+                    rule="kv-alloc-leak-on-exception",
+                    message=f"blocks allocated for {seq_src} in {fn.name} "
+                            f"leak if line {risky_line} raises before the "
+                            "release: no enclosing try frees them and the "
+                            "sequence is not yet registered where "
+                            "abort/salvage recovery can find it",
+                    pass_name=NAME))
+            return
+        if _stmt_can_raise(stmt, alloc) and risky_line is None \
+                and not _try_protects(stack, seq_src, receivers):
+            risky_line = stmt.lineno
+    findings.append(Finding(
+        file=rel, line=alloc.lineno, rule="kv-alloc-never-released",
+        message=f"blocks allocated for {seq_src} in {fn.name} are never "
+                "freed or ownership-transferred on any path out of the "
+                "function", pass_name=NAME))

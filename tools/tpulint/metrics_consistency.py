@@ -1,0 +1,179 @@
+"""P5: metrics consistency lint.
+
+``server/metrics.py`` is the single metric registry; drift shows up as
+dashboards that silently read zeros (registered-but-never-incremented) or
+runbooks that name families that don't exist (README drift).  Three
+checks:
+
+- ``metric-never-updated``: a registered metric attribute that no code
+  outside the registry ever increments / observes / sets.
+- ``metric-undocumented``: a registered family whose *exported* name
+  (prometheus_client appends ``_total`` to counters) never appears in
+  README.md.
+- ``metric-doc-drift``: a ``vllm_*`` / ``tpuserve_*`` family named in a
+  README table row that is not in the registry.
+
+``registry_from_source`` is the shared fixture consumed by both this
+pass and ``tests/test_tpulint.py``'s doc-sync test, so the two can never
+disagree about what "the registry" means.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+from tools.tpulint.core import Config, Finding, call_name, const_str, dotted
+
+NAME = "metrics"
+TAG = "metric-ok"
+
+_CTOR_KINDS = {
+    "counter": "counter", "Counter": "counter",
+    "gauge": "gauge", "Gauge": "gauge",
+    "histogram": "histogram", "Histogram": "histogram",
+}
+
+_DOC_NAME_RE = re.compile(r"`((?:vllm|tpuserve)_[a-z0-9_]+)`")
+
+
+@dataclasses.dataclass
+class Metric:
+    attr: str            # ServerMetrics attribute name
+    family: str          # registered prometheus family name
+    kind: str            # counter | gauge | histogram
+    line: int
+
+    @property
+    def exported(self) -> str:
+        """The family name as it appears in /metrics exposition —
+        prometheus_client appends _total to counters that lack it."""
+        if self.kind == "counter" and not self.family.endswith("_total"):
+            return self.family + "_total"
+        return self.family
+
+
+def registry_from_source(src: str) -> list[Metric]:
+    """Parse the metric registry out of server/metrics.py source: every
+    ``self.<attr> = counter("family", ...)`` (and Gauge/Histogram/
+    Counter(...) forms) in the module."""
+    tree = ast.parse(src)
+    out: list[Metric] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        v = node.value
+        call = v
+        # Counter(...).labels(...) registers via the inner call
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "labels" \
+                and isinstance(v.func.value, ast.Call):
+            call = v.func.value
+        if not isinstance(call, ast.Call):
+            continue
+        kind = _CTOR_KINDS.get(call_name(call).split(".")[-1])
+        if kind is None or not call.args:
+            continue
+        fam = const_str(call.args[0])
+        if fam is None:
+            continue
+        out.append(Metric(attr=t.attr, family=fam, kind=kind,
+                          line=node.lineno))
+    return out
+
+
+def documented_families(readme_text: str) -> set:
+    """Every backticked vllm_*/tpuserve_* family named anywhere in the
+    README (tables and prose both count as documentation)."""
+    return set(_DOC_NAME_RE.findall(readme_text))
+
+
+def table_families(readme_text: str) -> set:
+    """Families named in README *table rows* — the rows the doc-sync test
+    holds to existence in the registry."""
+    out = set()
+    for line in readme_text.splitlines():
+        if line.lstrip().startswith("|"):
+            out.update(_DOC_NAME_RE.findall(line))
+    return out
+
+
+def _used_attrs(files: dict, registry_rel: str) -> set:
+    """Feed sites: attribute READS of a metrics object (Load ctx only —
+    the registration assignments themselves are Store-ctx targets and
+    must not count as uses) plus ``getattr(self.metrics, "attr")`` with
+    a constant-string name."""
+    used = set()
+    for rel, (_src, tree) in files.items():
+        in_registry = rel == registry_rel
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                recv = dotted(node.value)
+                if recv.endswith("metrics") or (in_registry
+                                                and recv == "self"):
+                    used.add(node.attr)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "getattr" and len(node.args) >= 2:
+                if "metrics" in dotted(node.args[0]):
+                    s = const_str(node.args[1])
+                    if s:
+                        used.add(s)
+    return used
+
+
+def run(files: dict, config: Config, repo_root: str) -> list:
+    findings: list = []
+    sec = config.section("metrics")
+    registry_rel = sec.get("registry", "tpuserve/server/metrics.py")
+    if registry_rel not in files:
+        return findings
+    src, _tree = files[registry_rel]
+    registry = registry_from_source(src)
+    if not registry:
+        return findings
+    used = _used_attrs(files, registry_rel)
+
+    readme_rel = sec.get("readme", "README.md")
+    readme_path = os.path.join(repo_root, readme_rel)
+    readme_text = ""
+    if os.path.exists(readme_path):
+        with open(readme_path, "r", encoding="utf-8") as f:
+            readme_text = f.read()
+    documented = documented_families(readme_text)
+
+    for m in registry:
+        if m.attr not in used:
+            findings.append(Finding(
+                file=registry_rel, line=m.line, rule="metric-never-updated",
+                message=f"metric '{m.family}' (attr {m.attr}) is "
+                        "registered but never incremented/observed/set "
+                        "anywhere — dashboards scraping it read zeros "
+                        "forever", pass_name=NAME))
+        if readme_text and m.exported not in documented \
+                and m.family not in documented:
+            findings.append(Finding(
+                file=registry_rel, line=m.line, rule="metric-undocumented",
+                message=f"metric family '{m.exported}' is not documented "
+                        f"in {readme_rel} — every operator-facing family "
+                        "needs a table row", pass_name=NAME))
+    if readme_text:
+        exported = {m.exported for m in registry} | {m.family
+                                                     for m in registry}
+        for fam in sorted(table_families(readme_text)):
+            if fam not in exported:
+                findings.append(Finding(
+                    file=readme_rel, line=1, rule="metric-doc-drift",
+                    message=f"README documents metric family '{fam}' "
+                            "which is not in the server/metrics.py "
+                            "registry (renamed or removed?)",
+                    pass_name=NAME))
+    return findings

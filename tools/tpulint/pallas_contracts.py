@@ -1,0 +1,485 @@
+"""P4: Pallas kernel contract lint.
+
+Pallas TPU kernels fail at runtime (or silently mis-DMA) when structural
+contracts drift; all of them are statically checkable at the call site:
+
+- ``pallas-index-map-arity``: a ``pl.BlockSpec`` index-map lambda must
+  take ``len(grid)`` grid indices plus, under
+  ``pltpu.PrefetchScalarGridSpec``, one ref per scalar-prefetch operand
+  (the guide's contract; a miscounted lambda shifts every block index).
+- ``pallas-kernel-arity``: the kernel's positional parameters must equal
+  ``num_scalar_prefetch + len(in_specs) + len(out_specs) +
+  len(scratch_shapes)`` — scalar-prefetch refs FIRST.  Conditional
+  ``in_specs += [...]`` branches produce a set of feasible arities; the
+  kernel must match one of them.
+- ``pallas-call-arity``: the operands passed to ``pl.pallas_call(...)``
+  must number ``num_scalar_prefetch + len(in_specs)``.
+- ``pallas-dot-accum``: every ``dot_general``/``dot`` inside a kernel
+  must pin ``preferred_element_type`` (fp32 accumulation) — the int8/bf16
+  dequant path silently accumulates in bf16 without it.
+- ``pallas-upcast-before-dot``: ``.astype(jnp.float32)`` on a dot operand
+  runs the MXU at its slow fp32 rate for no accuracy gain (accumulate in
+  fp32 via preferred_element_type instead).
+- ``pallas-dequant-dtype``: ``dequantize_kv(..., jnp.float32)`` — dequant
+  results must stay in the compute dtype (q's dtype) to keep the dots on
+  the fast MXU path.
+- ``pallas-vmem-budget``: statically-resolvable VMEM scratch totals per
+  kernel must fit ``pallas.vmem_budget_mb`` (~16 MiB/core on v5e);
+  oversized combinations reach Mosaic unchecked and can silently regress
+  a kernel 40% (the spp16 sweep collapse).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.tpulint.core import Config, Finding, call_name, dotted
+
+NAME = "pallas"
+TAG = "pallas-ok"
+
+_ITEMSIZE = {
+    "jnp.float32": 4, "jnp.int32": 4, "jnp.uint32": 4, "np.float32": 4,
+    "jnp.bfloat16": 2, "jnp.float16": 2, "jnp.int16": 2,
+    "jnp.int8": 1, "jnp.uint8": 1, "jnp.float64": 8,
+}
+
+
+def _list_lengths(node: ast.AST, env: dict) -> set:
+    """Feasible element counts of a list/tuple expression."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return {len(node.elts)}
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mult):
+            base = _list_lengths(node.left, env)
+            k = _const_int(node.right, env)
+            if base and k is not None:
+                return {b * k for b in base}
+        if isinstance(node.op, ast.Add):
+            l, r = _list_lengths(node.left, env), _list_lengths(node.right,
+                                                                env)
+            if l and r:
+                return {a + b for a in l for b in r}
+    if isinstance(node, ast.Name) and node.id in env:
+        return set(env[node.id])
+    return set()
+
+
+def _const_int(node: ast.AST, consts: Optional[dict] = None) -> Optional[int]:
+    consts = consts or {}
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        v = consts.get(node.id)
+        return v if isinstance(v, int) else None
+    if isinstance(node, ast.BinOp):
+        l = _const_int(node.left, consts)
+        r = _const_int(node.right, consts)
+        if l is None or r is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Mult):
+                return l * r
+            if isinstance(node.op, ast.Add):
+                return l + r
+            if isinstance(node.op, ast.Sub):
+                return l - r
+            if isinstance(node.op, ast.FloorDiv):
+                return l // r
+            if isinstance(node.op, ast.Pow):
+                return l ** r
+            if isinstance(node.op, ast.LShift):
+                return l << r
+        except Exception:
+            return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand, consts)
+        return -v if v is not None else None
+    return None
+
+
+def _spec_env(scope_nodes: list) -> tuple[dict, dict, dict]:
+    """(list_lengths_env, const_env, assigns) from simple statements in a
+    scope: name -> feasible list lengths (conditional += adds branches),
+    name -> int constant, name -> last-assigned value node."""
+    lengths: dict = {}
+    consts: dict = {}
+    assigns: dict = {}
+
+    def handle(stmt, conditional):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            assigns[name] = stmt.value
+            ln = _list_lengths(stmt.value, lengths)
+            if ln:
+                lengths[name] = ln
+            ci = _const_int(stmt.value, consts)
+            if ci is not None:
+                consts[name] = ci
+        elif isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and isinstance(stmt.op, ast.Add):
+            name = stmt.target.id
+            add = _list_lengths(stmt.value, lengths)
+            if name in lengths and add:
+                new = {b + a for b in lengths[name] for a in add}
+                lengths[name] = (lengths[name] | new) if conditional else new
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            c = stmt.value
+            if isinstance(c.func, ast.Attribute) \
+                    and c.func.attr in ("append", "extend") \
+                    and isinstance(c.func.value, ast.Name):
+                name = c.func.value.id
+                add = (1 if c.func.attr == "append"
+                       else next(iter(_list_lengths(c.args[0], lengths)),
+                                 None) if c.args else None)
+                if name in lengths and add is not None:
+                    new = {b + add for b in lengths[name]}
+                    lengths[name] = (lengths[name] | new) if conditional \
+                        else new
+
+    def walk(stmts, conditional):
+        for s in stmts:
+            handle(s, conditional)
+            if isinstance(s, ast.If):
+                walk(s.body, True)
+                walk(s.orelse, True)
+            elif isinstance(s, (ast.For, ast.While, ast.With, ast.Try)):
+                for attr in ("body", "orelse", "finalbody"):
+                    walk(getattr(s, attr, []), True)
+
+    walk(scope_nodes, False)
+    return lengths, consts, assigns
+
+
+def _grid_info(call: ast.Call, lengths: dict, assigns: dict = None) -> dict:
+    """{'rank': set|None, 'nsp': int, 'in': set, 'out': set,
+    'scratch': set} for a grid-spec or pallas_call node."""
+    info = {"rank": None, "nsp": 0, "in": set(), "out": set(),
+            "scratch": {0}}
+    for kw in call.keywords:
+        if kw.arg == "grid":
+            gv = kw.value
+            if isinstance(gv, ast.Name) and assigns and gv.id in assigns:
+                gv = assigns[gv.id]
+            if isinstance(gv, (ast.Tuple, ast.List)):
+                info["rank"] = {len(gv.elts)}
+            elif isinstance(gv, ast.Constant):
+                info["rank"] = {1}
+            # unresolvable grid expression: leave rank None (index-map
+            # arity then skips rather than guessing)
+        elif kw.arg == "num_scalar_prefetch":
+            v = _const_int(kw.value)
+            info["nsp"] = v or 0
+        elif kw.arg == "in_specs":
+            info["in"] = _list_lengths(kw.value, lengths)
+        elif kw.arg == "out_specs":
+            n = _list_lengths(kw.value, lengths)
+            info["out"] = n or {1}
+        elif kw.arg == "scratch_shapes":
+            info["scratch"] = _list_lengths(kw.value, lengths) or {0}
+    return info
+
+
+def _kernel_arities(kernel_expr, defs: dict, assigns: dict) -> set:
+    """Feasible positional-parameter counts of the kernel callable —
+    through Name lookups (a name may have several defs: the conditional
+    re-wrap pattern) and functools.partial positional binding."""
+    out: set = set()
+
+    def arity_of_def(fn) -> int:
+        a = fn.args
+        return len(a.posonlyargs) + len(a.args)
+
+    def resolve(expr, depth=0):
+        if depth > 4:
+            return
+        if isinstance(expr, ast.Name):
+            for fn in defs.get(expr.id, []):
+                out.add(arity_of_def(fn))
+            if expr.id in assigns:
+                resolve(assigns[expr.id], depth + 1)
+        elif isinstance(expr, ast.Call) and \
+                call_name(expr).split(".")[-1] == "partial":
+            if expr.args:
+                inner: set = set()
+                sub = _kernel_arities(expr.args[0], defs, assigns)
+                bound = len(expr.args) - 1
+                kw_bound = {k.arg for k in expr.keywords if k.arg}
+                for n in sub:
+                    inner.add(n - bound)
+                # keyword-bound params reduce arity only if positional;
+                # kernels bind config via keyword-only args, so ignore
+                out.update(i for i in inner if i >= 0)
+        elif isinstance(expr, ast.Lambda):
+            out.add(len(expr.args.posonlyargs) + len(expr.args.args))
+    resolve(kernel_expr)
+    return out
+
+
+def _function_defs(scope) -> dict:
+    defs: dict = {}
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _vmem_bytes(call: ast.Call, consts: dict) -> Optional[int]:
+    """Bytes of one pltpu.VMEM(shape, dtype) scratch entry, or None when
+    a dimension / dtype cannot be resolved statically."""
+    if call_name(call).split(".")[-1] != "VMEM" or not call.args:
+        return None
+    shape = call.args[0]
+    if not isinstance(shape, (ast.Tuple, ast.List)):
+        return None
+    total = 1
+    for el in shape.elts:
+        v = _const_int(el, consts)
+        if v is None:
+            return None
+        total *= v
+    if len(call.args) < 2:
+        return None
+    itemsize = _ITEMSIZE.get(dotted(call.args[1]))
+    if itemsize is None:
+        return None
+    return total * itemsize
+
+
+def _iter_scopes(tree: ast.Module):
+    yield tree, tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+
+
+def run(files: dict, config: Config, repo_root: str) -> list:
+    findings: list = []
+    budget = config.section("pallas").get("vmem_budget_mb", 16) * 2**20
+    for rel, (_src, tree) in files.items():
+        if "pallas" not in _src:
+            continue
+        module_defs = _function_defs(tree)
+        _, module_consts, _ = _spec_env(tree.body)
+        for scope, body in _iter_scopes(tree):
+            lengths, consts, assigns = _spec_env(body)
+            consts = {**module_consts, **consts}
+            # grid contexts in this scope
+            grid_calls = []
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Call):
+                    leaf = call_name(node).split(".")[-1]
+                    if leaf in ("PrefetchScalarGridSpec", "GridSpec"):
+                        grid_calls.append(node)
+                    elif leaf == "pallas_call" and any(
+                            kw.arg == "grid" for kw in node.keywords):
+                        grid_calls.append(node)
+            if scope is not tree:
+                _check_scope(rel, scope, grid_calls, lengths, consts,
+                             assigns, module_defs, budget, findings)
+        _check_kernel_bodies(rel, tree, module_defs, findings)
+    return findings
+
+
+def _check_scope(rel, scope, grid_calls, lengths, consts, assigns,
+                 module_defs, budget, findings):
+    infos = [(g, _grid_info(g, lengths, assigns)) for g in grid_calls]
+    single = infos[0][1] if len(infos) == 1 else None
+
+    # index-map arity: every BlockSpec lambda in a single-grid scope
+    if single is not None and single["rank"]:
+        expected = {r + single["nsp"] for r in single["rank"]}
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node).split(".")[-1] == "BlockSpec"):
+                continue
+            lam = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Lambda):
+                lam = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "index_map" and isinstance(kw.value, ast.Lambda):
+                    lam = kw.value
+            if lam is None:
+                continue
+            nparams = len(lam.args.posonlyargs) + len(lam.args.args)
+            if nparams not in expected:
+                findings.append(Finding(
+                    file=rel, line=lam.lineno, rule="pallas-index-map-arity",
+                    message=f"BlockSpec index map takes {nparams} params "
+                            f"but the grid has rank {sorted(single['rank'])}"
+                            f" with {single['nsp']} scalar-prefetch "
+                            f"operand(s) — expected "
+                            f"{sorted(expected)} (grid indices first, "
+                            "then one ref per scalar-prefetch arg)",
+                    pass_name=NAME))
+
+    # kernel / operand arity per pallas_call
+    local_defs = _function_defs(scope) if scope is not None else {}
+    defs = {**module_defs, **local_defs}
+    for node in ast.walk(scope):
+        if not (isinstance(node, ast.Call)
+                and call_name(node).split(".")[-1] == "pallas_call"):
+            continue
+        info = None
+        for kw in node.keywords:
+            if kw.arg == "grid_spec":
+                gv = kw.value
+                if isinstance(gv, ast.Name) and gv.id in assigns:
+                    gv = assigns[gv.id]
+                if isinstance(gv, ast.Call):
+                    info = _grid_info(gv, lengths, assigns)
+        if info is None:
+            info = _grid_info(node, lengths, assigns)
+        n_in, n_out, n_scr = info["in"], info["out"], info["scratch"]
+        if not n_out:
+            # fall back to out_shape structure
+            for kw in node.keywords:
+                if kw.arg == "out_shape":
+                    n_out = _list_lengths(kw.value, lengths) or {1}
+        if node.args and n_in and n_out:
+            arities = _kernel_arities(node.args[0], defs, assigns)
+            expected = {info["nsp"] + i + o + s
+                        for i in n_in for o in n_out for s in n_scr}
+            if arities and not (arities & expected):
+                findings.append(Finding(
+                    file=rel, line=node.lineno, rule="pallas-kernel-arity",
+                    message=f"kernel takes {sorted(arities)} positional "
+                            f"ref(s) but the specs provide "
+                            f"{sorted(expected)} (num_scalar_prefetch="
+                            f"{info['nsp']} first, then "
+                            f"{sorted(n_in)} inputs, {sorted(n_out)} "
+                            f"outputs, {sorted(n_scr)} scratch)",
+                    pass_name=NAME))
+        # operand count at the invocation site
+        parent_call = _invocation_of(scope, node)
+        if parent_call is not None and n_in:
+            has_star = any(isinstance(a, ast.Starred)
+                           for a in parent_call.args)
+            nargs = len([a for a in parent_call.args
+                         if not isinstance(a, ast.Starred)])
+            expected_ops = {info["nsp"] + i for i in n_in}
+            bad = (nargs not in expected_ops if not has_star
+                   else nargs > max(expected_ops))
+            if bad:
+                findings.append(Finding(
+                    file=rel, line=parent_call.lineno,
+                    rule="pallas-call-arity",
+                    message=f"pallas_call invoked with "
+                            f"{nargs}{'+' if has_star else ''} operands "
+                            f"but the grid spec declares "
+                            f"{info['nsp']} scalar-prefetch + "
+                            f"{sorted(n_in)} inputs "
+                            f"(= {sorted(expected_ops)})",
+                    pass_name=NAME))
+        # VMEM budget over resolvable scratch entries
+        _check_vmem(rel, node, assigns, consts, budget, findings)
+
+
+def _invocation_of(scope, pallas_call_node) -> Optional[ast.Call]:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and node.func is pallas_call_node:
+            return node
+    return None
+
+
+def _check_vmem(rel, pallas_node, assigns, consts, budget, findings):
+    scratch_expr = None
+    for kw in pallas_node.keywords:
+        if kw.arg == "grid_spec":
+            gv = kw.value
+            if isinstance(gv, ast.Name) and gv.id in assigns:
+                gv = assigns[gv.id]
+            if isinstance(gv, ast.Call):
+                for gkw in gv.keywords:
+                    if gkw.arg == "scratch_shapes":
+                        scratch_expr = gkw.value
+        elif kw.arg == "scratch_shapes":
+            scratch_expr = kw.value
+    if scratch_expr is None:
+        return
+    if isinstance(scratch_expr, ast.Name):
+        scratch_expr = assigns.get(scratch_expr.id)
+    if not isinstance(scratch_expr, (ast.List, ast.Tuple)):
+        return
+    total = 0
+    for el in scratch_expr.elts:
+        if isinstance(el, ast.Call):
+            b = _vmem_bytes(el, consts)
+            if b is None:
+                if call_name(el).split(".")[-1] == "VMEM":
+                    return          # symbolic dims: cannot bound statically
+                continue            # semaphores etc.: no VMEM data bytes
+            total += b
+    if total > budget:
+        findings.append(Finding(
+            file=rel, line=pallas_node.lineno, rule="pallas-vmem-budget",
+            message=f"kernel VMEM scratch totals {total / 2**20:.1f} MiB, "
+                    f"over the {budget / 2**20:.0f} MiB/core budget — "
+                    "oversized scratch reaches Mosaic unchecked and can "
+                    "silently collapse kernel throughput (clamp the knobs "
+                    "like ops/pallas_paged_attention._clamp_to_vmem_budget)",
+            pass_name=NAME))
+
+
+def _check_kernel_bodies(rel, tree, defs, findings):
+    """dtype rules inside kernel bodies (any *_kernel def plus defs used
+    as pallas_call kernels — the naming convention is itself enforced by
+    review; the lint keys on both)."""
+    kernel_fns = []
+    kernel_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and call_name(node).split(".")[-1] == "pallas_call" \
+                and node.args:
+            k = node.args[0]
+            if isinstance(k, ast.Name):
+                kernel_names.add(k.id)
+            elif isinstance(k, ast.Call) and k.args \
+                    and isinstance(k.args[0], ast.Name):
+                kernel_names.add(k.args[0].id)
+    for name, fns in defs.items():
+        if name in kernel_names or name.endswith("_kernel"):
+            kernel_fns += fns
+    for fn in kernel_fns:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = call_name(node).split(".")[-1]
+            if leaf in ("dot_general", "dot"):
+                if not any(kw.arg == "preferred_element_type"
+                           for kw in node.keywords):
+                    findings.append(Finding(
+                        file=rel, line=node.lineno, rule="pallas-dot-accum",
+                        message=f"{leaf} in kernel {fn.name} without "
+                                "preferred_element_type — int8/bf16 "
+                                "operands silently accumulate in bf16; "
+                                "pin jnp.float32 accumulation",
+                        pass_name=NAME))
+                for arg in node.args:
+                    if isinstance(arg, ast.Call) \
+                            and isinstance(arg.func, ast.Attribute) \
+                            and arg.func.attr == "astype" and arg.args \
+                            and dotted(arg.args[0]) in ("jnp.float32",
+                                                        "np.float32"):
+                        findings.append(Finding(
+                            file=rel, line=arg.lineno,
+                            rule="pallas-upcast-before-dot",
+                            message=f"operand upcast to float32 before "
+                                    f"{leaf} in {fn.name} runs the MXU at "
+                                    "its slow fp32 rate; keep the stored "
+                                    "dtype and set preferred_element_type",
+                            pass_name=NAME))
+            elif leaf == "dequantize_kv":
+                if len(node.args) >= 3 and dotted(node.args[2]) in (
+                        "jnp.float32", "np.float32"):
+                    findings.append(Finding(
+                        file=rel, line=node.lineno,
+                        rule="pallas-dequant-dtype",
+                        message=f"dequantize_kv to float32 in {fn.name} — "
+                                "dequant results must stay in the compute "
+                                "dtype (q's dtype) to keep the PV/QK dots "
+                                "on the fast MXU path",
+                        pass_name=NAME))

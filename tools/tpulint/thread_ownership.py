@@ -1,0 +1,177 @@
+"""P2: thread-ownership lint.
+
+The engine is single-threaded by design: all scheduler / block-manager /
+request mutation happens on the engine loop thread
+(``AsyncEngineRunner._loop``).  Watchdog, gateway and health threads may
+*read* engine state and signal thread-safe primitives, but a mutation
+from one of them is the PR-3 bug class (the watchdog used to call
+``engine.abort_request`` under the loop thread's feet, corrupting
+scheduler state mid-dispatch).
+
+Per class, thread entry points are discovered from
+``threading.Thread(target=self.X)`` (and ``target=<local function>``)
+call sites.  Entry points not named in ``thread_ownership.loop_roots``
+are *foreign* threads; every method transitively reachable from a
+foreign root via ``self.<m>()`` calls is scanned for:
+
+- ``cross-thread-mutation``: assignment / augmented assignment / delete /
+  known-mutating method call rooted at an engine-loop-owned attribute
+  (``self.engine...`` plus the per-class ``owned_attrs`` config);
+- ``cross-thread-setattr``: any ``setattr(...)`` call (dynamic attribute
+  writes defeat the static ownership analysis, so they must each justify
+  themselves with ``# tpulint: thread-ok(reason)``).
+
+Deliberate, guarded cross-thread touches (a lock, a loop-side-only flag)
+carry ``# tpulint: thread-ok(reason)`` — the lint turns "reviewer
+remembered the threading model" into "the code states it".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Config, Finding, call_name, dotted, qual_match
+
+NAME = "thread-ownership"
+TAG = "thread-ok"
+
+_MUTATOR_HINTS = {
+    # container / engine mutators that change loop-owned state
+    "pop", "clear", "append", "appendleft", "remove", "add", "update",
+    "insert", "extend", "popleft", "discard", "setdefault",
+    "abort_request", "add_request", "step", "adopt_prefilled",
+    "salvage_requeue", "free", "allocate", "reserve", "advance",
+    "set_admission_filter", "mark_running", "preempt_last", "finish",
+}
+
+
+def _thread_targets(cls: ast.ClassDef) -> list:
+    """Names of methods / local functions used as Thread targets inside
+    this class, with the method that creates the thread."""
+    targets = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node).split(".")[-1] != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            if isinstance(t, ast.Attribute) and dotted(t).startswith("self."):
+                targets.append(t.attr)
+            elif isinstance(t, ast.Name):
+                targets.append(t.id)
+    return targets
+
+
+def _method_map(cls: ast.ClassDef) -> dict:
+    out = {}
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[item.name] = item
+            # local functions used as thread targets live inside methods
+            for sub in ast.walk(item):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and sub is not item and sub.name not in out:
+                    out[sub.name] = sub
+    return out
+
+
+def _self_calls(fn) -> set:
+    calls = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                calls.add(node.func.attr)
+    return calls
+
+
+def _owned_root(expr: ast.AST, owned: set) -> str:
+    """'engine' when ``expr`` is rooted at self.<owned-attr> (seen through
+    getattr() and subscripts), else ''."""
+    d = dotted(expr)
+    for attr in owned:
+        if d == f"self.{attr}" or d.startswith(f"self.{attr}."):
+            return attr
+    return ""
+
+
+def run(files: dict, config: Config, repo_root: str) -> list:
+    findings: list = []
+    sec = config.section("thread_ownership")
+    loop_roots = sec.get("loop_roots", [])
+    owned_cfg = sec.get("owned_attrs", {})
+    safe = set(sec.get("safe_methods", []))
+    for rel, (_src, tree) in files.items():
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            targets = _thread_targets(cls)
+            if not targets:
+                continue
+            methods = _method_map(cls)
+            foreign_roots = [
+                t for t in targets
+                if t in methods
+                and not qual_match(rel, f"{cls.name}.{t}", loop_roots)]
+            if not foreign_roots:
+                continue
+            owned = set(owned_cfg.get(cls.name, [])) | {"engine"}
+            # transitive closure over self.<m>() calls
+            reach = set()
+            frontier = list(foreign_roots)
+            while frontier:
+                m = frontier.pop()
+                if m in reach or m not in methods:
+                    continue
+                reach.add(m)
+                frontier += list(_self_calls(methods[m]))
+            for m in sorted(reach):
+                _scan_method(rel, cls.name, m, methods[m], owned, safe,
+                             findings)
+    return findings
+
+
+def _scan_method(rel, cls_name, mname, fn, owned, safe, findings):
+    qual = f"{cls_name}.{mname}"
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target] if isinstance(node, ast.AugAssign)
+                       else node.targets)
+            for t in targets:
+                attr = _owned_root(t, owned)
+                # a bare rebind of self.engine itself is construction-time
+                # wiring; what the loop owns is the state BEHIND it
+                if attr and dotted(t) != "self.engine":
+                    findings.append(Finding(
+                        file=rel, line=node.lineno,
+                        rule="cross-thread-mutation",
+                        message=f"{qual} runs on a non-engine-loop thread "
+                                f"but mutates loop-owned state "
+                                f"'{dotted(t)}' — the PR-3 watchdog bug "
+                                "class; route through the intake queue or "
+                                "mark # tpulint: thread-ok(reason)",
+                        pass_name=NAME))
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "setattr":
+                findings.append(Finding(
+                    file=rel, line=node.lineno, rule="cross-thread-setattr",
+                    message=f"setattr() in {qual} (reachable from a "
+                            "non-engine-loop thread) writes attributes "
+                            "the ownership analysis cannot see",
+                    pass_name=NAME))
+            elif isinstance(node.func, ast.Attribute):
+                attr = _owned_root(node.func.value, owned)
+                meth = node.func.attr
+                if attr and meth not in safe and (
+                        meth in _MUTATOR_HINTS or meth.startswith("set_")):
+                    findings.append(Finding(
+                        file=rel, line=node.lineno,
+                        rule="cross-thread-mutation",
+                        message=f"{qual} runs on a non-engine-loop thread "
+                                f"but calls mutating "
+                                f"'{dotted(node.func)}()' on loop-owned "
+                                f"state — the PR-3 watchdog bug class",
+                        pass_name=NAME))

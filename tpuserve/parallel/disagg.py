@@ -196,8 +196,17 @@ class DisaggregatedEngine:
         dst = self.decode
         dst_alloc = dst.block_manager.allocate(rid, req.prompt_token_ids)
         t0 = time.monotonic()
-        dst.kv_cache = insert_seq_kv(dst.kv_cache, seq_kv, dst_alloc.blocks,
-                                     device=self.decode_device)
+        try:
+            dst.kv_cache = insert_seq_kv(dst.kv_cache, seq_kv,
+                                         dst_alloc.blocks,
+                                         device=self.decode_device)
+        except Exception:
+            # the pages never landed in the decode cache: without this the
+            # decode pool permanently leaks the allocation (the request is
+            # not yet registered decode-side, so no abort/salvage path can
+            # free it — tpulint kv-leak pass)
+            dst.block_manager.free(rid, cache_blocks=False)
+            raise
         self.stats.transfer_time_s += time.monotonic() - t0
         self.stats.kv_transfers += 1
         per_block = (self.prefill.kv_cache[0]["k"].nbytes

@@ -90,7 +90,22 @@ def _stack_layers(layers: list, pp: int, sharding=None):
 
     if sharding is None:
         return stack(layers)
-    return jax.jit(stack, out_shardings=sharding)(layers)
+
+    K = len(layers) // pp
+    def build(*xs):
+        # write each layer into a born-sharded zero buffer via
+        # dynamic-update-slice: stacking with jnp.stack/concatenate under
+        # out_shardings psums the replica axes when the mesh carries
+        # dp/ep/tp next to pp (each replica group contributes its copy to
+        # the stacked dim), silently scaling every weight by the replica
+        # count.  The .at[].set form partitions correctly on every mesh.
+        out = jnp.zeros((pp, K) + xs[0].shape, xs[0].dtype)
+        for i, x in enumerate(xs):
+            out = out.at[divmod(i, K)].set(x)
+        return out
+
+    return jax.jit(lambda ls: jax.tree.map(build, *ls),
+                   out_shardings=sharding)(layers)
 
 
 def stack_pipeline_params(params, cfg: ModelConfig, mesh):

@@ -282,6 +282,79 @@ class BlockManager:
     def num_seqs(self) -> int:
         return len(self._seqs)
 
+    def seq_ids(self) -> set:
+        return set(self._seqs)
+
+    def check_integrity(self, expected_seq_ids=None) -> None:
+        """Debug strict mode (``TPUSERVE_STRICT_BLOCKS``): verify the
+        block accounting invariants the engine relies on, raising
+        RuntimeError with every violation found.  The runtime complement
+        to tpulint's static kv-leak pass: the lint proves allocate/free
+        pairing on exception edges at review time; this catches the
+        dynamic leaks (double-free, refcount drift, orphaned sequences)
+        each engine cycle while chaos tests are running.
+
+        ``expected_seq_ids``: when given, the exact set of sequence ids
+        that should currently hold allocations (the engine passes its
+        live running + mid-chunk requests) — a sequence holding blocks
+        with no live request is a leak; a live request without blocks is
+        corruption.
+        """
+        problems: list[str] = []
+        owned: dict[int, int] = {}
+        for sid, alloc in self._seqs.items():
+            for b in alloc.blocks:
+                if b != RELEASED:
+                    owned[b] = owned.get(b, 0) + 1
+        free_set = set(self._free)
+        cached_set = set(self._cached)
+        if len(free_set) != len(self._free):
+            problems.append("duplicate block ids in the free list")
+        if free_set & cached_set:
+            problems.append(
+                f"blocks in BOTH free and cached: {sorted(free_set & cached_set)}")
+        for b, n in sorted(owned.items()):
+            rc = self._refcount.get(b, 0)
+            if rc != n:
+                problems.append(
+                    f"block {b}: refcount {rc} != {n} owning sequence(s)")
+            if b in free_set:
+                problems.append(
+                    f"block {b} owned by a live sequence AND free")
+            if b in cached_set:
+                problems.append(
+                    f"block {b} owned by a live sequence AND cached")
+        for b, rc in sorted(self._refcount.items()):
+            if b not in owned:
+                problems.append(
+                    f"block {b} has refcount {rc} but no owning sequence")
+        accounted = free_set | cached_set | set(owned)
+        if len(accounted) != self.num_blocks:
+            lost = self.num_blocks - len(accounted)
+            problems.append(
+                f"{lost} block(s) leaked: in neither the free list, the "
+                "cached pool, nor any sequence table")
+        for h, b in self._prefix.items():
+            if self._block_hash.get(b) != h:
+                problems.append(
+                    f"prefix hash {h} maps to block {b} but the reverse "
+                    "mapping disagrees")
+        if expected_seq_ids is not None:
+            extra = set(self._seqs) - set(expected_seq_ids)
+            missing = set(expected_seq_ids) - set(self._seqs)
+            if extra:
+                problems.append(
+                    "sequences holding blocks with no live request "
+                    f"(leak): {sorted(extra)}")
+            if missing:
+                problems.append(
+                    "live requests without block allocations "
+                    f"(corruption): {sorted(missing)}")
+        if problems:
+            raise RuntimeError(
+                "KV block integrity violated (TPUSERVE_STRICT_BLOCKS): "
+                + "; ".join(problems))
+
 
 def create_block_manager(num_blocks: int, block_size: int,
                          enable_prefix_caching: bool = True,
@@ -290,9 +363,17 @@ def create_block_manager(num_blocks: int, block_size: int,
     shared library is available, else this module's pure-Python one.
 
     impl: "auto" | "native" | "python".  TPUSERVE_BLOCK_MANAGER overrides.
+
+    ``TPUSERVE_STRICT_BLOCKS`` (the debug refcount cross-check) steers
+    "auto" to the Python manager — the C++ one exposes no sequence-table
+    introspection, so the per-cycle ``check_integrity`` would silently
+    no-op.  An explicit impl="native" request still wins (and runs
+    unchecked).
     """
     import os
     impl = os.environ.get("TPUSERVE_BLOCK_MANAGER", impl)
+    if impl == "auto" and os.environ.get("TPUSERVE_STRICT_BLOCKS"):
+        impl = "python"
     if impl in ("auto", "native"):
         try:
             from tpuserve.native import NativeBlockManager, native_available

@@ -455,6 +455,12 @@ class Engine:
         spec = (config.faults if config.faults is not None
                 else _os.environ.get("TPUSERVE_FAULTS"))
         self.faults = FaultInjector.from_spec(spec, seed=config.seed)
+        # Debug strict mode: cross-check block refcounts against live
+        # requests after every successful step (block_manager.py
+        # check_integrity) — the chaos/salvage tests run with it on, so
+        # any recovery path that leaks or double-frees KV blocks fails
+        # the cycle it happens, not a soak later.
+        self._strict_blocks = bool(_os.environ.get("TPUSERVE_STRICT_BLOCKS"))
         self._dispatch_rids: tuple = ()
         # device outputs of warmup-only executables (samplers, token
         # select) whose producer chains the end-of-warmup sync must drain
@@ -798,41 +804,61 @@ class Engine:
         req = Request(request_id=request_id,
                       prompt_token_ids=prompt_token_ids, params=params)
         alloc = self.block_manager.allocate(request_id, prompt_token_ids)
-        seq_kv = [{kk: jnp.asarray(a) for kk, a in l.items()}
-                  for l in seq_kv]
-        self.kv_cache = insert_seq_kv(self.kv_cache, seq_kv, alloc.blocks)
-        req.output_token_ids.append(first_token)
-        req.state = RequestState.RUNNING
-        req.first_token_time = time.monotonic()
-        detok = IncrementalDetokenizer(self.tokenizer)
-        first_text = detok.add(first_token)  # seed; text streamed prefill-side
-        self._detok[request_id] = detok
-        if params.guided is not None:
-            # cross-pod migration: prefer the token-level FSM (advance by
-            # the first TOKEN — exact); a prefill pod that already left
-            # the FSM (suffix-plan bytes) falls back to the char acceptor
-            fsm = self._fsm_for(params)
-            if fsm is not None and not guided_plan:
-                ns = fsm.advance(fsm.start, first_token)
-                if ns >= 0:
-                    self._guided_fsm[request_id] = [fsm, ns]
-                    self.stats.guided_fsm_requests += 1
-        if params.guided is not None and request_id not in self._guided_fsm:
-            # rebuild the acceptor and advance it by the first token's
-            # text, mirroring what prefill emitted
-            st = self._make_guided(params)
-            try:
-                st.feed(first_text)
-                self._guided[request_id] = st
-                if guided_plan:
-                    # the first token opened a committed canonical-suffix
-                    # plan on the prefill pod (possibly a partial rune —
-                    # first_text empty): keep emitting the same sequence,
-                    # or the dangling bytes in ctx never complete and the
-                    # constraint silently drops (round-4 review finding)
-                    self._guided_plan[request_id] = list(guided_plan)
-            except ValueError:
-                pass                     # already off-grammar: unconstrained
+        try:
+            # Everything between the allocate and the self.requests
+            # registration below is a leak window: a raise here (bad page
+            # shapes from a remote pod, a failed scatter) exits with
+            # blocks that neither abort_request nor salvage can find —
+            # found by tpulint's kv-leak pass.
+            seq_kv = [{kk: jnp.asarray(a) for kk, a in l.items()}
+                      for l in seq_kv]
+            self.kv_cache = insert_seq_kv(self.kv_cache, seq_kv,
+                                          alloc.blocks)
+            req.output_token_ids.append(first_token)
+            req.state = RequestState.RUNNING
+            req.first_token_time = time.monotonic()
+            detok = IncrementalDetokenizer(self.tokenizer)
+            # seed; text streamed prefill-side
+            first_text = detok.add(first_token)
+            self._detok[request_id] = detok
+            if params.guided is not None:
+                # cross-pod migration: prefer the token-level FSM (advance
+                # by the first TOKEN — exact); a prefill pod that already
+                # left the FSM (suffix-plan bytes) falls back to the char
+                # acceptor
+                fsm = self._fsm_for(params)
+                if fsm is not None and not guided_plan:
+                    ns = fsm.advance(fsm.start, first_token)
+                    if ns >= 0:
+                        self._guided_fsm[request_id] = [fsm, ns]
+                        self.stats.guided_fsm_requests += 1
+            if params.guided is not None \
+                    and request_id not in self._guided_fsm:
+                # rebuild the acceptor and advance it by the first token's
+                # text, mirroring what prefill emitted
+                st = self._make_guided(params)
+                try:
+                    st.feed(first_text)
+                    self._guided[request_id] = st
+                    if guided_plan:
+                        # the first token opened a committed
+                        # canonical-suffix plan on the prefill pod
+                        # (possibly a partial rune — first_text empty):
+                        # keep emitting the same sequence, or the dangling
+                        # bytes in ctx never complete and the constraint
+                        # silently drops (round-4 review finding)
+                        self._guided_plan[request_id] = list(guided_plan)
+                except ValueError:
+                    pass                 # already off-grammar: unconstrained
+        except Exception:
+            # the transferred KV never fully landed: blocks are suspect,
+            # drop them from the prefix pool too
+            self.block_manager.free(request_id, cache_blocks=False)
+            self._detok.pop(request_id, None)
+            self._guided.pop(request_id, None)
+            self._guided_fsm.pop(request_id, None)
+            self._guided_plan.pop(request_id, None)
+            raise
         self.requests[request_id] = req
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
@@ -921,7 +947,27 @@ class Engine:
     # ------------------------------------------------------------------
 
     def step(self) -> list[RequestOutput]:
-        """Run one engine iteration (one prefill batch or one decode step)."""
+        """Run one engine iteration (one prefill batch or one decode
+        step).  Under ``TPUSERVE_STRICT_BLOCKS`` every successful cycle
+        cross-checks block refcounts against the live request set — the
+        runtime complement to tpulint's static kv-leak pass (faulted
+        steps skip the check: their orphans are reconciled by the
+        runner's salvage path, not mid-exception)."""
+        outputs = self._step_inner()
+        if self._strict_blocks:
+            self._check_block_integrity()
+        return outputs
+
+    def _check_block_integrity(self) -> None:
+        chk = getattr(self.block_manager, "check_integrity", None)
+        if chk is None:              # native C++ manager: no introspection
+            return
+        holders = {r.request_id for r in self.scheduler.running}
+        holders |= {r.request_id for r in self.scheduler.waiting
+                    if r.num_prefilled > 0}
+        chk(expected_seq_ids=holders)
+
+    def _step_inner(self) -> list[RequestOutput]:
         self._dispatch_rids = ()
         batch = self.scheduler.schedule()
         if batch is None:
@@ -1731,9 +1777,11 @@ class Engine:
         # exactly what the salvage path expects to find.
         self.faults.check("window_flush",
                           tuple(r.request_id for r in p.reqs))
+        # tpulint: sync-ok(THE designated sync: one device_get per S-token window is the whole fused-window design)
         toks_h = np.asarray(jax.device_get(p.toks))
         lp_h = None
         if p.lp is not None:
+            # tpulint: sync-ok(rides the same window-flush sync point; logprob arrays resolve with the tokens)
             lp_h = tuple(np.asarray(x) for x in jax.device_get(p.lp))
         outputs: list[RequestOutput] = []
         # Commit written KV BEFORE emitting (finish frees blocks mid-loop);
@@ -1936,13 +1984,16 @@ class Engine:
                 jnp.asarray(top_p), jnp.asarray(min_p))
             # ONE round trip for both arrays — a tunneled backend pays
             # tens of ms per host sync
-            accept_h, pred_h = (np.asarray(x) for x in
-                                jax.device_get((accept, pred)))
+            accept_h, pred_h = (
+                np.asarray(x) for x in
+                # tpulint: sync-ok(spec verify is synchronous by design: accept/pred decide host-side emission this step)
+                jax.device_get((accept, pred)))
         else:
             pred, self.kv_cache = self._exec_decode_verify(
                 jnp.asarray(tokens), jnp.asarray(ctx_lens),
                 jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
                 jnp.asarray(block_tables))
+            # tpulint: sync-ok(greedy spec verify twin of the sampled sync above)
             pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
@@ -1981,6 +2032,7 @@ class Engine:
             ids = (r.prompt_token_ids + r.output_token_ids)[-W:]
             tokens[i, :len(ids)] = ids
             lens[i] = len(ids)
+        # tpulint: sync-ok(draft proposals feed the verify batch built host-side this same step; spec path is synchronous)
         out = np.asarray(self._exec_draft_propose(
             jnp.asarray(tokens), jnp.asarray(lens), k=k))
         return [[int(t) for t in out[i]] for i in range(len(reqs))]
@@ -2016,6 +2068,7 @@ class Engine:
         p, self._pending = self._pending, None
         if p is None:
             return []
+        # tpulint: sync-ok(the single-step pipeline's designated sync: resolves the PREVIOUS step while the next runs)
         toks = np.asarray(jax.device_get(p.toks))
         reqs, vals = [], []
         for i, r in enumerate(p.reqs):
@@ -2050,6 +2103,7 @@ class Engine:
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
+        # tpulint: sync-ok(the synchronous per-step path's one sync; the pipelined paths never call _sample)
         toks_np = np.asarray(jax.device_get(toks))[:n].copy()
         if any(r.request_id in self._guided for r in reqs):
             # legacy substitution path: only rows WITHOUT a compiled FSM
@@ -2204,6 +2258,7 @@ class Engine:
         is written by the NEXT dispatch."""
         k = min(self.GUIDED_TOP_K, self.model_cfg.vocab_size)
         _, top_ids = jax.lax.top_k(logits, k)
+        # tpulint: sync-ok(legacy guided substitution is host-side by design; FSM-compilable grammars stay on device)
         ids_h = np.asarray(jax.device_get(top_ids))
         for i, r in enumerate(reqs):
             st = self._guided.get(r.request_id)

@@ -322,6 +322,7 @@ class AsyncEngineRunner:
         for e in self._inner_engines():
             stats = getattr(e, "stats", None)
             if stats is not None and hasattr(stats, name):
+                # tpulint: thread-ok(advisory stats counter; benign race, no engine-loop invariant reads it)
                 setattr(stats, name, getattr(stats, name) + n)
                 return
 
@@ -344,15 +345,21 @@ class AsyncEngineRunner:
             for rid, q in list(self._out_queues.items()):
                 if engine_side:
                     try:
+                        # tpulint: thread-ok(engine_side=True only on the loop thread; watchdog passes False, _consume_hard_trip reconciles loop-side)
                         self.engine.abort_request(rid)
                     except Exception:
                         pass
+                    # tpulint: thread-ok(guarded by engine_side, loop-thread-only branch)
                     getattr(self.engine, "requests", {}).pop(rid, None)
                 q.put(RuntimeError(message))
                 q.put(None)
+            # tpulint: thread-ok(client-queue map; writers serialised by _fail_lock, readers tolerate missing entries)
             self._out_queues.clear()
+            # tpulint: thread-ok(timing map under _fail_lock; metrics-only)
             self._req_started.clear()
+            # tpulint: thread-ok(timing map under _fail_lock; metrics-only)
             self._last_token_time.clear()
+            # tpulint: thread-ok(bisection evidence reset under _fail_lock)
             self._singleton_faults.clear()
 
     def _fail_request(self, rid: str, message: str,
@@ -596,9 +603,14 @@ class AsyncEngineRunner:
             total = sum(bm.num_blocks for bm in bms)
             free = sum(bm.num_free_blocks for bm in bms)
             self.metrics.kv_usage.set((total - free) / max(total, 1))
-            for name in ("prefix_hits", "prefix_queries"):
-                _advance_counter(getattr(self.metrics, name),
-                                 sum(getattr(bm, name, 0) for bm in bms))
+            # direct attribute access (not getattr-by-string) so the
+            # metrics-consistency lint can see these families are fed
+            _advance_counter(self.metrics.prefix_hits,
+                             sum(getattr(bm, "prefix_hits", 0)
+                                 for bm in bms))
+            _advance_counter(self.metrics.prefix_queries,
+                             sum(getattr(bm, "prefix_queries", 0)
+                                 for bm in bms))
         # engine-level stats live on the inner engines for the disagg
         # wrappers (DisaggStats has neither counter) — same special-casing
         # as the scheduler/block-manager reads above
