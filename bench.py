@@ -663,6 +663,66 @@ def _compare_mixed(args, model, batch, prompt_len, gen_len, on_tpu, *,
 V5E_HBM_GBS = 819.0   # v5e HBM bandwidth (BENCHMARKS.md roofline analysis)
 
 
+def _host_overhead_sweep(args, model, prompt_len, gen_len, *,
+                         attn_impl, pipeline, warm_modes):
+    """Client-count-scaled host-overhead rows (ROADMAP open item 3 /
+    DeepServe's host-side scaling wall): one engine per stream count, the
+    same burst workload, with the host phase profiler armed — reporting
+    decode tok/s AND host-ms-per-cycle (schedule + block accounting +
+    detokenize/emit, the phases this repo moved off per-request Python)
+    per count.  Host overhead grows with concurrent streams while device
+    time per cycle stays ~flat, so this is the number that says whether
+    the host loop is back on the critical path."""
+    import numpy as np
+
+    from tpuserve.runtime.hostprof import PROF
+    from tpuserve.runtime.request import SamplingParams
+    counts = [int(c) for c in args.clients_sweep.split(",") if c.strip()]
+    rows = []
+    bm_name = ""
+    host_batched = True
+    for n in counts:
+        eng = _build_engine(model, n, prompt_len, gen_len,
+                            attn_impl=attn_impl, pipeline=pipeline,
+                            multi_step=args.multi_step,
+                            quantization=args.quant,
+                            kv_quant=args.kv_quant,
+                            block_size=args.block_size)
+        bm_name = type(eng.block_manager).__name__
+        host_batched = eng._host_batched   # the engine's own resolved mode
+        _warm(eng, n, prompt_len, modes=warm_modes)
+        rng = np.random.default_rng(0)
+        vocab = eng.model_cfg.vocab_size
+        prompts = [rng.integers(1, vocab - 1, size=prompt_len).tolist()
+                   for _ in range(n)]
+        params = SamplingParams(max_tokens=gen_len,
+                                temperature=args.temperature,
+                                top_p=args.top_p, seed=0, ignore_eos=True)
+        PROF.reset()
+        PROF.enabled = True
+        try:
+            r = _run_workload(eng, prompts, params)
+        finally:
+            PROF.enabled = False
+        rep = PROF.report()
+        phases = {k: v["ms_per_cycle"] for k, v in rep["phases"].items()}
+        dec = r["gen_tokens"] - n
+        rows.append({
+            "clients": n,
+            "decode_tok_s": round(dec / r["decode_s"], 1)
+                            if r["decode_s"] else 0.0,
+            # pure-host phases only (dispatch/flush include device wait)
+            "host_ms_per_cycle": rep["host_ms_per_cycle"],
+            "phases_ms_per_cycle": phases,
+            "cycles": rep["cycles"],
+        })
+    return {
+        "block_manager": bm_name,
+        "host_batched": host_batched,
+        "rows": rows,
+    }
+
+
 def _roofline(eng0, batch, prompt_len, gen_len, steps_s):
     """Estimated HBM traffic at the measured rate — decode is
     bandwidth-bound, so tok/s is only meaningful against the pipe
@@ -835,6 +895,14 @@ def main(argv=None):
                          "'decode_dispatch:raise:0.02'), driven through "
                          "the salvage-capable runner; reports wall-clock "
                          "overhead + salvage/poison/watchdog counters")
+    ap.add_argument("--clients-sweep", default=None, metavar="N,N,...",
+                    help="host-overhead scaling rows: re-run the workload "
+                         "at each client count (e.g. 16,64,256), reporting "
+                         "decode tok/s and host-ms-per-cycle per count "
+                         "(schedule + block accounting + detokenize — the "
+                         "phases the native/batched host path moved off "
+                         "per-request Python; TPUSERVE_HOST_BATCHED=0 "
+                         "measures the legacy path for the A/B)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -1114,6 +1182,11 @@ def main(argv=None):
                 decode_tokens / r["num_decode_steps"], 2)
                           if r["num_decode_steps"] else 0.0,
         }
+    if args.clients_sweep:
+        with tpu_guard("host overhead sweep"):
+            out["host_overhead"] = _host_overhead_sweep(
+                args, model, prompt_len, gen_len, attn_impl=attn_impl,
+                pipeline=pipeline, warm_modes=warm_modes)
     if args.compare_mixed:
         with tpu_guard("mixed comparison"):
             out["mixed_ab"] = _compare_mixed(

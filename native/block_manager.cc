@@ -77,4 +77,36 @@ void bm_free_seq_uncached(void* h, const char* seq_id) {
   static_cast<BlockManager*>(h)->free_seq(seq_id, /*cache_blocks=*/false);
 }
 
+// ---- per-cycle batched ops (see block_manager.hh) -----------------------
+
+int64_t bm_decode_shortfall(void* h, const char* const* seq_ids,
+                            int64_t n) {
+  return static_cast<BlockManager*>(h)->decode_shortfall(seq_ids, n);
+}
+int64_t bm_charge_decode(void* h, const char* const* seq_ids, int64_t n,
+                         int32_t* slots_out) {
+  return static_cast<BlockManager*>(h)->charge_decode(seq_ids, n, slots_out);
+}
+int64_t bm_fill_block_tables(void* h, const char* const* seq_ids, int64_t n,
+                             int32_t* out, int64_t stride) {
+  return static_cast<BlockManager*>(h)->fill_block_tables(seq_ids, n, out,
+                                                          stride);
+}
+int64_t bm_reserve_batch(void* h, const char* const* seq_ids, int64_t n,
+                         const int64_t* totals) {
+  return static_cast<BlockManager*>(h)->reserve_batch(seq_ids, n, totals);
+}
+int64_t bm_advance_batch(void* h, const char* const* seq_ids, int64_t n,
+                         int64_t steps) {
+  return static_cast<BlockManager*>(h)->advance_batch(seq_ids, n, steps);
+}
+void bm_admit_prefill(void* h, const int32_t* counts, int64_t n,
+                      int64_t max_seats, int64_t max_prefill_tokens,
+                      int32_t min_bucket, int64_t* picked_out,
+                      int64_t* bucket_out) {
+  static_cast<BlockManager*>(h)->admit_prefill(counts, n, max_seats,
+                                               max_prefill_tokens, min_bucket,
+                                               picked_out, bucket_out);
+}
+
 }  // extern "C"

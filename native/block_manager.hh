@@ -116,10 +116,7 @@ class BlockManager {
   int needs_new_block(const std::string& seq_id) const {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -1;
-    const SeqAlloc& a = it->second;
-    return a.num_tokens % block_size_ == 0 &&
-           a.num_tokens / block_size_ ==
-               static_cast<int64_t>(a.blocks.size());
+    return needs_new_block_alloc(it->second);
   }
 
   int can_append(const std::string& seq_id) const {
@@ -132,18 +129,7 @@ class BlockManager {
   int64_t append_slot(const std::string& seq_id) {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
-    SeqAlloc& a = it->second;
-    int64_t offset = a.num_tokens % block_size_;
-    if (a.num_tokens % block_size_ == 0 &&
-        a.num_tokens / block_size_ == static_cast<int64_t>(a.blocks.size())) {
-      if (num_free_blocks() == 0) return -1;
-      int32_t b = pop_free_block();
-      refcount_[b] = 1;
-      a.blocks.push_back(b);
-    }
-    int32_t block = a.blocks[a.num_tokens / block_size_];
-    ++a.num_tokens;
-    return static_cast<int64_t>(block) * block_size_ + offset;
+    return append_slot_alloc(it->second);
   }
 
   // Grow the block table to hold total_tokens slots without advancing the
@@ -151,16 +137,7 @@ class BlockManager {
   int64_t reserve(const std::string& seq_id, int64_t total_tokens) {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
-    SeqAlloc& a = it->second;
-    int64_t need = blocks_needed(total_tokens) -
-                   static_cast<int64_t>(a.blocks.size());
-    if (need > num_free_blocks()) return -1;
-    for (int64_t i = 0; i < need; ++i) {
-      int32_t b = pop_free_block();
-      refcount_[b] = 1;
-      a.blocks.push_back(b);
-    }
-    return 0;
+    return reserve_alloc(it->second, total_tokens);
   }
 
   // Commit n written tokens.  Returns 0, or -2 unknown seq, -3 beyond
@@ -168,12 +145,7 @@ class BlockManager {
   int64_t advance(const std::string& seq_id, int64_t n) {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
-    SeqAlloc& a = it->second;
-    if (a.num_tokens + n >
-        static_cast<int64_t>(a.blocks.size()) * block_size_)
-      return -3;
-    a.num_tokens += n;
-    return 0;
+    return advance_alloc(it->second, n);
   }
 
   int64_t slot_for_token(const std::string& seq_id, int64_t idx) const {
@@ -191,14 +163,7 @@ class BlockManager {
                       int64_t max_out) const {
     auto it = seqs_.find(seq_id);
     if (it == seqs_.end()) return -2;
-    int64_t n = static_cast<int64_t>(it->second.blocks.size());
-    for (int64_t i = 0; i < n && i < max_out; ++i) {
-      int32_t b = it->second.blocks[i];
-      // released entries report block 0 (valid id; those positions are
-      // masked/skipped by every attention impl) — mirrors the Python side
-      out[i] = b == kReleased ? 0 : b;
-    }
-    return n;
+    return block_table_alloc(it->second, out, max_out);
   }
 
   // Sliding-window rolling buffer: return blocks holding only positions
@@ -228,6 +193,133 @@ class BlockManager {
     return released;
   }
 
+  // ---- per-cycle batched ops (the host hot path) ---------------------
+  //
+  // The engine's decode cycle used to make 2-3 Python->native calls PER
+  // ROW (needs_new_block, append_slot, block_table); at production
+  // stream counts that per-request churn is the dominant host cost once
+  // the device loop is pipelined.  These batch the whole cycle's
+  // admission / charge / table fill into ONE boundary crossing each.
+
+  // Non-mutating capacity probe: blocks missing for one decode append
+  // across these rows (0 = the charge below will succeed).  The engine's
+  // preemption loop polls this until the pool fits.  -2 unknown seq.
+  int64_t decode_shortfall(const char* const* seq_ids, int64_t n) {
+    std::vector<SeqAlloc*> allocs;
+    if (!resolve(seq_ids, n, &allocs)) return -2;
+    int64_t need = 0;
+    for (SeqAlloc* a : allocs) need += needs_new_block_alloc(*a);
+    int64_t s = need - num_free_blocks();
+    return s > 0 ? s : 0;
+  }
+
+  // Decode charge: either the pool covers every row's potential fresh
+  // block (then append a slot for each row, writing flat slot ids into
+  // slots_out[i]) or NOTHING is mutated and the shortfall in blocks is
+  // returned (the engine preempts and retries).  Returns 0 on success,
+  // the positive shortfall on capacity miss, -1 on a mid-batch append
+  // OOM, -2 on an unknown sequence.  The no-mutation guarantee holds
+  // for DISTINCT seq ids (the engine's batches always are): a
+  // duplicated id can defeat the pre-count and hit the -1 path with
+  // earlier rows charged — exactly the partial state a per-request
+  // append_slot loop (the Python manager) leaves before raising.
+  int64_t charge_decode(const char* const* seq_ids, int64_t n,
+                        int32_t* slots_out) {
+    std::vector<SeqAlloc*> allocs;
+    if (!resolve(seq_ids, n, &allocs)) return -2;
+    int64_t need = 0;
+    for (SeqAlloc* a : allocs) need += needs_new_block_alloc(*a);
+    if (need > num_free_blocks()) return need - num_free_blocks();
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t s = append_slot_alloc(*allocs[static_cast<size_t>(i)]);
+      if (s == -1) return -1;  // duplicate-id OOM, see above
+      slots_out[i] = static_cast<int32_t>(s);
+    }
+    return 0;
+  }
+
+  // Write each sequence's block table into row i of a caller-owned
+  // (n, stride) int32 buffer (only the first len(blocks) entries of a
+  // row are touched; callers pass zeroed padding buffers).  Returns the
+  // longest table written, or -2 on an unknown sequence (rows already
+  // written stay written — the caller treats -2 as fatal).
+  int64_t fill_block_tables(const char* const* seq_ids, int64_t n,
+                            int32_t* out, int64_t stride) {
+    std::vector<SeqAlloc*> allocs;
+    if (!resolve(seq_ids, n, &allocs)) return -2;
+    int64_t longest = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t len = block_table_alloc(*allocs[static_cast<size_t>(i)],
+                                      out + i * stride, stride);
+      if (len > longest) longest = len;
+    }
+    return longest;
+  }
+
+  // Batched reserve (fused decode windows / spec drafts): reserve each
+  // sequence's table up to totals[i] slots.  On OOM returns -1 with
+  // earlier rows' reservations kept — the same semantics as the Python
+  // loop in Engine._try_reserve_window (over-reserved blocks stay
+  // attached and are used as the sequence grows).  -2 unknown seq.
+  int64_t reserve_batch(const char* const* seq_ids, int64_t n,
+                        const int64_t* totals) {
+    std::vector<SeqAlloc*> allocs;
+    if (!resolve(seq_ids, n, &allocs)) return -2;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t r = reserve_alloc(*allocs[static_cast<size_t>(i)], totals[i]);
+      if (r != 0) return r;
+    }
+    return 0;
+  }
+
+  // Batched advance (window flush commits S written tokens per row).
+  // 0 ok; -2 unknown; -3 beyond reserved capacity (nothing after the
+  // offending row is advanced).
+  int64_t advance_batch(const char* const* seq_ids, int64_t n,
+                        int64_t steps) {
+    std::vector<SeqAlloc*> allocs;
+    if (!resolve(seq_ids, n, &allocs)) return -2;
+    for (SeqAlloc* a : allocs) {
+      int64_t r = advance_alloc(*a, steps);
+      if (r != 0) return r;
+    }
+    return 0;
+  }
+
+  // Scheduler admission (one call per cycle): greedy head-of-queue pick
+  // over candidate prompt lengths with the scheduler's own arithmetic —
+  // shared power-of-2 length bucket, token-budget charge
+  // bucket*(picked+1), and a +1-block decode headroom charge per pick
+  // against the CURRENT free pool.  counts[] is the waiting queue's
+  // head segment (the caller truncates at the first chunk-route or
+  // over-seat candidate).  Writes the number of admissible requests and
+  // their shared padded bucket.
+  void admit_prefill(const int32_t* counts, int64_t n, int64_t max_seats,
+                     int64_t max_prefill_tokens, int32_t min_bucket,
+                     int64_t* picked_out, int64_t* bucket_out) {
+    int64_t picked = 0, bucket = 0, reserved = 0;
+    int64_t free = num_free_blocks();
+    for (int64_t i = 0; i < n && picked < max_seats; ++i) {
+      int64_t b = next_pow2(counts[i]);
+      if (b < min_bucket) b = min_bucket;
+      int64_t cand = bucket > b ? bucket : b;
+      if (cand * (picked + 1) > max_prefill_tokens && picked) break;
+      int64_t need = blocks_needed(counts[i]) + 1;
+      if (reserved + need > free) break;
+      ++picked;
+      reserved += need;
+      bucket = cand;
+    }
+    *picked_out = picked;
+    *bucket_out = bucket;
+  }
+
+  static int64_t next_pow2(int64_t n) {
+    int64_t p = 1;
+    while (p < n) p <<= 1;
+    return p;
+  }
+
   // cache_blocks=false drops the blocks' prefix hashes instead of parking
   // them in the cached pool — for sequences whose KV was never fully
   // written (e.g. a chunked prefill aborted mid-prompt).
@@ -242,6 +334,79 @@ class BlockManager {
   }
 
  private:
+  // ---- alloc-based twins of the per-seq ops ---------------------------
+  // The batched cycle ops resolve each sequence's SeqAlloc ONCE (with a
+  // reused key buffer) and then work through these, so "one boundary
+  // crossing per cycle" doesn't hide per-row std::string construction
+  // and repeated hash lookups inside the call.
+
+  int needs_new_block_alloc(const SeqAlloc& a) const {
+    return a.num_tokens % block_size_ == 0 &&
+           a.num_tokens / block_size_ ==
+               static_cast<int64_t>(a.blocks.size());
+  }
+
+  int64_t append_slot_alloc(SeqAlloc& a) {
+    int64_t offset = a.num_tokens % block_size_;
+    if (a.num_tokens % block_size_ == 0 &&
+        a.num_tokens / block_size_ == static_cast<int64_t>(a.blocks.size())) {
+      if (num_free_blocks() == 0) return -1;
+      int32_t b = pop_free_block();
+      refcount_[b] = 1;
+      a.blocks.push_back(b);
+    }
+    int32_t block = a.blocks[a.num_tokens / block_size_];
+    ++a.num_tokens;
+    return static_cast<int64_t>(block) * block_size_ + offset;
+  }
+
+  int64_t reserve_alloc(SeqAlloc& a, int64_t total_tokens) {
+    int64_t need = blocks_needed(total_tokens) -
+                   static_cast<int64_t>(a.blocks.size());
+    if (need > num_free_blocks()) return -1;
+    for (int64_t i = 0; i < need; ++i) {
+      int32_t b = pop_free_block();
+      refcount_[b] = 1;
+      a.blocks.push_back(b);
+    }
+    return 0;
+  }
+
+  int64_t advance_alloc(SeqAlloc& a, int64_t n) {
+    if (a.num_tokens + n >
+        static_cast<int64_t>(a.blocks.size()) * block_size_)
+      return -3;
+    a.num_tokens += n;
+    return 0;
+  }
+
+  int64_t block_table_alloc(const SeqAlloc& a, int32_t* out,
+                            int64_t max_out) const {
+    int64_t n = static_cast<int64_t>(a.blocks.size());
+    for (int64_t i = 0; i < n && i < max_out; ++i) {
+      int32_t b = a.blocks[i];
+      // released entries report block 0 (valid id; those positions are
+      // masked/skipped by every attention impl) — mirrors the Python side
+      out[i] = b == kReleased ? 0 : b;
+    }
+    return n;
+  }
+
+  // Resolve a batch of seq ids to their allocs with ONE reused key
+  // buffer; false when any id is unknown.
+  bool resolve(const char* const* seq_ids, int64_t n,
+               std::vector<SeqAlloc*>* out) {
+    out->resize(static_cast<size_t>(n));
+    std::string key;
+    for (int64_t i = 0; i < n; ++i) {
+      key.assign(seq_ids[i]);
+      auto it = seqs_.find(key);
+      if (it == seqs_.end()) return false;
+      (*out)[static_cast<size_t>(i)] = &it->second;
+    }
+    return true;
+  }
+
   void release_block(int32_t b, bool cache_blocks) {
     auto rc = refcount_.find(b);
     int32_t count = (rc == refcount_.end() ? 1 : rc->second) - 1;

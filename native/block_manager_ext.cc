@@ -250,6 +250,181 @@ PyObject* core_free(CoreObject* self, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ---- per-cycle batched ops ------------------------------------------------
+// One boundary crossing per engine cycle instead of 2-3 per row: the
+// seq-id list converts once, results land straight in caller-owned numpy
+// buffers via the buffer protocol (no per-row Python lists).
+
+bool seq_ids_from_list(PyObject* list, std::vector<const char*>* out) {
+  if (!PyList_Check(list)) {
+    PyErr_SetString(PyExc_TypeError, "expected a list of str seq ids");
+    return false;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  out->resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(list, i));
+    if (!s) return false;
+    (*out)[i] = s;
+  }
+  return true;
+}
+
+// Writable C-contiguous int32 buffer with at least min_items items
+// (numpy int32 arrays satisfy this); caller must PyBuffer_Release.
+bool i32_buffer(PyObject* obj, Py_buffer* view, Py_ssize_t min_items) {
+  if (PyObject_GetBuffer(obj, view,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE) != 0)
+    return false;
+  if (view->itemsize != 4 || view->len < min_items * 4) {
+    PyBuffer_Release(view);
+    PyErr_SetString(PyExc_TypeError,
+                    "expected a C-contiguous int32 buffer of sufficient "
+                    "size");
+    return false;
+  }
+  return true;
+}
+
+PyObject* core_decode_shortfall(CoreObject* self, PyObject* arg) {
+  std::vector<const char*> ids;
+  if (!seq_ids_from_list(arg, &ids)) return nullptr;
+  int64_t r = self->bm->decode_shortfall(ids.data(),
+                                         static_cast<int64_t>(ids.size()));
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, "unknown sequence in decode_shortfall");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(r);
+}
+
+PyObject* core_charge_decode(CoreObject* self, PyObject* args) {
+  PyObject* ids_list;
+  PyObject* slots_obj;
+  if (!PyArg_ParseTuple(args, "OO", &ids_list, &slots_obj)) return nullptr;
+  std::vector<const char*> ids;
+  if (!seq_ids_from_list(ids_list, &ids)) return nullptr;
+  Py_buffer view;
+  if (!i32_buffer(slots_obj, &view,
+                  static_cast<Py_ssize_t>(ids.size())))
+    return nullptr;
+  int64_t r = self->bm->charge_decode(
+      ids.data(), static_cast<int64_t>(ids.size()),
+      static_cast<int32_t*>(view.buf));
+  PyBuffer_Release(&view);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, "unknown sequence in charge_decode");
+    return nullptr;
+  }
+  if (r == -1) {
+    // duplicate-id batch defeated the pre-count: same MemoryError the
+    // Python manager's append_slot raises mid-batch
+    PyErr_SetString(PyExc_MemoryError, "out of KV blocks on append");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(r);
+}
+
+PyObject* core_fill_block_tables(CoreObject* self, PyObject* args) {
+  PyObject* ids_list;
+  PyObject* tables_obj;
+  if (!PyArg_ParseTuple(args, "OO", &ids_list, &tables_obj)) return nullptr;
+  std::vector<const char*> ids;
+  if (!seq_ids_from_list(ids_list, &ids)) return nullptr;
+  Py_buffer view;
+  if (PyObject_GetBuffer(tables_obj, &view,
+                         PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE
+                         | PyBUF_STRIDES) != 0)
+    return nullptr;
+  if (view.itemsize != 4 || view.ndim != 2
+      || view.shape[0] < static_cast<Py_ssize_t>(ids.size())) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_TypeError,
+                    "expected a 2-D C-contiguous int32 buffer with a row "
+                    "per sequence");
+    return nullptr;
+  }
+  int64_t stride = static_cast<int64_t>(view.shape[1]);
+  int64_t r = self->bm->fill_block_tables(
+      ids.data(), static_cast<int64_t>(ids.size()),
+      static_cast<int32_t*>(view.buf), stride);
+  PyBuffer_Release(&view);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, "unknown sequence in fill_block_tables");
+    return nullptr;
+  }
+  if (r > stride) {
+    PyErr_SetString(PyExc_ValueError,
+                    "block table longer than the buffer row");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(r);
+}
+
+PyObject* core_reserve_batch(CoreObject* self, PyObject* args) {
+  PyObject* ids_list;
+  PyObject* totals_list;
+  if (!PyArg_ParseTuple(args, "OO", &ids_list, &totals_list)) return nullptr;
+  std::vector<const char*> ids;
+  if (!seq_ids_from_list(ids_list, &ids)) return nullptr;
+  if (!PyList_Check(totals_list)
+      || PyList_GET_SIZE(totals_list)
+         != static_cast<Py_ssize_t>(ids.size())) {
+    PyErr_SetString(PyExc_TypeError, "totals must be a list matching "
+                                     "seq_ids");
+    return nullptr;
+  }
+  std::vector<int64_t> totals(ids.size());
+  for (Py_ssize_t i = 0; i < static_cast<Py_ssize_t>(ids.size()); ++i) {
+    long long v = PyLong_AsLongLong(PyList_GET_ITEM(totals_list, i));
+    if (v == -1 && PyErr_Occurred()) return nullptr;
+    totals[static_cast<size_t>(i)] = v;
+  }
+  int64_t r = self->bm->reserve_batch(
+      ids.data(), static_cast<int64_t>(ids.size()), totals.data());
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, "unknown sequence in reserve_batch");
+    return nullptr;
+  }
+  return PyBool_FromLong(r == 0);
+}
+
+PyObject* core_advance_batch(CoreObject* self, PyObject* args) {
+  PyObject* ids_list;
+  long long steps;
+  if (!PyArg_ParseTuple(args, "OL", &ids_list, &steps)) return nullptr;
+  std::vector<const char*> ids;
+  if (!seq_ids_from_list(ids_list, &ids)) return nullptr;
+  int64_t r = self->bm->advance_batch(
+      ids.data(), static_cast<int64_t>(ids.size()), steps);
+  if (r == -2) {
+    PyErr_SetString(PyExc_KeyError, "unknown sequence in advance_batch");
+    return nullptr;
+  }
+  if (r == -3) {
+    PyErr_SetString(PyExc_ValueError, "advance beyond reserved capacity");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* core_admit_prefill(CoreObject* self, PyObject* args) {
+  PyObject* counts_list;
+  long long max_seats, max_prefill_tokens;
+  int min_bucket;
+  if (!PyArg_ParseTuple(args, "OLLi", &counts_list, &max_seats,
+                        &max_prefill_tokens, &min_bucket))
+    return nullptr;
+  std::vector<int32_t> counts;
+  if (!tokens_from_list(counts_list, &counts)) return nullptr;
+  int64_t picked = 0, bucket = 0;
+  self->bm->admit_prefill(counts.data(),
+                          static_cast<int64_t>(counts.size()), max_seats,
+                          max_prefill_tokens, min_bucket, &picked, &bucket);
+  return Py_BuildValue("LL", static_cast<long long>(picked),
+                       static_cast<long long>(bucket));
+}
+
 PyObject* core_release_out_of_window(CoreObject* self, PyObject* args) {
   const char* seq_id;
   long long first_needed;
@@ -279,6 +454,13 @@ PyMethodDef core_methods[] = {
     {"slot_for_token", (PyCFunction)core_slot_for_token, METH_VARARGS, ""},
     {"block_table", (PyCFunction)core_block_table, METH_O, ""},
     {"free", (PyCFunction)core_free, METH_VARARGS, ""},
+    {"decode_shortfall", (PyCFunction)core_decode_shortfall, METH_O, ""},
+    {"charge_decode", (PyCFunction)core_charge_decode, METH_VARARGS, ""},
+    {"fill_block_tables", (PyCFunction)core_fill_block_tables, METH_VARARGS,
+     ""},
+    {"reserve_batch", (PyCFunction)core_reserve_batch, METH_VARARGS, ""},
+    {"advance_batch", (PyCFunction)core_advance_batch, METH_VARARGS, ""},
+    {"admit_prefill", (PyCFunction)core_admit_prefill, METH_VARARGS, ""},
     {"release_out_of_window", (PyCFunction)core_release_out_of_window,
      METH_VARARGS, ""},
     {nullptr, nullptr, 0, nullptr},

@@ -179,6 +179,121 @@ def test_randomized_differential():
         assert py.block_table(sid) == cc.block_table(sid)
 
 
+def test_randomized_batched_op_trace():
+    """Randomized op-trace property test over the PER-CYCLE batched
+    boundary (admission / decode charge / table fill / window reserve+
+    advance / free): the native and Python managers must produce
+    identical allocation state after every op — slots, tables, shortfalls,
+    admission picks, free counts."""
+    import numpy as np
+    rng = random.Random(7)
+    py, cc = make_pair(num_blocks=64, block_size=4)
+    live: list[str] = []
+    next_id = 0
+    for step in range(600):
+        op = rng.random()
+        if op < 0.25:
+            # admission arithmetic over a synthetic waiting-head segment
+            counts = [rng.randrange(1, 40)
+                      for _ in range(rng.randrange(1, 9))]
+            seats = rng.randrange(1, 9)
+            budget = rng.choice([64, 256, 8192])
+            got_py = py.admit_prefill(counts, seats, budget, 8)
+            got_cc = cc.admit_prefill(counts, seats, budget, 8)
+            assert got_py == got_cc, (step, counts, seats, budget)
+            # actually allocate the picked prompts so state diverges if
+            # the admission decision ever would
+            for _ in range(got_py[0]):
+                n = counts.pop(0)
+                sid = f"s{next_id}"; next_id += 1
+                toks = [rng.randrange(16) for _ in range(n)]
+                sh_py, _ = py.lookup_prefix(toks)
+                sh_cc, _ = cc.lookup_prefix(toks)
+                assert sh_py == sh_cc
+                try:
+                    a_py = py.allocate(sid, toks, shared_blocks=sh_py)
+                    a_cc = cc.allocate(sid, toks, shared_blocks=sh_cc)
+                    assert a_py.blocks == a_cc.blocks
+                    live.append(sid)
+                except MemoryError:
+                    with pytest.raises(MemoryError):
+                        cc.allocate(sid, toks, shared_blocks=sh_cc)
+                    break
+        elif op < 0.55 and live:
+            # one decode cycle over a random row subset
+            rows = rng.sample(live, rng.randrange(1, len(live) + 1))
+            assert py.decode_shortfall(rows) == cc.decode_shortfall(rows)
+            s_py = np.full((len(rows),), -7, np.int32)
+            s_cc = np.full((len(rows),), -7, np.int32)
+            r_py = py.charge_decode(rows, s_py)
+            r_cc = cc.charge_decode(rows, s_cc)
+            assert r_py == r_cc, step
+            assert s_py.tolist() == s_cc.tolist(), step
+            t_py = np.zeros((len(rows), 24), np.int32)
+            t_cc = np.zeros((len(rows), 24), np.int32)
+            assert py.fill_block_tables(rows, t_py) == \
+                cc.fill_block_tables(rows, t_cc)
+            assert t_py.tolist() == t_cc.tolist(), step
+        elif op < 0.7 and live:
+            # fused-window reserve + advance
+            rows = rng.sample(live, rng.randrange(1, len(live) + 1))
+            window = rng.randrange(1, 9)
+            totals = []
+            for sid in rows:
+                nt = py._seqs[sid].num_tokens
+                totals.append(nt + window)
+            ok_py = py.reserve_batch(rows, totals)
+            ok_cc = cc.reserve_batch(rows, totals)
+            assert ok_py == ok_cc, step
+            if ok_py:
+                py.advance_batch(rows, window)
+                cc.advance_batch(rows, window)
+        elif live:
+            sid = live.pop(rng.randrange(len(live)))
+            cache = rng.random() < 0.7
+            py.free(sid, cache_blocks=cache)
+            cc.free(sid, cache_blocks=cache)
+        assert py.num_free_blocks == cc.num_free_blocks, step
+        assert py.num_seqs() == cc.num_seqs(), step
+    for sid in live:
+        assert py.block_table(sid) == cc.block_table(sid)
+    # the Python manager's own invariants held throughout
+    py.check_integrity(expected_seq_ids=live)
+
+
+def test_charge_decode_shortfall_is_non_mutating():
+    py, cc = make_pair(num_blocks=4, block_size=2, prefix=False)
+    import numpy as np
+    for bm in (py, cc):
+        bm.allocate("a", [1, 2, 3, 4])           # 2 blocks
+        bm.allocate("b", [5, 6, 7, 8])           # 2 blocks -> pool empty
+        # both rows at a block boundary, nothing free: shortfall, and NO
+        # slot may have been appended
+        slots = np.full((2,), -7, np.int32)
+        short = bm.charge_decode(["a", "b"], slots)
+        assert short == bm.decode_shortfall(["a", "b"]) == 2
+        assert slots.tolist() == [-7, -7]
+        assert bm.block_table("a") == bm.block_table("a")  # still intact
+        bm.free("b")
+        assert bm.charge_decode(["a"], slots[:1]) == 0
+        assert slots[0] >= 0
+
+
+def test_batched_unknown_seq_raises():
+    import numpy as np
+    _, cc = make_pair()
+    with pytest.raises(KeyError):
+        cc.decode_shortfall(["ghost"])
+    with pytest.raises(KeyError):
+        cc.charge_decode(["ghost"], np.zeros((1,), np.int32))
+    with pytest.raises(KeyError):
+        cc.fill_block_tables(["ghost"], np.zeros((1, 4), np.int32))
+    with pytest.raises(KeyError):
+        cc.reserve_batch(["ghost"], [4])
+    with pytest.raises(KeyError):
+        cc.advance_batch(["ghost"], 1)
+
+
 def test_factory_selects_native():
     bm = create_block_manager(8, 4, impl="native")
     assert isinstance(bm, NativeBlockManager)

@@ -25,3 +25,38 @@ def test_profile_smoke_emits_attribution_row():
     # XLA cost analysis present on the CPU backend too
     assert row.get("xla_bytes_accessed_per_window", 0) > 0
     assert "residual_ms" in row
+
+
+def test_profile_host_soak_emits_phase_breakdown():
+    """--streams N --json: the per-phase host-time breakdown (schedule /
+    block-accounting / dispatch / detokenize / flush) — the diffable
+    before/after artifact behind BENCHMARKS.md "Host overhead"."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "profile_step.py"),
+         "--streams", "8", "--gen-len", "24", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""})
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "host_phase_breakdown"
+    assert row["streams"] == 8
+    assert row["cycles"] > 0
+    assert row["multi_step"] > 1          # the soak exercises fused windows
+    for phase in ("schedule", "block", "dispatch", "detokenize", "flush"):
+        assert phase in row["phases"], row["phases"].keys()
+    assert row["host_ms_per_cycle"] >= 0
+    assert isinstance(row["host_batched"], bool)
+
+
+def test_profile_host_soak_legacy_env_is_recorded():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "profile_step.py"),
+         "--streams", "4", "--gen-len", "16", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+             "TPUSERVE_HOST_BATCHED": "0",
+             "TPUSERVE_BLOCK_MANAGER": "python"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["host_batched"] is False
+    assert row["block_manager"] == "BlockManager"

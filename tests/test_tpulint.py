@@ -236,6 +236,70 @@ def test_p2_transitive_reachability_and_setattr():
     assert "cross-thread-mutation" in got
 
 
+def test_p2_native_boundary_call_flagged():
+    """A foreign thread reaching THROUGH the native handle (``._core``)
+    on loop-owned state is a finding even when the method name is
+    unknown to the mutator heuristics — ownership transfer across the
+    ctypes boundary must be annotated, never silently exempt."""
+    findings = lint_snippet("""
+        import threading
+
+        class Runner:
+            def __init__(self, engine):
+                self.engine = engine
+                threading.Thread(target=self._health_loop).start()
+
+            def _health_loop(self):
+                # not in _MUTATOR_HINTS, still crosses the boundary
+                self.engine.block_manager._core.lookup_prefix([1, 2])
+                self.engine.block_manager._core.charge_decode(["a"], None)
+    """, passes=["thread-ownership"])
+    assert rules(findings).count("native-boundary-call") == 2
+
+
+def test_p2_native_boundary_thread_ok_and_loop_root_clean():
+    # annotated boundary crossing passes; loop-root crossings are free
+    findings = lint_snippet("""
+        import threading
+
+        class Runner:
+            def __init__(self, engine):
+                self.engine = engine
+                threading.Thread(target=self._wd).start()
+                threading.Thread(target=self._loop).start()
+
+            def _wd(self):
+                # tpulint: thread-ok(fixture: engine loop parked, lock held)
+                self.engine.block_manager._core.num_free_blocks()
+
+            def _loop(self):
+                self.engine.block_manager._core.charge_decode(["a"], None)
+    """, passes=["thread-ownership"],
+        path="tpuserve/server/runner.py",
+        extra={"thread_ownership": {
+            **DEFAULT_CONFIG["thread_ownership"],
+            "loop_roots": ["tpuserve/server/runner.py::Runner._loop"]}})
+    assert findings == []
+
+
+def test_p2_batched_block_ops_are_mutator_hints():
+    # the per-cycle batched ops mutate a whole cycle's allocation state
+    # in one call: flagged as cross-thread mutations WITHOUT the native
+    # handle in the chain (e.g. through the pure-Python manager)
+    findings = lint_snippet("""
+        import threading
+
+        class Runner:
+            def __init__(self, engine):
+                self.engine = engine
+                threading.Thread(target=self._wd).start()
+
+            def _wd(self):
+                self.engine.block_manager.advance_batch(["a"], 4)
+    """, passes=["thread-ownership"])
+    assert rules(findings) == ["cross-thread-mutation"]
+
+
 def test_p2_thread_ok_suppression():
     findings = lint_snippet("""
         import threading

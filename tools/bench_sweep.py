@@ -53,6 +53,14 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("flash-q64", [], {"TPUSERVE_FLASH_BLK_Q": "64"}),
     ("flash-k256", [], {"TPUSERVE_FLASH_BLK_K": "256"}),
     ("multistep64", ["--multi-step", "64"], {}),
+    # Host-overhead scaling (ROADMAP open item 3): decode tok/s + pure-host
+    # ms/cycle (schedule + block accounting + detokenize) at growing
+    # concurrent-stream counts; the legacy row re-measures with the
+    # batched host path and the native block manager disabled — the A/B
+    # behind BENCHMARKS.md "Host overhead".
+    ("host-overhead", ["--clients-sweep", "16,64,256"], {}),
+    ("host-overhead-legacy", ["--clients-sweep", "16,64,256"],
+     {"TPUSERVE_HOST_BATCHED": "0", "TPUSERVE_BLOCK_MANAGER": "python"}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -100,22 +100,104 @@ def host_rtt(repeats: int = 5) -> float:
     return sorted(times)[len(times) // 2]
 
 
+def host_soak(args):
+    """Host hot-path soak (``--streams N --json``): run N concurrent
+    streams through one engine with the host phase profiler armed
+    (tpuserve/runtime/hostprof.py) and report ms-per-cycle per phase —
+    schedule / block-accounting / dispatch / detokenize / flush.  The
+    per-phase numbers are machine-readable and diffable across commits;
+    ``TPUSERVE_HOST_BATCHED=0`` (plus ``TPUSERVE_BLOCK_MANAGER=python``)
+    measures the pre-batching host path for the A/B recorded in
+    BENCHMARKS.md "Host overhead"."""
+    import jax
+    import numpy as np
+
+    from bench import _build_engine, _warm
+    from tpuserve.runtime.hostprof import PROF
+    from tpuserve.runtime.request import SamplingParams
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, plen = args.model, args.prompt_len or 128
+        attn, gen = "auto", args.gen_len or 128
+    else:
+        # CPU soak shapes: tiny model, short prompts — the DEVICE work is
+        # irrelevant here, the host bookkeeping per cycle is the measurand
+        model, plen = "tiny-qwen3", args.prompt_len or 16
+        attn, gen = "reference", args.gen_len or 48
+    streams = args.streams
+    # fused windows on by default even on CPU (the host win is per-window
+    # batching; S=1 would measure the single-step path instead)
+    ms = args.multi_step if args.multi_step is not None else (None if on_tpu
+                                                              else 8)
+    eng = _build_engine(model, streams, plen, gen, attn_impl=attn,
+                        multi_step=ms, quantization=args.quant,
+                        kv_quant=args.kv_quant)
+    _warm(eng, streams, plen)
+    rng = np.random.default_rng(0)
+    vocab = eng.model_cfg.vocab_size
+    params = SamplingParams(max_tokens=gen, temperature=0.0,
+                            ignore_eos=True)
+    prompts = [rng.integers(1, vocab - 1, size=plen).tolist()
+               for _ in range(streams)]
+    PROF.reset()
+    PROF.enabled = True
+    t0 = time.perf_counter()
+    try:
+        for p in prompts:
+            eng.add_request(prompt_token_ids=p, params=params)
+        while eng.has_work():
+            eng.step()
+    finally:
+        PROF.enabled = False
+    wall = time.perf_counter() - t0
+    rep = PROF.report()
+    out = {
+        "metric": "host_phase_breakdown",
+        "backend": jax.default_backend(),
+        "model": eng.model_cfg.name,
+        "streams": streams,
+        "prompt_len": plen,
+        "gen_len": gen,
+        "multi_step": eng._multi_step,
+        "block_manager": type(eng.block_manager).__name__,
+        "host_batched": eng._host_batched,
+        "wall_s": round(wall, 3),
+        "gen_tok_s": round(streams * gen / wall, 1),
+        **rep,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="qwen3-0.6b")
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--prompt-len", type=int, default=None)
+    ap.add_argument("--gen-len", type=int, default=None)
     ap.add_argument("--quant", default=None, choices=["int8"])
     ap.add_argument("--kv-quant", default=None, choices=["int8"])
     ap.add_argument("--multi-step", type=int, default=None)
     ap.add_argument("--windows", type=int, default=12,
                     help="timed decode windows (median reported)")
+    ap.add_argument("--streams", type=int, default=None, metavar="N",
+                    help="host hot-path soak: run N concurrent streams "
+                         "with the host phase profiler armed and report "
+                         "per-phase host ms/cycle (use with --json)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable per-phase host-time breakdown "
+                         "(one JSON line; implied output format of "
+                         "--streams)")
     ap.add_argument("--trace-dir", default=None,
                     help="also capture a jax.profiler trace of the timed "
                          "windows into this directory")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU shapes (harness tests)")
     args = ap.parse_args(argv)
+
+    if args.streams:
+        return host_soak(args)
 
     import jax
     import numpy as np
@@ -160,11 +242,17 @@ def main(argv=None):
             walls.append(time.perf_counter() - t0)
         return walls
 
+    from tpuserve.runtime.hostprof import PROF
+    if args.json:
+        # per-phase host breakdown alongside the attribution numbers
+        PROF.reset()
+        PROF.enabled = True
     if args.trace_dir:
         with jax.profiler.trace(args.trace_dir):
             walls = timed_windows()
     else:
         walls = timed_windows()
+    PROF.enabled = False
     for r in list(eng.requests):
         eng.abort_request(r)
 
@@ -207,6 +295,8 @@ def main(argv=None):
     if cost.get("flops"):
         out["xla_flops_per_window"] = cost["flops"]
         out["achieved_tflops"] = round(cost["flops"] / wall / 1e12, 2)
+    if args.json:
+        out["host_phases"] = PROF.report()
     print(json.dumps(out))
     return out
 

@@ -71,6 +71,13 @@ PRIORITY = [
     # carries both engines), then mixed mode under the headline shape
     # and under sustained Poisson admission.
     "compare-mixed", "mixed", "mixed-poisson16",
+    # Host-overhead scaling on silicon (NEW this round; the CPU A/B in
+    # BENCHMARKS.md "Host overhead" measured 2.3x less pure-host
+    # ms/cycle at 256 streams with the native+batched host path): on TPU
+    # the device window is ~13 ms at S=32, so host ms/cycle is the
+    # headroom number that says how many concurrent streams one host can
+    # feed before the Python loop caps the chip.
+    "host-overhead", "host-overhead-legacy",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

@@ -72,6 +72,8 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/engine.py::Engine._draft_propose",
             "tpuserve/runtime/engine.py::Engine._append_and_emit",
             "tpuserve/runtime/engine.py::Engine._emit_one",
+            "tpuserve/runtime/engine.py::Engine._emit_window_row",
+            "tpuserve/runtime/engine.py::Engine._bm_*",
             "tpuserve/runtime/engine.py::Engine._record_logprobs",
         ],
     },
@@ -89,6 +91,10 @@ DEFAULT_CONFIG: dict = {
         # methods on owned state that are safe from any thread
         "safe_methods": ["release_hangs", "get", "items", "keys", "values",
                          "empty", "qsize"],
+        # native-handle attributes: calls through these cross the ctypes/
+        # C-extension boundary and must be thread-ok-annotated from any
+        # foreign thread (the C++ core races concurrent access)
+        "native_attrs": ["_core"],
     },
     "kv_leak": {
         # substrings identifying a block-manager receiver

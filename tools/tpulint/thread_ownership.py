@@ -19,7 +19,14 @@ foreign root via ``self.<m>()`` calls is scanned for:
   (``self.engine...`` plus the per-class ``owned_attrs`` config);
 - ``cross-thread-setattr``: any ``setattr(...)`` call (dynamic attribute
   writes defeat the static ownership analysis, so they must each justify
-  themselves with ``# tpulint: thread-ok(reason)``).
+  themselves with ``# tpulint: thread-ok(reason)``);
+- ``native-boundary-call``: ANY call that reaches through a native
+  handle attribute (``native_attrs`` config, default ``_core`` — the
+  C++ block manager) on loop-owned state.  The mutation analysis cannot
+  see inside the extension, and the C++ core is not thread-safe even
+  for reads (its hash maps race concurrent writers), so ownership
+  transfer across the ctypes/C-extension boundary must be ANNOTATED
+  (``thread-ok``), never silently exempt.
 
 Deliberate, guarded cross-thread touches (a lock, a loop-side-only flag)
 carry ``# tpulint: thread-ok(reason)`` — the lint turns "reviewer
@@ -42,6 +49,10 @@ _MUTATOR_HINTS = {
     "abort_request", "add_request", "step", "adopt_prefilled",
     "salvage_requeue", "free", "allocate", "reserve", "advance",
     "set_admission_filter", "mark_running", "preempt_last", "finish",
+    # per-cycle batched block-manager ops (native boundary): each mutates
+    # the allocation state behind ONE call, so a foreign-thread caller
+    # corrupts a whole cycle's bookkeeping at once
+    "charge_decode", "fill_block_tables", "reserve_batch", "advance_batch",
 }
 
 
@@ -105,6 +116,7 @@ def run(files: dict, config: Config, repo_root: str) -> list:
     loop_roots = sec.get("loop_roots", [])
     owned_cfg = sec.get("owned_attrs", {})
     safe = set(sec.get("safe_methods", []))
+    native_attrs = list(sec.get("native_attrs", ["_core"]))
     for rel, (_src, tree) in files.items():
         for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
             targets = _thread_targets(cls)
@@ -129,11 +141,12 @@ def run(files: dict, config: Config, repo_root: str) -> list:
                 frontier += list(_self_calls(methods[m]))
             for m in sorted(reach):
                 _scan_method(rel, cls.name, m, methods[m], owned, safe,
-                             findings)
+                             native_attrs, findings)
     return findings
 
 
-def _scan_method(rel, cls_name, mname, fn, owned, safe, findings):
+def _scan_method(rel, cls_name, mname, fn, owned, safe, native_attrs,
+                 findings):
     qual = f"{cls_name}.{mname}"
     for node in ast.walk(fn):
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
@@ -165,7 +178,23 @@ def _scan_method(rel, cls_name, mname, fn, owned, safe, findings):
             elif isinstance(node.func, ast.Attribute):
                 attr = _owned_root(node.func.value, owned)
                 meth = node.func.attr
-                if attr and meth not in safe and (
+                chain = dotted(node.func)
+                if attr and any(f".{na}." in chain
+                                or chain.endswith(f".{na}")
+                                for na in native_attrs):
+                    findings.append(Finding(
+                        file=rel, line=node.lineno,
+                        rule="native-boundary-call",
+                        message=f"{qual} runs on a non-engine-loop thread "
+                                f"but calls '{chain}()' THROUGH the native "
+                                "boundary on loop-owned state — the C++ "
+                                "core races concurrent access (reads "
+                                "included); ownership transfer across the "
+                                "ctypes boundary must be annotated with "
+                                "# tpulint: thread-ok(reason), never "
+                                "silently exempt",
+                        pass_name=NAME))
+                elif attr and meth not in safe and (
                         meth in _MUTATOR_HINTS or meth.startswith("set_")):
                     findings.append(Finding(
                         file=rel, line=node.lineno,

@@ -171,3 +171,29 @@ class NativeBlockManager:
 
     def num_seqs(self) -> int:
         return self._core.num_seqs()
+
+    # ---- per-cycle batched ops (ONE boundary crossing per engine cycle;
+    # results land in caller-owned numpy buffers via the buffer protocol)
+
+    def decode_shortfall(self, seq_ids) -> int:
+        return self._core.decode_shortfall(list(seq_ids))
+
+    def charge_decode(self, seq_ids, slots_out) -> int:
+        return self._core.charge_decode(list(seq_ids), slots_out)
+
+    def fill_block_tables(self, seq_ids, out) -> int:
+        return self._core.fill_block_tables(list(seq_ids), out)
+
+    def reserve_batch(self, seq_ids, totals) -> bool:
+        return self._core.reserve_batch(list(seq_ids),
+                                        [int(t) for t in totals])
+
+    def advance_batch(self, seq_ids, steps: int) -> None:
+        self._core.advance_batch(list(seq_ids), steps)
+
+    def admit_prefill(self, counts, max_seats: int,
+                      max_prefill_tokens: int,
+                      min_bucket: int) -> tuple[int, int]:
+        return self._core.admit_prefill([int(c) for c in counts],
+                                        max_seats, max_prefill_tokens,
+                                        min_bucket)

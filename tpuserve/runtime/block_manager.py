@@ -20,7 +20,7 @@ import dataclasses
 from collections import OrderedDict
 from typing import Optional
 
-from tpuserve.utils import cdiv
+from tpuserve.utils import cdiv, next_power_of_2
 
 
 # Sentinel in a sequence's block table for a leading block returned to the
@@ -284,6 +284,85 @@ class BlockManager:
 
     def seq_ids(self) -> set:
         return set(self._seqs)
+
+    # ---- per-cycle batched ops ------------------------------------------
+    # One call per engine cycle instead of 2-3 per request — the Python
+    # reference for the native batched boundary (block_manager.hh carries
+    # the C++ twins; tests/test_native.py drives both with identical op
+    # traces).  The engine calls ONLY these on its decode hot path, so
+    # impl="python" and impl="native" share one code shape.
+
+    def decode_shortfall(self, seq_ids) -> int:
+        """Non-mutating capacity probe: blocks missing for one decode
+        append across these rows (0 = charge_decode will succeed); the
+        engine preempts while this is positive."""
+        need = sum(self.needs_new_block(s) for s in seq_ids)
+        return max(need - self.num_free_blocks, 0)
+
+    def charge_decode(self, seq_ids, slots_out) -> int:
+        """Charge one decode append per sequence: either every row fits
+        (slots written into ``slots_out[i]``, returns 0) or NOTHING is
+        mutated and the block shortfall is returned — the engine preempts
+        and retries."""
+        need = sum(self.needs_new_block(s) for s in seq_ids)
+        short = need - self.num_free_blocks
+        if short > 0:
+            return short
+        for i, s in enumerate(seq_ids):
+            slots_out[i] = self.append_slot(s)
+        return 0
+
+    def fill_block_tables(self, seq_ids, out) -> int:
+        """Write each sequence's block table into row i of ``out`` (a
+        zeroed (n, max_blocks_per_seq) int32 array); returns the longest
+        table written."""
+        longest = 0
+        for i, s in enumerate(seq_ids):
+            bt = self.block_table(s)
+            out[i, :len(bt)] = bt
+            if len(bt) > longest:
+                longest = len(bt)
+        return longest
+
+    def reserve_batch(self, seq_ids, totals) -> bool:
+        """Reserve each sequence up to ``totals[i]`` slots; False on OOM
+        with earlier reservations KEPT (Engine._try_reserve_window
+        semantics: over-reserved blocks stay attached and get used as the
+        sequence grows)."""
+        try:
+            for s, t in zip(seq_ids, totals):
+                self.reserve(s, t)
+        except MemoryError:
+            return False
+        return True
+
+    def advance_batch(self, seq_ids, steps: int) -> None:
+        for s in seq_ids:
+            self.advance(s, steps)
+
+    def admit_prefill(self, counts, max_seats: int,
+                      max_prefill_tokens: int,
+                      min_bucket: int) -> tuple[int, int]:
+        """Scheduler admission arithmetic over the waiting queue's head
+        segment (prompt token counts): greedy pick sharing one power-of-2
+        length bucket, charging bucket*(picked+1) against the token
+        budget and blocks_needed+1 decode headroom against the free pool.
+        Returns (picked, bucket)."""
+        picked = bucket = reserved = 0
+        free = self.num_free_blocks
+        for c in counts:
+            if picked >= max_seats:
+                break
+            cand = max(bucket, max(next_power_of_2(c), min_bucket))
+            if cand * (picked + 1) > max_prefill_tokens and picked:
+                break
+            need = self.blocks_needed(c) + 1
+            if reserved + need > free:
+                break
+            picked += 1
+            reserved += need
+            bucket = cand
+        return picked, bucket
 
     def check_integrity(self, expected_seq_ids=None) -> None:
         """Debug strict mode (``TPUSERVE_STRICT_BLOCKS``): verify the

@@ -33,11 +33,12 @@ from tpuserve.models.weights import load_or_init
 from tpuserve.ops import sampling as sampling_ops
 from tpuserve.ops.attention import PAD_SLOT
 from tpuserve.runtime.block_manager import BlockManager, create_block_manager
+from tpuserve.runtime.hostprof import PROF
 from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
 from tpuserve.runtime.request import (
     FinishReason, Request, RequestOutput, RequestState, SamplingParams, check_stop)
 from tpuserve.runtime.scheduler import ScheduledBatch, Scheduler, SchedulerConfig
-from tpuserve.utils import hard_sync, next_power_of_2
+from tpuserve.utils import env_flag, hard_sync, next_power_of_2
 
 logger = logging.getLogger("tpuserve.engine")
 
@@ -461,6 +462,14 @@ class Engine:
         # any recovery path that leaks or double-frees KV blocks fails
         # the cycle it happens, not a soak later.
         self._strict_blocks = bool(_os.environ.get("TPUSERVE_STRICT_BLOCKS"))
+        # Host hot-path batching (TPUSERVE_HOST_BATCHED=0 restores the
+        # pre-batching per-request/per-token path — the A/B lever behind
+        # the host-overhead numbers in BENCHMARKS.md): ON, each decode
+        # cycle makes ONE block-manager crossing per operation kind
+        # (shortfall probe / slot charge / table fill / window advance)
+        # instead of 2-3 per row, and fused-window flushes detokenize +
+        # emit once per row per window instead of once per token.
+        self._host_batched = env_flag("TPUSERVE_HOST_BATCHED")
         self._dispatch_rids: tuple = ()
         # device outputs of warmup-only executables (samplers, token
         # select) whose producer chains the end-of-warmup sync must drain
@@ -969,7 +978,9 @@ class Engine:
 
     def _step_inner(self) -> list[RequestOutput]:
         self._dispatch_rids = ()
-        batch = self.scheduler.schedule()
+        PROF.bump_cycle()
+        with PROF.phase("schedule"):
+            batch = self.scheduler.schedule()
         if batch is None:
             # nothing schedulable but a decode result may still be in flight
             return self._flush_pending() + self._flush_window()
@@ -1075,13 +1086,70 @@ class Engine:
         cap = self.cache_cfg.max_blocks_per_seq * self.cache_cfg.block_size
         if any(r.num_tokens - 1 + window > cap for r in reqs):
             return False
-        try:
+        with PROF.phase("block"):
+            if self._host_batched:
+                return self.block_manager.reserve_batch(
+                    [r.request_id for r in reqs],
+                    [r.num_tokens - 1 + window for r in reqs])
+            try:
+                for r in reqs:
+                    self.block_manager.reserve(r.request_id,
+                                               r.num_tokens - 1 + window)
+            except MemoryError:
+                return False
+            return True
+
+    # ---- batched block-manager boundary -------------------------------
+    # ONE manager crossing per operation kind per cycle (the native
+    # manager makes each a single C++ call; the Python manager loops
+    # internally) — TPUSERVE_HOST_BATCHED=0 keeps the historical
+    # per-request call pattern for A/B measurement (bench.py
+    # --clients-sweep, BENCHMARKS.md "Host overhead").
+
+    def _bm_decode_shortfall(self, reqs: list[Request]) -> int:
+        with PROF.phase("block"):
+            if self._host_batched:
+                return self.block_manager.decode_shortfall(
+                    [r.request_id for r in reqs])
+            bm = self.block_manager
+            need = sum(bm.needs_new_block(r.request_id) for r in reqs)
+            return max(need - bm.num_free_blocks, 0)
+
+    def _bm_charge_decode(self, reqs: list[Request],
+                          slots_out: np.ndarray) -> None:
+        """Append one KV slot per row into ``slots_out[:len(reqs)]``.
+        Capacity was already established by the shortfall probe; a miss
+        here raises MemoryError like the historical append_slot loop."""
+        with PROF.phase("block"):
+            if self._host_batched:
+                if self.block_manager.charge_decode(
+                        [r.request_id for r in reqs], slots_out):
+                    raise MemoryError("out of KV blocks on append")
+                return
+            for i, r in enumerate(reqs):
+                slots_out[i] = self.block_manager.append_slot(r.request_id)
+
+    def _bm_fill_tables(self, reqs: list[Request],
+                        out: np.ndarray) -> None:
+        """Write every row's block table into the zeroed (B, mb) dispatch
+        buffer in one crossing."""
+        with PROF.phase("block"):
+            if self._host_batched:
+                self.block_manager.fill_block_tables(
+                    [r.request_id for r in reqs], out)
+                return
+            for i, r in enumerate(reqs):
+                bt = self.block_manager.block_table(r.request_id)
+                out[i, :len(bt)] = bt
+
+    def _bm_advance(self, reqs: list[Request], steps: int) -> None:
+        with PROF.phase("block"):
+            if self._host_batched:
+                self.block_manager.advance_batch(
+                    [r.request_id for r in reqs], steps)
+                return
             for r in reqs:
-                self.block_manager.reserve(r.request_id,
-                                           r.num_tokens - 1 + window)
-        except MemoryError:
-            return False
-        return True
+                self.block_manager.advance(r.request_id, steps)
 
     # ---- execution hooks (multi-host coordinators wrap these to broadcast
     # each step to follower processes before running it — parallel/multihost).
@@ -1254,9 +1322,10 @@ class Engine:
             slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
                                                        len(ids))
         kw = self._lora_kw(reqs, B)
-        logits, self.kv_cache = self._exec_prefill(
-            jnp.asarray(tokens), jnp.asarray(prompt_lens),
-            jnp.asarray(slot_ids), **kw)
+        with PROF.phase("dispatch"):
+            logits, self.kv_cache = self._exec_prefill(
+                jnp.asarray(tokens), jnp.asarray(prompt_lens),
+                jnp.asarray(slot_ids), **kw)
         self.scheduler.mark_running(reqs)
         self.stats.num_prefill_steps += 1
         self._note_step_tokens(int(prompt_lens[:len(reqs)].sum()), B * L)
@@ -1372,10 +1441,9 @@ class Engine:
         self._dispatch_rids = tuple(r.request_id for r in decode_reqs)
         # decode rows each append one KV slot — the same reserve-then-
         # append preemption discipline as _run_decode (no pending here:
-        # both pipelines were just flushed)
-        while (sum(self.block_manager.needs_new_block(r.request_id)
-                   for r in decode_reqs)
-               > self.block_manager.num_free_blocks):
+        # both pipelines were just flushed); probe + charge are one
+        # manager crossing each (_bm_* helpers)
+        while self._bm_decode_shortfall(decode_reqs) > 0:
             victim = self.scheduler.preempt_last()
             self.stats.preemptions += 1
             if victim is None:
@@ -1383,8 +1451,8 @@ class Engine:
                                   "sequence")
             decode_reqs = [r for r in decode_reqs if r is not victim]
         self.faults.check("kv_alloc", self._dispatch_rids)
-        slots = [self.block_manager.append_slot(r.request_id)
-                 for r in decode_reqs]
+        slots = np.empty((len(decode_reqs),), np.int32)
+        self._bm_charge_decode(decode_reqs, slots)
         # prefill chunks: first chunk allocates (with prefix-cache
         # compute skip — prefill_chunk semantics); a request whose blocks
         # no longer fit (decode appends ate them) goes back to the head
@@ -1444,8 +1512,7 @@ class Engine:
             q_starts[i] = i
             q_lens[i] = 1
             last_rows[i] = i
-            bt = self.block_manager.block_table(r.request_id)
-            block_tables[i, :len(bt)] = bt
+        self._bm_fill_tables(decode_reqs, block_tables)
         blk_seq = np.full((T // blk,), -1, np.int32)
         for si, ((req, ids, done, take), start) in enumerate(
                 zip(comp + cont, starts), start=n_dec):
@@ -1480,13 +1547,14 @@ class Engine:
                 if req.adapter_idx is not None:
                     ad_rows[start:start + take, req.adapter_idx] = 1.0
             kw["ad"] = jnp.asarray(ad_rows)
-        logits, self.kv_cache = self._exec_forward_ragged(
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(slot_ids), jnp.asarray(row_seq),
-            jnp.asarray(block_tables), jnp.asarray(kv_lens),
-            jnp.asarray(q_starts), jnp.asarray(q_lens),
-            jnp.asarray(meta), jnp.asarray(blk_seq),
-            jnp.asarray(last_rows), **kw)
+        with PROF.phase("dispatch"):
+            logits, self.kv_cache = self._exec_forward_ragged(
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(slot_ids), jnp.asarray(row_seq),
+                jnp.asarray(block_tables), jnp.asarray(kv_lens),
+                jnp.asarray(q_starts), jnp.asarray(q_lens),
+                jnp.asarray(meta), jnp.asarray(blk_seq),
+                jnp.asarray(last_rows), **kw)
         self.stats.num_mixed_steps += 1
         if decode_reqs:
             self.stats.num_decode_steps += 1
@@ -1651,8 +1719,7 @@ class Engine:
                 # chained rows overwrite this with the device gstate via
                 # the same use_host/gather select as their input tokens
                 gstate_host[i] = gent[1]
-            bt = self.block_manager.block_table(r.request_id)
-            block_tables[i, :len(bt)] = bt
+        self._bm_fill_tables(reqs, block_tables)
         mode = ("greedy" if all(r.params.greedy for r in reqs)
                 else "temperature"
                 if not any(r.params.needs_truncation for r in reqs)
@@ -1722,11 +1789,12 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
-        res = self._exec_decode_multi(
-            tokens, jnp.asarray(positions),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens),
-            jnp.asarray(active), jnp.asarray(keys),
-            jnp.asarray(temperature), steps=S, mode=mode, **kw)
+        with PROF.phase("dispatch"):
+            res = self._exec_decode_multi(
+                tokens, jnp.asarray(positions),
+                jnp.asarray(block_tables), jnp.asarray(seq_lens),
+                jnp.asarray(active), jnp.asarray(keys),
+                jnp.asarray(temperature), steps=S, mode=mode, **kw)
         toks, self.kv_cache = res[0], res[1]
         ri = 2
         window_lp = None
@@ -1777,8 +1845,9 @@ class Engine:
         # exactly what the salvage path expects to find.
         self.faults.check("window_flush",
                           tuple(r.request_id for r in p.reqs))
-        # tpulint: sync-ok(THE designated sync: one device_get per S-token window is the whole fused-window design)
-        toks_h = np.asarray(jax.device_get(p.toks))
+        with PROF.phase("flush"):
+            # tpulint: sync-ok(THE designated sync: one device_get per S-token window is the whole fused-window design)
+            toks_h = np.asarray(jax.device_get(p.toks))
         lp_h = None
         if p.lp is not None:
             # tpulint: sync-ok(rides the same window-flush sync point; logprob arrays resolve with the tokens)
@@ -1786,29 +1855,153 @@ class Engine:
         outputs: list[RequestOutput] = []
         # Commit written KV BEFORE emitting (finish frees blocks mid-loop);
         # zombie rows' blocks were already freed at the previous flush.
-        for r in p.reqs:
-            if not r.finished:
-                self.block_manager.advance(r.request_id, p.steps)
-        for i, r in enumerate(p.reqs):
-            if r.finished:
-                self.stats.window_overrun_tokens += p.steps
-                continue
-            for s in range(p.steps):
-                if lp_h is not None and r.params.logprobs is not None:
-                    # recorded BEFORE emit (same order as the per-step
-                    # path: _record_logprobs then _append_and_emit), and
-                    # only for CONSUMED tokens — overrun rows break out
-                    # below before recording theirs
-                    chosen_lp, top_ids, top_lps = lp_h
-                    self._append_logprob_entry(
-                        r, int(toks_h[i, s]), chosen_lp[i, s],
-                        top_ids[i, s], top_lps[i, s])
-                out = self._emit_one(r, int(toks_h[i, s]))
-                outputs.append(out)
-                if out.finished:
-                    self.stats.window_overrun_tokens += p.steps - 1 - s
-                    break
+        self._bm_advance([r for r in p.reqs if not r.finished], p.steps)
+        with PROF.phase("detokenize"):
+            for i, r in enumerate(p.reqs):
+                if r.finished:
+                    self.stats.window_overrun_tokens += p.steps
+                    continue
+                if (self._host_batched and not r.params.stop
+                        and r.request_id not in self._guided):
+                    # window-batched detokenize-and-emit: ONE delta and
+                    # ONE RequestOutput per row per window (token- and
+                    # text-identical to the per-token path — pinned by
+                    # tests/test_host_hotpath.py).  Rows with stop
+                    # strings keep the per-token path: a stop match must
+                    # truncate at its exact TOKEN position.
+                    outputs.append(self._emit_window_row(
+                        r, toks_h[i], p.steps, lp_h, i))
+                    continue
+                for s in range(p.steps):
+                    if lp_h is not None and r.params.logprobs is not None:
+                        # recorded BEFORE emit (same order as the per-step
+                        # path: _record_logprobs then _append_and_emit), and
+                        # only for CONSUMED tokens — overrun rows break out
+                        # below before recording theirs
+                        chosen_lp, top_ids, top_lps = lp_h
+                        self._append_logprob_entry(
+                            r, int(toks_h[i, s]), chosen_lp[i, s],
+                            top_ids[i, s], top_lps[i, s])
+                    out = self._emit_one(r, int(toks_h[i, s]))
+                    outputs.append(out)
+                    if out.finished:
+                        self.stats.window_overrun_tokens += p.steps - 1 - s
+                        break
         return outputs
+
+    def _emit_window_row(self, req: Request, row, steps: int,
+                         lp_h, li: int) -> RequestOutput:
+        """Window-batched twin of the per-token ``_emit_one`` loop for one
+        row: decide the consumed token count by scanning ints (EOS /
+        stop_token_ids / max_tokens / max_model_len / grammar-FSM
+        completion — the same rules ``check_stop`` and the FSM advance
+        apply per token, in the same order), then detokenize the consumed
+        tokens in ONE ``add_many`` call and build ONE RequestOutput.
+        Content is identical to per-token flushing: same tokens appended,
+        same concatenated text, same finish reason — only the chunk
+        granularity changes (one multi-token chunk per window).  Callers
+        guarantee no stop strings and no substitution-path guided state on
+        this row."""
+        prm = req.params
+        n0 = len(req.output_token_ids)
+        fsm_ent = (self._guided_fsm.get(req.request_id)
+                   if prm.guided is not None else None)
+        # output-length cap this window can reach (>= 1: rows already at
+        # their cap never get another window — dispatch-gated)
+        cap = min(prm.max_tokens, self.max_seq_len - req.num_prompt_tokens)
+        limit = min(steps, cap - n0)
+        reason = None
+        if fsm_ent is None and not prm.min_tokens_active(n0 + 1):
+            # fast scan (the common case): membership against the
+            # precomputed stop set over a C-converted token list — no
+            # per-token Python method calls.  min_tokens_active is
+            # monotone in n, so inactive at n0+1 means inactive for the
+            # whole window.
+            # tpulint: sync-ok(row is a host numpy slice of the already-flushed window; .tolist() is a C list build, not a device sync)
+            toks_list = row[:limit].tolist()
+            if prm.stop_token_ids:
+                stopset = (set(prm.stop_token_ids) if prm.ignore_eos
+                           else self._eos_ids | set(prm.stop_token_ids))
+            else:
+                stopset = None if prm.ignore_eos else self._eos_ids
+            if stopset is not None:
+                for s, tok in enumerate(toks_list):
+                    if tok in stopset:
+                        reason = FinishReason.STOP
+                        toks_list = toks_list[:s + 1]
+                        break
+            if reason is None and limit >= cap - n0:
+                reason = FinishReason.LENGTH
+            consumed = len(toks_list)
+        else:
+            # grammar-FSM / min-tokens rows: per-token rule order exactly
+            # as _emit_one applies it (FSM advance, then check_stop)
+            consumed = 0
+            for s in range(limit):
+                tok = int(row[s])
+                n = n0 + s + 1
+                consumed = s + 1
+                if fsm_ent is not None:
+                    fsm = fsm_ent[0]
+                    ns = fsm.advance(fsm_ent[1], tok)
+                    if ns < 0:
+                        # off-grammar token (masking bypassed): drop the
+                        # constraint rather than track a corrupt state
+                        self._guided_fsm.pop(req.request_id, None)
+                        fsm_ent = None
+                    else:
+                        fsm_ent[1] = ns
+                        if fsm.complete[ns] and tok not in self._eos_ids:
+                            reason = FinishReason.STOP
+                if reason is None:
+                    # check_stop over host counters (request.check_stop
+                    # semantics at output length n)
+                    if (not prm.min_tokens_active(n)
+                            and ((not prm.ignore_eos
+                                  and tok in self._eos_ids)
+                                 or tok in prm.stop_token_ids)):
+                        reason = FinishReason.STOP
+                    elif n >= cap:
+                        reason = FinishReason.LENGTH
+                if reason is not None:
+                    break
+            toks_list = [int(t) for t in row[:consumed]]
+        if lp_h is not None and prm.logprobs is not None:
+            # consumed tokens only, appended before the emit bookkeeping —
+            # the per-token path's entry order
+            chosen_lp, top_ids, top_lps = lp_h
+            for s in range(consumed):
+                self._append_logprob_entry(req, toks_list[s],
+                                           chosen_lp[li, s],
+                                           top_ids[li, s], top_lps[li, s])
+        req.output_token_ids.extend(toks_list)
+        # progress resets the salvage budget, exactly like _emit_one
+        req.num_salvages = 0
+        self.stats.generated_tokens += consumed
+        delta = self._detok[req.request_id].add_many(toks_list)
+        req.output_text += delta
+        finished = reason is not None
+        if finished:
+            if req.stop_held:
+                # unreachable on this path (no stop strings) but kept in
+                # lockstep with _emit_one: held text is real output
+                req.output_text += req.stop_held
+                delta += req.stop_held
+                req.stop_held = ""
+            req.finish_reason = reason
+            req.finish_time = time.monotonic()
+            self.scheduler.finish(req)
+            self.stats.requests_finished += 1
+            self.stats.window_overrun_tokens += steps - consumed
+            self._detok.pop(req.request_id, None)
+            self._guided.pop(req.request_id, None)
+            self._guided_fsm.pop(req.request_id, None)
+            self._guided_plan.pop(req.request_id, None)
+        return RequestOutput(
+            request_id=req.request_id, new_token_ids=toks_list,
+            new_text=delta, finished=finished, finish_reason=reason,
+            num_prompt_tokens=req.num_prompt_tokens,
+            num_output_tokens=len(req.output_token_ids))
 
     def _run_decode(self, batch: ScheduledBatch) -> list[RequestOutput]:
         outputs: list[RequestOutput] = []
@@ -1849,9 +2042,10 @@ class Engine:
             return outputs + self._flush_pending()
         self._dispatch_rids = tuple(r.request_id for r in reqs)
         # Reserve capacity up front (preempting if needed), THEN append —
-        # append_slot mutates per-seq state, so it must not fail mid-batch.
-        while (sum(self.block_manager.needs_new_block(r.request_id) for r in reqs)
-               > self.block_manager.num_free_blocks):
+        # the slot charge mutates per-seq state, so it must not fail
+        # mid-batch.  Probe + charge + table fill are each ONE manager
+        # crossing per cycle (_bm_* helpers), not 2-3 per row.
+        while self._bm_decode_shortfall(reqs) > 0:
             if self._pending is not None:
                 # resolve in-flight results before evicting anyone — some of
                 # these requests may already be finished
@@ -1871,7 +2065,6 @@ class Engine:
                 return outputs
         self._dispatch_rids = tuple(r.request_id for r in reqs)
         self.faults.check("kv_alloc", self._dispatch_rids)
-        slots = [self.block_manager.append_slot(r.request_id) for r in reqs]
         B = self.scheduler.decode_bucket(len(reqs))
         host_tokens = np.zeros((B,), np.int32)
         use_host = np.ones((B,), bool)
@@ -1880,6 +2073,8 @@ class Engine:
         slot_arr = np.full((B,), PAD_SLOT, np.int32)
         seq_lens = np.ones((B,), np.int32)
         block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq), np.int32)
+        self._bm_charge_decode(reqs, slot_arr)
+        self._bm_fill_tables(reqs, block_tables)
         in_flight = set()
         for i, req in enumerate(reqs):
             pend = pend_idx.get(req.request_id)
@@ -1891,10 +2086,7 @@ class Engine:
                 gather[i] = pend
                 in_flight.add(req.request_id)
             positions[i] = nt - 1
-            slot_arr[i] = slots[i]
             seq_lens[i] = nt
-            bt = self.block_manager.block_table(req.request_id)
-            block_tables[i, :len(bt)] = bt
         if pending is not None:
             tokens = _select_tokens(pending.toks, jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -1902,9 +2094,10 @@ class Engine:
         else:
             tokens = jnp.asarray(host_tokens)
         kw = self._lora_kw(reqs, B)
-        logits, self.kv_cache = self._exec_decode(
-            tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
-            jnp.asarray(block_tables), jnp.asarray(seq_lens), **kw)
+        with PROF.phase("dispatch"):
+            logits, self.kv_cache = self._exec_decode(
+                tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
+                jnp.asarray(block_tables), jnp.asarray(seq_lens), **kw)
         self.stats.num_decode_steps += 1
         self._note_step_tokens(len(reqs), B)
         if pipeline_ok:
@@ -1957,16 +2150,17 @@ class Engine:
         chunk_lens = np.ones((B,), np.int32)
         block_tables = np.zeros((B, self.cache_cfg.max_blocks_per_seq),
                                 np.int32)
+        self._bm_fill_tables(reqs, block_tables)
         for i, r in enumerate(reqs):
             d = drafts[i]
             tokens[i, 0] = r.output_token_ids[-1]
             tokens[i, 1:1 + len(d)] = d
             ctx_lens[i] = base[i]
             chunk_lens[i] = 1 + len(d)
-            bt = self.block_manager.block_table(r.request_id)
+            # the padded table row is index-safe: every token in the
+            # verify window sits inside the reserved table
             slot_ids[i] = self._token_slots(r.request_id, base[i], K,
-                                            block_table=bt)
-            block_tables[i, :len(bt)] = bt
+                                            block_table=block_tables[i])
         sampled = not all(r.params.greedy for r in reqs)
         accept_h = None
         if sampled:
@@ -2068,8 +2262,9 @@ class Engine:
         p, self._pending = self._pending, None
         if p is None:
             return []
-        # tpulint: sync-ok(the single-step pipeline's designated sync: resolves the PREVIOUS step while the next runs)
-        toks = np.asarray(jax.device_get(p.toks))
+        with PROF.phase("flush"):
+            # tpulint: sync-ok(the single-step pipeline's designated sync: resolves the PREVIOUS step while the next runs)
+            toks = np.asarray(jax.device_get(p.toks))
         reqs, vals = [], []
         for i, r in enumerate(p.reqs):
             if r.finished:                      # aborted while in flight
@@ -2103,8 +2298,9 @@ class Engine:
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
-        # tpulint: sync-ok(the synchronous per-step path's one sync; the pipelined paths never call _sample)
-        toks_np = np.asarray(jax.device_get(toks))[:n].copy()
+        with PROF.phase("flush"):
+            # tpulint: sync-ok(the synchronous per-step path's one sync; the pipelined paths never call _sample)
+            toks_np = np.asarray(jax.device_get(toks))[:n].copy()
         if any(r.request_id in self._guided for r in reqs):
             # legacy substitution path: only rows WITHOUT a compiled FSM
             toks_np = self._apply_guided(logits, toks_np, reqs)
@@ -2550,8 +2746,9 @@ class Engine:
 
     def _append_and_emit(self, reqs: list[Request], new_tokens: np.ndarray,
                          from_prefill: bool = False) -> list[RequestOutput]:
-        return [self._emit_one(req, int(tok), from_prefill)
-                for req, tok in zip(reqs, new_tokens)]
+        with PROF.phase("detokenize"):
+            return [self._emit_one(req, int(tok), from_prefill)
+                    for req, tok in zip(reqs, new_tokens)]
 
     def _emit_one(self, req: Request, tok: int,
                   from_prefill: bool = False) -> RequestOutput:

@@ -17,7 +17,7 @@ from typing import Optional
 
 from tpuserve.runtime.block_manager import BlockManager
 from tpuserve.runtime.request import Request, RequestState
-from tpuserve.utils import next_power_of_2
+from tpuserve.utils import env_flag, next_power_of_2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,6 +92,13 @@ class Scheduler:
         self.cfg = cfg
         self.block_manager = block_manager
         self.max_model_len = max_model_len
+        # Batched admission (one block_manager.admit_prefill call per
+        # cycle — native when the C++ manager is loaded) vs the
+        # historical inline per-candidate loop: TPUSERVE_HOST_BATCHED=0
+        # keeps the pre-batching path so the host-overhead A/B
+        # (bench.py --clients-sweep, BENCHMARKS.md) measures what it
+        # claims on every phase, admission included.
+        self._batched_admission = env_flag("TPUSERVE_HOST_BATCHED")
         # Mixed mode: the engine pads the decode region and every prefill
         # chunk to this flat-row block (the ragged kernel's grid
         # granularity) — the token budget must charge those PADDED rows,
@@ -281,34 +288,53 @@ class Scheduler:
         if cached >= max(2 * self.block_manager.block_size,
                          head.num_tokens // 4):
             return self._pop_head_for_chunking(head, cached)
-        picked: list[Request] = []
-        bucket = 0
-        reserved = 0   # blocks spoken for by requests already picked this batch
-        while (self.waiting and len(picked) < self.cfg.max_prefill_seqs
-               and len(self.running) + len(picked) < self.cfg.max_num_seqs):
-            req = self.waiting[0]
+        # Admission arithmetic runs in the BLOCK MANAGER (one native call
+        # per cycle when the C++ manager is loaded): the manager holds the
+        # free-pool state the decision charges against, and the shared
+        # power-of-2 bucket / token-budget / +1-headroom rules live in one
+        # place for both impls (block_manager.admit_prefill).  This loop
+        # only collects the candidate head segment — truncated at the
+        # first chunk-route prompt, whose batching here would one-shot
+        # prefill a giant uncompiled bucket.  num_tokens (not
+        # num_prompt_tokens): a preempted request re-prefills its prompt
+        # plus everything generated so far.
+        seats = min(self.cfg.max_prefill_seqs,
+                    self.cfg.max_num_seqs - len(self.running))
+        counts: list[int] = []
+        for req in self.waiting:
+            if len(counts) >= seats:
+                break
             if (self.cfg.allow_chunked_prefill
                     and req.num_tokens > self.cfg.prefill_chunk_size):
-                # long prompt behind the head: leave it for its own chunked
-                # step — batching it here would one-shot prefill a giant
-                # uncompiled bucket
                 break
-            # All prompts in one prefill batch share a padded length bucket.
-            # num_tokens (not num_prompt_tokens): a preempted request
-            # re-prefills its prompt plus everything generated so far.
-            cand = max(bucket, self.prefill_bucket(req.num_tokens))
-            if cand * (len(picked) + 1) > self.cfg.max_prefill_tokens and picked:
-                break
-            # +1 block headroom so the first decode append can't OOM.
-            need = self.block_manager.blocks_needed(req.num_tokens) + 1
-            if reserved + need > self.block_manager.num_free_blocks:
-                break
-            self.waiting.popleft()
-            picked.append(req)
-            reserved += need
-            bucket = cand
-        if not picked:
+            counts.append(req.num_tokens)
+        if not counts:
             return None
+        if self._batched_admission:
+            n_pick, bucket = self.block_manager.admit_prefill(
+                counts, seats, self.cfg.max_prefill_tokens,
+                self.cfg.min_prefill_bucket)
+        else:
+            # legacy inline loop (the pre-batching admission path, kept
+            # for the A/B) — MUST stay arithmetic-identical to
+            # block_manager.admit_prefill, which tests/test_scheduler
+            # and the native op-trace differential pin
+            n_pick = bucket = reserved = 0
+            free = self.block_manager.num_free_blocks
+            for c in counts:
+                cand = max(bucket, self.prefill_bucket(c))
+                if (cand * (n_pick + 1) > self.cfg.max_prefill_tokens
+                        and n_pick):
+                    break
+                need = self.block_manager.blocks_needed(c) + 1
+                if reserved + need > free:
+                    break
+                n_pick += 1
+                reserved += need
+                bucket = cand
+        if not n_pick:
+            return None
+        picked = [self.waiting.popleft() for _ in range(n_pick)]
         return ScheduledBatch(kind="prefill", requests=picked, padded_len=bucket)
 
     def _schedule_mixed(self) -> Optional[ScheduledBatch]:

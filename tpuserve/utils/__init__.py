@@ -1,4 +1,5 @@
-from tpuserve.utils.misc import (cdiv, round_up, pad_to, next_power_of_2,
-                                 hard_sync)
+from tpuserve.utils.misc import (cdiv, env_flag, round_up, pad_to,
+                                 next_power_of_2, hard_sync)
 
-__all__ = ["cdiv", "round_up", "pad_to", "next_power_of_2", "hard_sync"]
+__all__ = ["cdiv", "env_flag", "round_up", "pad_to", "next_power_of_2",
+           "hard_sync"]

@@ -3,6 +3,19 @@
 from __future__ import annotations
 
 
+def env_flag(name: str, default: bool = True) -> bool:
+    """Boolean env-var parse shared by every consumer of a given flag —
+    ONE definition of falsiness ("0"/"false"/"off"), so sites like
+    ``TPUSERVE_HOST_BATCHED`` (engine emit batching, scheduler admission,
+    profiler labelling) can never resolve the same process-wide flag
+    differently and silently split an A/B lever."""
+    import os
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("0", "false", "off", "no")
+
+
 def cdiv(a: int, b: int) -> int:
     """Ceiling division."""
     return -(-a // b)
