@@ -281,7 +281,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   prefix_caching=False, multi_step=None, quantization=None,
                   prefill_split=1, kv_quant=None, interleave=False,
                   adaptive_window=True, block_size=32, mixed=False,
-                  mixed_budget=None, faults=None):
+                  mixed_budget=None, faults=None, num_blocks=None,
+                  kv_tiers=None, max_num_seqs=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -289,7 +290,8 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     max_len = prompt_len + gen_len
     blocks_per_seq = -(-max_len // block_size) + 1
     cache = CacheConfig(block_size=block_size,
-                        num_blocks=batch * blocks_per_seq + 2 * batch,
+                        num_blocks=(num_blocks if num_blocks is not None
+                                    else batch * blocks_per_seq + 2 * batch),
                         max_blocks_per_seq=blocks_per_seq,
                         dtype=kv_quant or "bfloat16")
     # Admit the whole batch in ONE prefill step by default: queueing behind
@@ -299,7 +301,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
     # batch's requests see first tokens ~N× sooner while the last batch
     # pays an extra dispatch round-trip.
     seqs_per_batch = max(1, batch // max(1, prefill_split))
-    sched = SchedulerConfig(max_num_seqs=batch,
+    sched = SchedulerConfig(max_num_seqs=max_num_seqs or batch,
                             max_prefill_seqs=seqs_per_batch,
                             max_prefill_tokens=max(
                                 8192 // max(1, prefill_split),
@@ -317,7 +319,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                        pipeline_decode=pipeline, speculative=spec,
                        multi_step=multi_step, quantization=quantization,
                        adaptive_multi_step=adaptive_window,
-                       faults=faults)
+                       kv_tiers=kv_tiers, faults=faults)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -723,6 +725,224 @@ def _host_overhead_sweep(args, model, prompt_len, gen_len, *,
     }
 
 
+def _multiturn_workload(engine, sys_ids, user_ids, turns, gen_per_turn,
+                        rate, think_s, seed=7):
+    """Shared-system-prompt Poisson conversation mix (ISSUE 7 workload):
+    every conversation opens with the SAME system prompt, then alternates
+    user turns and generations; a conversation's next turn arrives an
+    exponential think time after its previous turn completes.  Between a
+    conversation's turns its KV goes cold — at an HBM budget below the
+    working set it gets EVICTED, and turn>=2 TTFT measures what the
+    tiered cache (demote + async restore) saves vs re-prefilling the
+    whole history.
+
+    Returns per-turn TTFT percentiles, ITL percentiles, the engine's
+    prefix-hit rate over the run, and the tier flow counters."""
+    import bisect
+
+    import numpy as np
+
+    from tpuserve.runtime.request import SamplingParams
+    rng = np.random.default_rng(seed)
+    C = len(user_ids)
+    params = SamplingParams(max_tokens=gen_per_turn, temperature=0.0,
+                            seed=0, ignore_eos=True)
+    hist = [list(sys_ids) + list(user_ids[c][0]) for c in range(C)]
+    pending = sorted(
+        (float(t), c) for c, t in
+        enumerate(np.cumsum(rng.exponential(1.0 / rate, size=C))))
+    turn_idx = [0] * C
+    live: dict = {}            # rid -> (conv, intended_mono, turn)
+    ttfts = [[] for _ in range(turns)]
+    itls: list = []
+    last_tok: dict = {}
+    bm = engine.block_manager
+    q0, h0 = bm.prefix_queries, bm.prefix_hits
+    stats = engine.stats
+    gen0, d0 = stats.generated_tokens, stats.num_decode_steps
+    t_start = time.perf_counter()
+    t_mono = time.monotonic()
+    decode_time = 0.0
+    done = 0
+    while done < C * turns:
+        now = time.perf_counter() - t_start
+        while pending and pending[0][0] <= now:
+            off, c = pending.pop(0)
+            rid = engine.add_request(prompt_token_ids=list(hist[c]),
+                                     params=params)
+            live[rid] = (c, t_mono + off, turn_idx[c])
+        if not engine.has_work():
+            if not pending:
+                break          # stragglers only finish via step outputs
+            time.sleep(max(0.0, pending[0][0]
+                           - (time.perf_counter() - t_start)))
+            continue
+        dsteps = stats.num_decode_steps
+        t0 = time.perf_counter()
+        outs = engine.step()
+        dt = time.perf_counter() - t0
+        t_emit = time.perf_counter()
+        if (stats.num_decode_steps > dsteps
+                or any(not o.from_prefill for o in outs)):
+            decode_time += dt
+        for o in outs:
+            if o.from_prefill and o.num_output_tokens > 1:
+                last_tok[o.request_id] = t_emit      # re-prefill: reset
+            else:
+                prev = last_tok.get(o.request_id)
+                if prev is not None:
+                    itls.append(t_emit - prev)
+                last_tok[o.request_id] = t_emit
+            if o.finished and o.request_id in live:
+                c, intended, ti = live.pop(o.request_id)
+                req = engine.requests.pop(o.request_id)
+                last_tok.pop(o.request_id, None)
+                if req.first_token_time is not None:
+                    ttfts[ti].append(
+                        1000.0 * (req.first_token_time - intended))
+                hist[c].extend(req.output_token_ids)
+                turn_idx[c] += 1
+                done += 1
+                if turn_idx[c] < turns:
+                    hist[c].extend(user_ids[c][turn_idx[c]])
+                    # exponential THINK time before the next turn: the
+                    # cold gap in which this conversation's KV is at the
+                    # mercy of other conversations' HBM pressure
+                    nxt = (time.perf_counter() - t_start
+                           + float(rng.exponential(think_s)))
+                    bisect.insort(pending, (nxt, c))
+    total = time.perf_counter() - t_start
+    queries = bm.prefix_queries - q0
+    gen = stats.generated_tokens - gen0
+    return {
+        "total_s": round(total, 3),
+        "turns_completed": done,
+        "ttft_by_turn": [
+            {"turn": i + 1, "n": len(t),
+             "p50_ms": round(_pct(sorted(t), 0.50), 1),
+             "p95_ms": round(_pct(sorted(t), 0.95), 1)}
+            for i, t in enumerate(ttfts)],
+        "itl_p50_ms": round(_pct(sorted(1000.0 * x for x in itls), 0.50), 2),
+        "itl_p99_ms": round(_pct(sorted(1000.0 * x for x in itls), 0.99), 2),
+        "prefix_hit_rate": round((bm.prefix_hits - h0) / queries, 3)
+                           if queries else 0.0,
+        "prefix_queries": queries,
+        "decode_tok_s": round((gen - done) / decode_time, 1)
+                        if decode_time else 0.0,
+        "kv": {"demoted": stats.kv_demoted_blocks,
+               "restored": stats.kv_restored_blocks,
+               "restores": stats.kv_restores,
+               "spilled": stats.kv_spilled_blocks,
+               "dropped": stats.kv_tier_dropped_blocks,
+               "preemptions": stats.preemptions},
+    }
+
+
+def _multiturn_ab(args, model, on_tpu, *, attn_impl, pipeline, vocab):
+    """Tiered-vs-HBM-only A/B on the multi-turn shared-prefix workload
+    (ISSUE 7 acceptance): both engines run the SAME fixed-seed
+    conversation mix at an HBM block budget ~40% of the conversation
+    working set, so cold prefixes must leave HBM — the tiered engine
+    demotes and restores them, the legacy engine re-prefills.  Rows under
+    TPUSERVE_KV_TIERS=0 (the kv-tiers-legacy sweep variant) skip the
+    tiered half: the env kill switch would silently neuter it."""
+    import numpy as np
+
+    from tpuserve.utils import env_flag, next_power_of_2
+
+    turns = args.turns
+    if on_tpu:
+        C, sys_len, user_len, gen_per = 32, 512, 128, 64
+        rate = args.arrival_rate
+    else:
+        C, sys_len, user_len, gen_per = 16, 128, 48, 16
+        rate = max(args.arrival_rate, 50.0)
+    rng = np.random.default_rng(11)
+    sys_ids = rng.integers(1, vocab - 1, size=sys_len).tolist()
+    user_ids = [[rng.integers(1, vocab - 1, size=user_len).tolist()
+                 for _ in range(turns)] for _ in range(C)]
+    conv_len = sys_len + turns * (user_len + gen_per)
+    block = args.block_size
+    blocks_per_conv = -(-conv_len // block) + 2
+    seqs = min(C, 8 if on_tpu else 4)
+    # HBM forced under the working set: every concurrent conversation
+    # fits (serving stays correct), but the UNIQUE hashed working set —
+    # the shared system prompt counts once, each conversation's own
+    # full history blocks once — does not, so cold conversations'
+    # prefixes must leave HBM between turns
+    sys_blocks = sys_len // block
+    unique_ws = sys_blocks + C * (conv_len // block - sys_blocks)
+    num_blocks = max(seqs * blocks_per_conv + 4, int(0.5 * unique_ws))
+
+    def build(tiers):
+        eng = _build_engine(
+            model, seqs, conv_len, gen_per, attn_impl=attn_impl,
+            pipeline=pipeline, multi_step=args.multi_step,
+            quantization=args.quant, kv_quant=args.kv_quant,
+            block_size=block, prefix_caching=True, kv_tiers=tiers,
+            num_blocks=num_blocks, max_num_seqs=seqs)
+        # staggered-arrival bucket ladder over the GROWING conversation
+        # lengths: power-of-two prompt buckets from the first turn up to
+        # the chunk size (longer prompts route through chunked prefill),
+        # small admission batches, the full decode ladder
+        cfg = eng.scheduler.cfg
+        L = eng.scheduler.prefill_bucket(sys_len + user_len)
+        top = next_power_of_2(min(conv_len, cfg.prefill_chunk_size))
+        admit = next_power_of_2(min(seqs, cfg.max_prefill_seqs))
+        buckets = []
+        while L <= top:
+            b = 1
+            while b <= admit:        # clustered turn arrivals batch up to
+                buckets.append((b, L))   # the admission limit — warm the
+                b *= 2                   # whole (batch, len) grid
+            L *= 2
+        # later turns carry a SUBSTANTIAL cached prefix and route through
+        # chunk-by-choice prefill (scheduler._schedule_prefill), whose
+        # padded suffix buckets are small powers of two — left cold, the
+        # first turn-2 request stalls the whole arrival cluster on an
+        # _exec_prefill_chunk compile
+        chunked, cb = [], cfg.min_prefill_bucket
+        while cb <= min(next_power_of_2(conv_len), cfg.prefill_chunk_size):
+            chunked.append(cb)
+            cb *= 2
+        eng.warmup(prefill_buckets=buckets,
+                   decode_buckets=sorted(
+                       {eng.scheduler.decode_bucket(n)
+                        for n in range(1, seqs + 1)}),
+                   chunk_buckets=chunked, sample_modes=("greedy",))
+        return eng
+
+    # mean think time between a conversation's turns: the whole herd
+    # cycles while one conversation is cold, so its prefix experiences
+    # the full fleet's HBM pressure — the reuse pattern the tier exists
+    # for (20 ms think times never let anything go cold)
+    think_s = C / rate
+    out = {"conversations": C, "turns": turns, "system_prompt_len": sys_len,
+           "user_turn_len": user_len, "gen_per_turn": gen_per,
+           "conv_len": conv_len, "num_blocks": num_blocks,
+           "working_set_blocks": unique_ws,
+           "arrival_rate_req_s": rate, "think_mean_s": round(think_s, 3)}
+    legacy_env = not env_flag("TPUSERVE_KV_TIERS")
+    if legacy_env:
+        out["legacy_only"] = ("TPUSERVE_KV_TIERS=0 in the environment: "
+                              "tiered half skipped")
+    else:
+        eng_t = build(True)
+        out["tiered"] = _multiturn_workload(eng_t, sys_ids, user_ids,
+                                            turns, gen_per, rate, think_s)
+    eng_l = build(False)
+    out["hbm_only"] = _multiturn_workload(eng_l, sys_ids, user_ids,
+                                          turns, gen_per, rate, think_s)
+    if "tiered" in out:
+        def p50_reused(r):
+            vals = [t["p50_ms"] for t in r["ttft_by_turn"][1:] if t["n"]]
+            return sum(vals) / len(vals) if vals else 0.0
+        base, tier = p50_reused(out["hbm_only"]), p50_reused(out["tiered"])
+        out["ttft_turn2plus_improvement"] = (round(base / tier, 2)
+                                             if tier else 0.0)
+    return out
+
+
 def _roofline(eng0, batch, prompt_len, gen_len, steps_s):
     """Estimated HBM traffic at the measured rate — decode is
     bandwidth-bound, so tok/s is only meaningful against the pipe
@@ -895,6 +1115,17 @@ def main(argv=None):
                          "'decode_dispatch:raise:0.02'), driven through "
                          "the salvage-capable runner; reports wall-clock "
                          "overhead + salvage/poison/watchdog counters")
+    ap.add_argument("--multiturn", action="store_true",
+                    help="tiered-KV A/B on a shared-system-prompt Poisson "
+                         "conversation mix at an HBM budget that forces "
+                         "eviction: per-turn TTFT/ITL percentiles, prefix "
+                         "hit rate, and demote/restore counters for the "
+                         "tiered vs HBM-only engine (TPUSERVE_KV_TIERS=0 "
+                         "in the env measures the legacy half only); adds "
+                         "a 'multiturn' sub-object")
+    ap.add_argument("--turns", type=int, default=4, metavar="T",
+                    help="turns per conversation for --multiturn "
+                         "(default 4)")
     ap.add_argument("--clients-sweep", default=None, metavar="N,N,...",
                     help="host-overhead scaling rows: re-run the workload "
                          "at each client count (e.g. 16,64,256), reporting "
@@ -1187,6 +1418,11 @@ def main(argv=None):
             out["host_overhead"] = _host_overhead_sweep(
                 args, model, prompt_len, gen_len, attn_impl=attn_impl,
                 pipeline=pipeline, warm_modes=warm_modes)
+    if args.multiturn:
+        with tpu_guard("multiturn tiered-KV comparison"):
+            out["multiturn"] = _multiturn_ab(
+                args, model, on_tpu, attn_impl=attn_impl,
+                pipeline=pipeline, vocab=vocab)
     if args.compare_mixed:
         with tpu_guard("mixed comparison"):
             out["mixed_ab"] = _compare_mixed(

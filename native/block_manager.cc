@@ -109,4 +109,39 @@ void bm_admit_prefill(void* h, const int32_t* counts, int64_t n,
                                                picked_out, bucket_out);
 }
 
+// ---- tiered KV cache: eviction log + restore (see block_manager.hh) -----
+
+void bm_set_record_evictions(void* h, int on) {
+  static_cast<BlockManager*>(h)->set_record_evictions(on != 0);
+}
+int64_t bm_num_evictions(void* h) {
+  return static_cast<BlockManager*>(h)->num_evictions();
+}
+int64_t bm_take_evictions(void* h, int32_t* blocks_out, uint64_t* hashes_out,
+                          int64_t max_out) {
+  return static_cast<BlockManager*>(h)->take_evictions(blocks_out, hashes_out,
+                                                       max_out);
+}
+int64_t bm_prefix_chain(void* h, const int32_t* tokens, int64_t n,
+                        uint64_t* out, int64_t max_out) {
+  return static_cast<BlockManager*>(h)->prefix_chain(tokens, n, out, max_out);
+}
+int bm_prefix_resolvable(void* h, uint64_t hash) {
+  return static_cast<BlockManager*>(h)->prefix_resolvable(hash);
+}
+int64_t bm_begin_restore(void* h, const uint64_t* hashes, int64_t n,
+                         int32_t* blocks_out) {
+  return static_cast<BlockManager*>(h)->begin_restore(hashes, n, blocks_out);
+}
+int64_t bm_commit_restore(void* h, const uint64_t* hashes,
+                          const int32_t* blocks, int64_t n) {
+  return static_cast<BlockManager*>(h)->commit_restore(hashes, blocks, n);
+}
+void bm_abort_restore(void* h, const int32_t* blocks, int64_t n) {
+  static_cast<BlockManager*>(h)->abort_restore(blocks, n);
+}
+int32_t bm_num_cached_blocks(void* h) {
+  return static_cast<BlockManager*>(h)->num_cached_blocks();
+}
+
 }  // extern "C"

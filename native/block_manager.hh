@@ -57,6 +57,12 @@ class BlockManager {
   }
   int64_t prefix_hits() const { return prefix_hits_; }
   int64_t prefix_queries() const { return prefix_queries_; }
+  int32_t num_cached_blocks() const {
+    return static_cast<int32_t>(cached_lru_.size());
+  }
+  int32_t num_restoring_blocks() const {
+    return static_cast<int32_t>(restoring_.size());
+  }
 
   // Longest cached whole-block prefix; at least one token stays uncached.
   int64_t lookup_prefix(const int32_t* tokens, int64_t n, int32_t* out,
@@ -74,6 +80,96 @@ class BlockManager {
     }
     if (got > 0 && count_stats) ++prefix_hits_;
     return got;
+  }
+
+  // Chain hashes of every full prompt block (at least one token stays
+  // uncached), residency-independent — the tier-store keys the engine
+  // probes lower tiers with.  Mirrors Python prefix_chain.
+  int64_t prefix_chain(const int32_t* tokens, int64_t n, uint64_t* out,
+                       int64_t max_out) const {
+    if (!enable_prefix_) return 0;
+    int64_t max_full = (n - 1) / block_size_;
+    uint64_t h = 0;
+    int64_t got = 0;
+    for (int64_t i = 0; i < max_full && got < max_out; ++i) {
+      h = chain_hash(h, tokens + i * block_size_, block_size_);
+      out[got++] = h;
+    }
+    return got;
+  }
+
+  // Whether a chain hash currently resolves in HBM (the engine's demote
+  // drain filters out hashes re-registered since their eviction).
+  bool prefix_resolvable(uint64_t h) const { return prefix_.count(h) != 0; }
+
+  // ---- tiered KV cache: eviction log + restore state machine ----------
+  // Mirrors runtime/block_manager.py (the Python twin is the semantic
+  // reference; tests/test_native.py drives both with one op trace).
+
+  void set_record_evictions(bool on) { record_evictions_ = on; }
+  bool record_evictions() const { return record_evictions_; }
+
+  // Drain the (block, chain-hash) eviction log into caller arrays;
+  // returns entries written (the log is cleared regardless — the engine
+  // sizes the buffers from num_evictions() first).
+  int64_t num_evictions() const {
+    return static_cast<int64_t>(evicted_.size());
+  }
+  int64_t take_evictions(int32_t* blocks_out, uint64_t* hashes_out,
+                         int64_t max_out) {
+    int64_t n = 0;
+    for (const auto& e : evicted_) {
+      if (n >= max_out) break;
+      blocks_out[n] = e.first;
+      hashes_out[n] = e.second;
+      ++n;
+    }
+    evicted_.clear();
+    return n;
+  }
+
+  // Claim one free block per hash for an in-flight host->HBM restore;
+  // the blocks leave every pool until commit_restore.  Returns the count
+  // (== n) or -1 without mutating when the pool can't cover it.
+  int64_t begin_restore(const uint64_t* hashes, int64_t n,
+                        int32_t* blocks_out) {
+    if (n > num_free_blocks()) return -1;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t b = pop_free_block();
+      restoring_[b] = hashes[i];
+      blocks_out[i] = b;
+    }
+    return n;
+  }
+
+  // Publish restored blocks as cached-pool prefix entries (MRU); a hash
+  // re-registered meanwhile returns its redundant block to the free
+  // list.  Returns prefix entries published.
+  int64_t commit_restore(const uint64_t* hashes, const int32_t* blocks,
+                         int64_t n) {
+    int64_t published = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      int32_t b = blocks[i];
+      uint64_t h = hashes[i];
+      restoring_.erase(b);
+      if (prefix_.count(h) || block_hash_.count(b)) {
+        free_.push_back(b);
+        continue;
+      }
+      prefix_[h] = b;
+      block_hash_[b] = h;
+      cached_lru_.push_back(b);
+      cached_pos_[b] = std::prev(cached_lru_.end());
+      ++published;
+    }
+    return published;
+  }
+
+  void abort_restore(const int32_t* blocks, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+      restoring_.erase(blocks[i]);
+      free_.push_back(blocks[i]);
+    }
   }
 
   // Returns block count, or -1 OOM, -2 seq exists.
@@ -432,10 +528,19 @@ class BlockManager {
       free_.pop_back();
       return b;
     }
-    // evict the LRU cached block; its prefix entry dies with it
+    // evict the LRU cached block; its prefix entry dies with it — or is
+    // demoted by the engine when eviction recording is armed
     int32_t b = cached_lru_.front();
     cached_lru_.pop_front();
     cached_pos_.erase(b);
+    if (record_evictions_) {
+      auto it = block_hash_.find(b);
+      if (it != block_hash_.end()) {
+        auto p = prefix_.find(it->second);
+        if (p != prefix_.end() && p->second == b)
+          evicted_.emplace_back(b, it->second);
+      }
+    }
     drop_hash(b);
     return b;
   }
@@ -475,6 +580,10 @@ class BlockManager {
   std::unordered_map<int32_t, uint64_t> block_hash_;
   int64_t prefix_hits_ = 0;
   int64_t prefix_queries_ = 0;
+  // tiered KV cache (mirrors the Python twin's tier state)
+  bool record_evictions_ = false;
+  std::vector<std::pair<int32_t, uint64_t>> evicted_;
+  std::unordered_map<int32_t, uint64_t> restoring_;
 };
 
 }  // namespace tpuserve

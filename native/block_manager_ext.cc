@@ -110,6 +110,114 @@ PyObject* core_lookup_prefix(CoreObject* self, PyObject* args) {
   return list_from_blocks(out.data(), n);
 }
 
+PyObject* core_num_cached_blocks(CoreObject* self, PyObject*) {
+  return PyLong_FromLong(self->bm->num_cached_blocks());
+}
+
+PyObject* core_num_restoring_blocks(CoreObject* self, PyObject*) {
+  return PyLong_FromLong(self->bm->num_restoring_blocks());
+}
+
+PyObject* core_prefix_chain(CoreObject* self, PyObject* arg) {
+  std::vector<int32_t> tokens;
+  if (!tokens_from_list(arg, &tokens)) return nullptr;
+  std::vector<uint64_t> out(tokens.size() + 1);
+  int64_t n = self->bm->prefix_chain(tokens.data(),
+                                     static_cast<int64_t>(tokens.size()),
+                                     out.data(),
+                                     static_cast<int64_t>(out.size()));
+  PyObject* list = PyList_New(n);
+  if (!list) return nullptr;
+  for (int64_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(list, i, PyLong_FromUnsignedLongLong(out[i]));
+  return list;
+}
+
+PyObject* core_prefix_resolvable(CoreObject* self, PyObject* arg) {
+  unsigned long long h = PyLong_AsUnsignedLongLong(arg);
+  if (h == static_cast<unsigned long long>(-1) && PyErr_Occurred())
+    return nullptr;
+  return PyBool_FromLong(self->bm->prefix_resolvable(h));
+}
+
+PyObject* core_set_record_evictions(CoreObject* self, PyObject* arg) {
+  int on = PyObject_IsTrue(arg);
+  if (on < 0) return nullptr;
+  self->bm->set_record_evictions(on != 0);
+  Py_RETURN_NONE;
+}
+
+PyObject* core_take_evictions(CoreObject* self, PyObject*) {
+  int64_t n = self->bm->num_evictions();
+  std::vector<int32_t> blocks(static_cast<size_t>(n));
+  std::vector<uint64_t> hashes(static_cast<size_t>(n));
+  n = self->bm->take_evictions(blocks.data(), hashes.data(), n);
+  PyObject* list = PyList_New(n);
+  if (!list) return nullptr;
+  for (int64_t i = 0; i < n; ++i) {
+    PyObject* pair = Py_BuildValue(
+        "iK", blocks[static_cast<size_t>(i)],
+        static_cast<unsigned long long>(hashes[static_cast<size_t>(i)]));
+    if (!pair) { Py_DECREF(list); return nullptr; }
+    PyList_SET_ITEM(list, i, pair);
+  }
+  return list;
+}
+
+bool hashes_from_list(PyObject* list, std::vector<uint64_t>* out) {
+  if (!PyList_Check(list)) {
+    PyErr_SetString(PyExc_TypeError, "expected a list of hash ints");
+    return false;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  out->resize(static_cast<size_t>(n));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    unsigned long long v =
+        PyLong_AsUnsignedLongLong(PyList_GET_ITEM(list, i));
+    if (v == static_cast<unsigned long long>(-1) && PyErr_Occurred())
+      return false;
+    (*out)[i] = static_cast<uint64_t>(v);
+  }
+  return true;
+}
+
+PyObject* core_begin_restore(CoreObject* self, PyObject* arg) {
+  std::vector<uint64_t> hashes;
+  if (!hashes_from_list(arg, &hashes)) return nullptr;
+  std::vector<int32_t> blocks(hashes.size());
+  int64_t n = self->bm->begin_restore(hashes.data(),
+                                      static_cast<int64_t>(hashes.size()),
+                                      blocks.data());
+  if (n < 0) Py_RETURN_NONE;  // pool can't cover it, like Python's None
+  return list_from_blocks(blocks.data(), n);
+}
+
+PyObject* core_commit_restore(CoreObject* self, PyObject* args) {
+  PyObject* hashes_list;
+  PyObject* blocks_list;
+  if (!PyArg_ParseTuple(args, "OO", &hashes_list, &blocks_list))
+    return nullptr;
+  std::vector<uint64_t> hashes;
+  std::vector<int32_t> blocks;
+  if (!hashes_from_list(hashes_list, &hashes)) return nullptr;
+  if (!tokens_from_list(blocks_list, &blocks)) return nullptr;
+  if (hashes.size() != blocks.size()) {
+    PyErr_SetString(PyExc_ValueError, "hashes/blocks length mismatch");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(self->bm->commit_restore(
+      hashes.data(), blocks.data(),
+      static_cast<int64_t>(hashes.size())));
+}
+
+PyObject* core_abort_restore(CoreObject* self, PyObject* arg) {
+  std::vector<int32_t> blocks;
+  if (!tokens_from_list(arg, &blocks)) return nullptr;
+  self->bm->abort_restore(blocks.data(),
+                          static_cast<int64_t>(blocks.size()));
+  Py_RETURN_NONE;
+}
+
 PyObject* core_allocate(CoreObject* self, PyObject* args) {
   const char* seq_id;
   PyObject* tokens_list;
@@ -445,6 +553,18 @@ PyMethodDef core_methods[] = {
     {"prefix_hits", (PyCFunction)core_prefix_hits, METH_NOARGS, ""},
     {"prefix_queries", (PyCFunction)core_prefix_queries, METH_NOARGS, ""},
     {"lookup_prefix", (PyCFunction)core_lookup_prefix, METH_VARARGS, ""},
+    {"prefix_chain", (PyCFunction)core_prefix_chain, METH_O, ""},
+    {"prefix_resolvable", (PyCFunction)core_prefix_resolvable, METH_O, ""},
+    {"num_cached_blocks", (PyCFunction)core_num_cached_blocks, METH_NOARGS,
+     ""},
+    {"num_restoring_blocks", (PyCFunction)core_num_restoring_blocks,
+     METH_NOARGS, ""},
+    {"set_record_evictions", (PyCFunction)core_set_record_evictions, METH_O,
+     ""},
+    {"take_evictions", (PyCFunction)core_take_evictions, METH_NOARGS, ""},
+    {"begin_restore", (PyCFunction)core_begin_restore, METH_O, ""},
+    {"commit_restore", (PyCFunction)core_commit_restore, METH_VARARGS, ""},
+    {"abort_restore", (PyCFunction)core_abort_restore, METH_O, ""},
     {"allocate", (PyCFunction)core_allocate, METH_VARARGS, ""},
     {"needs_new_block", (PyCFunction)core_needs_new_block, METH_O, ""},
     {"can_append", (PyCFunction)core_can_append, METH_O, ""},

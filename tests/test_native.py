@@ -261,6 +261,110 @@ def test_randomized_batched_op_trace():
     py.check_integrity(expected_seq_ids=live)
 
 
+def test_randomized_tier_op_trace():
+    """Eviction-order correctness under pressure (ISSUE 7): randomized
+    demote/restore op trace over the tier state machine, native vs
+    Python.  Hash VALUES are impl-internal (Python hash() vs FNV-1a), so
+    each impl keys a private simulated tier store by its own hashes; the
+    OBSERVABLE behaviour — which blocks evict and in what order, restore
+    begin/commit block assignments, free counts, and post-restore lookup
+    results — must match exactly."""
+    import numpy as np
+    rng = random.Random(21)
+    py, cc = make_pair(num_blocks=40, block_size=4)
+    py.record_evictions = True
+    cc.record_evictions = True
+    tier_py: dict = {}               # own-hash -> True (simulated store)
+    tier_cc: dict = {}
+    prompts: list[list[int]] = []    # historical prompts to restore against
+    live: list[str] = []
+    next_id = 0
+    for step in range(600):
+        op = rng.random()
+        if op < 0.3:
+            toks = [rng.randrange(12) for _ in range(rng.randrange(4, 32))]
+            sid = f"s{next_id}"; next_id += 1
+            sh_py, _ = py.lookup_prefix(toks)
+            sh_cc, _ = cc.lookup_prefix(toks)
+            assert sh_py == sh_cc, step
+            try:
+                a_py = py.allocate(sid, toks, shared_blocks=sh_py)
+                a_cc = cc.allocate(sid, toks, shared_blocks=sh_cc)
+                assert a_py.blocks == a_cc.blocks, step
+                live.append(sid)
+                prompts.append(toks)
+            except MemoryError:
+                with pytest.raises(MemoryError):
+                    cc.allocate(sid, toks, shared_blocks=sh_cc)
+        elif op < 0.5 and live:
+            rows = rng.sample(live, rng.randrange(1, len(live) + 1))
+            s_py = np.zeros((len(rows),), np.int32)
+            s_cc = np.zeros((len(rows),), np.int32)
+            assert py.charge_decode(rows, s_py) == \
+                cc.charge_decode(rows, s_cc), step
+            assert s_py.tolist() == s_cc.tolist(), step
+        elif op < 0.7 and prompts:
+            # tier restore against a historical prompt: each impl probes
+            # ITS OWN chain hashes against its own store and restores the
+            # first resolvable contiguous span past its HBM hit
+            toks = rng.choice(prompts)
+            ch_py = py.prefix_chain(toks)
+            ch_cc = cc.prefix_chain(toks)
+            assert len(ch_py) == len(ch_cc), step
+            sh_py, _ = py.lookup_prefix(toks, count_stats=False)
+            sh_cc, _ = cc.lookup_prefix(toks, count_stats=False)
+            assert len(sh_py) == len(sh_cc), step
+            k = len(sh_py)
+            span_py, span_cc = [], []
+            while (k + len(span_py) < len(ch_py)
+                   and ch_py[k + len(span_py)] in tier_py):
+                span_py.append(ch_py[k + len(span_py)])
+            while (k + len(span_cc) < len(ch_cc)
+                   and ch_cc[k + len(span_cc)] in tier_cc):
+                span_cc.append(ch_cc[k + len(span_cc)])
+            assert len(span_py) == len(span_cc), step
+            if span_py:
+                b_py = py.begin_restore(span_py)
+                b_cc = cc.begin_restore(span_cc)
+                assert (b_py is None) == (b_cc is None), step
+                if b_py is not None:
+                    assert b_py == b_cc, step
+                    for h in span_py:
+                        del tier_py[h]
+                    for h in span_cc:
+                        del tier_cc[h]
+                    if rng.random() < 0.15:      # occasional failed copy
+                        py.abort_restore(b_py)
+                        cc.abort_restore(b_cc)
+                    else:
+                        n_py = py.commit_restore(span_py, b_py)
+                        n_cc = cc.commit_restore(span_cc, b_cc)
+                        assert n_py == n_cc, step
+                        r_py, n1 = py.lookup_prefix(toks, count_stats=False)
+                        r_cc, n2 = cc.lookup_prefix(toks, count_stats=False)
+                        assert r_py == r_cc and n1 == n2, step
+        elif live:
+            sid = live.pop(rng.randrange(len(live)))
+            cache = rng.random() < 0.8
+            py.free(sid, cache_blocks=cache)
+            cc.free(sid, cache_blocks=cache)
+        # drain eviction logs in lockstep: identical blocks in identical
+        # order (the LRU eviction order IS the demotion order)
+        ev_py = py.take_evictions()
+        ev_cc = cc.take_evictions()
+        assert [b for b, _ in ev_py] == [b for b, _ in ev_cc], step
+        for b, h in ev_py:
+            tier_py[h] = True
+        for b, h in ev_cc:
+            tier_cc[h] = True
+        assert len(tier_py) == len(tier_cc), step
+        assert py.num_free_blocks == cc.num_free_blocks, step
+        assert py.num_cached_blocks == cc.num_cached_blocks, step
+        assert py.num_restoring_blocks == cc.num_restoring_blocks == 0, step
+    # Python-side invariants held throughout (native has no introspection)
+    py.check_integrity(expected_seq_ids=live, tier_hashes=list(tier_py))
+
+
 def test_charge_decode_shortfall_is_non_mutating():
     py, cc = make_pair(num_blocks=4, block_size=2, prefix=False)
     import numpy as np

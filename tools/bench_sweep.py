@@ -61,6 +61,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("host-overhead", ["--clients-sweep", "16,64,256"], {}),
     ("host-overhead-legacy", ["--clients-sweep", "16,64,256"],
      {"TPUSERVE_HOST_BATCHED": "0", "TPUSERVE_BLOCK_MANAGER": "python"}),
+    # Tiered KV cache (ISSUE 7): multi-turn shared-prefix Poisson mix at
+    # an HBM budget forcing eviction — per-turn TTFT, prefix hit rate,
+    # demote/restore counters, tiered vs HBM-only in one row.  The
+    # legacy row re-runs with the kill switch so the HBM-only number is
+    # measured under the exact pre-tiering code path.
+    ("kv-tiers", ["--multiturn"], {}),
+    ("kv-tiers-legacy", ["--multiturn"], {"TPUSERVE_KV_TIERS": "0"}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -71,6 +71,12 @@ PRIORITY = [
     # carries both engines), then mixed mode under the headline shape
     # and under sustained Poisson admission.
     "compare-mixed", "mixed", "mixed-poisson16",
+    # Tiered KV cache (NEW this round; ISSUE 7 acceptance): the
+    # multi-turn shared-prefix A/B at an HBM budget forcing eviction —
+    # turn>=2 TTFT tiered vs HBM-only is the headline; the legacy row
+    # pins the pre-tiering path under TPUSERVE_KV_TIERS=0 on the same
+    # commit.
+    "kv-tiers", "kv-tiers-legacy",
     # Host-overhead scaling on silicon (NEW this round; the CPU A/B in
     # BENCHMARKS.md "Host overhead" measured 2.3x less pure-host
     # ms/cycle at 256 streams with the native+batched host path): on TPU

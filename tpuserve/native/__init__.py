@@ -105,6 +105,7 @@ class NativeBlockManager:
         self._core = ext.BlockManagerCore(
             num_blocks, block_size,
             enable_prefix_caching=enable_prefix_caching)
+        self._record_evictions = False
 
     # ---- capacity -------------------------------------------------------
 
@@ -132,6 +133,44 @@ class NativeBlockManager:
                       count_stats: bool = True) -> tuple[list[int], int]:
         blocks = self._core.lookup_prefix(list(token_ids), count_stats)
         return blocks, len(blocks) * self.block_size
+
+    def prefix_chain(self, token_ids) -> list[int]:
+        return self._core.prefix_chain(list(token_ids))
+
+    def prefix_resolvable(self, h: int) -> bool:
+        return self._core.prefix_resolvable(int(h))
+
+    # ---- tiered KV cache: eviction log + restore state machine ----------
+
+    @property
+    def record_evictions(self) -> bool:
+        return self._record_evictions
+
+    @record_evictions.setter
+    def record_evictions(self, on: bool) -> None:
+        self._record_evictions = bool(on)
+        self._core.set_record_evictions(bool(on))
+
+    def take_evictions(self) -> list[tuple[int, int]]:
+        return self._core.take_evictions()
+
+    def begin_restore(self, hashes):
+        return self._core.begin_restore([int(h) for h in hashes])
+
+    def commit_restore(self, hashes, blocks) -> int:
+        return self._core.commit_restore([int(h) for h in hashes],
+                                         [int(b) for b in blocks])
+
+    def abort_restore(self, blocks) -> None:
+        self._core.abort_restore([int(b) for b in blocks])
+
+    @property
+    def num_restoring_blocks(self) -> int:
+        return self._core.num_restoring_blocks()
+
+    @property
+    def num_cached_blocks(self) -> int:
+        return self._core.num_cached_blocks()
 
     # ---- allocation -----------------------------------------------------
 

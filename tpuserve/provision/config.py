@@ -57,6 +57,19 @@ class DeployConfig:
     # Multi-LoRA serving: {adapter_name: path-inside-model-pvc}; forwarded
     # as --lora-modules so requests pick adapters by the "model" field
     lora_modules: Optional[dict] = None
+    # Tiered KV cache (runtime/kv_tiers.py): demote evicted prefix KV to
+    # host DRAM and from there to a spill dir on the model PVC instead of
+    # destroying it; restore asynchronously ahead of admission.  The
+    # reference's pods are stateless — every pod restart or cache miss
+    # re-prefills from zero (PARITY.md).
+    kv_tiers: bool = True
+    # host-DRAM tier byte budget (server --kv-host-bytes); 0 = engine
+    # default (TPUSERVE_KV_HOST_BYTES or 1 GiB)
+    kv_host_bytes: int = 0
+    # PVC spill dir for the third tier (server --kv-spill-dir); lives on
+    # the model PVC next to the compile caches so demoted prefixes
+    # survive pod restarts.  Empty = no spill tier.
+    kv_spill_dir: str = "/models/.kv-spill"
     # Admission backpressure cap (server --max-waiting); 0 = auto
     max_waiting: int = 0
     # Hang watchdog threshold (server --step-watchdog-s): a dispatch
@@ -180,6 +193,9 @@ class DeployConfig:
                 raise ValueError("lora_modules needs plain single-chip "
                                  "replicas (the engine rejects multi-LoRA "
                                  "with tp/pp/disagg/speculation)")
+        if self.kv_host_bytes < 0:
+            raise ValueError("kv_host_bytes must be >= 0 (0 = engine "
+                             "default)")
         if self.max_waiting < -1:
             raise ValueError("max_waiting must be >= -1")
         if self.drain_timeout_s < 0:

@@ -168,6 +168,14 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
     if cfg.lora_modules:
         args += ["--lora-modules"] + [f"{name}={path}" for name, path
                                       in cfg.lora_modules.items()]
+    if not cfg.kv_tiers:
+        args += ["--no-kv-tiers"]
+    elif cfg.kv_spill_dir:
+        # spill tier on the model PVC (mounted at /models): demoted
+        # prefixes survive pod restarts, like the compile caches below
+        args += ["--kv-spill-dir", cfg.kv_spill_dir]
+    if cfg.kv_tiers and cfg.kv_host_bytes:
+        args += ["--kv-host-bytes", str(cfg.kv_host_bytes)]
     if cfg.max_waiting:
         args += ["--max-waiting", str(cfg.max_waiting)]
     if cfg.step_watchdog_s:

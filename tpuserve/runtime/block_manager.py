@@ -52,6 +52,19 @@ class BlockManager:
         self._block_hash: dict[int, int] = {}   # physical block -> chain-hash
         self.prefix_hits = 0
         self.prefix_queries = 0
+        # Tiered KV cache (runtime/kv_tiers.py): with recording on, an
+        # eviction that kills a live prefix entry is LOGGED instead of
+        # silently forgotten — the engine drains the log before its next
+        # dispatch and demotes the block's still-intact device pages to
+        # the host tier.  Off by default: without a tier store the log
+        # would only grow.
+        self.record_evictions = False
+        self._evicted: list[tuple[int, int]] = []   # (block, chain-hash)
+        # restore-in-flight blocks (block -> chain-hash): popped from the
+        # free pool, being filled by an async host->HBM copy; in NO other
+        # pool until commit_restore parks them in the cached pool, so they
+        # can neither be evicted nor double-charged mid-copy.
+        self._restoring: dict[int, int] = {}
 
     # ---- capacity -------------------------------------------------------
 
@@ -69,10 +82,25 @@ class BlockManager:
     def _pop_free_block(self) -> int:
         if self._free:
             return self._free.pop()
-        # evict the LRU cached block: its prefix entry dies with it
+        # evict the LRU cached block: its prefix entry dies with it — or,
+        # with eviction recording on, is demoted to a lower tier by the
+        # engine (which drains the log before the dispatch that would
+        # overwrite the block's device pages)
         block, _ = self._cached.popitem(last=False)
+        if self.record_evictions:
+            h = self._block_hash.get(block)
+            if h is not None and self._prefix.get(h) == block:
+                self._evicted.append((block, h))
         self._drop_hash(block)
         return block
+
+    def take_evictions(self) -> list[tuple[int, int]]:
+        """Drain the (block, chain-hash) eviction log.  The blocks' device
+        pages are still intact — nothing writes KV outside a dispatch, and
+        the engine drains this before dispatching — so they can be copied
+        host-side and the hash stays resolvable in a lower tier."""
+        ev, self._evicted = self._evicted, []
+        return ev
 
     def _drop_hash(self, block: int) -> None:
         h = self._block_hash.pop(block, None)
@@ -112,6 +140,85 @@ class BlockManager:
         if blocks and count_stats:
             self.prefix_hits += 1
         return blocks, len(blocks) * self.block_size
+
+    def prefix_chain(self, token_ids: list[int]) -> list[int]:
+        """Chain hashes of EVERY full prompt block (same at-least-one-
+        token-uncached bound as lookup_prefix), regardless of residency —
+        the keys the tier store files demoted blocks under, so the engine
+        can probe lower tiers past the HBM hit.  Hash values are impl-
+        internal (Python hash() here, FNV-1a in native/): tier keys must
+        come from the same manager that will restore against them."""
+        if not self.enable_prefix_caching:
+            return []
+        hashes: list[int] = []
+        h = 0
+        for i in range((len(token_ids) - 1) // self.block_size):
+            chunk = tuple(token_ids[i * self.block_size:
+                                    (i + 1) * self.block_size])
+            h = self._chain_hash(h, chunk)
+            hashes.append(h)
+        return hashes
+
+    def prefix_resolvable(self, h: int) -> bool:
+        """Whether a chain hash currently resolves in HBM.  The engine's
+        demote drain filters on this: a block evicted early in a cycle
+        whose hash was RE-registered by a later allocation in the same
+        cycle (two requests sharing the prefix in one batch) must not be
+        demoted — HBM already holds the canonical copy, and a store copy
+        would violate exactly-one-tier."""
+        return h in self._prefix
+
+    # ---- tier restore (host/PVC -> HBM) ---------------------------------
+
+    def begin_restore(self, hashes: list[int]) -> Optional[list[int]]:
+        """Claim one free block per hash for an in-flight host->HBM
+        restore.  The blocks leave every pool (not free, not cached, not
+        owned by a sequence) until ``commit_restore``, so concurrent
+        allocation can neither evict nor double-charge them mid-copy.
+        Returns None without mutating when the pool can't cover it."""
+        if len(hashes) > self.num_free_blocks:
+            return None
+        blocks = [self._pop_free_block() for _ in hashes]
+        for b, h in zip(blocks, hashes):
+            self._restoring[b] = h
+        return blocks
+
+    def commit_restore(self, hashes: list[int], blocks: list[int]) -> int:
+        """Publish restored blocks: each becomes a cached-pool prefix
+        entry (MRU), exactly as if its original sequence had just freed
+        it — the next lookup_prefix resolves the hash in HBM again.  A
+        hash re-registered meanwhile (an identical prompt recomputed it)
+        returns its redundant block to the free list.  Returns the number
+        of prefix entries published."""
+        published = 0
+        for h, b in zip(hashes, blocks):
+            self._restoring.pop(b, None)
+            if h in self._prefix or b in self._block_hash:
+                self._free.append(b)    # raced with a fresh registration
+                continue
+            self._prefix[h] = b
+            self._block_hash[b] = h
+            self._cached[b] = None
+            self._cached.move_to_end(b)
+            published += 1
+        return published
+
+    def abort_restore(self, blocks: list[int]) -> None:
+        """Return claimed restore blocks to the free pool (copy failed or
+        the tier entry vanished); their pages were never published."""
+        for b in blocks:
+            self._restoring.pop(b, None)
+            self._free.append(b)
+
+    @property
+    def num_restoring_blocks(self) -> int:
+        return len(self._restoring)
+
+    @property
+    def num_cached_blocks(self) -> int:
+        """Freed-but-hashed blocks currently parked in the HBM cached
+        pool (the tier-0 occupancy the kv-tier gauges report)."""
+        return len(self._cached)
 
     def _register_prefix_blocks(self, seq_id: str, token_ids: list[int]) -> None:
         """Hash and publish this sequence's fully-written prompt blocks."""
@@ -364,7 +471,8 @@ class BlockManager:
             bucket = cand
         return picked, bucket
 
-    def check_integrity(self, expected_seq_ids=None) -> None:
+    def check_integrity(self, expected_seq_ids=None,
+                        tier_hashes=None) -> None:
         """Debug strict mode (``TPUSERVE_STRICT_BLOCKS``): verify the
         block accounting invariants the engine relies on, raising
         RuntimeError with every violation found.  The runtime complement
@@ -378,6 +486,12 @@ class BlockManager:
         live running + mid-chunk requests) — a sequence holding blocks
         with no live request is a leak; a live request without blocks is
         corruption.
+
+        ``tier_hashes``: when given (the engine passes its tier store's
+        resolvable hashes), the exactly-one-tier invariant is checked at
+        the hash level too: a chain hash resolvable in HBM must not also
+        be resolvable in a lower tier, and a restore-in-flight hash must
+        already have LEFT the tier store (``take`` removed it).
         """
         problems: list[str] = []
         owned: dict[int, int] = {}
@@ -407,17 +521,47 @@ class BlockManager:
             if b not in owned:
                 problems.append(
                     f"block {b} has refcount {rc} but no owning sequence")
-        accounted = free_set | cached_set | set(owned)
+        restoring_set = set(self._restoring)
+        for b in sorted(restoring_set):
+            # restore-in-flight blocks live in NO pool until commit: any
+            # overlap means the async host->HBM copy races an eviction or
+            # a sequence write into the same device page
+            if b in free_set:
+                problems.append(f"restore-in-flight block {b} also free")
+            if b in cached_set:
+                problems.append(f"restore-in-flight block {b} also cached")
+            if b in owned:
+                problems.append(
+                    f"restore-in-flight block {b} also owned by a live "
+                    "sequence (double-charged)")
+            if b in self._refcount:
+                problems.append(
+                    f"restore-in-flight block {b} carries a refcount")
+        accounted = free_set | cached_set | set(owned) | restoring_set
         if len(accounted) != self.num_blocks:
             lost = self.num_blocks - len(accounted)
             problems.append(
                 f"{lost} block(s) leaked: in neither the free list, the "
-                "cached pool, nor any sequence table")
+                "cached pool, the restore-in-flight set, nor any sequence "
+                "table")
         for h, b in self._prefix.items():
             if self._block_hash.get(b) != h:
                 problems.append(
                     f"prefix hash {h} maps to block {b} but the reverse "
                     "mapping disagrees")
+        if tier_hashes is not None:
+            tiered = set(tier_hashes)
+            both = tiered & set(self._prefix)
+            if both:
+                problems.append(
+                    f"{len(both)} chain hash(es) resolvable in BOTH HBM "
+                    f"and a lower tier (exactly-one-tier violated): "
+                    f"{sorted(both)[:4]}")
+            stuck = tiered & set(self._restoring.values())
+            if stuck:
+                problems.append(
+                    f"restore-in-flight hash(es) still resolvable in a "
+                    f"lower tier: {sorted(stuck)[:4]}")
         if expected_seq_ids is not None:
             extra = set(self._seqs) - set(expected_seq_ids)
             missing = set(expected_seq_ids) - set(self._seqs)

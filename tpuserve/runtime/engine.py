@@ -122,6 +122,21 @@ class EngineConfig:
     # 0 disables (the CPU-test default: interpreted kernels have no hang
     # bound worth enforcing).
     step_watchdog_s: float = 0.0
+    # Tiered KV cache (runtime/kv_tiers.py, ROADMAP item 1): when HBM
+    # pressure evicts a cached prefix block, demote its pages to a host-
+    # DRAM tier (bounded byte budget) and from there to a PVC spill dir,
+    # instead of freeing the KV; a later prompt whose prefix resolves in
+    # a lower tier is restored asynchronously ahead of admission and
+    # prefills only the uncached suffix.  None = auto: on whenever prefix
+    # caching is on (single-process, non-pp), subject to the
+    # TPUSERVE_KV_TIERS env kill switch (=0 restores byte-identical
+    # HBM-only behaviour — the same-commit A/B lever).
+    kv_tiers: Optional[bool] = None
+    # Host-DRAM tier byte budget; 0 = TPUSERVE_KV_HOST_BYTES or 1 GiB.
+    kv_host_bytes: int = 0
+    # PVC spill directory (third tier); None = TPUSERVE_KV_SPILL_DIR
+    # (unset: no spill tier, host-budget overflow is dropped).
+    kv_spill_dir: Optional[str] = None
     # Grammar-FSM guided decoding (runtime/grammar/): compile guided
     # specs to token-level FSMs whose per-state masks ride the fused
     # decode window (true logit masking, distribution-correct), so
@@ -203,6 +218,19 @@ class EngineStats:
     requests_poisoned: int = 0
     watchdog_trips: int = 0
     engine_restarts: int = 0
+    # tiered KV cache (runtime/kv_tiers.py): blocks demoted out of HBM
+    # into the host tier; host->PVC spills; blocks dropped off the last
+    # tier (KV lost, re-prefill on next use); blocks restored back into
+    # HBM; restore operations begun.  restore_latencies holds the
+    # begin->commit wall times of recent restores (drained into the
+    # tpuserve_kv_restore_latency_seconds histogram by server/runner.py;
+    # bounded so a runner-less engine can't grow it without bound).
+    kv_demoted_blocks: int = 0
+    kv_spilled_blocks: int = 0
+    kv_tier_dropped_blocks: int = 0
+    kv_restored_blocks: int = 0
+    kv_restores: int = 0
+    restore_latencies: list = dataclasses.field(default_factory=list)
     ttft_sum: float = 0.0
     ttft_count: int = 0
     # recent per-token latencies (decode step wall time / batch)
@@ -396,6 +424,26 @@ class Engine:
         self.block_manager = create_block_manager(
             self.cache_cfg.num_blocks, self.cache_cfg.block_size,
             enable_prefix_caching=prefix_caching)
+        # Tiered KV cache (runtime/kv_tiers.py): demote evicted prefix
+        # blocks to host DRAM / PVC instead of losing the KV; restore
+        # asynchronously ahead of admission.  Gated off under pp (the
+        # stage-stacked cache has a different page layout) and multi-host
+        # (the lockstep protocol doesn't mirror the scatter dispatches).
+        import os as _os_t
+        self._kv_tiers = None
+        self._restores: dict[str, tuple] = {}   # rid -> (hashes, blocks, t0)
+        tiers_on = config.kv_tiers
+        if tiers_on is None:
+            tiers_on = env_flag("TPUSERVE_KV_TIERS")
+        if (tiers_on and prefix_caching and self._pp == 1
+                and jax.process_count() == 1):
+            from tpuserve.runtime.kv_tiers import TieredPageStore
+            host_bytes = config.kv_host_bytes or int(
+                _os_t.environ.get("TPUSERVE_KV_HOST_BYTES", 0) or (1 << 30))
+            spill = (config.kv_spill_dir
+                     or _os_t.environ.get("TPUSERVE_KV_SPILL_DIR") or None)
+            self._kv_tiers = TieredPageStore(host_bytes, spill_dir=spill)
+            self.block_manager.record_evictions = True
         sched_cfg = config.scheduler
         if sched_cfg.mixed_batching and (self._pp > 1
                                          or jax.process_count() > 1):
@@ -819,8 +867,12 @@ class Engine:
             # shapes from a remote pod, a failed scatter) exits with
             # blocks that neither abort_request nor salvage can find —
             # found by tpulint's kv-leak pass.
+            self._drop_superseded_tier_entries(prompt_token_ids)
             seq_kv = [{kk: jnp.asarray(a) for kk, a in l.items()}
                       for l in seq_kv]
+            # the allocate above may have evicted cached blocks that the
+            # scatter below immediately overwrites — demote them first
+            self._demote_evicted()
             self.kv_cache = insert_seq_kv(self.kv_cache, seq_kv,
                                           alloc.blocks)
             req.output_token_ids.append(first_token)
@@ -948,8 +1000,12 @@ class Engine:
         return [r.request_id for r in cohort]
 
     def has_work(self) -> bool:
+        # _restores counts as work: an in-flight tier restore must reach
+        # its commit step even if every request was aborted meanwhile, or
+        # its blocks would sit in the restore-in-flight set forever
         return (self.scheduler.has_work() or self._pending is not None
-                or self._pending_window is not None)
+                or self._pending_window is not None
+                or bool(self._restores))
 
     # ------------------------------------------------------------------
     # Step
@@ -974,11 +1030,23 @@ class Engine:
         holders = {r.request_id for r in self.scheduler.running}
         holders |= {r.request_id for r in self.scheduler.waiting
                     if r.num_prefilled > 0}
-        chk(expected_seq_ids=holders)
+        # tiered mode: also verify the exactly-one-tier invariant (a hash
+        # resolvable in HBM must not be in the tier store, and restore-
+        # in-flight hashes must already have LEFT it)
+        tier_hashes = (list(self._kv_tiers.hashes())
+                       if self._kv_tiers is not None else None)
+        chk(expected_seq_ids=holders, tier_hashes=tier_hashes)
 
     def _step_inner(self) -> list[RequestOutput]:
         self._dispatch_rids = ()
         PROF.bump_cycle()
+        if self._kv_tiers is not None:
+            # commit FIRST: last cycle's restored prefixes become HBM
+            # prefix entries, so their requests admit THIS cycle with the
+            # restored span as shared blocks; then start new restores,
+            # whose copies overlap the batch dispatched below
+            self._commit_tier_restores()
+            self._begin_tier_restores()
         with PROF.phase("schedule"):
             batch = self.scheduler.schedule()
         if batch is None:
@@ -1040,6 +1108,143 @@ class Engine:
             if r.num_prefilled > 0:
                 self.stats.released_blocks += bm.release_out_of_window(
                     r.request_id, max(0, r.num_prefilled - W))
+
+    # ---- tiered KV cache (runtime/kv_tiers.py) ------------------------
+    # HBM -> host-DRAM -> PVC prefix offload: evictions demote instead of
+    # destroying KV, lower-tier hits restore asynchronously ahead of
+    # admission.  TPUSERVE_KV_TIERS=0 (or kv_tiers=False) removes all of
+    # it — self._kv_tiers is None and no path below runs.
+
+    def _demote_evicted(self) -> None:
+        """Drain the block manager's eviction log and demote the evicted
+        blocks' device pages into the tier store.  MUST run before any
+        dispatch that could overwrite those pages (every _run_* path
+        calls this right before its _exec_*; adopt_prefilled before its
+        KV scatter): until that dispatch executes, the pages still hold
+        the evicted prefix's KV, so one fused gather + one device_get
+        moves the whole cycle's evictions host-side."""
+        store = self._kv_tiers
+        if store is None:
+            return
+        # filter out hashes that became HBM-resolvable again since their
+        # eviction (a later allocation in the SAME cycle recomputed and
+        # re-registered the prefix — two requests sharing it in one
+        # batch): HBM holds the canonical copy, demoting the stale block
+        # would put the hash in two tiers at once
+        ev = [(b, h) for b, h in self.block_manager.take_evictions()
+              if not self.block_manager.prefix_resolvable(h)]
+        if not ev:
+            return
+        from tpuserve.runtime.kv_cache import gather_block_pages
+        pages = gather_block_pages(self.kv_cache, [b for b, _ in ev])
+        for (_b, h), p in zip(ev, pages):
+            store.put(h, p)
+        self.stats.kv_demoted_blocks += len(ev)
+        self.stats.kv_spilled_blocks = store.spilled_blocks
+        self.stats.kv_tier_dropped_blocks = store.dropped_blocks
+
+    def _drop_superseded_tier_entries(self, ids: list[int]) -> None:
+        """Called right after a first allocate: the request's prefill is
+        about to (re)compute and re-register every full block of ``ids``
+        that wasn't served from HBM — any tier-store copies of those
+        hashes are now superseded and must leave the store, or the
+        exactly-one-tier invariant breaks the moment the recompute
+        publishes the hash in HBM (and the stale host/PVC copies squat
+        on budget forever).  The common case costs one chain walk per
+        admission, which admission already pays twice (lookup +
+        register)."""
+        store = self._kv_tiers
+        if store is None or len(store) == 0:
+            return
+        # registration hashes len//block_size full blocks, ONE more than
+        # prefix_chain's lookup bound when the length is an exact block
+        # multiple (lookup leaves a token uncached; registration doesn't)
+        # — the appended dummy token raises the bound to the registered
+        # chain without changing any hash
+        for h in self.block_manager.prefix_chain(list(ids) + [0]):
+            store.drop(h)
+
+    def _begin_tier_restores(self) -> None:
+        """Restore lower-tier prefix hits for head-of-queue requests: claim
+        blocks (restore-in-flight: in no pool, un-evictable), take the
+        pages out of the tier store, and dispatch the host->HBM scatter
+        WITHOUT waiting on it — the copy overlaps whatever this cycle
+        dispatches, and the request (held in RESTORING for the cycle)
+        admits next cycle with the restored span as a prefix-cache hit,
+        prefilling only the uncached suffix."""
+        store = self._kv_tiers
+        if not store or len(store) == 0 or not self.scheduler.waiting:
+            return
+        from tpuserve.runtime.kv_cache import scatter_block_pages
+        bm = self.block_manager
+        seats = self.config.scheduler.max_prefill_seqs
+        for req in list(self.scheduler.waiting)[:seats]:
+            if (req.state == RequestState.RESTORING
+                    or req.num_prefilled > 0):
+                continue
+            ids = self._prefill_tokens(req)
+            hashes = bm.prefix_chain(ids)
+            if not hashes:
+                continue
+            shared, _ = bm.lookup_prefix(ids, count_stats=False)
+            k = len(shared)
+            span: list[int] = []
+            while (k + len(span) < len(hashes)
+                   and store.has(hashes[k + len(span)])):
+                span.append(hashes[k + len(span)])
+            if not span:
+                continue
+            # the request's total fresh-block demand is independent of how
+            # much we restore (restored blocks are revived as shared at
+            # allocate): everything past the HBM hit plus decode headroom
+            # must fit, or the restore would just thrash the cached pool
+            if bm.blocks_needed(len(ids)) - k + 1 > bm.num_free_blocks:
+                continue
+            blocks = bm.begin_restore(span)
+            if blocks is None:
+                continue
+            pages = []
+            for h in span:
+                p = store.take(h)
+                if p is None:       # unreadable spill entry mid-chain:
+                    break           # restore only the intact prefix
+                pages.append(p)
+            if len(pages) < len(span):
+                bm.abort_restore(blocks[len(pages):])
+                blocks, span = blocks[:len(pages)], span[:len(pages)]
+                # the unreadable entry was dropped as LOST KV — surface
+                # the store's counter without waiting for the next demote
+                self.stats.kv_tier_dropped_blocks = store.dropped_blocks
+            if not blocks:
+                continue
+            # claiming restore blocks can itself evict cold cached blocks
+            # — demote THEM before the scatter below overwrites the pages
+            self._demote_evicted()
+            self.kv_cache = scatter_block_pages(self.kv_cache, blocks,
+                                                pages)
+            req.state = RequestState.RESTORING
+            self._restores[req.request_id] = (span, blocks,
+                                              time.monotonic())
+            self.stats.kv_restores += 1
+            self.stats.kv_restored_blocks += len(blocks)
+
+    def _commit_tier_restores(self) -> None:
+        """Publish last cycle's restored blocks as HBM prefix entries and
+        release their requests back to WAITING.  Safe without a sync: the
+        scatter was dispatched a cycle ago, and any prefill that reads
+        the restored pages is dispatched after this — device execution
+        order does the rest."""
+        if not self._restores:
+            return
+        now = time.monotonic()
+        for rid, (span, blocks, t0) in self._restores.items():
+            self.block_manager.commit_restore(span, blocks)
+            req = self.requests.get(rid)
+            if req is not None and req.state == RequestState.RESTORING:
+                req.state = RequestState.WAITING
+            if len(self.stats.restore_latencies) < 512:
+                self.stats.restore_latencies.append(now - t0)
+        self._restores.clear()
 
     def _note_step_tokens(self, actual: int, padded: int) -> None:
         """Record one dispatch's real vs padded token counts (the
@@ -1317,11 +1522,13 @@ class Engine:
             self.faults.check("kv_alloc", (req.request_id,))
             shared, _cached = self.block_manager.lookup_prefix(ids)
             self.block_manager.allocate(req.request_id, ids, shared_blocks=shared)
+            self._drop_superseded_tier_entries(ids)
             tokens[i, :len(ids)] = ids
             prompt_lens[i] = len(ids)
             slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
                                                        len(ids))
         kw = self._lora_kw(reqs, B)
+        self._demote_evicted()
         with PROF.phase("dispatch"):
             logits, self.kv_cache = self._exec_prefill(
                 jnp.asarray(tokens), jnp.asarray(prompt_lens),
@@ -1371,6 +1578,7 @@ class Engine:
             shared, cached = self.block_manager.lookup_prefix(ids)
             self.block_manager.allocate(req.request_id, ids,
                                         shared_blocks=shared)
+            self._drop_superseded_tier_entries(ids)
             # Compute skip: the shared blocks already hold valid KV for the
             # cached tokens, so prefill starts at the cached offset instead
             # of recomputing them (lookup always leaves >= 1 token to
@@ -1390,6 +1598,7 @@ class Engine:
                                 np.int32)
         block_tables[0, :len(bt)] = bt
         kw = self._lora_kw([req], 1)
+        self._demote_evicted()
         logits, self.kv_cache = self._exec_prefill_chunk(
             jnp.asarray(tokens),
             jnp.asarray(np.asarray([done], np.int32)),
@@ -1468,6 +1677,7 @@ class Engine:
                 except MemoryError:
                     self.scheduler.waiting.appendleft(req)
                     continue
+                self._drop_superseded_tier_entries(ids)
                 req.num_prefilled = cached
             done = req.num_prefilled
             take = min(n, len(ids) - done)
@@ -1547,6 +1757,7 @@ class Engine:
                 if req.adapter_idx is not None:
                     ad_rows[start:start + take, req.adapter_idx] = 1.0
             kw["ad"] = jnp.asarray(ad_rows)
+        self._demote_evicted()
         with PROF.phase("dispatch"):
             logits, self.kv_cache = self._exec_forward_ragged(
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -1789,6 +2000,7 @@ class Engine:
                                     jnp.asarray(use_host))
         else:
             tokens = jnp.asarray(host_tokens)
+        self._demote_evicted()
         with PROF.phase("dispatch"):
             res = self._exec_decode_multi(
                 tokens, jnp.asarray(positions),
@@ -2094,6 +2306,7 @@ class Engine:
         else:
             tokens = jnp.asarray(host_tokens)
         kw = self._lora_kw(reqs, B)
+        self._demote_evicted()
         with PROF.phase("dispatch"):
             logits, self.kv_cache = self._exec_decode(
                 tokens, jnp.asarray(positions), jnp.asarray(slot_arr),
@@ -2162,6 +2375,7 @@ class Engine:
             slot_ids[i] = self._token_slots(r.request_id, base[i], K,
                                             block_table=block_tables[i])
         sampled = not all(r.params.greedy for r in reqs)
+        self._demote_evicted()
         accept_h = None
         if sampled:
             keys = np.zeros((B, 2), np.uint32)
@@ -3342,6 +3556,18 @@ class Engine:
                     jnp.full((Tm // blkm,), -1, jnp.int32),
                     jnp.zeros((Bm,), jnp.int32), **mkw)
                 self._warm_sampling(logits, sample_modes)
+        if self._kv_tiers is not None:
+            # tiered KV cache: the demote gather and restore scatter pad
+            # their block axis to a power of two — warm the small end of
+            # that ladder so the first eviction burst doesn't stall the
+            # loop on page-copy compiles (bigger buckets compile on
+            # demand; they only occur under heavy pressure)
+            from tpuserve.runtime.kv_cache import (gather_block_pages,
+                                                   scatter_block_pages)
+            for n in (1, 2, 4, 8, 16):
+                pages = gather_block_pages(self.kv_cache, [0] * n)
+                self.kv_cache = scatter_block_pages(self.kv_cache,
+                                                    [0] * n, pages)
         if embed_buckets:
             if self._pp > 1:
                 raise ValueError("embeddings not supported on the pipeline "

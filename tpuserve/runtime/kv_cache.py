@@ -11,6 +11,7 @@ here capacity is derived from the HBM budget).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +78,79 @@ def num_blocks_for_budget(model_cfg: ModelConfig, cache_cfg: CacheConfig,
             "device/share, quantize the weights, or set num_blocks "
             "explicitly")
     return max(budget // bytes_per_block(model_cfg, cache_cfg), 16)
+
+
+# --------------------------------------------------------------------------
+# Device <-> host page copies (the tiered KV cache's data plane,
+# runtime/kv_tiers.py).  Both directions move WHOLE physical blocks keyed
+# by block id, preserving dtype — int8 KV pages demote at half the bytes
+# of bf16, exactly the capacity ratio they have in HBM.
+# --------------------------------------------------------------------------
+
+
+@jax.jit
+def _gather_pages(cache, idx):
+    """One fused gather of ``idx`` blocks' pages from every layer/array."""
+    return [{k: v[idx] for k, v in layer.items()} for layer in cache]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(cache, idx, pages):
+    """Scatter host pages back into the donated cache arrays in place."""
+    return [{k: v.at[idx].set(pages[li][k].astype(v.dtype))
+             for k, v in layer.items()}
+            for li, layer in enumerate(cache)]
+
+
+def gather_block_pages(kv_cache: list[dict], blocks: list[int]) -> list[list[dict]]:
+    """Copy the given physical blocks' KV pages to host numpy, returned
+    per block: ``out[i]`` is a per-layer ``{key: (block_size, heads,
+    head_dim) ndarray}`` list for ``blocks[i]`` — the value format the
+    tier store (kv_tiers.TieredPageStore) files.
+
+    ONE gather dispatch + ONE device_get for the whole batch, however
+    many blocks evicted this cycle: demotion is a per-cycle cost, not a
+    per-block one.  The sync is safe by construction — the engine drains
+    evictions BEFORE dispatching the step that would overwrite these
+    pages, so the read is ordered after every write that produced them.
+
+    The block-count axis is padded to a power of two (repeating the last
+    id; the extra gathers are discarded) so the jitted gather compiles a
+    log-sized executable ladder instead of one per distinct eviction
+    count.
+    """
+    from tpuserve.utils import next_power_of_2
+    n = len(blocks)
+    padded = list(blocks) + [blocks[-1]] * (next_power_of_2(n) - n)
+    idx = jnp.asarray(padded, jnp.int32)
+    batched = jax.device_get(_gather_pages(kv_cache, idx))
+    return [[{k: v[i] for k, v in layer.items()} for layer in batched]
+            for i in range(n)]
+
+
+def scatter_block_pages(kv_cache: list[dict], blocks: list[int],
+                        pages: list[list[dict]]) -> list[dict]:
+    """Write per-block host pages (the ``gather_block_pages`` format)
+    back into the cache at ``blocks``; returns the new (donated) cache.
+    Dispatch-only — no sync: the copy lands on device asynchronously,
+    ordered before any later-dispatched step that reads the pages, which
+    is what lets a restore overlap the current fused window.
+
+    Pads the block axis to a power of two by REPEATING the last
+    (block, page) pair — duplicate scatters of identical content are
+    idempotent — bounding the executable ladder like the gather."""
+    import numpy as np
+
+    from tpuserve.utils import next_power_of_2
+    n = len(blocks)
+    pad = next_power_of_2(n) - n
+    padded_blocks = list(blocks) + [blocks[-1]] * pad
+    rows = list(range(n)) + [n - 1] * pad
+    idx = jnp.asarray(padded_blocks, jnp.int32)
+    batched = [{k: np.stack([pages[i][li][k] for i in rows])
+                for k in pages[0][li]}
+               for li in range(len(pages[0]))]
+    return _scatter_pages(kv_cache, idx, batched)
 
 
 def create_kv_cache(model_cfg: ModelConfig, cache_cfg: CacheConfig,

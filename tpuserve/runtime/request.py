@@ -16,6 +16,12 @@ from typing import Optional, Sequence
 
 class RequestState(enum.Enum):
     WAITING = "waiting"
+    # Tiered KV cache: a lower-tier prefix hit is being copied back into
+    # HBM ahead of this request's admission (engine._begin_tier_restores).
+    # The request stays in the waiting queue but the scheduler holds its
+    # admission for the one cycle the async host->HBM copy overlaps with;
+    # it then prefills only the uncached suffix.
+    RESTORING = "restoring"
     RUNNING = "running"
     PREEMPTED = "preempted"
     FINISHED = "finished"

@@ -269,6 +269,14 @@ class Scheduler:
                                       padded_len=self._chunk_bucket(
                                           req.num_tokens - req.num_prefilled))
         head = self.waiting[0]
+        # Tiered KV cache: a head request whose lower-tier prefix is mid-
+        # restore holds admission for the cycle the async host->HBM copy
+        # overlaps (engine._begin_tier_restores) — it admits next cycle
+        # with the restored span as a prefix-cache hit and prefills only
+        # the uncached suffix.  Same shape as waiting for blocks: the
+        # caller falls through to a decode step.
+        if head.state == RequestState.RESTORING:
+            return None
         # Long prompts chunk by necessity (checked first — no cache probe,
         # which would re-hash an unbounded prompt every scheduling cycle
         # while it waits for blocks).
@@ -306,6 +314,10 @@ class Scheduler:
                 break
             if (self.cfg.allow_chunked_prefill
                     and req.num_tokens > self.cfg.prefill_chunk_size):
+                break
+            if req.state == RequestState.RESTORING:
+                # mid-restore: its prefix lands in HBM next cycle — the
+                # head segment stops here (FIFO order preserved)
                 break
             counts.append(req.num_tokens)
         if not counts:
@@ -390,6 +402,8 @@ class Scheduler:
                 seats -= 1
         while self.waiting and budget >= align and seats > 0:
             head = self.waiting[0]
+            if head.state == RequestState.RESTORING:
+                break                    # prefix mid-restore: admit next cycle
             need = self.block_manager.blocks_needed(head.num_tokens) + 1
             if need > free:
                 break                        # wait for blocks to free up

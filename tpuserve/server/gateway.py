@@ -62,6 +62,17 @@ class Backend:
     outstanding: int = 0
     last_checked: float = 0.0
     consecutive_failures: int = 0
+    # cache-affinity advertisement parsed off /healthz (kv_digest.py): a
+    # bloom digest of the prefix keys this backend has served, windowed
+    # to its cache reach across the KV tiers.  Empty until the first
+    # probe (selection then falls back to the static rendezvous ring).
+    # kv_digest_chars is the backend's OWN key-derivation prefix length:
+    # membership probes must hash with the backend's value, not the
+    # gateway's, or a non-default affinity_prefix_chars silently turns
+    # every probe into a miss.
+    kv_digest: str = ""
+    kv_digest_bits: int = 0
+    kv_digest_chars: int = 0
 
 
 @dataclasses.dataclass
@@ -98,20 +109,24 @@ class Gateway:
 
     # ---- backend selection ---------------------------------------------
 
-    def _prefix_key(self, body: bytes) -> Optional[str]:
+    def _affinity_payload(self, body: bytes) -> Optional[dict]:
         try:
             payload = json.loads(body)
-            prompt = payload.get("prompt")
-            if isinstance(prompt, list):
-                prompt = "".join(map(str, prompt[:64]))
-            if not prompt and isinstance(payload.get("messages"), list):
-                prompt = json.dumps(payload["messages"])[:512]
-            if not isinstance(prompt, str) or not prompt:
-                return None
-            return hashlib.sha256(
-                prompt[: self.config.affinity_prefix_chars].encode()).hexdigest()
         except Exception:
             return None
+        return payload if isinstance(payload, dict) else None
+
+    def _prefix_key(self, body: bytes) -> Optional[str]:
+        # shared derivation (server/kv_digest.affinity_key): the backends
+        # track the SAME key function into their advertised digests, so a
+        # digest probe here and a tracker note there can never hash
+        # differently (prefix lengths are reconciled per backend in
+        # pick_backend — each advertises its own on /healthz)
+        from tpuserve.server.kv_digest import affinity_key
+        payload = self._affinity_payload(body)
+        if payload is None:
+            return None
+        return affinity_key(payload, self.config.affinity_prefix_chars)
 
     @staticmethod
     def _rendezvous_target(key: str, pool: list[Backend]) -> Backend:
@@ -137,11 +152,39 @@ class Gateway:
             pool = (healthy
                     or [b for b in self.backends if b.url not in ex]
                     or self.backends)
-            key = self._prefix_key(body) if body else None
+            from tpuserve.server.kv_digest import affinity_key, digest_has
+            payload = self._affinity_payload(body) if body else None
+            chars = self.config.affinity_prefix_chars
+            key = (affinity_key(payload, chars)
+                   if payload is not None else None)
             least = min(pool, key=lambda b: b.outstanding)
             chosen = least
             if key is not None:
-                target = self._rendezvous_target(key, pool)
+                # Cache-aware affinity: backends whose advertised digest
+                # says they HAVE this prefix (across HBM/host/PVC tiers)
+                # outrank the static ring's guess — after failovers or
+                # slack diversions, the replica actually holding a
+                # conversation's KV is often not the rendezvous target.
+                # Membership is probed with EACH backend's advertised
+                # prefix length (keys memoised per length), so a gateway
+                # configured with a non-default affinity_prefix_chars
+                # still matches what the backends tracked.  Rendezvous
+                # WITHIN the digest-hit subset keeps multiple gateway
+                # replicas deterministic for the same backend state; no
+                # digest info (old backends, first probe pending)
+                # degrades to the plain ring.
+                keys_by_chars = {chars: key}
+
+                def bkey(b):
+                    c = b.kv_digest_chars or chars
+                    if c not in keys_by_chars:
+                        keys_by_chars[c] = affinity_key(payload, c)
+                    return keys_by_chars[c]
+
+                hits = [b for b in pool
+                        if digest_has(b.kv_digest, b.kv_digest_bits,
+                                      bkey(b))]
+                target = self._rendezvous_target(key, hits or pool)
                 if (target.outstanding - least.outstanding
                         <= self.config.affinity_load_slack):
                     chosen = target
@@ -176,11 +219,22 @@ class Gateway:
         ones that stopped answering.  The background loop below is just
         this on a timer."""
         for b in self.backends:
+            digest, digest_bits, digest_chars = None, 0, 0
             try:
                 with urllib.request.urlopen(
                         b.url + "/healthz",
                         timeout=self.config.health_timeout_s) as resp:
                     ok = resp.status == 200
+                    if ok:
+                        try:
+                            info = json.loads(resp.read())
+                            digest = info.get("kv_digest")
+                            digest_bits = int(info.get("kv_digest_bits")
+                                              or 0)
+                            digest_chars = int(info.get("kv_digest_chars")
+                                               or 0)
+                        except Exception:
+                            pass     # plain-liveness backend: no digest
             except Exception:
                 ok = False
             with self._lock:
@@ -190,6 +244,10 @@ class Gateway:
                                     "passed)", b.url)
                     b.healthy = True
                     b.consecutive_failures = 0
+                    if isinstance(digest, str):
+                        b.kv_digest = digest
+                        b.kv_digest_bits = digest_bits
+                        b.kv_digest_chars = digest_chars
                 else:
                     b.healthy = False
                 b.last_checked = time.monotonic()

@@ -76,11 +76,15 @@ class ServerMetrics:
             "--multi-step; no vLLM analog)")
         self.prefix_hits = counter(
             "tpuserve_prefix_cache_hits",
-            "Prompt blocks served from the prefix cache (vLLM "
-            "gpu_prefix_cache_hit_rate analog: divide by queries)")
+            "Prefix-cache lookups that found at least one cached block "
+            "(vLLM gpu_prefix_cache_hit_rate analog: divide by queries)."
+            "  Counted once PER LOOKUP, exactly like queries — the two "
+            "must share a unit or the hit-rate gauge lies when the "
+            "first block already misses")
         self.prefix_queries = counter(
             "tpuserve_prefix_cache_queries",
-            "Prompt blocks looked up in the prefix cache")
+            "Prefix-cache lookups performed (one per real admission "
+            "lookup; scheduler routing peeks don't count)")
         self.spec_proposed = counter(
             "tpuserve_spec_draft_tokens_proposed",
             "Draft tokens offered to the speculative verifier (vLLM "
@@ -168,6 +172,39 @@ class ServerMetrics:
             "salvage window, unrecoverable hangs, or engines without "
             "the salvage hook — each count failed every in-flight "
             "stream (the pre-salvage crash-only behaviour)")
+        # Tiered KV cache (runtime/kv_tiers.py): per-tier residency plus
+        # the demote/restore/spill flow.  tier= one of "hbm" (freed-but-
+        # hashed blocks parked in the device cached pool), "host"
+        # (demoted pages in host DRAM under the byte budget), "spill"
+        # (PVC .npz overflow).
+        self.kv_tier_blocks = Gauge(
+            "tpuserve_kv_tier_blocks",
+            "Prefix-cache KV blocks resident per tier (exactly-one-tier "
+            "invariant: a chain hash resolves in hbm, host, OR spill)",
+            ["model_name", "tier"], registry=self.registry)
+        self.kv_demoted = counter(
+            "tpuserve_kv_blocks_demoted",
+            "Prefix blocks demoted out of HBM into the host-DRAM tier "
+            "instead of destroyed on eviction (tiered KV cache; "
+            "TPUSERVE_KV_TIERS=0 restores destroy-on-evict)")
+        self.kv_spilled = counter(
+            "tpuserve_kv_blocks_spilled",
+            "Host-tier blocks cascaded to the PVC spill tier under "
+            "host-byte-budget pressure")
+        self.kv_tier_dropped = counter(
+            "tpuserve_kv_blocks_tier_dropped",
+            "Blocks that fell off the LAST tier (KV lost; the next "
+            "reuse pays full prefill) — rising fast means the spill "
+            "tier is undersized for the reuse window")
+        self.kv_restored = counter(
+            "tpuserve_kv_blocks_restored",
+            "Prefix blocks copied back host->HBM ahead of admission "
+            "(each one is a block of prefill compute a request skipped)")
+        self.kv_restore_latency = histogram(
+            "tpuserve_kv_restore_latency_seconds",
+            "Tier-restore begin->commit wall time (the async copy "
+            "overlaps the current dispatch; this is the admission hold, "
+            "one engine cycle + copy tail)", _ITL_BUCKETS)
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

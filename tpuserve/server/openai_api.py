@@ -279,6 +279,13 @@ class OpenAIServer:
         if self.config.chat_template:
             import jinja2
             self._chat_template = jinja2.Template(self.config.chat_template)
+        # Cache-aware routing (server/kv_digest.py): affinity keys of the
+        # prompts this replica has served, rendered as the bloom digest
+        # /healthz advertises — the gateway's rendezvous prefix affinity
+        # weighs what a replica HAS cached across tiers, not just where
+        # the static ring says a prefix should live.
+        from tpuserve.server.kv_digest import PrefixDigestTracker
+        self.kv_digest = PrefixDigestTracker()
         self.tpu_exporter = None
         if self.config.tpu_metrics:
             try:
@@ -567,7 +574,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
         elif self.path == "/healthz":
-            self._json(200, {"status": "ok"})
+            self._json(200, self._healthz_payload())
         elif self.path == "/readyz":
             if ctx.ready.is_set():
                 self._json(200, {"status": "ready"})
@@ -588,6 +595,48 @@ class _Handler(BaseHTTPRequestHandler):
                             "server_error")
         else:
             self._error(404, f"no route {self.path}")
+
+    def _healthz_payload(self) -> dict:
+        """Liveness plus the cache-affinity advertisement: the prefix
+        digest (server/kv_digest.py) and per-tier KV residency.  Reads
+        are count/snapshot-only — nothing here touches engine-loop-owned
+        block state — and the digest window resizes with the replica's
+        total cache reach across tiers, so a tiered replica advertises
+        the (much longer) retention it actually has."""
+        ctx = self.ctx
+        out: dict = {"status": "ok"}
+        try:
+            engines = [e for e in (getattr(ctx.engine, "prefill", None),
+                                   getattr(ctx.engine, "decode", None))
+                       if e is not None] or [ctx.engine]
+            tiers = {"hbm": 0, "host": 0, "spill": 0}
+            reach = 0
+            for e in engines:
+                bm = getattr(e, "block_manager", None)
+                tiers["hbm"] += getattr(bm, "num_cached_blocks", 0)
+                store = getattr(e, "_kv_tiers", None)
+                if store is not None:
+                    tiers["host"] += store.host_count
+                    tiers["spill"] += store.spill_count
+                reach += getattr(bm, "num_blocks", 0) + (len(store)
+                                                         if store else 0)
+            if reach:
+                # reach is in BLOCKS; a tracked key is a whole prompt
+                # prefix (several blocks) — divide so the digest window
+                # approximates retained conversations, not pages
+                ctx.kv_digest.resize(max(4096, reach // 4))
+            out["kv_tier_blocks"] = tiers
+            out["kv_digest"] = ctx.kv_digest.digest_hex()
+            out["kv_digest_bits"] = ctx.kv_digest.bits
+            # the key-derivation prefix length this tracker hashed with:
+            # the gateway probes membership using OUR value, so its own
+            # affinity_prefix_chars setting can't silently de-sync the
+            # digest (kv_digest.py)
+            from tpuserve.server.kv_digest import AFFINITY_PREFIX_CHARS
+            out["kv_digest_chars"] = AFFINITY_PREFIX_CHARS
+        except Exception:       # liveness must never fail on telemetry
+            pass
+        return out
 
     def do_POST(self):
         # enter BEFORE the draining check: checking first races drain()'s
@@ -644,6 +693,13 @@ class _Handler(BaseHTTPRequestHandler):
                 body.get("stream_options"), dict):
             self._error(400, "'stream_options' must be an object")
             return
+        # digest the affinity key only after every API-layer validation
+        # has passed: a 400'd request caches no KV and must not steer the
+        # gateway here.  (Engine-side rejects — oversize prompt, 503
+        # backpressure — can still note a key; the bit is advisory and
+        # ages out of the LRU window.)
+        from tpuserve.server.kv_digest import affinity_key
+        self.ctx.kv_digest.note(affinity_key(body))
         kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
                   else {"prompt": prompt})
         # multi-LoRA routing (vLLM semantics): "model" naming a loaded
@@ -1577,6 +1633,19 @@ def main(argv=None):
     ap.add_argument("--min-multi-step", type=int, default=4,
                     help="window size while arrivals are landing "
                          "(adaptive window sizing; default 4)")
+    ap.add_argument("--no-kv-tiers", action="store_true",
+                    help="disable the tiered KV cache (HBM -> host-DRAM "
+                         "-> PVC prefix offload; runtime/kv_tiers.py) — "
+                         "evicted prefix blocks are destroyed instead of "
+                         "demoted, the pre-tiering behaviour "
+                         "(TPUSERVE_KV_TIERS=0 is the env twin)")
+    ap.add_argument("--kv-host-bytes", type=int, default=0,
+                    help="host-DRAM KV tier byte budget (0 = "
+                         "TPUSERVE_KV_HOST_BYTES or 1 GiB)")
+    ap.add_argument("--kv-spill-dir", default=None, metavar="DIR",
+                    help="PVC spill directory for the third KV tier "
+                         "(default: TPUSERVE_KV_SPILL_DIR; unset = no "
+                         "spill tier, host overflow is dropped)")
     ap.add_argument("--kv-cache-dtype", default="bfloat16",
                     choices=["bfloat16", "float32", "int8"],
                     help="KV cache storage dtype; int8 quantizes on write "
@@ -1673,6 +1742,8 @@ def main(argv=None):
         adaptive_multi_step=not args.no_adaptive_window,
         min_multi_step=args.min_multi_step,
         quantization=args.quantization,
+        kv_tiers=False if args.no_kv_tiers else None,
+        kv_host_bytes=args.kv_host_bytes, kv_spill_dir=args.kv_spill_dir,
         faults=args.faults, step_watchdog_s=args.step_watchdog_s)
     mesh = None
     if args.pp > 1 and args.tp > 1:

@@ -645,6 +645,14 @@ class AsyncEngineRunner:
                                   self.metrics.actual_tokens_total),
                                  ("num_mixed_steps",
                                   self.metrics.mixed_steps),
+                                 ("kv_demoted_blocks",
+                                  self.metrics.kv_demoted),
+                                 ("kv_spilled_blocks",
+                                  self.metrics.kv_spilled),
+                                 ("kv_tier_dropped_blocks",
+                                  self.metrics.kv_tier_dropped),
+                                 ("kv_restored_blocks",
+                                  self.metrics.kv_restored),
                                  ("requests_salvaged",
                                   self.metrics.requests_salvaged),
                                  ("requests_poisoned",
@@ -663,6 +671,27 @@ class AsyncEngineRunner:
             self.metrics.step_actual_tokens.set(
                 sum(getattr(s, "step_actual_tokens", 0)
                     for s in stats_objs))
+            # tier-restore latency histogram: the engine accumulates
+            # begin->commit wall times; drain them here (loop thread —
+            # same thread that appended them)
+            for s in stats_objs:
+                lats = getattr(s, "restore_latencies", None)
+                if lats:
+                    for v in lats:
+                        self.metrics.kv_restore_latency.observe(v)
+                    lats.clear()
+        # tiered-KV residency gauges: tier=hbm is the device cached pool,
+        # host/spill come from the engines' tier stores (exactly-one-tier:
+        # the three gauges partition every resolvable prefix hash)
+        label = {"model_name": self.metrics.model_name}
+        self.metrics.kv_tier_blocks.labels(tier="hbm", **label).set(
+            sum(getattr(bm, "num_cached_blocks", 0) for bm in bms))
+        stores = [t for t in (getattr(e, "_kv_tiers", None)
+                              for e in (inners or [eng])) if t is not None]
+        self.metrics.kv_tier_blocks.labels(tier="host", **label).set(
+            sum(t.host_count for t in stores))
+        self.metrics.kv_tier_blocks.labels(tier="spill", **label).set(
+            sum(t.spill_count for t in stores))
 
     def _loop(self) -> None:
         logger.info("engine loop started")
