@@ -943,6 +943,183 @@ def _multiturn_ab(args, model, on_tpu, *, attn_impl, pipeline, vocab):
     return out
 
 
+def _two_class_workload(engine, interactive, offsets, inter_params,
+                        batch_jobs=(), batch_params=None):
+    """Drive a two-class mix on a bare engine: batch jobs land at t=0
+    (background saturation), interactive requests arrive Poisson.
+    Returns per-class client-observed latency plus the overload-policy
+    counters (preemptions / sheds / max brownout level)."""
+    stats = engine.stats
+    pre0 = stats.slo_preemptions
+    shed0 = stats.requests_shed
+    rids_b = set()
+    for p in batch_jobs:
+        rids_b.add(engine.add_request(prompt_token_ids=p,
+                                      params=batch_params))
+    pending = sorted(zip(offsets, interactive))
+    t0 = time.perf_counter()
+    t0_mono = time.monotonic()
+    intended: dict = {}
+    last_tok: dict = {}
+    itls_i: list = []
+    # client-observed inter-token gaps INCLUDING preemption stalls: a
+    # preempted stream's client waits out queue + re-prefill between two
+    # consecutive tokens — the convention-pure itl list excludes that
+    # (RequestOutput.from_prefill doc), but for the SLO story it is
+    # exactly the regression class-aware victim choice prevents
+    gaps_i: list = []
+    batch_tokens = 0
+    rejected = 0
+    brownout_max = 0
+    while True:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            off, p = pending.pop(0)
+            try:
+                rid = engine.add_request(prompt_token_ids=p,
+                                         params=inter_params)
+            except (MemoryError, RuntimeError):
+                rejected += 1        # backpressure 503 / brownout shed
+                continue
+            intended[rid] = t0_mono + off
+        if not engine.has_work():
+            if not pending:
+                break
+            time.sleep(max(0.0, pending[0][0]
+                           - (time.perf_counter() - t0)))
+            continue
+        outs = engine.step()
+        brownout_max = max(brownout_max, stats.brownout_level)
+        t_emit = time.perf_counter()
+        for o in outs:
+            if o.request_id in rids_b:
+                batch_tokens += len(o.new_token_ids)
+            prev = last_tok.get(o.request_id)
+            if prev is not None and o.request_id in intended:
+                gaps_i.append(t_emit - prev)
+            if o.from_prefill and o.num_output_tokens > 1:
+                last_tok[o.request_id] = t_emit   # re-prefill: reset clock
+                continue
+            if prev is not None and o.request_id in intended:
+                itls_i.append(t_emit - prev)
+            last_tok[o.request_id] = t_emit
+    wall = time.perf_counter() - t0
+    reqs = getattr(engine, "requests", {})
+    ttfts = sorted(
+        1000.0 * (rq.first_token_time - intended[rid])
+        for rid, rq in ((r, reqs.get(r)) for r in intended)
+        if rq is not None and rq.first_token_time is not None)
+    itls = sorted(1000.0 * x for x in itls_i)
+    gaps = sorted(1000.0 * x for x in gaps_i)
+    out = {
+        "wall_s": round(wall, 3),
+        "interactive_done": len(ttfts),
+        "interactive_rejected": rejected,
+        "interactive_ttft_p50_ms": round(_pct(ttfts, 0.50), 2),
+        "interactive_ttft_p99_ms": round(_pct(ttfts, 0.99), 2),
+        "interactive_itl_p50_ms": round(_pct(itls, 0.50), 3),
+        "interactive_itl_p99_ms": round(_pct(itls, 0.99), 3),
+        "interactive_gap_p99_ms": round(_pct(gaps, 0.99), 3),
+        "preemptions": stats.preemptions,
+        "slo_preemptions": stats.slo_preemptions - pre0,
+        "requests_shed": stats.requests_shed - shed0,
+        "brownout_level_max": brownout_max,
+    }
+    if rids_b:
+        out["batch_jobs"] = len(rids_b)
+        out["batch_tokens"] = batch_tokens
+        out["batch_tok_s"] = round(batch_tokens / wall, 1) if wall else 0.0
+    return out
+
+
+def _two_class_ab(args, model, on_tpu, *, attn_impl, pipeline, vocab):
+    """Two-class Poisson mix (ISSUE 8 acceptance): interactive p99 ITL
+    with background batch jobs saturating leftover budget, vs an
+    interactive-only baseline on an identical engine.  SLO scheduling
+    on/off comes from the environment (TPUSERVE_SLO_CLASSES=0 is the
+    same-commit A/B row, two-class-noslo in tools/bench_sweep.py): with
+    classes ON, interactive preempts/queue-jumps batch and p99 ITL holds
+    near the baseline; OFF, interactive queues FIFO behind long batch
+    generations and degrades materially."""
+    import numpy as np
+
+    from tpuserve.runtime.request import SamplingParams
+    from tpuserve.utils import env_flag
+
+    if on_tpu:
+        n_inter, inter_gen, n_batch, batch_gen = 64, 32, 16, 512
+        prompt_len, rate, seqs = 128, args.arrival_rate, 16
+    else:
+        n_inter, inter_gen, n_batch, batch_gen = 24, 16, 8, 160
+        prompt_len, rate, seqs = 32, max(args.arrival_rate, 12.0), 8
+    rng = np.random.default_rng(17)
+    inter = [rng.integers(1, vocab - 1, size=prompt_len).tolist()
+             for _ in range(n_inter)]
+    bjobs = [rng.integers(1, vocab - 1, size=prompt_len).tolist()
+             for _ in range(n_batch)]
+    offsets = np.cumsum(rng.exponential(1.0 / rate, size=n_inter)).tolist()
+    inter_params = SamplingParams(max_tokens=inter_gen, temperature=0.0,
+                                  ignore_eos=True, slo_class="interactive")
+    batch_params = SamplingParams(max_tokens=batch_gen, temperature=0.0,
+                                  ignore_eos=True, slo_class="batch")
+
+    # batch jobs saturate every seat at t=0 AND the block pool is sized
+    # under the full batch working set, so an interactive arrival needs a
+    # seat or blocks someone else holds: classless FIFO makes it wait
+    # out a whole batch generation (and decode-OOM evicts the MOST
+    # RECENT row — usually the interactive stream itself, whose client
+    # then waits out queue + re-prefill mid-stream); class-aware
+    # scheduling preempts a batch row instead
+    blocks_full = -(-(prompt_len + batch_gen) // args.block_size)
+    num_blocks = seqs * blocks_full - max(2, seqs // 2)
+
+    from tpuserve.utils import next_power_of_2
+
+    def build():
+        eng = _build_engine(
+            model, seqs, prompt_len, batch_gen, attn_impl=attn_impl,
+            pipeline=pipeline, multi_step=args.multi_step,
+            quantization=args.quant, kv_quant=args.kv_quant,
+            block_size=args.block_size, max_num_seqs=seqs,
+            num_blocks=num_blocks)
+        # arrival ladder PLUS the preemption re-prefill buckets: an
+        # evicted batch row replays prompt+generated at its grown
+        # length, and a cold (1, 256) prefill compile landing inside a
+        # measured TTFT would masquerade as scheduling latency
+        kw = _warm_plan_arrivals(eng, seqs, prompt_len)
+        L = 2 * next_power_of_2(prompt_len)
+        top = next_power_of_2(prompt_len + batch_gen)
+        extra = []
+        while L <= top:
+            extra.append((1, L))
+            L *= 2
+        kw["prefill_buckets"] = list(kw["prefill_buckets"]) + extra
+        eng.warmup(sample_modes=("greedy",), **kw)
+        return eng
+
+    eng = build()
+    slo_on = eng._slo is not None
+    out = {"slo_classes_enabled": slo_on,
+           "env_kill_switch": not env_flag("TPUSERVE_SLO_CLASSES"),
+           "interactive_n": n_inter, "interactive_gen": inter_gen,
+           "batch_jobs": n_batch, "batch_gen": batch_gen,
+           "prompt_len": prompt_len, "max_num_seqs": seqs,
+           "arrival_rate_req_s": rate}
+    # interactive-only baseline: the ITL/TTFT floor this engine gives an
+    # interactive stream with nothing competing
+    out["baseline"] = _two_class_workload(eng, inter, offsets, inter_params)
+    # two-class mix on a FRESH engine (prefix caches / stats clean)
+    out["two_class"] = _two_class_workload(build(), inter, offsets,
+                                           inter_params, bjobs,
+                                           batch_params)
+    for key in ("interactive_itl_p99_ms", "interactive_gap_p99_ms",
+                "interactive_ttft_p99_ms"):
+        base = out["baseline"][key]
+        out[key.replace("_ms", "_ratio")] = (
+            round(out["two_class"][key] / base, 3) if base else 0.0)
+    return out
+
+
 def _roofline(eng0, batch, prompt_len, gen_len, steps_s):
     """Estimated HBM traffic at the measured rate — decode is
     bandwidth-bound, so tok/s is only meaningful against the pipe
@@ -1123,6 +1300,14 @@ def main(argv=None):
                          "tiered vs HBM-only engine (TPUSERVE_KV_TIERS=0 "
                          "in the env measures the legacy half only); adds "
                          "a 'multiturn' sub-object")
+    ap.add_argument("--two-class", action="store_true", dest="two_class",
+                    help="two-class SLO A/B (runtime/slo.py): interactive "
+                         "Poisson stream alone vs mixed with background "
+                         "batch jobs on an identical engine — interactive "
+                         "p99 ITL held vs classless FIFO "
+                         "(TPUSERVE_SLO_CLASSES=0 re-runs the same "
+                         "workload with classes off); emits a 'two_class' "
+                         "sub-object")
     ap.add_argument("--turns", type=int, default=4, metavar="T",
                     help="turns per conversation for --multiturn "
                          "(default 4)")
@@ -1421,6 +1606,11 @@ def main(argv=None):
     if args.multiturn:
         with tpu_guard("multiturn tiered-KV comparison"):
             out["multiturn"] = _multiturn_ab(
+                args, model, on_tpu, attn_impl=attn_impl,
+                pipeline=pipeline, vocab=vocab)
+    if args.two_class:
+        with tpu_guard("two-class SLO comparison"):
+            out["two_class"] = _two_class_ab(
                 args, model, on_tpu, attn_impl=attn_impl,
                 pipeline=pipeline, vocab=vocab)
     if args.compare_mixed:

@@ -278,15 +278,119 @@ def test_gateway_ejects_backend_on_consecutive_5xx():
                 {"model": "tiny-qwen3", "prompt": f"after-eject-{i}",
                  "max_tokens": 2, "temperature": 0, "ignore_eos": True})
             assert status == 200
-        # readmit via the health probe loop's own round: /healthz passes,
-        # so the backend re-enters the pool with a clean failure count
+        # the ejection armed a jittered exponential readmission backoff:
+        # a probe round inside the window must NOT readmit (its /healthz
+        # passes — a fixed-cadence readmit would aim a retry storm at a
+        # replica that is still sick)
+        assert flaky.backoff_until > 0 and flaky.eject_count == 1
+        gw.probe_backends_once()
+        assert not flaky.healthy
+        # window elapsed: the next probe round readmits with a clean
+        # failure count
+        with gw._lock:
+            flaky.backoff_until = 0.0
         gw.probe_backends_once()
         assert flaky.healthy
         assert flaky.consecutive_failures == 0
+        # the episode count resets only after SUSTAINED health — one
+        # more probe round right away keeps the ladder armed (a replica
+        # flapping on a multi-probe period must keep growing backoff)
+        gw.probe_backends_once()
+        assert flaky.eject_count == 1
+        # ... but once the backend has been healthy past the reset
+        # window, the next flap starts from the base again
+        import time as _time
+        with gw._lock:
+            flaky.healthy_since = (_time.monotonic()
+                                   - gw.config.readmit_reset_healthy_s - 1)
+        gw.probe_backends_once()
+        assert flaky.eject_count == 0
     finally:
         gw.shutdown()
         flaky_httpd.shutdown()
         srv.shutdown()
+
+
+def test_gateway_readmit_backoff_grows_exponentially():
+    """Repeat ejection episodes push the readmission probe further out
+    (jittered exponential): episode 2's window strictly exceeds episode
+    1's even at the jitter extremes, and a backend that stays healthy a
+    full probe round resets the ladder."""
+    import time as _time
+    gw = Gateway(["http://127.0.0.1:1", "http://127.0.0.1:2"],
+                 GatewayConfig(host="127.0.0.1", port=0,
+                               eject_after_failures=1,
+                               readmit_backoff_base_s=2.0,
+                               readmit_jitter_frac=0.25))
+    b = gw.backends[0]
+    picked = gw.pick_backend(None)
+    gw.release(b, ok=False)                  # episode 1
+    assert not b.healthy and b.eject_count == 1
+    w1 = b.backoff_until - _time.monotonic()
+    assert 1.4 <= w1 <= 2.6                  # base 2s +/- 25% jitter
+    with gw._lock:
+        b.healthy = True                     # (simulated readmission)
+    gw.release(b, ok=False)                  # episode 2: ladder doubles
+    assert b.eject_count == 2
+    w2 = b.backoff_until - _time.monotonic()
+    assert 2.9 <= w2 <= 5.1                  # 4s +/- 25%
+    assert w2 > w1
+    gw.release(picked, ok=True)
+
+
+def test_gateway_injects_tenant_default_slo_class():
+    """Gateway-only tenancy: a keyed tenant's configured default class
+    rides to the engine as X-SLO-Class when the client sent none (the
+    engine server's registry is empty in that topology); an explicit
+    client header is never overwritten."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    seen = {}
+
+    class Echo(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            self.rfile.read(length)
+            seen["slo"] = self.headers.get("X-SLO-Class")
+            body = b'{"usage": {"total_tokens": 3}}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    up = f"http://127.0.0.1:{httpd.server_address[1]}"
+    gw = Gateway([up], GatewayConfig(
+        host="127.0.0.1", port=0, health_interval_s=3600,
+        tenant_config=json.dumps({"tenants": {"acme": {
+            "slo_class": "interactive", "api_keys": ["sk-a"]}}})))
+    gport = gw.start()
+    try:
+        def post(payload, headers=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gport}/v1/completions",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json",
+                         **(headers or {})}, method="POST")
+            urllib.request.urlopen(req, timeout=30).read()
+
+        post({"prompt": "x", "max_tokens": 1},
+             headers={"Authorization": "Bearer sk-a"})
+        assert seen["slo"] == "interactive"       # tenant default injected
+        post({"prompt": "x", "max_tokens": 1},
+             headers={"Authorization": "Bearer sk-a",
+                      "X-SLO-Class": "batch"})
+        assert seen["slo"] == "batch"             # client header wins
+        post({"prompt": "x", "max_tokens": 1})
+        assert seen["slo"] is None                # default tenant: no class
+    finally:
+        gw.shutdown()
+        httpd.shutdown()
 
 
 def test_gateway_all_backends_unreachable():

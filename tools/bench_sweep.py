@@ -68,6 +68,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # measured under the exact pre-tiering code path.
     ("kv-tiers", ["--multiturn"], {}),
     ("kv-tiers-legacy", ["--multiturn"], {"TPUSERVE_KV_TIERS": "0"}),
+    # Overload robustness (ISSUE 8): two-class Poisson mix — interactive
+    # p99 ITL with batch jobs saturating leftover budget vs an
+    # interactive-only baseline; the noslo row re-runs the SAME workload
+    # under the kill switch so the classless-FIFO degradation is
+    # measured on the same commit.
+    ("two-class", ["--two-class"], {}),
+    ("two-class-noslo", ["--two-class"], {"TPUSERVE_SLO_CLASSES": "0"}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -77,6 +77,11 @@ PRIORITY = [
     # pins the pre-tiering path under TPUSERVE_KV_TIERS=0 on the same
     # commit.
     "kv-tiers", "kv-tiers-legacy",
+    # Overload robustness (NEW this round; ISSUE 8 acceptance): the
+    # two-class Poisson mix on silicon — interactive p99 ITL held while
+    # batch saturates leftover budget; the noslo row is the same-commit
+    # classless-FIFO A/B under TPUSERVE_SLO_CLASSES=0.
+    "two-class", "two-class-noslo",
     # Host-overhead scaling on silicon (NEW this round; the CPU A/B in
     # BENCHMARKS.md "Host overhead" measured 2.3x less pure-host
     # ms/cycle at 256 streams with the native+batched host path): on TPU

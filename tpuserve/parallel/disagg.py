@@ -152,7 +152,8 @@ class DisaggregatedEngine:
     def add_request(self, prompt: str | None = None,
                     prompt_token_ids: Optional[Sequence[int]] = None,
                     params: Optional[SamplingParams] = None,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    deadline: Optional[float] = None) -> str:
         params = params or SamplingParams()
         # Validate against BOTH pools at intake: a prompt the decode pool can
         # never admit must be rejected here, not discovered as a MemoryError
@@ -172,7 +173,8 @@ class DisaggregatedEngine:
                 f"({self.decode.max_seq_len} tokens)")
         rid = self.prefill.add_request(prompt=prompt,
                                        prompt_token_ids=prompt_token_ids,
-                                       params=params, request_id=request_id)
+                                       params=params, request_id=request_id,
+                                       deadline=deadline)
         # Mirror the record decode-side immediately: every request is claimed
         # from (and popped off) decode.requests regardless of where it ends.
         self.decode.requests[rid] = self.prefill.requests[rid]

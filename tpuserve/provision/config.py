@@ -72,6 +72,17 @@ class DeployConfig:
     kv_spill_dir: str = "/models/.kv-spill"
     # Admission backpressure cap (server --max-waiting); 0 = auto
     max_waiting: int = 0
+    # SLO class scheduling + brownout ladder (runtime/slo.py): class-
+    # ordered admission, budget headroom for interactive traffic,
+    # priority preemption of batch rows, graceful shed under overload.
+    # False emits --no-slo-classes (classless FIFO, the pre-SLO
+    # behaviour; TPUSERVE_SLO_CLASSES=0 is the runtime twin).
+    slo_classes: bool = True
+    # Per-tenant token metering + rate limits (server/tenants.py),
+    # exported as TPUSERVE_TENANTS to the engine pods.  For gateway-
+    # fronted fleets configure the gateway instead (one charge per
+    # request).  None = no tenancy config (metering under 'default').
+    tenants: Optional[dict] = None
     # Hang watchdog threshold (server --step-watchdog-s): a dispatch
     # blocking past this is failed + salvaged like an exception instead
     # of stranding clients behind a wedged device call.  0 disables.
@@ -157,6 +168,11 @@ class DeployConfig:
             # not as an in-cluster CrashLoopBackOff
             from tpuserve.runtime.faults import FaultInjector
             FaultInjector.from_spec(self.faults)
+        if self.tenants is not None:
+            # same deploy-time-parse rule as faults: a malformed tenant
+            # config must fail the deploy, not CrashLoop the pods
+            from tpuserve.server.tenants import TenantRegistry
+            TenantRegistry.from_config(self.tenants)
         if self.pipeline_parallel > 1 and self.tensor_parallel > 1:
             raise ValueError("pipeline_parallel and tensor_parallel are "
                              "mutually exclusive (the server rejects "

@@ -178,6 +178,8 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         args += ["--kv-host-bytes", str(cfg.kv_host_bytes)]
     if cfg.max_waiting:
         args += ["--max-waiting", str(cfg.max_waiting)]
+    if not cfg.slo_classes:
+        args += ["--no-slo-classes"]
     if cfg.step_watchdog_s:
         # hang watchdog: fail+salvage a wedged dispatch instead of waiting
         # for the liveness probe to kill the whole pod (which loses every
@@ -209,6 +211,12 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         # layer (runtime/faults.py) so recovery claims are verified
         # in-cluster under seeded chaos, not just in unit tests
         env.append({"name": "TPUSERVE_FAULTS", "value": cfg.faults})
+    if cfg.tenants is not None:
+        # per-tenant metering + rate limits (server/tenants.py);
+        # validated at deploy time by DeployConfig like the chaos spec
+        import json as _json
+        env.append({"name": "TPUSERVE_TENANTS",
+                    "value": _json.dumps(cfg.tenants, sort_keys=True)})
     if cfg.provider != "gke":
         env.append({"name": "JAX_PLATFORMS", "value": "cpu"})
     if cfg.chat_template:

@@ -38,6 +38,8 @@ from tpuserve.runtime.kv_cache import CacheConfig, create_kv_cache
 from tpuserve.runtime.request import (
     FinishReason, Request, RequestOutput, RequestState, SamplingParams, check_stop)
 from tpuserve.runtime.scheduler import ScheduledBatch, Scheduler, SchedulerConfig
+from tpuserve.runtime.slo import (
+    ShedError, SloConfig, SloController, class_rank)
 from tpuserve.utils import env_flag, hard_sync, next_power_of_2
 
 logger = logging.getLogger("tpuserve.engine")
@@ -137,6 +139,17 @@ class EngineConfig:
     # PVC spill directory (third tier); None = TPUSERVE_KV_SPILL_DIR
     # (unset: no spill tier, host-budget overflow is dropped).
     kv_spill_dir: Optional[str] = None
+    # SLO class scheduling + overload robustness (runtime/slo.py):
+    # request classes (interactive/standard/batch) order admission,
+    # reserve prefill/mixed budget headroom for strict classes, preempt
+    # batch rows for interactive arrivals (token-identical re-prefill
+    # replay), and walk a hysteretic brownout ladder (spec off for
+    # batch -> batch max_tokens cap -> shed) under sustained overload.
+    # None = TPUSERVE_SLO_CLASSES env (default on; =0 restores classless
+    # FIFO byte-identically — the bench.py --two-class A/B lever).
+    slo_classes: Optional[bool] = None
+    # Brownout/estimator knobs; None = SloConfig() defaults.
+    slo: Optional["SloConfig"] = None
     # Grammar-FSM guided decoding (runtime/grammar/): compile guided
     # specs to token-level FSMs whose per-state masks ride the fused
     # decode window (true logit masking, distribution-correct), so
@@ -218,6 +231,15 @@ class EngineStats:
     requests_poisoned: int = 0
     watchdog_trips: int = 0
     engine_restarts: int = 0
+    # overload robustness (runtime/slo.py): requests shed at intake by
+    # the brownout ladder / queue-full class eviction (429 + Retry-After
+    # at the API edge, never any prefill spent); batch rows preempted
+    # for stricter-class admissions (also counted in ``preemptions``);
+    # current brownout level (0 = normal), exported as the
+    # tpuserve_brownout_level gauge
+    requests_shed: int = 0
+    slo_preemptions: int = 0
+    brownout_level: int = 0
     # tiered KV cache (runtime/kv_tiers.py): blocks demoted out of HBM
     # into the host tier; host->PVC spills; blocks dropped off the last
     # tier (KV lost, re-prefill on next use); blocks restored back into
@@ -492,6 +514,25 @@ class Engine:
         self.scheduler = Scheduler(sched_cfg, self.block_manager,
                                    max_model_len=self.cache_cfg.max_model_len,
                                    ragged_align=self._ragged_blk)
+        # SLO class scheduling + brownout ladder (runtime/slo.py): the
+        # controller is consulted at intake (shed / max_tokens clamp),
+        # by the scheduler (class-ordered queue, budget reserve,
+        # class-aware preemption victims), and per cycle (estimator
+        # tick).  TPUSERVE_SLO_CLASSES=0 / EngineConfig.slo_classes=False
+        # leaves it None — every consumer degrades to classless FIFO
+        # byte-identically (the bench.py --two-class A/B lever).
+        slo_on = config.slo_classes
+        if slo_on is None:
+            slo_on = env_flag("TPUSERVE_SLO_CLASSES")
+        self._slo = (SloController(config.slo or SloConfig(),
+                                   sched_cfg.resolve_max_waiting())
+                     if slo_on else None)
+        self.scheduler.slo = self._slo
+        # terminal errors for QUEUED requests decided engine-side
+        # (deadline expiry, queue-full class eviction): (rid, exc) pairs
+        # the runner drains and routes to the waiting clients — the
+        # engine's step() has no channel to a request's output queue
+        self._error_outbox: list = []
         self.stats = EngineStats()
         # Chaos layer (runtime/faults.py): disabled unless EngineConfig
         # .faults or TPUSERVE_FAULTS arms it.  Every _exec_* hook plus the
@@ -693,8 +734,29 @@ class Engine:
                     prompt_token_ids: Optional[Sequence[int]] = None,
                     params: Optional[SamplingParams] = None,
                     request_id: Optional[str] = None,
-                    adapter: Optional[str] = None) -> str:
+                    adapter: Optional[str] = None,
+                    deadline: Optional[float] = None) -> str:
         params = params or SamplingParams()
+        # SLO intake policy (runtime/slo.py) — BEFORE tokenization, so a
+        # shed costs nothing: validate the class (400 at the API edge),
+        # shed classes the brownout ladder has turned away (429 +
+        # Retry-After, retryable by contract), and clamp batch
+        # max_tokens at level 2+ (the graceful step before shedding).
+        rank = class_rank(params.slo_class)
+        if self._slo is not None:
+            # the shed gate wants the LIVE queue depth, not last tick's
+            self._slo._waiting = self.scheduler.num_waiting
+            retry_after = self._slo.shed_retry_after(rank)
+            if retry_after is not None:
+                self.stats.requests_shed += 1
+                self._slo.shed_total += 1
+                raise ShedError(
+                    f"overloaded (brownout level {self._slo.level}): "
+                    f"{params.slo_class} work is shed; retry in "
+                    f"{retry_after:.0f}s", retry_after_s=retry_after)
+            cap = self._slo.max_tokens_cap(rank)
+            if cap is not None and params.max_tokens > cap:
+                params = dataclasses.replace(params, max_tokens=cap)
         caller_ids = prompt_token_ids is not None
         adapter_idx = None
         if adapter is not None:
@@ -797,11 +859,22 @@ class Engine:
             else:
                 self._guided[request_id] = acceptor
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
-                      params=params, prompt=prompt, adapter_idx=adapter_idx)
+                      params=params, prompt=prompt, adapter_idx=adapter_idx,
+                      deadline=deadline)
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
         self.requests[request_id] = req
         try:
-            self.scheduler.add(req)
+            try:
+                self.scheduler.add(req)
+            except MemoryError:
+                # Queue full: shed the loosest-class waiting work first
+                # (ShedError -> 429 to ITS client) to seat a stricter
+                # arrival — overload costs batch before interactive.
+                # No evictable victim (classless, or the queue is all
+                # same-or-stricter): the MemoryError 503 stands.
+                if not self._shed_queue_victim(rank):
+                    raise
+                self.scheduler.add(req)
         except MemoryError:
             # backpressure rejection must not leak the half-registered
             # request record
@@ -963,6 +1036,117 @@ class Engine:
         self._guided_plan.pop(request_id, None)
         return True
 
+    # ---- overload robustness (runtime/slo.py) -------------------------
+
+    def _shed_queue_victim(self, rank: int) -> bool:
+        """Queue-full class eviction: drop the TAIL-most waiting request
+        of a class strictly looser than ``rank`` (never one with prefill
+        progress or delivered tokens — that work is paid for) so a
+        stricter arrival gets the seat.  The victim's client is answered
+        through the error outbox with a retryable ShedError."""
+        if self._slo is None:
+            return False
+        for victim in reversed(self.scheduler.waiting):
+            if (class_rank(victim.params.slo_class) > rank
+                    and victim.num_prefilled == 0
+                    and not victim.output_token_ids
+                    and victim.state == RequestState.WAITING):
+                self.abort_request(victim.request_id)
+                self.stats.requests_shed += 1
+                self._slo.shed_total += 1
+                ra = self._slo.cfg.shed_retry_after_s
+                self._error_outbox.append((victim.request_id, ShedError(
+                    "shed from a full queue for higher-priority "
+                    f"admission; retry in {ra:.0f}s", retry_after_s=ra)))
+                return True
+        return False
+
+    def _expire_queued_deadlines(self) -> None:
+        """Abort WAITING requests whose admission deadline has passed —
+        their client's request_timeout_s fails them anyway; expiring
+        queue-side means the engine never spends prefill on a response
+        nobody will read.  RESTORING requests are skipped for the one
+        cycle their tier restore is in flight (it must commit)."""
+        sched = self.scheduler
+        if not sched.waiting:
+            return
+        now = time.monotonic()
+        # only requests with NO progress expire here: a preempted
+        # mid-stream request (delivered tokens) or a mid-chunk prompt
+        # (prefill spent) is paid-for work — aborting it queue-side
+        # would discard that and 504 a stream that already produced
+        # output; those stay under the handler's own timeout
+        expired = [r for r in sched.waiting
+                   if r.deadline is not None and now > r.deadline
+                   and r.state == RequestState.WAITING
+                   and r.num_prefilled == 0 and not r.output_token_ids]
+        for r in expired:
+            self.abort_request(r.request_id)
+            self._error_outbox.append((r.request_id, TimeoutError(
+                "request deadline expired before admission (engine "
+                "overloaded); aborted queue-side")))
+
+    def drain_request_errors(self) -> list:
+        """(rid, exception) pairs for queued requests the engine
+        terminated itself (deadline expiry, queue-full eviction);
+        consumed by the runner loop, which fails the waiting clients."""
+        out, self._error_outbox = self._error_outbox, []
+        return out
+
+    def _slo_preempt_for_admission(self) -> list[RequestOutput]:
+        """Priority preemption: when the waiting head is stricter-class
+        than running batch rows and cannot be admitted for seats or
+        blocks, preempt the loosest-class most-recent running rows
+        (bounded per cycle and by each victim's preemption budget)
+        through the token-identical re-prefill replay path.  Flushes the
+        pipelined window first — preempting a request with an in-flight
+        device window would double-append its tokens at replay."""
+        slo, sched = self._slo, self.scheduler
+        if slo is None or not sched.waiting or not sched.running:
+            return []
+        head = sched.waiting[0]
+        if head.state == RequestState.RESTORING:
+            return []
+        rank = class_rank(head.params.slo_class)
+        budget = slo.cfg.preempt_budget
+
+        def victims():
+            # loosest class first, most recent admission breaking ties
+            # (index captured by enumerate — running.index() in a sort
+            # key would be O(n^2) on the host hot path)
+            return [r for _, _, r in sorted(
+                (class_rank(r.params.slo_class), i, r)
+                for i, r in enumerate(sched.running)
+                if class_rank(r.params.slo_class) > rank
+                and r.num_preemptions < budget)]
+
+        def shortfall() -> bool:
+            """Mirror of the head's OWN admission arithmetic: preempting
+            when the scheduler would have admitted anyway burns a full
+            re-prefill for nothing.  Only the mixed path charges
+            per-decode-row headroom against the free pool; the
+            phase-split prefill/chunk admissions check the raw free
+            count."""
+            seats = len(sched.running) >= sched.cfg.max_num_seqs
+            need = self.block_manager.blocks_needed(head.num_tokens) + 1
+            headroom = (len(sched.running)
+                        if sched.cfg.mixed_batching else 0)
+            blocks = need > (self.block_manager.num_free_blocks - headroom)
+            return seats or blocks
+
+        if not victims() or not shortfall():
+            return []
+        outputs = self._flush_pending() + self._flush_window()
+        for _ in range(slo.cfg.max_preempt_per_cycle):
+            cand = victims()
+            if not cand or not shortfall():
+                break
+            victim = cand[-1]         # most recent loosest-class row
+            sched.preempt_for_class(victim)
+            self.stats.preemptions += 1
+            self.stats.slo_preemptions += 1
+        return outputs
+
     def salvage_requeue(self) -> list[str]:
         """Crash-only salvage after a faulted/stuck step (server/runner.py):
         drop every piece of in-flight device state and re-queue every live
@@ -1019,6 +1203,13 @@ class Engine:
         steps skip the check: their orphans are reconciled by the
         runner's salvage path, not mid-exception)."""
         outputs = self._step_inner()
+        if self._slo is not None:
+            # estimator tick once per successful cycle (queue depth +
+            # the EWMAs fed during scheduling) drives the brownout
+            # ladder; the level is mirrored into stats for the
+            # tpuserve_brownout_level gauge
+            self._slo.tick(self.scheduler.num_waiting)
+            self.stats.brownout_level = self._slo.level
         if self._strict_blocks:
             self._check_block_integrity()
         return outputs
@@ -1040,6 +1231,12 @@ class Engine:
     def _step_inner(self) -> list[RequestOutput]:
         self._dispatch_rids = ()
         PROF.bump_cycle()
+        # overload robustness, BEFORE scheduling: deadline-expired queued
+        # requests leave without spending prefill, and a stricter-class
+        # waiting head may preempt running batch rows for its seat/blocks
+        # (runtime/slo.py; no-ops when SLO scheduling is off)
+        self._expire_queued_deadlines()
+        pre = self._slo_preempt_for_admission()
         if self._kv_tiers is not None:
             # commit FIRST: last cycle's restored prefixes become HBM
             # prefix entries, so their requests admit THIS cycle with the
@@ -1051,7 +1248,7 @@ class Engine:
             batch = self.scheduler.schedule()
         if batch is None:
             # nothing schedulable but a decode result may still be in flight
-            return self._flush_pending() + self._flush_window()
+            return pre + self._flush_pending() + self._flush_window()
         t0 = time.monotonic()
         if batch.kind == "prefill":
             outputs = self._run_prefill(batch)
@@ -1061,6 +1258,8 @@ class Engine:
             outputs = self._run_mixed(batch)
         elif (self._spec is not None
               and self.stats.num_decode_steps >= self._spec_resume_step
+              and not (self._slo is not None
+                       and self._slo.spec_paused_for(batch.requests))
               and all(not r.params.needs_penalties
                       and not r.params.needs_logit_bias
                       and not (r.params.needs_min_tokens
@@ -1082,7 +1281,7 @@ class Engine:
                 outputs = self._run_decode(batch)
         self.stats.last_step_time = time.monotonic() - t0
         self._release_window_blocks()
-        return outputs
+        return pre + outputs
 
     def _release_window_blocks(self) -> None:
         """Sliding-window rolling buffer: blocks whose every position fell
@@ -1255,6 +1454,11 @@ class Engine:
         self.stats.step_padded_tokens = padded
         self.stats.actual_tokens_total += actual
         self.stats.padded_tokens_total += padded
+        if self._slo is not None:
+            # padding-waste EWMA feeds the overload estimator: waste
+            # derates delivered capacity, so pressure rises sooner on a
+            # badly-bucketed workload (runtime/slo.py)
+            self._slo.note_step(actual, padded)
 
     def _next_key(self) -> jax.Array:
         self._rng_key, sub = jax.random.split(self._rng_key)

@@ -62,6 +62,12 @@ class SamplingParams:
     # vLLM priority scheduling: LOWER value = admitted sooner; FIFO
     # within a level (runtime/scheduler.py Scheduler.add)
     priority: int = 0
+    # SLO class (runtime/slo.py): "interactive" / "standard" / "batch".
+    # With SLO scheduling enabled the waiting queue orders by
+    # (class rank, priority), the mixed/prefill token budgets reserve
+    # headroom for non-batch classes, and under pressure batch rows are
+    # preempted (token-identical re-prefill replay) or shed first.
+    slo_class: str = "standard"
     # vLLM truncate_prompt_tokens: keep only the LAST N prompt tokens
     # at intake (clients cap their own context budget server-side)
     truncate_prompt_tokens: Optional[int] = None
@@ -165,6 +171,17 @@ class Request:
     # multi-LoRA: index into the engine's loaded adapter stack
     # (weights.load_lora_stack); None = base model
     adapter_idx: Optional[int] = None
+    # Admission deadline (time.monotonic seconds): a request still
+    # QUEUED past this is aborted engine-side with a TimeoutError
+    # before any prefill is spent (Engine._expire_queued_deadlines) —
+    # its client's request_timeout_s would kill it anyway; honoring the
+    # deadline queue-side just stops the engine paying for a response
+    # nobody is waiting for.  None = no queue-side deadline.
+    deadline: Optional[float] = None
+    # SLO class preemptions absorbed so far (runtime/slo.py): bounded by
+    # SloConfig.preempt_budget so interactive pressure cannot starve a
+    # batch request's forward progress forever.
+    num_preemptions: int = 0
     # crash-only salvage: CONSECUTIVE faulted engine steps this request was
     # dispatched in without emitting a token since (reset on every emission
     # — engine._emit_one).  The runner's per-request fault budget

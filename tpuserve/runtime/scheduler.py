@@ -12,11 +12,13 @@ SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Optional
 
 from tpuserve.runtime.block_manager import BlockManager
 from tpuserve.runtime.request import Request, RequestState
+from tpuserve.runtime.slo import BATCH, class_rank
 from tpuserve.utils import env_flag, next_power_of_2
 
 
@@ -112,6 +114,11 @@ class Scheduler:
         # groups are probed in isolation to find a poison request.  Running
         # requests are unaffected; None lifts the restriction.
         self.admission_filter: Optional[set[str]] = None
+        # SLO controller (runtime/slo.py), set by the engine when class
+        # scheduling is enabled.  None = classless FIFO: every policy
+        # below degrades byte-identically to the pre-SLO behaviour
+        # (TPUSERVE_SLO_CLASSES=0, the same-commit A/B lever).
+        self.slo = None
         # Set after scheduling a chunked-prefill step: the next cycle runs a
         # decode step first (if anything is running) so in-flight streams get
         # a token between chunks — without this, a 32k prompt at the 2048
@@ -121,31 +128,56 @@ class Scheduler:
 
     # ---- intake ---------------------------------------------------------
 
+    def _rank(self, req: Request) -> int:
+        """SLO class rank for queue ordering; 0 for everyone when class
+        scheduling is off, so the legacy priority-only order is exact."""
+        return class_rank(req.params.slo_class) if self.slo is not None else 0
+
+    def _key(self, req: Request) -> tuple:
+        return (self._rank(req), req.params.priority)
+
     def add(self, req: Request) -> None:
-        """Queue for admission.  FIFO within a priority level; a request
-        with a LOWER ``params.priority`` value is admitted sooner (vLLM
-        priority semantics).  Preempted requests re-enter at the queue
-        head regardless (appendleft at the call sites, which also bypasses
-        the backpressure cap) — resuming holds its own priority: their KV
-        was already paid for once."""
+        """Queue for admission.  Ordered by (SLO class rank, priority) —
+        both LOWER = admitted sooner — FIFO within a level (vLLM priority
+        semantics; class rank is 0 for everyone when SLO scheduling is
+        off).  Preempted requests re-enter at the queue head regardless
+        (appendleft / reinsert_preempted at the call sites, which also
+        bypass the backpressure cap) — resuming holds its own priority:
+        their KV was already paid for once."""
         if len(self.waiting) >= self.cfg.resolve_max_waiting():
             raise MemoryError(
                 f"waiting queue full ({len(self.waiting)} requests); "
                 "retry later or add replicas (backpressure — the engine "
                 "bounds host-side queue state)")
-        pr = req.params.priority
-        if not self.waiting or self.waiting[-1].params.priority <= pr:
-            self.waiting.append(req)         # common case: same priority
+        key = self._key(req)
+        if not self.waiting or self._key(self.waiting[-1]) <= key:
+            self.waiting.append(req)         # common case: same level
             return
         idx = len(self.waiting)
-        while idx > 0 and self.waiting[idx - 1].params.priority > pr:
-            if self.waiting[idx - 1].output_token_ids:
+        while idx > 0 and self._key(self.waiting[idx - 1]) > key:
+            prev = self.waiting[idx - 1]
+            if prev.output_token_ids and self._rank(prev) <= key[0]:
                 # a preempted mid-stream request is a barrier: new
-                # arrivals never insert ahead of it, whatever their
-                # priority — otherwise a sustained higher-priority stream
-                # starves its half-delivered response forever
+                # arrivals of its own or a looser class never insert
+                # ahead of it — otherwise a sustained same-priority
+                # stream starves its half-delivered response forever.
+                # A strictly STRICTER class may jump it: that is the
+                # SLO contract, and the victim's preemption budget (not
+                # queue position) bounds its total regression.
                 break
             idx -= 1
+        self.waiting.insert(idx, req)
+
+    def reinsert_preempted(self, req: Request) -> None:
+        """Re-queue a CLASS-preemption victim: ahead of every waiting
+        request of its own class (its KV was paid for once and it may
+        hold half-delivered output) but behind all stricter classes —
+        unlike the decode-OOM ``appendleft``, which must go absolutely
+        first so its freed blocks can drain."""
+        rank = self._rank(req)
+        idx = 0
+        while idx < len(self.waiting) and self._rank(self.waiting[idx]) < rank:
+            idx += 1
         self.waiting.insert(idx, req)
 
     def abort(self, request_id: str) -> Optional[Request]:
@@ -177,11 +209,21 @@ class Scheduler:
         small power-of-two bucket instead of the full chunk shape."""
         return min(self.cfg.prefill_chunk_size, self.prefill_bucket(remaining))
 
+    def _note_admit(self, req: Request) -> None:
+        """Feed the SLO load estimator with a FRESH admission's queue
+        delay (preempted re-entries and chunk continuations excluded —
+        their wait measures preemption policy, not admission load)."""
+        if (self.slo is not None and req.state == RequestState.WAITING
+                and req.num_prefilled == 0 and not req.output_token_ids):
+            self.slo.note_admission(self._rank(req),
+                                    time.monotonic() - req.arrival_time)
+
     def _pop_head_for_chunking(self, head: Request,
                                cached: int = 0) -> Optional[ScheduledBatch]:
         need = self.block_manager.blocks_needed(head.num_tokens) + 1
         if need > self.block_manager.num_free_blocks:
             return None          # wait for blocks to free up
+        self._note_admit(head)
         self.waiting.popleft()
         return ScheduledBatch(kind="prefill_chunk", requests=[head],
                               padded_len=self._chunk_bucket(
@@ -308,6 +350,13 @@ class Scheduler:
         # plus everything generated so far.
         seats = min(self.cfg.max_prefill_seqs,
                     self.cfg.max_num_seqs - len(self.running))
+        budget = self.cfg.max_prefill_tokens
+        head_rank = self._rank(head)
+        if self.slo is not None and head_rank >= BATCH:
+            # batch prefill admits only into the leftover budget: the
+            # reserved headroom stays free for a stricter-class arrival,
+            # which would otherwise wait out a fully-booked batch bucket
+            budget -= int(budget * self.slo.cfg.reserve_frac)
         counts: list[int] = []
         for req in self.waiting:
             if len(counts) >= seats:
@@ -319,12 +368,17 @@ class Scheduler:
                 # mid-restore: its prefix lands in HBM next cycle — the
                 # head segment stops here (FIFO order preserved)
                 break
+            if self.slo is not None and self._rank(req) != head_rank:
+                # classes never share a prefill batch: a batch row
+                # co-admitted with interactive ones would widen their
+                # shared bucket and charge the reserved budget
+                break
             counts.append(req.num_tokens)
         if not counts:
             return None
         if self._batched_admission:
             n_pick, bucket = self.block_manager.admit_prefill(
-                counts, seats, self.cfg.max_prefill_tokens,
+                counts, seats, budget,
                 self.cfg.min_prefill_bucket)
         else:
             # legacy inline loop (the pre-batching admission path, kept
@@ -335,7 +389,7 @@ class Scheduler:
             free = self.block_manager.num_free_blocks
             for c in counts:
                 cand = max(bucket, self.prefill_bucket(c))
-                if (cand * (n_pick + 1) > self.cfg.max_prefill_tokens
+                if (cand * (n_pick + 1) > budget
                         and n_pick):
                     break
                 need = self.block_manager.blocks_needed(c) + 1
@@ -346,6 +400,8 @@ class Scheduler:
                 bucket = cand
         if not n_pick:
             return None
+        for i in range(n_pick):
+            self._note_admit(self.waiting[i])
         picked = [self.waiting.popleft() for _ in range(n_pick)]
         return ScheduledBatch(kind="prefill", requests=picked, padded_len=bucket)
 
@@ -377,13 +433,21 @@ class Scheduler:
         seats = self.cfg.max_num_seqs - len(self.running)
         if budget < align or seats <= 0:
             return None
+        # SLO headroom: fresh BATCH-class admissions only fill the budget
+        # left above this reserve, so an interactive arrival next cycle
+        # finds flat rows free instead of a fully-booked batch step.
+        # Continuations are exempt (the block-drain livelock rule).
+        reserve = 0
+        if self.slo is not None:
+            reserve = rows(int(self.cfg.mixed_token_budget
+                               * self.slo.cfg.reserve_frac))
 
-        def take(remaining: int) -> int:
+        def take(remaining: int, avail: int) -> int:
             # largest admissible chunk: whole remainder if its aligned
             # span fits the row budget, else the biggest aligned span
-            if rows(remaining) <= budget:
+            if rows(remaining) <= avail:
                 return remaining
-            return (budget // align) * align
+            return (avail // align) * align
 
         # each decode row may append into a fresh block this step — leave
         # them headroom before reserving for admissions
@@ -393,7 +457,7 @@ class Scheduler:
             if budget < align or seats <= 0:
                 break
             if req.num_prefilled > 0:
-                n = take(req.num_tokens - req.num_prefilled)
+                n = take(req.num_tokens - req.num_prefilled, budget)
                 if n <= 0:
                     break
                 self.waiting.remove(req)
@@ -404,6 +468,14 @@ class Scheduler:
             head = self.waiting[0]
             if head.state == RequestState.RESTORING:
                 break                    # prefix mid-restore: admit next cycle
+            avail = budget
+            if reserve and self._rank(head) >= BATCH:
+                # fresh batch work fills leftover budget only; the queue
+                # is class-ordered, so everything behind this head is
+                # batch too — stop rather than skip
+                avail = budget - reserve
+                if avail < align:
+                    break
             need = self.block_manager.blocks_needed(head.num_tokens) + 1
             if need > free:
                 break                        # wait for blocks to free up
@@ -415,9 +487,10 @@ class Scheduler:
                 _, cached = self.block_manager.lookup_prefix(
                     head.prompt_token_ids + head.output_token_ids,
                     count_stats=False)
-            n = take(head.num_tokens - cached)
+            n = take(head.num_tokens - cached, avail)
             if n <= 0:
                 break
+            self._note_admit(head)
             self.waiting.popleft()
             chunks.append((head, n))
             free -= need
@@ -442,14 +515,36 @@ class Scheduler:
         self.block_manager.free(req.request_id)
 
     def preempt_last(self) -> Optional[Request]:
-        """Evict the most recent running request back to waiting (frees its
-        blocks; it will re-prefill later).  Called on decode OOM."""
+        """Evict a running request back to waiting (frees its blocks; it
+        will re-prefill later).  Called on decode OOM.  Classless: the
+        most recent admission; with SLO scheduling: the most recent row
+        of the LOOSEST class present, so memory pressure costs batch
+        work before interactive streams."""
         if not self.running:
             return None
-        req = self.running.pop()
+        idx = len(self.running) - 1
+        if self.slo is not None:
+            worst = max(self._rank(r) for r in self.running)
+            while idx > 0 and self._rank(self.running[idx]) != worst:
+                idx -= 1
+        req = self.running.pop(idx)
         self.block_manager.free(req.request_id)
         # Re-prefill will recompute the full context (prompt + generated).
         req.state = RequestState.PREEMPTED
         req.num_prefilled = 0
         self.waiting.appendleft(req)
         return req
+
+    def preempt_for_class(self, victim: Request) -> None:
+        """SLO priority preemption (engine picks the victim): free the
+        victim's KV and re-queue it BY CLASS — behind stricter waiting
+        work, ahead of its own class — charging its per-request
+        preemption budget.  Replay through the re-prefill path is
+        token-identical (the property tests/test_salvage.py pins), so
+        preempting background work for interactive traffic is safe."""
+        self.running.remove(victim)
+        self.block_manager.free(victim.request_id)
+        victim.state = RequestState.PREEMPTED
+        victim.num_prefilled = 0
+        victim.num_preemptions += 1
+        self.reinsert_preempted(victim)

@@ -29,6 +29,9 @@ import dataclasses
 import hashlib
 import json
 import logging
+import os
+import random
+import re
 import threading
 import time
 import urllib.error
@@ -37,6 +40,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 logger = logging.getLogger("tpuserve.gateway")
+
+# "not provided" sentinel for pre-parsed request payloads (None is a
+# valid parse result: a non-JSON body)
+_UNSET = object()
 
 
 def _is_connect_failure(e: Exception) -> bool:
@@ -73,6 +80,15 @@ class Backend:
     kv_digest: str = ""
     kv_digest_bits: int = 0
     kv_digest_chars: int = 0
+    # Readmission backoff: consecutive ejection episodes and the time
+    # before which the health loop will NOT probe this (ejected)
+    # backend.  Exponential + jittered — a sick replica that keeps
+    # passing /healthz but failing requests would otherwise be
+    # readmitted on a fixed cadence and take a synchronized retry storm
+    # every health interval.
+    eject_count: int = 0
+    backoff_until: float = 0.0
+    healthy_since: float = 0.0
 
 
 @dataclasses.dataclass
@@ -94,6 +110,24 @@ class GatewayConfig:
     # backend stops receiving new traffic until the health probe loop
     # sees its /healthz pass again (auto-readmit).
     eject_after_failures: int = 2
+    # Jittered exponential readmission backoff: after the Nth ejection
+    # episode the health loop waits base * 2^(N-1) seconds (capped,
+    # +/- jitter_frac) before even PROBING the backend again, so a
+    # flapping replica isn't readmitted on a fixed cadence into a
+    # synchronized retry storm.  The count resets once the backend
+    # survives a full healthy probe round.
+    readmit_backoff_base_s: float = 2.0
+    readmit_backoff_max_s: float = 60.0
+    readmit_jitter_frac: float = 0.25
+    # The episode count resets only after the backend stays healthy this
+    # long — a replica that passes /healthz but fails requests (the
+    # motivating eject case) would otherwise re-arm the ladder at its
+    # base on every flap that outlasts one probe round.
+    readmit_reset_healthy_s: float = 30.0
+    # Per-tenant token metering + rate limits enforced HERE, in front of
+    # the whole replica pool (server/tenants.py): inline JSON or a file
+    # path; None = TPUSERVE_TENANTS env (unset: no gateway tenancy).
+    tenant_config: Optional[str] = None
 
 
 class Gateway:
@@ -106,6 +140,21 @@ class Gateway:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # per-tenant metering/limits for the whole pool (None when not
+        # configured — the relay path then skips tenancy entirely)
+        from tpuserve.server.tenants import TenantRegistry
+        self.tenants = TenantRegistry.load(self.config.tenant_config) \
+            if (self.config.tenant_config
+                or os.environ.get("TPUSERVE_TENANTS")) else None
+
+    def _eject_backoff_s(self, eject_count: int) -> float:
+        """Jittered exponential delay before the Nth-ejection backend is
+        probed for readmission (deterministic growth, random jitter)."""
+        cfg = self.config
+        base = min(cfg.readmit_backoff_base_s * (2 ** max(eject_count - 1, 0)),
+                   cfg.readmit_backoff_max_s)
+        return base * (1 + random.uniform(-cfg.readmit_jitter_frac,
+                                          cfg.readmit_jitter_frac))
 
     # ---- backend selection ---------------------------------------------
 
@@ -137,11 +186,14 @@ class Gateway:
             f"{key}|{b.url}".encode()).digest())
 
     def pick_backend(self, body: bytes | None = None,
-                     exclude: set[str] | None = None) -> Backend:
+                     exclude: set[str] | None = None,
+                     payload=_UNSET) -> Backend:
         """Pick a backend: rendezvous prefix affinity (with a load-slack
         escape to least-loaded), else least-loaded.  ``exclude``: URLs
         already tried this request (connect-failure failover) — skipped
-        unless nothing else remains."""
+        unless nothing else remains.  ``payload``: the body's
+        already-parsed JSON (the relay parses once; failover retries and
+        the tenant check must not re-parse a large body)."""
         with self._lock:
             ex = exclude or set()
             # preference order: healthy+untried > any untried (a backend
@@ -153,7 +205,8 @@ class Gateway:
                     or [b for b in self.backends if b.url not in ex]
                     or self.backends)
             from tpuserve.server.kv_digest import affinity_key, digest_has
-            payload = self._affinity_payload(body) if body else None
+            if payload is _UNSET:
+                payload = self._affinity_payload(body) if body else None
             chars = self.config.affinity_prefix_chars
             key = (affinity_key(payload, chars)
                    if payload is not None else None)
@@ -205,10 +258,17 @@ class Gateway:
                 if (backend.consecutive_failures
                         >= self.config.eject_after_failures):
                     if backend.healthy:
+                        backend.eject_count += 1
+                        backend.backoff_until = (
+                            time.monotonic()
+                            + self._eject_backoff_s(backend.eject_count))
                         logger.warning(
                             "ejecting backend %s after %d consecutive "
-                            "failures (readmit via health probe)",
-                            backend.url, backend.consecutive_failures)
+                            "failures (readmission probe backs off "
+                            "%.1fs, episode %d)",
+                            backend.url, backend.consecutive_failures,
+                            backend.backoff_until - time.monotonic(),
+                            backend.eject_count)
                     backend.healthy = False
 
     # ---- health checking ------------------------------------------------
@@ -216,9 +276,15 @@ class Gateway:
     def probe_backends_once(self) -> None:
         """One health-probe round: readmits ejected backends whose
         /healthz passes again (resetting their failure count) and ejects
-        ones that stopped answering.  The background loop below is just
-        this on a timer."""
+        ones that stopped answering.  An ejected backend still inside
+        its jittered exponential backoff window is NOT probed — repeated
+        eject episodes push readmission attempts further apart instead
+        of hammering a flapping replica on the health-loop cadence.  The
+        background loop below is just this on a timer."""
         for b in self.backends:
+            with self._lock:
+                if not b.healthy and time.monotonic() < b.backoff_until:
+                    continue          # ejected + backing off: don't probe
             digest, digest_bits, digest_chars = None, 0, 0
             try:
                 with urllib.request.urlopen(
@@ -239,9 +305,19 @@ class Gateway:
                 ok = False
             with self._lock:
                 if ok:
+                    now = time.monotonic()
                     if not b.healthy:
                         logger.info("readmitting backend %s (health probe "
-                                    "passed)", b.url)
+                                    "passed after backoff episode %d)",
+                                    b.url, b.eject_count)
+                        b.healthy_since = now
+                    elif (b.eject_count and b.healthy_since
+                          and now - b.healthy_since
+                          >= self.config.readmit_reset_healthy_s):
+                        # sustained health since readmission: the flap is
+                        # over, the next ejection starts the ladder from
+                        # its base again
+                        b.eject_count = 0
                     b.healthy = True
                     b.consecutive_failures = 0
                     if isinstance(digest, str):
@@ -286,8 +362,11 @@ class Gateway:
 
     def status(self) -> dict:
         with self._lock:
-            return {"backends": [dataclasses.asdict(b) for b in self.backends],
-                    "affinity": "rendezvous"}
+            out = {"backends": [dataclasses.asdict(b) for b in self.backends],
+                   "affinity": "rendezvous"}
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.snapshot()
+        return out
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):
@@ -300,13 +379,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         logger.debug("%s " + fmt, self.address_string(), *args)
 
-    def _send_json_safely(self, code: int, data: bytes) -> None:
+    def _send_json_safely(self, code: int, data: bytes,
+                          headers: Optional[dict] = None) -> None:
         """Write a JSON response, swallowing client-gone errors (the
         client may have hung up while backends were being tried)."""
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):
@@ -324,6 +406,47 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None
+        # Per-tenant rate limiting for the whole pool (server/tenants.py):
+        # charge the admission estimate here, settle against the
+        # response's real usage below.  tenant = mapped API key or the
+        # "model" (LoRA adapter) field.
+        tenant, charged, inject_cls = None, 0, None
+        # body parsed ONCE for both tenancy and affinity; failover
+        # retries reuse the same parse
+        payload = (ctx._affinity_payload(body)
+                   if method == "POST" and body else None)
+        # tenancy covers the COMPLETION routes only — the same set the
+        # engine server meters, so moving the config between the two
+        # documented layers never changes which traffic is limited
+        # (embeddings don't fit the token-bucket cost model anyway)
+        if (ctx.tenants is not None and payload is not None
+                and self.path in ("/v1/completions",
+                                  "/v1/chat/completions")):
+            from tpuserve.server.tenants import estimate_cost
+            tenant = ctx.tenants.resolve(
+                self.headers.get("Authorization"), payload.get("model"))
+            charged = estimate_cost(payload)
+            if (payload.get("slo_class") is None
+                    and not self.headers.get("X-SLO-Class")):
+                # gateway-only tenancy: the engine server's registry is
+                # empty there, so the tenant's configured default class
+                # must travel with the request or it silently degrades
+                # to 'standard'
+                inject_cls = ctx.tenants.slo_class_for(tenant)
+            retry = ctx.tenants.charge(tenant, charged)
+            if retry is not None:
+                self._send_json_safely(429, json.dumps({"error": {
+                    "message": f"tenant {tenant!r} token rate limit "
+                               f"exceeded; retry in {retry:.1f}s",
+                    "type": "rate_limit_exceeded"}}).encode(),
+                    headers={"Retry-After": str(int(retry) + 1)})
+                return
+
+        def settle(actual: int) -> None:
+            nonlocal tenant
+            if tenant is not None:
+                ctx.tenants.settle(tenant, charged, actual)
+                tenant = None
         # Connect-level failover: an unreachable backend costs one retry on
         # the next candidate, not a client-visible 502, as long as another
         # backend remains untried (no response bytes have flowed yet, so
@@ -333,12 +456,20 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         headers_sent = False
         while True:
             backend = ctx.pick_backend(body if method == "POST" else None,
-                                       exclude=tried)
+                                       exclude=tried, payload=payload)
             try:
+                fwd = {"Content-Type": self.headers.get(
+                    "Content-Type", "application/json")}
+                for h in ("Authorization", "X-SLO-Class"):
+                    # tenant identity + SLO class must reach the engine
+                    # server (per-tenant default class, exact metering)
+                    if self.headers.get(h):
+                        fwd[h] = self.headers[h]
+                if inject_cls:
+                    fwd["X-SLO-Class"] = inject_cls
                 req = urllib.request.Request(
                     backend.url + self.path, data=body, method=method,
-                    headers={"Content-Type": self.headers.get(
-                        "Content-Type", "application/json")})
+                    headers=fwd)
                 resp_ctx = urllib.request.urlopen(
                     req, timeout=ctx.config.upstream_timeout_s)
                 break
@@ -348,11 +479,14 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # writing — a client that hung up must not leak the
                 # backend's outstanding count.
                 ctx.release(backend, ok=e.code < 500)
+                settle(0)           # nothing served: full refund
                 try:
                     data = e.read()
                 except Exception:        # body lost mid-flight
                     data = b'{"error":{"message":"upstream error"}}'
-                self._send_json_safely(e.code, data)
+                hdrs = ({"Retry-After": e.headers["Retry-After"]}
+                        if e.headers.get("Retry-After") else None)
+                self._send_json_safely(e.code, data, headers=hdrs)
                 return
             except Exception as e:
                 ctx.release(backend, ok=False)
@@ -367,6 +501,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     # (read timeout / mid-request reset): retrying would
                     # duplicate inference work — surface the failure
                     msg = f"upstream {backend.url} failed mid-request"
+                settle(0)
                 self._send_json_safely(502, json.dumps({"error": {
                     "message": msg, "type": "bad_gateway"}}).encode())
                 return
@@ -379,6 +514,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     headers_sent = True
+                    tail = b""
                     while True:
                         try:
                             chunk = resp.read1(65536)
@@ -387,16 +523,33 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                             break
                         if not chunk:
                             break
+                        # rolling tail: the final usage chunk (when the
+                        # client asked for stream_options.include_usage)
+                        # lives in the last few events
+                        tail = (tail + chunk)[-8192:]
                         self.wfile.write(hex(len(chunk))[2:].encode()
                                          + b"\r\n" + chunk + b"\r\n")
                         self.wfile.flush()
                     self.wfile.write(b"0\r\n\r\n")
+                    # settle against the stream's OWN final usage chunk
+                    # when present — charging max_tokens*n for a short
+                    # answer would drain the tenant's bucket many times
+                    # faster than real consumption.  Streams without
+                    # include_usage keep the admission estimate.
+                    m = re.findall(rb'"total_tokens":\s*(\d+)', tail)
+                    settle(int(m[-1]) if m else charged)
                 else:
                     data = resp.read()
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     headers_sent = True
                     self.wfile.write(data)
+                    try:
+                        # settle against the response's real usage
+                        settle(int(json.loads(data)["usage"]
+                                   ["total_tokens"]))
+                    except Exception:
+                        settle(charged)     # no usage: estimate stands
         except (BrokenPipeError, ConnectionResetError):
             pass                      # client went away — backend is fine
         except Exception:
@@ -412,6 +565,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 except Exception:
                     pass
         finally:
+            settle(charged)         # no-op when already settled above
             ctx.release(backend, backend_ok)
 
     def do_GET(self):
@@ -436,9 +590,14 @@ def main(argv=None):
                     help="backend URL (repeatable)")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--tenant-config", default=None, metavar="JSON|PATH",
+                    help="per-tenant token metering + rate limits for "
+                         "the whole pool (server/tenants.py); default: "
+                         "TPUSERVE_TENANTS env")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    gw = Gateway(args.backend, GatewayConfig(host=args.host, port=args.port))
+    gw = Gateway(args.backend, GatewayConfig(host=args.host, port=args.port,
+                                             tenant_config=args.tenant_config))
     port = gw.start()
     print(f"gateway listening on :{port}", flush=True)
     try:

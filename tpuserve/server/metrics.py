@@ -205,6 +205,55 @@ class ServerMetrics:
             "Tier-restore begin->commit wall time (the async copy "
             "overlaps the current dispatch; this is the admission hold, "
             "one engine cycle + copy tail)", _ITL_BUCKETS)
+        # Overload robustness (runtime/slo.py): SLO classes + the
+        # brownout ladder.  Shed/preempt counters partition overload's
+        # cost by class; the level gauge says which degradation rung the
+        # engine is on RIGHT NOW; the labelled queue-delay histogram is
+        # the per-class admission-latency SLI the estimator steers by.
+        self.requests_shed = counter(
+            "tpuserve_requests_shed",
+            "Requests rejected at intake by the brownout ladder or "
+            "evicted from a full queue for a stricter-class arrival "
+            "(HTTP 429 + Retry-After; no prefill was spent) — overload "
+            "costs batch work first instead of degrading every class "
+            "equally")
+        self.requests_preempted = counter(
+            "tpuserve_requests_preempted",
+            "Running batch-class rows preempted to seat a "
+            "stricter-class arrival (token-identical re-prefill "
+            "replay; bounded per request by the preemption budget).  "
+            "A subset of vllm_num_preemptions, which also counts "
+            "decode-OOM evictions")
+        self.brownout_level = gauge(
+            "tpuserve_brownout_level",
+            "Current graceful-degradation rung (0 normal, 1 spec off "
+            "for batch, 2 batch max_tokens capped, 3 batch shed, 4 "
+            "standard shed too) — entered on pressure immediately, "
+            "exited hysteretically (runtime/slo.py)")
+        self.queue_delay = Histogram(
+            "tpuserve_queue_delay_seconds",
+            "Admission queue delay per SLO class (slo_class= "
+            "interactive|standard|batch): arrival to first prefill "
+            "scheduling, fresh admissions only — the per-class SLI the "
+            "overload estimator steers the brownout ladder by",
+            ["model_name", "slo_class"], buckets=_DURATION_BUCKETS,
+            registry=self.registry)
+        # Multi-tenant metering (server/tenants.py): tenant = API key /
+        # LoRA adapter.  Label cardinality is bounded by the configured
+        # tenant set (+ "default").
+        self.tenant_tokens = Counter(
+            "tpuserve_tenant_tokens",
+            "Tokens served per tenant (prompt + generated; settled "
+            "against the estimate the rate limiter charged at "
+            "admission) — the metering source for per-tenant billing "
+            "and the token-bucket rate limits",
+            ["model_name", "tenant"], registry=self.registry)
+        self.tenant_rate_limited = Counter(
+            "tpuserve_tenant_rate_limited",
+            "Requests rejected 429 by a tenant's token-bucket rate "
+            "limit (Retry-After = time until the bucket refills "
+            "enough)",
+            ["model_name", "tenant"], registry=self.registry)
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -23,8 +23,10 @@ from typing import Optional
 from tpuserve.models.tokenizer import default_chat_template
 from tpuserve.server.tool_calls import ToolContext, normalize_messages
 from tpuserve.runtime.request import SamplingParams
+from tpuserve.runtime.slo import SLO_CLASSES, ShedError
 from tpuserve.server.metrics import ServerMetrics
 from tpuserve.server.runner import AsyncEngineRunner
+from tpuserve.server.tenants import TenantRegistry, estimate_cost
 
 logger = logging.getLogger("tpuserve.server")
 
@@ -66,6 +68,12 @@ class ServerConfig:
     # lands on another replica; the header exists so well-behaved clients
     # back off at all instead of treating the 503 as terminal.
     drain_retry_after_s: int = 1
+    # Per-tenant metering + rate limits (server/tenants.py): inline JSON
+    # or a file path; None = TPUSERVE_TENANTS env (unset: metering only,
+    # everything under tenant 'default').  Configure limits HERE only
+    # when this server is directly exposed — behind the gateway, enforce
+    # there instead (one charge per request, not two).
+    tenant_config: Optional[str] = None
 
 
 def _num(body: dict, key: str, default, cast):
@@ -157,6 +165,10 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
     priority = _num(body, "priority", 0, int)
     if not -(2**31) <= priority < 2**31:
         raise ValueError("'priority' must be a 32-bit integer")
+    slo_class = body.get("slo_class")
+    if slo_class is not None and slo_class not in SLO_CLASSES:
+        raise ValueError(f"'slo_class' must be one of "
+                         f"{'/'.join(SLO_CLASSES)}, got {slo_class!r}")
     guided = None
     guided_schema = None
     rf = body.get("response_format")
@@ -243,6 +255,7 @@ def _sampling_from_request(body: dict, cap: int) -> SamplingParams:
         guided=guided,
         guided_schema=guided_schema,
         priority=priority,
+        slo_class=slo_class or "standard",
         truncate_prompt_tokens=tpt,
     )
 
@@ -286,6 +299,11 @@ class OpenAIServer:
         # the static ring says a prefix should live.
         from tpuserve.server.kv_digest import PrefixDigestTracker
         self.kv_digest = PrefixDigestTracker()
+        # Multi-tenant metering/limits + per-tenant default SLO class
+        # (server/tenants.py); an empty registry still meters usage
+        # under 'default' and resolves LoRA adapters as tenants.
+        self.tenants = (TenantRegistry.load(self.config.tenant_config)
+                        or TenantRegistry())
         self.tpu_exporter = None
         if self.config.tpu_metrics:
             try:
@@ -645,6 +663,8 @@ class _Handler(BaseHTTPRequestHandler):
         # client for the submit timeout
         self.ctx._handler_enter()
         self._pid_cache = None     # per-request memo (keep-alive reuse)
+        self._tenant = None        # tenant accounting (keep-alive reuse)
+        self._charged = None
         try:
             if self.ctx.draining:
                 # graceful drain: in-flight streams keep running;
@@ -658,7 +678,24 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._do_post_inner()
         finally:
+            # a request that errored before serving refunds its whole
+            # rate-limit charge (settle is once-only; served paths
+            # already settled with their real token counts)
+            self._settle_tenant(0)
             self.ctx._handler_exit()
+
+    def _settle_tenant(self, actual: int) -> None:
+        """Reconcile the tenant rate-limit charge against tokens
+        actually served and feed the metering counter.  Idempotent per
+        request: the first call wins."""
+        charged, tenant = self._charged, self._tenant
+        if tenant is None or charged is None:
+            return
+        self._charged = None
+        self.ctx.tenants.settle(tenant, charged, actual)
+        if actual:
+            self.ctx.metrics.tenant_tokens.labels(
+                model_name=self.ctx.model_name, tenant=tenant).inc(actual)
 
     def _do_post_inner(self):
         if self.path == "/internal/migrate":
@@ -693,6 +730,33 @@ class _Handler(BaseHTTPRequestHandler):
                 body.get("stream_options"), dict):
             self._error(400, "'stream_options' must be an object")
             return
+        # ---- multi-tenant + SLO class (server/tenants.py, runtime/slo.py)
+        ctx = self.ctx
+        tenant = ctx.tenants.resolve(self.headers.get("Authorization"),
+                                     body.get("model"),
+                                     tuple(ctx.lora_names or ()))
+        self._tenant = tenant
+        if body.get("slo_class") is None:
+            # body field > X-SLO-Class header > tenant default > standard
+            cls = (self.headers.get("X-SLO-Class")
+                   or ctx.tenants.slo_class_for(tenant))
+            if cls is not None:
+                if cls not in SLO_CLASSES:
+                    self._error(400, "X-SLO-Class must be one of "
+                                     f"{'/'.join(SLO_CLASSES)}, got {cls!r}")
+                    return
+                params = dataclasses.replace(params, slo_class=cls)
+        cost = estimate_cost(body)
+        retry = ctx.tenants.charge(tenant, cost)
+        if retry is not None:
+            ctx.metrics.tenant_rate_limited.labels(
+                model_name=ctx.model_name, tenant=tenant).inc()
+            self._error(429, f"tenant {tenant!r} token rate limit "
+                             f"exceeded; retry in {retry:.1f}s",
+                        "rate_limit_exceeded",
+                        headers={"Retry-After": str(int(retry) + 1)})
+            return
+        self._charged = cost
         # digest the affinity key only after every API-layer validation
         # has passed: a 400'd request caches no KV and must not steer the
         # gateway here.  (Engine-side rejects — oversize prompt, 503
@@ -987,10 +1051,16 @@ class _Handler(BaseHTTPRequestHandler):
         leak their engine records."""
         ctx = self.ctx
         submits = []
+        # queue-side admission deadline: a request this handler would
+        # time out anyway (request_timeout_s) is aborted by the ENGINE
+        # while still queued, so overload never spends prefill on a
+        # response nobody is waiting for (runtime/slo.py)
+        deadline = time.monotonic() + ctx.config.request_timeout_s
         try:
             for i in range(n):
                 submits.append(ctx.runner.submit(
-                    params=self._choice_params(params, i, n), **kwargs))
+                    params=self._choice_params(params, i, n),
+                    deadline=deadline, **kwargs))
         except Exception:
             for rid, _ in submits:
                 ctx.runner.abort(rid)
@@ -1118,11 +1188,12 @@ class _Handler(BaseHTTPRequestHandler):
         deadline = t0 + ctx.config.request_timeout_s
         import queue as _queue
 
-        def fail(code, message, etype="invalid_request_error"):
+        def fail(code, message, etype="invalid_request_error",
+                 headers=None):
             for rid, _ in submits:
                 ctx.runner.abort(rid)
                 ctx.engine.requests.pop(rid, None)
-            self._error(code, message, etype)
+            self._error(code, message, etype, headers=headers)
 
         cands = []
         prompt_tokens = 0
@@ -1175,10 +1246,21 @@ class _Handler(BaseHTTPRequestHandler):
                 if isinstance(item, Exception):
                     if isinstance(item, ValueError):   # rejected at intake
                         fail(400, str(item))
+                    elif isinstance(item, ShedError):
+                        # brownout shed / queue-full class eviction:
+                        # retryable by contract, with the ladder's own
+                        # backoff hint (runtime/slo.py)
+                        fail(429, str(item), "overloaded", headers={
+                            "Retry-After": str(
+                                int(item.retry_after_s) + 1)})
                     elif isinstance(item, MemoryError):
                         # admission backpressure (scheduler max_waiting):
                         # retryable, not a server fault
-                        fail(503, str(item), "server_error")
+                        fail(503, str(item), "server_error",
+                             headers={"Retry-After": "1"})
+                    elif isinstance(item, TimeoutError):
+                        # queue-side deadline expiry (engine overloaded)
+                        fail(504, str(item), "server_error")
                     else:                              # engine-side fault
                         fail(500, str(item), "server_error")
                     return
@@ -1237,6 +1319,7 @@ class _Handler(BaseHTTPRequestHandler):
             "completion_tokens": completion_tokens,
             "total_tokens": prompt_tokens + completion_tokens,
         }
+        self._settle_tenant(usage["total_tokens"])
         obj = "chat.completion" if chat else "text_completion"
         self._json(200, {"id": oid, "object": obj, "created": int(time.time()),
                          "model": served, "choices": choices,
@@ -1251,6 +1334,11 @@ class _Handler(BaseHTTPRequestHandler):
         ret_ids = bool(body.get("return_token_ids"))
         submits = self._submit_choices(params, kwargs, n)
         oid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        # initialised BEFORE the try: the disconnect handlers settle the
+        # tenant with whatever was actually served — a client that drops
+        # the socket mid-stream must not refund tokens it received
+        prompt_toks = 0
+        completion_toks = 0
 
         def abort_all():
             for rid, _ in submits:
@@ -1285,8 +1373,12 @@ class _Handler(BaseHTTPRequestHandler):
                 ctx.engine.requests.pop(rid, None)
             if isinstance(err, TimeoutError):
                 self._error(504, str(err), "server_error")
+            elif isinstance(err, ShedError):
+                self._error(429, str(err), "overloaded", headers={
+                    "Retry-After": str(int(err.retry_after_s) + 1)})
             elif isinstance(err, MemoryError):
-                self._error(503, str(err), "server_error")
+                self._error(503, str(err), "server_error",
+                            headers={"Retry-After": "1"})
             elif isinstance(err, ValueError):
                 self._error(400, str(err))
             else:
@@ -1400,8 +1492,6 @@ class _Handler(BaseHTTPRequestHandler):
                     if include_usage:
                         chunk["usage"] = None
                     send_chunk(chunk)
-            prompt_toks = 0
-            completion_toks = 0
             errored = False
             lp_cursor = [0] * n        # per-choice logprob emission offset
             # tools: hold marker text out of content deltas per choice;
@@ -1524,6 +1614,7 @@ class _Handler(BaseHTTPRequestHandler):
                                 "completion_tokens": completion_toks,
                                 "total_tokens": prompt_toks + completion_toks,
                             }})
+            self._settle_tenant(prompt_toks + completion_toks)
             flush_chunks()
             done = b"data: [DONE]\n\n"
             self.wfile.write(hex(len(done))[2:].encode() + b"\r\n" + done + b"\r\n")
@@ -1531,9 +1622,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             abort_all()                 # client went away mid-stream
+            # tokens already written to the socket were SERVED: settle
+            # them, or dropping the connection before [DONE] would evade
+            # the tenant's rate limit indefinitely
+            self._settle_tenant(prompt_toks + completion_toks)
         except Exception:
             logger.exception("streaming failed")
             abort_all()
+            self._settle_tenant(prompt_toks + completion_toks)
         finally:
             for rid, _ in submits:
                 ctx.engine.requests.pop(rid, None)
@@ -1688,6 +1784,16 @@ def main(argv=None):
                          "(runtime/faults.py), e.g. "
                          "'decode_dispatch:raise:0.02'; equivalent to the "
                          "TPUSERVE_FAULTS env var")
+    ap.add_argument("--no-slo-classes", action="store_true",
+                    help="disable SLO class scheduling + the brownout "
+                         "ladder (runtime/slo.py): classless FIFO, no "
+                         "class-aware admission/preemption/shedding "
+                         "(TPUSERVE_SLO_CLASSES=0 is the env twin)")
+    ap.add_argument("--tenant-config", default=None, metavar="JSON|PATH",
+                    help="per-tenant token metering + rate limits "
+                         "(server/tenants.py); inline JSON or a file "
+                         "path (default: TPUSERVE_TENANTS).  Behind the "
+                         "gateway, configure limits there instead")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=25.0,
                     help="graceful-drain budget on SIGTERM, seconds; keep "
@@ -1744,6 +1850,7 @@ def main(argv=None):
         quantization=args.quantization,
         kv_tiers=False if args.no_kv_tiers else None,
         kv_host_bytes=args.kv_host_bytes, kv_spill_dir=args.kv_spill_dir,
+        slo_classes=False if args.no_slo_classes else None,
         faults=args.faults, step_watchdog_s=args.step_watchdog_s)
     mesh = None
     if args.pp > 1 and args.tp > 1:
@@ -1803,6 +1910,7 @@ def main(argv=None):
     server = OpenAIServer(engine, ServerConfig(
         host=args.host, port=args.port, chat_template=chat_template,
         tool_call_parser=args.tool_call_parser, warmup_embed=warmup_embed,
+        tenant_config=args.tenant_config,
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)

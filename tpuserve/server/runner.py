@@ -40,6 +40,10 @@ class _Submit:
     request_id: Optional[str] = None
     assigned_id: Optional[str] = None
     adapter: Optional[str] = None     # multi-LoRA adapter name
+    # admission deadline (time.monotonic): still queued past this, the
+    # engine aborts the request queue-side (no prefill spent) and the
+    # client gets a TimeoutError through the output queue
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -162,6 +166,7 @@ class AsyncEngineRunner:
                params: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                adapter: Optional[str] = None,
+               deadline: Optional[float] = None,
                ) -> tuple[str, "queue.Queue[RequestOutput | Exception | None]"]:
         """Enqueue a request; returns (request_id, output queue).  The queue
         yields RequestOutput items, then None when finished; an Exception
@@ -170,7 +175,8 @@ class AsyncEngineRunner:
                       prompt_token_ids=list(prompt_token_ids) if prompt_token_ids else None,
                       params=params or SamplingParams(),
                       out_queue=queue.Queue(), rid_event=threading.Event(),
-                      request_id=request_id, adapter=adapter)
+                      request_id=request_id, adapter=adapter,
+                      deadline=deadline)
         self._intake.put(sub)
         self._wake.set()
         sub.rid_event.wait(timeout=60)
@@ -256,6 +262,8 @@ class AsyncEngineRunner:
                 continue
             try:
                 kw = {"adapter": msg.adapter} if msg.adapter else {}
+                if msg.deadline is not None:
+                    kw["deadline"] = msg.deadline
                 rid = self.engine.add_request(
                     prompt=msg.prompt, prompt_token_ids=msg.prompt_token_ids,
                     params=msg.params, request_id=msg.request_id, **kw)
@@ -363,9 +371,12 @@ class AsyncEngineRunner:
             self._singleton_faults.clear()
 
     def _fail_request(self, rid: str, message: str,
-                      poisoned: bool = False) -> None:
+                      poisoned: bool = False,
+                      exc: Optional[Exception] = None) -> None:
         """Fail ONE stream with a clean per-request error — the whole point
-        of salvage: a poisoned batch costs one request, not a batch."""
+        of salvage: a poisoned batch costs one request, not a batch.
+        ``exc`` overrides the default RuntimeError so typed rejections
+        (ShedError -> 429, TimeoutError -> 504) keep their HTTP status."""
         try:
             self.engine.abort_request(rid)
         except Exception:
@@ -375,11 +386,23 @@ class AsyncEngineRunner:
         self._last_token_time.pop(rid, None)
         q = self._out_queues.pop(rid, None)
         if q is not None:
-            q.put(RuntimeError(message))
+            q.put(exc if exc is not None else RuntimeError(message))
             q.put(None)
         if poisoned:
             self._bump_stat("requests_poisoned")
         logger.warning("request %s failed: %s", rid, message)
+
+    def _drain_engine_errors(self) -> None:
+        """Terminal errors the engine decided for QUEUED requests
+        (admission-deadline expiry, queue-full class eviction —
+        runtime/slo.py): route each to its waiting client with the typed
+        exception so the HTTP layer keeps the right status code."""
+        for eng in self._inner_engines():
+            drain = getattr(eng, "drain_request_errors", None)
+            if drain is None:
+                continue
+            for rid, exc in drain():
+                self._fail_request(rid, str(exc), exc=exc)
 
     def _handle_step_fault(self, exc: Exception) -> None:
         """Salvage instead of mass-fail: requeue every in-flight request
@@ -653,6 +676,10 @@ class AsyncEngineRunner:
                                   self.metrics.kv_tier_dropped),
                                  ("kv_restored_blocks",
                                   self.metrics.kv_restored),
+                                 ("requests_shed",
+                                  self.metrics.requests_shed),
+                                 ("slo_preemptions",
+                                  self.metrics.requests_preempted),
                                  ("requests_salvaged",
                                   self.metrics.requests_salvaged),
                                  ("requests_poisoned",
@@ -680,6 +707,21 @@ class AsyncEngineRunner:
                     for v in lats:
                         self.metrics.kv_restore_latency.observe(v)
                     lats.clear()
+            # overload robustness (runtime/slo.py): current brownout
+            # level (max across disagg halves) + the per-class
+            # queue-delay observations the scheduler noted at admission
+            # (drained loop-side, same thread that appended them)
+            self.metrics.brownout_level.set(
+                max((getattr(s, "brownout_level", 0) for s in stats_objs),
+                    default=0))
+            for e in (inners or [eng]):
+                ctl = getattr(e, "_slo", None)
+                if ctl is not None:
+                    for cls, delay in ctl.drain_delay_obs():
+                        self.metrics.queue_delay.labels(
+                            slo_class=cls,
+                            model_name=self.metrics.model_name,
+                        ).observe(delay)
         # tiered-KV residency gauges: tier=hbm is the device cached pool,
         # host/spill come from the engines' tier stores (exactly-one-tier:
         # the three gauges partition every resolvable prefix hash)
@@ -726,6 +768,7 @@ class AsyncEngineRunner:
             if self._consume_hard_trip(seq):
                 continue
             self._note_salvage_progress()
+            self._drain_engine_errors()
             self._route_outputs(outputs)
             self._update_gauges()
         logger.info("engine loop stopped")
