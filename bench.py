@@ -282,7 +282,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                   prefill_split=1, kv_quant=None, interleave=False,
                   adaptive_window=True, block_size=32, mixed=False,
                   mixed_budget=None, faults=None, num_blocks=None,
-                  kv_tiers=None, max_num_seqs=None):
+                  kv_tiers=None, max_num_seqs=None, flight=None):
     from tpuserve.runtime.engine import Engine, EngineConfig
     from tpuserve.runtime.kv_cache import CacheConfig
     from tpuserve.runtime.scheduler import SchedulerConfig
@@ -319,7 +319,7 @@ def _build_engine(model, batch, prompt_len, gen_len, *, attn_impl,
                        pipeline_decode=pipeline, speculative=spec,
                        multi_step=multi_step, quantization=quantization,
                        adaptive_multi_step=adaptive_window,
-                       kv_tiers=kv_tiers, faults=faults)
+                       kv_tiers=kv_tiers, faults=faults, flight=flight)
     if disagg:
         from tpuserve.parallel.disagg import DisaggregatedEngine
         return DisaggregatedEngine(cfg, cfg)
@@ -1319,6 +1319,15 @@ def main(argv=None):
                          "phases the native/batched host path moved off "
                          "per-request Python; TPUSERVE_HOST_BATCHED=0 "
                          "measures the legacy path for the A/B)")
+    ap.add_argument("--recorder-ab", action="store_true",
+                    dest="recorder_ab",
+                    help="flight-recorder overhead guard (runtime/"
+                         "flight.py): after the main (recorder-on, the "
+                         "default) run, repeat the identical workload on "
+                         "an engine built with the recorder removed "
+                         "(TPUSERVE_FLIGHT=0 equivalent) and report the "
+                         "tok/s delta; 'ok' asserts the always-on "
+                         "recorder costs <1%")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -1424,7 +1433,12 @@ def main(argv=None):
                            interleave=args.interleave_prefill,
                            adaptive_window=not args.no_adaptive_window,
                            block_size=args.block_size, mixed=args.mixed,
-                           mixed_budget=args.mixed_budget)
+                           mixed_budget=args.mixed_budget,
+                           # the ON arm of --recorder-ab must actually
+                           # carry the recorder: a TPUSERVE_FLIGHT=0
+                           # shell would otherwise compare off-vs-off and
+                           # publish a green guard that measured nothing
+                           flight=True if args.recorder_ab else None)
 
     eng0 = getattr(engine, "prefill", engine)
     rng = np.random.default_rng(0)
@@ -1646,6 +1660,73 @@ def main(argv=None):
             "vs_colocated": round(d_tok_s / decode_tok_s, 3)
                             if decode_tok_s else 0.0,
         }
+
+    if args.recorder_ab:
+        # Flight-recorder overhead guard: the recorder-ON engine is the
+        # main (already-warm) engine — the recorder is always-on by
+        # default — and the OFF twin is built identically with the
+        # recorder removed.  INTERLEAVED pairs (on, off, on, off, ...)
+        # with medians per arm, the same drift-cancelling methodology as
+        # the host-overhead A/B: a sequential on-block/off-block ordering
+        # measured an 11% phantom delta from machine drift on CPU.  The
+        # guard contract is <1% tok/s.
+        with tpu_guard("recorder A/B"):
+            off_engine = _build_engine(
+                model, batch, prompt_len, gen_len, attn_impl=attn_impl,
+                pipeline=pipeline, spec_k=args.spec,
+                multi_step=args.multi_step, quantization=args.quant,
+                prefill_split=args.prefill_split, kv_quant=args.kv_quant,
+                interleave=args.interleave_prefill,
+                block_size=args.block_size, mixed=args.mixed,
+                mixed_budget=args.mixed_budget,
+                adaptive_window=not args.no_adaptive_window,
+                flight=False)
+            _warm(off_engine, batch, prompt_len, arrivals=poisson,
+                  modes=warm_modes)
+            pairs = max(n_rep, 3)
+            on_runs, off_runs = [], []
+            eng_main = getattr(engine, "prefill", engine)
+            engine_flight_on = getattr(eng_main, "flight", None) is not None \
+                and eng_main.flight.enabled
+            assert engine_flight_on, \
+                "--recorder-ab ON arm has no recorder (flight=True forced " \
+                "at build — a facade must forward EngineConfig.flight)"
+            # the recorder flips the process-global hostprof profiler
+            # always-on; a true TPUSERVE_FLIGHT=0 process never pays it,
+            # so the OFF arm must run with it disabled or the guard
+            # undercounts the recorder's real cost
+            from tpuserve.runtime.hostprof import PROF
+            for _ in range(pairs):
+                PROF.enabled = True
+                on_runs.append(_run_workload(
+                    engine, prompts, params,
+                    arrival_offsets=arrival_offsets))
+                PROF.enabled = False
+                off_runs.append(_run_workload(
+                    off_engine, prompts, params,
+                    arrival_offsets=arrival_offsets))
+            # restore the ON-arm state (the main engine's recorder is
+            # forced on under --recorder-ab, so this is always True here)
+            PROF.enabled = engine_flight_on
+        on_tok_s = _rate(sorted(on_runs, key=_rate)[len(on_runs) // 2])
+        off_tok_s = _rate(sorted(off_runs, key=_rate)[len(off_runs) // 2])
+        overhead = (1.0 - on_tok_s / off_tok_s) if off_tok_s else 0.0
+        out["recorder_ab"] = {
+            "pairs": pairs,
+            "on_tok_s": round(on_tok_s, 1),
+            "off_tok_s": round(off_tok_s, 1),
+            "on_runs_tok_s": sorted(round(_rate(x), 1) for x in on_runs),
+            "off_runs_tok_s": sorted(round(_rate(x), 1)
+                                     for x in off_runs),
+            # negative = recorder-on measured FASTER (noise floor)
+            "overhead_frac": round(overhead, 4),
+            "ok": overhead < 0.01,
+        }
+        if overhead >= 0.01:
+            import sys as _sys
+            print(f"recorder-ab GUARD FAILED: always-on flight recorder "
+                  f"costs {overhead:.1%} tok/s (budget <1%)",
+                  file=_sys.stderr, flush=True)
 
     if args.faults:
         # Recovery-overhead A/B (crash-only engine): same workload, same

@@ -269,13 +269,19 @@ def test_sse_stream_content_identical_batched_vs_per_token(monkeypatch):
 
 def test_hostprof_report_shape_and_noop_when_disabled():
     from tpuserve.runtime.hostprof import PROF
+    # the flight recorder (runtime/flight.py) flips the module profiler
+    # always-on when an engine with the recorder is built — force the
+    # disabled state so this test pins the disabled BEHAVIOUR, then
+    # RESTORE the process-global flag (other modules' recorders rely on
+    # it for their phase_ms assertions)
+    was_enabled = PROF.enabled
+    PROF.enabled = False
     PROF.reset()
-    assert not PROF.enabled
-    with PROF.phase("block"):
-        pass
-    assert PROF.cycles == 0 and not PROF.seconds   # disabled = no-op
-    PROF.enabled = True
     try:
+        with PROF.phase("block"):
+            pass
+        assert PROF.cycles == 0 and not PROF.seconds   # disabled = no-op
+        PROF.enabled = True
         PROF.bump_cycle()
         with PROF.phase("block"):
             pass
@@ -283,7 +289,7 @@ def test_hostprof_report_shape_and_noop_when_disabled():
             pass
         rep = PROF.report()
     finally:
-        PROF.enabled = False
+        PROF.enabled = was_enabled
         PROF.reset()
     assert rep["cycles"] == 1
     assert set(rep["phases"]) >= {"block", "schedule"}

@@ -75,6 +75,10 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # measured on the same commit.
     ("two-class", ["--two-class"], {}),
     ("two-class-noslo", ["--two-class"], {"TPUSERVE_SLO_CLASSES": "0"}),
+    # Flight recorder (ISSUE 9): the always-on overhead guard on silicon
+    # — recorder-on vs TPUSERVE_FLIGHT=0 on the same workload; the
+    # acceptance contract is <1% tok/s (CPU row in BENCHMARKS.md).
+    ("recorder-ab", ["--recorder-ab"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -89,6 +89,11 @@ PRIORITY = [
     # headroom number that says how many concurrent streams one host can
     # feed before the Python loop caps the chip.
     "host-overhead", "host-overhead-legacy",
+    # Flight recorder (NEW this round; ISSUE 9 acceptance): the
+    # always-on recorder's tok/s cost on silicon — the <1% guard that
+    # keeps per-request lifecycle tracing on in production (CPU A/B in
+    # BENCHMARKS.md "Flight recorder").
+    "recorder-ab",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

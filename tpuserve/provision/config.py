@@ -83,6 +83,14 @@ class DeployConfig:
     # fronted fleets configure the gateway instead (one charge per
     # request).  None = no tenancy config (metering under 'default').
     tenants: Optional[dict] = None
+    # Engine flight recorder (runtime/flight.py): always-on lifecycle
+    # tracing + post-mortem bundles.  False exports TPUSERVE_FLIGHT=0
+    # (the measured-overhead A/B lever, bench.py --recorder-ab).
+    flight: bool = True
+    # Post-mortem bundle directory — on the model PVC next to the
+    # compile caches, so watchdog/fault-storm bundles survive the pod
+    # that wrote them (exported as TPUSERVE_FLIGHT_DIR).
+    flight_dir: str = "/models/.flight"
     # Hang watchdog threshold (server --step-watchdog-s): a dispatch
     # blocking past this is failed + salvaged like an exception instead
     # of stranding clients behind a wedged device call.  0 disables.

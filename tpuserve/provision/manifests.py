@@ -206,6 +206,15 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
            # .npz tables instead of walking 151k token texts inline.
            {"name": "TPUSERVE_FSM_CACHE_DIR",
             "value": "/models/.fsm-cache"}]
+    if not cfg.flight:
+        # kill switch for the engine flight recorder (the --recorder-ab
+        # measured-overhead lever; default on)
+        env.append({"name": "TPUSERVE_FLIGHT", "value": "0"})
+    elif cfg.flight_dir:
+        # post-mortem bundles (watchdog trips, fault storms, poison
+        # isolation) land on the model PVC and survive the pod
+        env.append({"name": "TPUSERVE_FLIGHT_DIR",
+                    "value": cfg.flight_dir})
     if cfg.faults:
         # chaos drill: arm the engine's deterministic fault-injection
         # layer (runtime/faults.py) so recovery claims are verified

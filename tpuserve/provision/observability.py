@@ -30,6 +30,7 @@ def setup(cfg: DeployConfig, kube: KubeCtl) -> None:
     _collector_rbac(cfg, kube)
     _otel_prometheus(cfg, kube)
     _collector(cfg, kube)
+    _grafana_dashboard(cfg, kube)
     _wait_ready(cfg, kube)
 
 
@@ -410,6 +411,41 @@ def collector_manifests(cfg: DeployConfig) -> list[dict]:
 
 def _collector(cfg: DeployConfig, kube: KubeCtl) -> None:
     kube.apply_manifest(manifests.render(*collector_manifests(cfg)))
+
+
+# --- Grafana dashboard (closes the reference's Grafana parity gap: its
+#     observability playbook prints a query cookbook, :754-775, but ships
+#     no dashboard) ---------------------------------------------------------
+
+def grafana_dashboard_manifests(cfg: DeployConfig) -> list[dict]:
+    """The generated engine dashboard (tools/gen_dashboard.py — derived
+    from the metrics registry, pinned by a golden test) as a ConfigMap
+    labelled ``grafana_dashboard: "1"``: the Grafana sidecar shipped by
+    the kube-prometheus-stack the cluster layer installs imports every
+    ConfigMap carrying that label."""
+    from tools.gen_dashboard import render as render_dashboard
+    return [{
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "tpuserve-grafana-dashboard",
+                     "namespace": cfg.monitoring_namespace,
+                     "labels": {"grafana_dashboard": "1",
+                                "app": "tpuserve"}},
+        "data": {"tpuserve-engine.json": render_dashboard()},
+    }]
+
+
+def _grafana_dashboard(cfg: DeployConfig, kube: KubeCtl) -> None:
+    try:
+        objs = grafana_dashboard_manifests(cfg)
+    except ImportError:
+        # installed-package deploys without the tools/ tree: the
+        # dashboard is repo-generated, skip rather than fail the deploy
+        logger.warning("tools.gen_dashboard unavailable; skipping the "
+                       "Grafana dashboard ConfigMap")
+        return
+    kube.apply_manifest(manifests.render(
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": cfg.monitoring_namespace}}, *objs))
 
 
 def _wait_ready(cfg: DeployConfig, kube: KubeCtl) -> None:

@@ -150,6 +150,14 @@ class EngineConfig:
     slo_classes: Optional[bool] = None
     # Brownout/estimator knobs; None = SloConfig() defaults.
     slo: Optional["SloConfig"] = None
+    # Engine flight recorder (runtime/flight.py): always-on ring of
+    # per-request lifecycle events + per-cycle step records, surfaced at
+    # /debug/requests/{id} and /debug/engine, exported as OTLP child
+    # spans, and dumped as post-mortem bundles on watchdog trips /
+    # fault storms / poison isolation.  None = TPUSERVE_FLIGHT env
+    # (default on; =0 removes the recorder byte-for-byte — the
+    # bench.py --recorder-ab overhead A/B lever).
+    flight: Optional[bool] = None
     # Grammar-FSM guided decoding (runtime/grammar/): compile guided
     # specs to token-level FSMs whose per-state masks ride the fused
     # decode window (true logit masking, distribution-correct), so
@@ -240,6 +248,11 @@ class EngineStats:
     requests_shed: int = 0
     slo_preemptions: int = 0
     brownout_level: int = 0
+    # flight recorder (runtime/flight.py): post-mortem bundles written
+    # (watchdog trip / fault-storm fail-all / poison isolation); the
+    # tpuserve_flight_postmortems_total metric points operators at the
+    # bundle files on the model PVC
+    flight_postmortems: int = 0
     # tiered KV cache (runtime/kv_tiers.py): blocks demoted out of HBM
     # into the host tier; host->PVC spills; blocks dropped off the last
     # tier (KV lost, re-prefill on next use); blocks restored back into
@@ -528,6 +541,23 @@ class Engine:
                                    sched_cfg.resolve_max_waiting())
                      if slo_on else None)
         self.scheduler.slo = self._slo
+        # Flight recorder (runtime/flight.py): always-on lifecycle ring
+        # + per-cycle step records; single-writer from this engine's
+        # loop thread, snapshot reads from serving threads.  Hot-path
+        # emission sites gate on the cached bool so TPUSERVE_FLIGHT=0
+        # costs one attribute load per site (the --recorder-ab lever).
+        from tpuserve.runtime.flight import FlightRecorder
+        self.flight = FlightRecorder(enabled=config.flight)
+        self._flight_on = self.flight.enabled
+        self.scheduler.flight = self.flight if self._flight_on else None
+        if self._slo is not None:
+            self._slo.flight = self.flight if self._flight_on else None
+        if self._flight_on:
+            # hostprof goes always-on at low overhead (two perf_counter
+            # calls per phase) so every step record carries its
+            # schedule/block/dispatch/detokenize/flush breakdown
+            PROF.enabled = True
+        self._step_kind = "idle"
         # terminal errors for QUEUED requests decided engine-side
         # (deadline expiry, queue-full class eviction): (rid, exc) pairs
         # the runner drains and routes to the waiting clients — the
@@ -545,6 +575,10 @@ class Engine:
         spec = (config.faults if config.faults is not None
                 else _os.environ.get("TPUSERVE_FAULTS"))
         self.faults = FaultInjector.from_spec(spec, seed=config.seed)
+        if self._flight_on:
+            # firing chaos rules land in the affected requests' timelines
+            # (post-mortems and salvage sequences become self-explanatory)
+            self.faults.on_fire = self.flight.fault_hook
         # Debug strict mode: cross-check block refcounts against live
         # requests after every successful step (block_manager.py
         # check_integrity) — the chaos/salvage tests run with it on, so
@@ -737,6 +771,10 @@ class Engine:
                     adapter: Optional[str] = None,
                     deadline: Optional[float] = None) -> str:
         params = params or SamplingParams()
+        # rid assigned FIRST so intake-policy events (SHED,
+        # BROWNOUT_CLAMPED) land in the flight recorder under the id the
+        # caller can actually look up at /debug/requests/{id}
+        request_id = request_id or f"req-{next(self._req_counter)}"
         # SLO intake policy (runtime/slo.py) — BEFORE tokenization, so a
         # shed costs nothing: validate the class (400 at the API edge),
         # shed classes the brownout ladder has turned away (429 +
@@ -750,6 +788,10 @@ class Engine:
             if retry_after is not None:
                 self.stats.requests_shed += 1
                 self._slo.shed_total += 1
+                self.flight.req_event(request_id, "SHED",
+                                      slo_class=params.slo_class,
+                                      level=self._slo.level,
+                                      retry_after_s=retry_after)
                 raise ShedError(
                     f"overloaded (brownout level {self._slo.level}): "
                     f"{params.slo_class} work is shed; retry in "
@@ -757,6 +799,9 @@ class Engine:
             cap = self._slo.max_tokens_cap(rank)
             if cap is not None and params.max_tokens > cap:
                 params = dataclasses.replace(params, max_tokens=cap)
+                self.flight.req_event(request_id, "BROWNOUT_CLAMPED",
+                                      max_tokens=cap,
+                                      level=self._slo.level)
         caller_ids = prompt_token_ids is not None
         adapter_idx = None
         if adapter is not None:
@@ -836,7 +881,6 @@ class Engine:
                     f"{score / 2**30:.1f} GiB of attention scores "
                     f"(budget {self.PP_PREFILL_SCORE_BUDGET_BYTES / 2**30:.0f}"
                     " GiB); lower max_tokens or use tp instead of pp")
-        request_id = request_id or f"req-{next(self._req_counter)}"
         if params.guided is not None:
             if params.guided not in ("json", "json_schema", "regex",
                                      "choice"):
@@ -884,6 +928,9 @@ class Engine:
             self._guided_fsm.pop(request_id, None)
             self._guided_plan.pop(request_id, None)
             raise
+        self.flight.req_event(request_id, "QUEUED",
+                              slo_class=params.slo_class,
+                              prompt_tokens=len(prompt_token_ids))
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
             # an arrival into a BUSY engine predicts more: shrink the next
@@ -994,6 +1041,11 @@ class Engine:
             self._guided_plan.pop(request_id, None)
             raise
         self.requests[request_id] = req
+        # migrated sequences skip the waiting queue entirely: QUEUED and
+        # ADMITTED collapse into the adoption instant
+        self.flight.req_event(request_id, "QUEUED", migrated=True,
+                              prompt_tokens=len(prompt_token_ids))
+        self.flight.req_event(request_id, "ADMITTED", migrated=True)
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
             # cross-pod migration into a busy decode pod is an arrival
@@ -1021,6 +1073,7 @@ class Engine:
             self._guided.pop(request_id, None)
             self._guided_fsm.pop(request_id, None)
             self._guided_plan.pop(request_id, None)
+            self.flight.req_event(request_id, "FINISHED", cause="abort")
             return True
         # A mid-prefill chunked request (holds blocks but isn't RUNNING yet)
         # has later blocks with no KV written: freeing them into the
@@ -1034,6 +1087,7 @@ class Engine:
         self._guided.pop(request_id, None)
         self._guided_fsm.pop(request_id, None)
         self._guided_plan.pop(request_id, None)
+        self.flight.req_event(request_id, "FINISHED", cause="abort")
         return True
 
     # ---- overload robustness (runtime/slo.py) -------------------------
@@ -1051,6 +1105,8 @@ class Engine:
                     and victim.num_prefilled == 0
                     and not victim.output_token_ids
                     and victim.state == RequestState.WAITING):
+                self.flight.req_event(victim.request_id, "SHED",
+                                      cause="queue_full_eviction")
                 self.abort_request(victim.request_id)
                 self.stats.requests_shed += 1
                 self._slo.shed_total += 1
@@ -1174,6 +1230,8 @@ class Engine:
             self.block_manager.free(r.request_id, cache_blocks=False)
             r.state = RequestState.PREEMPTED
             r.num_prefilled = 0
+            self.flight.req_event(r.request_id, "SALVAGED",
+                                  output_tokens=len(r.output_token_ids))
         for r in self.scheduler.waiting:
             if r.num_prefilled > 0:
                 # mid-chunk prompts hold blocks whose KV is now suspect too
@@ -1202,7 +1260,15 @@ class Engine:
         runtime complement to tpulint's static kv-leak pass (faulted
         steps skip the check: their orphans are reconciled by the
         runner's salvage path, not mid-exception)."""
+        t_cycle = time.monotonic()
         outputs = self._step_inner()
+        if self._flight_on:
+            dispatched = bool(self._dispatch_rids)
+            self.flight.note_step(
+                self._step_kind, len(self._dispatch_rids),
+                self.stats.step_actual_tokens if dispatched else 0,
+                self.stats.step_padded_tokens if dispatched else 0,
+                time.monotonic() - t_cycle)
         if self._slo is not None:
             # estimator tick once per successful cycle (queue depth +
             # the EWMAs fed during scheduling) drives the brownout
@@ -1230,6 +1296,7 @@ class Engine:
 
     def _step_inner(self) -> list[RequestOutput]:
         self._dispatch_rids = ()
+        self._step_kind = "idle"
         PROF.bump_cycle()
         # overload robustness, BEFORE scheduling: deadline-expired queued
         # requests leave without spending prefill, and a stricter-class
@@ -1422,6 +1489,8 @@ class Engine:
             self.kv_cache = scatter_block_pages(self.kv_cache, blocks,
                                                 pages)
             req.state = RequestState.RESTORING
+            self.flight.req_event(req.request_id, "RESTORING",
+                                  blocks=len(blocks))
             self._restores[req.request_id] = (span, blocks,
                                               time.monotonic())
             self.stats.kv_restores += 1
@@ -1716,6 +1785,7 @@ class Engine:
     def _run_prefill(self, batch: ScheduledBatch) -> list[RequestOutput]:
         reqs = batch.requests
         self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self._step_kind = "prefill"
         L = batch.padded_len
         B = next_power_of_2(len(reqs))
         tokens = np.zeros((B, L), np.int32)
@@ -1731,6 +1801,10 @@ class Engine:
             prompt_lens[i] = len(ids)
             slot_ids[i, :len(ids)] = self._token_slots(req.request_id, 0,
                                                        len(ids))
+            if self._flight_on:
+                self.flight.req_event(req.request_id, "PREFILL",
+                                      tokens=len(ids),
+                                      replay=bool(req.output_token_ids))
         kw = self._lora_kw(reqs, B)
         self._demote_evicted()
         with PROF.phase("dispatch"):
@@ -1775,6 +1849,7 @@ class Engine:
         its last chunk, which samples the first token."""
         req = batch.requests[0]
         self._dispatch_rids = (req.request_id,)
+        self._step_kind = "prefill_chunk"
         C = batch.padded_len
         ids = self._prefill_tokens(req)
         if req.num_prefilled == 0:
@@ -1792,6 +1867,9 @@ class Engine:
         done = req.num_prefilled
         chunk = ids[done:done + C]
         n = len(chunk)
+        if self._flight_on:
+            self.flight.req_event(req.request_id, "PREFILL_CHUNK",
+                                  done=done, tokens=n, total=len(ids))
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :n] = chunk
         slot_ids = np.full((1, C), PAD_SLOT, np.int32)
@@ -1852,6 +1930,7 @@ class Engine:
         outputs = self._flush_pending() + self._flush_window()
         decode_reqs = [r for r in batch.requests if not r.finished]
         self._dispatch_rids = tuple(r.request_id for r in decode_reqs)
+        self._step_kind = "mixed"
         # decode rows each append one KV slot — the same reserve-then-
         # append preemption discipline as _run_decode (no pending here:
         # both pipelines were just flushed); probe + charge are one
@@ -1886,6 +1965,10 @@ class Engine:
             done = req.num_prefilled
             take = min(n, len(ids) - done)
             chunks.append((req, ids, done, take))
+            if self._flight_on:
+                self.flight.req_event(req.request_id, "PREFILL_CHUNK",
+                                      done=done, tokens=take,
+                                      total=len(ids), mixed=True)
         if not decode_reqs and not chunks:
             return outputs
         self._dispatch_rids = tuple(
@@ -1926,6 +2009,10 @@ class Engine:
             q_starts[i] = i
             q_lens[i] = 1
             last_rows[i] = i
+        if self._flight_on and decode_reqs:
+            self.flight.req_event_many(
+                tuple(r.request_id for r in decode_reqs), "WINDOW",
+                steps=1, mixed=True)
         self._bm_fill_tables(decode_reqs, block_tables)
         blk_seq = np.full((T // blk,), -1, np.int32)
         for si, ((req, ids, done, take), start) in enumerate(
@@ -2092,6 +2179,7 @@ class Engine:
         if not reqs:
             return outputs + self._flush_window()
         self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self._step_kind = "window"
         self.faults.check("kv_alloc", self._dispatch_rids)
         # Rows continuing from the in-flight window need p.steps extra KV
         # slots (its advance hasn't run yet); reserving the conservative
@@ -2134,6 +2222,14 @@ class Engine:
                 # chained rows overwrite this with the device gstate via
                 # the same use_host/gather select as their input tokens
                 gstate_host[i] = gent[1]
+        if self._flight_on:
+            # recorded at DISPATCH (entered a fused window), so a fault
+            # at the flush still shows the window in the timeline;
+            # consumed tokens land in FINISHED.  One batched ring entry
+            # for the whole dispatch — per-row events cost tok/s at 256
+            # streams (--recorder-ab guard).
+            self.flight.req_event_many(self._dispatch_rids, "WINDOW",
+                                       steps=S)
         self._bm_fill_tables(reqs, block_tables)
         mode = ("greedy" if all(r.params.greedy for r in reqs)
                 else "temperature"
@@ -2409,6 +2505,9 @@ class Engine:
             self.scheduler.finish(req)
             self.stats.requests_finished += 1
             self.stats.window_overrun_tokens += steps - consumed
+            self.flight.req_event(req.request_id, "FINISHED",
+                                  cause=reason.value,
+                                  output_tokens=len(req.output_token_ids))
             self._detok.pop(req.request_id, None)
             self._guided.pop(req.request_id, None)
             self._guided_fsm.pop(req.request_id, None)
@@ -2480,6 +2579,7 @@ class Engine:
             if not reqs:
                 return outputs
         self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self._step_kind = "decode"
         self.faults.check("kv_alloc", self._dispatch_rids)
         B = self.scheduler.decode_bucket(len(reqs))
         host_tokens = np.zeros((B,), np.int32)
@@ -2503,6 +2603,9 @@ class Engine:
                 in_flight.add(req.request_id)
             positions[i] = nt - 1
             seq_lens[i] = nt
+        if self._flight_on:
+            self.flight.req_event_many(self._dispatch_rids, "WINDOW",
+                                       steps=1)
         if pending is not None:
             tokens = _select_tokens(pending.toks, jnp.asarray(gather),
                                     jnp.asarray(host_tokens),
@@ -2544,6 +2647,7 @@ class Engine:
         if not reqs:
             return outputs
         self._dispatch_rids = tuple(r.request_id for r in reqs)
+        self._step_kind = "spec"
         k = self._spec.num_draft_tokens
         K = k + 1
         if self._draft_params is not None:
@@ -2578,6 +2682,11 @@ class Engine:
             # verify window sits inside the reserved table
             slot_ids[i] = self._token_slots(r.request_id, base[i], K,
                                             block_table=block_tables[i])
+        if self._flight_on:
+            # spec verify window: K is the max per-row window; accepted
+            # counts surface in FINISHED/output deltas
+            self.flight.req_event_many(self._dispatch_rids, "WINDOW",
+                                       steps=K, spec=True)
         sampled = not all(r.params.greedy for r in reqs)
         self._demote_evicted()
         accept_h = None
@@ -3240,6 +3349,9 @@ class Engine:
             req.finish_time = time.monotonic()
             self.scheduler.finish(req)
             self.stats.requests_finished += 1
+            self.flight.req_event(req.request_id, "FINISHED",
+                                  cause=reason.value,
+                                  output_tokens=len(req.output_token_ids))
             self._detok.pop(req.request_id, None)
             self._guided.pop(req.request_id, None)
             self._guided_fsm.pop(req.request_id, None)

@@ -85,6 +85,12 @@ class FaultInjector:
         self._rng = random.Random(seed)
         self._hang_release = threading.Event()
         self._suspended = 0
+        # Observer hook: called as on_fire(site, mode, rids) the moment a
+        # rule fires, BEFORE the raise/hang/sleep — the flight recorder
+        # (runtime/flight.py) stamps the fault into the affected
+        # requests' timelines so salvage sequences and post-mortems are
+        # self-explanatory.  Must not raise; None = no observer.
+        self.on_fire = None
 
     @property
     def enabled(self) -> bool:
@@ -107,6 +113,8 @@ class FaultInjector:
             if rule.prob < 1.0 and self._rng.random() >= rule.prob:
                 continue
             rule.fired += 1
+            if self.on_fire is not None:
+                self.on_fire(site, rule.mode, tuple(rids))
             if rule.mode == "delay":
                 time.sleep(rule.delay_s)
                 continue

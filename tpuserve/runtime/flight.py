@@ -1,0 +1,309 @@
+"""Engine flight recorder: always-on lifecycle tracing + post-mortems.
+
+After PRs 3-7 a request can be queued, deadline-expired, tier-restored,
+chunk-prefilled, window-batched, preempted, salvaged, browned-out, or
+shed — and before this module none of that lifecycle was observable per
+request, only as aggregate counters.  The recorder is the narration
+layer the autoscaler (ROADMAP item 2) and the host-overhead work
+(item 3) read from, the engine-emitted signal DeepServe scales on
+(PAPERS.md, arxiv 2501.14417):
+
+- a fixed-size ring of per-request lifecycle **events** (QUEUED,
+  ADMITTED, RESTORING, PREFILL, PREFILL_CHUNK, WINDOW, PREEMPTED,
+  SALVAGED, BROWNOUT_CLAMPED, SHED, FAULT, FINISHED-with-cause);
+- a fixed-size ring of per-cycle **step records** (dispatch kind, rows,
+  actual/padded flat tokens, wall ms, hostprof phase ms — the profiler
+  is flipped always-on when the recorder is enabled; its cost is two
+  ``perf_counter`` calls per phase);
+- per-SLO-class **SLI reservoirs** (client-observable TTFT/ITL/e2e,
+  fed by the runner loop) behind the ``tpuserve_ttft/itl/e2e_seconds``
+  histogram families and the brownout controller's transition logs;
+- **post-mortem bundles**: on a watchdog trip, fault-storm fail-all, or
+  poison isolation the last N cycles + affected request timelines are
+  written as one JSON file (``TPUSERVE_FLIGHT_DIR``, the model PVC in
+  the manifests) and counted in ``tpuserve_flight_postmortems_total``.
+
+Threading contract: every MUTATING call happens on the engine loop
+thread (the same thread that runs ``Engine.step`` — the runner's
+salvage/intake paths included).  Serving threads take SNAPSHOTS only:
+ring entries are immutable tuples, a snapshot copies the backing list,
+and a concurrent append at worst duplicates or misses the newest slot —
+never a torn read.  The sole exception is ``postmortem``, which the
+watchdog thread may call while the loop thread is wedged inside a stuck
+dispatch (that is the point); it reads snapshots and touches only
+recorder-owned counters.
+
+Timestamps are ``time.monotonic()`` ONLY — no wall-clock deltas (pinned
+by tests/test_flight.py) and no device syncs anywhere (tpulint P1 stays
+green: the recorder stores host-known ints/strs, never a jax array).
+``TPUSERVE_FLIGHT=0`` (or ``EngineConfig.flight=False``) removes it —
+the ``bench.py --recorder-ab`` overhead A/B lever.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+from tpuserve.runtime.hostprof import PROF
+from tpuserve.utils import env_flag
+
+logger = logging.getLogger("tpuserve.flight")
+
+#: canonical lifecycle event names, in rough lifecycle order (the
+#: /debug/requests timeline and the OTLP child spans use these verbatim)
+EVENTS = ("QUEUED", "ADMITTED", "RESTORING", "PREFILL", "PREFILL_CHUNK",
+          "WINDOW", "PREEMPTED", "SALVAGED", "BROWNOUT_CLAMPED", "SHED",
+          "FAULT", "FINISHED")
+
+SLI_KINDS = ("ttft", "itl", "e2e")
+
+# bound post-mortem disk usage: a fault storm must not convert the model
+# PVC into a bundle dump
+MAX_POSTMORTEMS = 32
+
+
+class _Ring:
+    """Fixed-size append-only ring of immutable entries.  Single writer;
+    ``snapshot()`` is safe from any thread (list copy of tuples)."""
+
+    __slots__ = ("_buf", "_n", "idx")
+
+    def __init__(self, n: int):
+        self._buf = [None] * max(2, n)
+        self._n = len(self._buf)
+        self.idx = 0
+
+    def append(self, item) -> None:
+        self._buf[self.idx % self._n] = item
+        self.idx += 1
+
+    def snapshot(self) -> list:
+        i, buf = self.idx, list(self._buf)
+        if i <= self._n:
+            return [x for x in buf[:i] if x is not None]
+        cut = i % self._n
+        return [x for x in buf[cut:] + buf[:cut] if x is not None]
+
+
+class FlightRecorder:
+    def __init__(self, enabled: Optional[bool] = None,
+                 events: int = 0, steps: int = 0,
+                 dirpath: Optional[str] = None):
+        if enabled is None:
+            enabled = env_flag("TPUSERVE_FLIGHT")
+        self.enabled = bool(enabled)
+        ev_n = events or int(os.environ.get("TPUSERVE_FLIGHT_EVENTS",
+                                            0) or 8192)
+        st_n = steps or int(os.environ.get("TPUSERVE_FLIGHT_STEPS",
+                                           0) or 512)
+        self._events = _Ring(ev_n)
+        self._steps = _Ring(st_n)
+        self._dir = dirpath or os.environ.get("TPUSERVE_FLIGHT_DIR") or None
+        # monotonic->wall anchor for OTLP span export and bundle headers
+        # ONLY; every recorded timestamp and every delta stays monotonic
+        self._mono0 = time.monotonic()
+        self._wall0 = time.time()        # wall-anchor-ok: export mapping, never a delta
+        # per-cycle hostprof deltas are diffs against this snapshot of the
+        # module profiler's cumulative seconds
+        self._prof_last: dict = {}
+        # client-observable SLI reservoirs: (class, kind) -> bounded ring
+        self._sli: dict = {}
+        self.postmortems = 0
+        self.last_postmortem: Optional[str] = None
+
+    # ---- writes (engine-loop thread) ----------------------------------
+
+    def req_event(self, rid: str, event: str, **detail) -> None:
+        if not self.enabled:
+            return
+        self._events.append((time.monotonic(), rid, event,
+                             detail or None))
+
+    def req_event_many(self, rids: tuple, event: str, **detail) -> None:
+        """Batched twin of :meth:`req_event` for per-dispatch events that
+        cover every row (WINDOW): ONE timestamp, ONE ring entry, ONE
+        shared detail dict for the whole batch — at 256 streams the
+        per-row form measurably cost tok/s (the --recorder-ab guard)."""
+        if not self.enabled or not rids:
+            return
+        self._events.append((time.monotonic(), tuple(rids), event,
+                             detail or None))
+
+    def fault_hook(self, site: str, mode: str,
+                   rids: Sequence[str]) -> None:
+        """FaultInjector.on_fire target: a firing chaos rule shows up in
+        every affected request's timeline (post-mortems and the salvage
+        sequence become self-explanatory)."""
+        if not self.enabled:
+            return
+        t = time.monotonic()
+        for rid in rids or ("(engine)",):
+            self._events.append((t, rid, "FAULT",
+                                 {"site": site, "mode": mode}))
+
+    def note_step(self, kind: str, rows: int, actual: int, padded: int,
+                  dur_s: float) -> None:
+        """One engine cycle's step record.  Phase ms are deltas of the
+        module hostprof profiler since the previous record — exact for a
+        one-engine process (the common case); multi-engine processes
+        interleave and the attribution is approximate."""
+        if not self.enabled:
+            return
+        phases = None
+        if PROF.enabled:
+            cur = dict(PROF.seconds)
+            phases = {}
+            for k, v in cur.items():
+                d = v - self._prof_last.get(k, 0.0)
+                if d > 0:
+                    phases[k] = round(d * 1000, 4)
+            self._prof_last = cur
+        self._steps.append((time.monotonic(), kind, rows, actual, padded,
+                            round(dur_s * 1000, 4), phases or None))
+
+    def note_sli(self, slo_class: str, kind: str, value: float) -> None:
+        """Client-observable latency sample (runner loop thread): TTFT /
+        inter-token / end-to-end seconds for one request of ``slo_class``.
+        Mirrors what the tpuserve_{ttft,itl,e2e}_seconds histograms
+        export, kept here so /debug/engine and the brownout transition
+        logs can quote recent percentiles without scraping."""
+        if not self.enabled:
+            return
+        ring = self._sli.get((slo_class, kind))
+        if ring is None:
+            ring = self._sli[(slo_class, kind)] = _Ring(256)
+        ring.append(value)
+
+    # ---- snapshots (any thread) ---------------------------------------
+
+    def request_timeline(self, rid: str) -> list[dict]:
+        """Ordered lifecycle events recorded for ``rid`` (may be partial:
+        the ring holds the most recent TPUSERVE_FLIGHT_EVENTS events
+        engine-wide).  Scans newest-to-oldest and stops at the request's
+        QUEUED event, so per-request span export under load costs the
+        request's own event span, not the whole ring (only an unknown
+        rid pays a full scan)."""
+        out = []
+        for t, r, ev, detail in reversed(self._events.snapshot()):
+            if r == rid or (type(r) is tuple and rid in r):
+                entry = {"t": t, "event": ev}
+                if detail:
+                    entry["detail"] = detail
+                out.append(entry)
+                if ev == "QUEUED":
+                    break
+        out.reverse()
+        return out
+
+    def recent_request_ids(self, limit: int = 64) -> list[str]:
+        """Most-recently-seen request ids, newest last."""
+        seen: dict = {}
+        for t, rid, _ev, _d in self._events.snapshot():
+            for r in (rid if type(rid) is tuple else (rid,)):
+                seen.pop(r, None)
+                seen[r] = True
+        ids = list(seen)
+        return ids[-limit:]
+
+    def steps_snapshot(self, limit: int = 128) -> list[dict]:
+        out = []
+        for t, kind, rows, actual, padded, ms, phases in \
+                self._steps.snapshot()[-limit:]:
+            rec = {"t": t, "kind": kind, "rows": rows,
+                   "actual_tokens": actual, "padded_tokens": padded,
+                   "ms": ms}
+            if phases:
+                rec["phase_ms"] = phases
+            out.append(rec)
+        return out
+
+    def sli_summary(self) -> dict:
+        """p50/p95 over the recent reservoirs, per class per kind —
+        what the brownout controller logs on level transitions and
+        /debug/engine reports."""
+        out: dict = {}
+        for (cls, kind), ring in list(self._sli.items()):
+            vals = sorted(ring.snapshot())
+            if not vals:
+                continue
+            out.setdefault(cls, {})[kind] = {
+                "n": len(vals),
+                "p50": round(vals[len(vals) // 2], 6),
+                "p95": round(vals[min(len(vals) - 1,
+                                      int(len(vals) * 0.95))], 6),
+            }
+        return out
+
+    def engine_snapshot(self, steps: int = 128) -> dict:
+        return {
+            "enabled": self.enabled,
+            "events_recorded": self._events.idx,
+            "steps_recorded": self._steps.idx,
+            "requests": self.recent_request_ids(),
+            "steps": self.steps_snapshot(steps),
+            "sli": self.sli_summary(),
+            "postmortems": self.postmortems,
+            "last_postmortem": self.last_postmortem,
+        }
+
+    def wall_of(self, t_mono: float) -> float:
+        """Map a recorded monotonic timestamp onto the wall clock (OTLP
+        span export / bundle headers only)."""
+        return self._wall0 + (t_mono - self._mono0)
+
+    # ---- post-mortems --------------------------------------------------
+
+    def postmortem(self, reason: str, rids: Sequence[str] = (),
+                   extra: Optional[dict] = None) -> Optional[str]:
+        """Write the last N cycles + affected request timelines to a JSON
+        bundle and return its path (None when disabled, capped, or the
+        write fails — a post-mortem must never take serving down with
+        it).  Callable from the watchdog thread while the engine loop is
+        wedged: snapshot reads only."""
+        if not self.enabled or self.postmortems >= MAX_POSTMORTEMS:
+            return None
+        try:
+            import tempfile
+            import uuid
+            d = self._dir or tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
+            # counter bumped only AFTER the write lands: failed writes
+            # (full/read-only PVC) must neither eat the bundle budget nor
+            # make the reported count disagree with the files on disk.
+            # uuid suffix: a disagg pod runs TWO recorders (same pid,
+            # same counter values) into one dir, and the watchdog thread
+            # can dump concurrently with the loop thread — names must
+            # never collide or os.replace silently drops a bundle
+            n = self.postmortems + 1
+            path = os.path.join(
+                d, f"flight-{reason}-{os.getpid()}-{n}"
+                   f"-{uuid.uuid4().hex[:8]}.json")
+            ids = list(rids) or self.recent_request_ids()
+            bundle = {
+                "reason": reason,
+                "written_unix": self.wall_of(time.monotonic()),
+                "monotonic_anchor": {"mono": self._mono0,
+                                     "wall": self._wall0},
+                "steps": self.steps_snapshot(256),
+                "requests": {rid: self.request_timeline(rid)
+                             for rid in ids},
+                "sli": self.sli_summary(),
+            }
+            if extra:
+                bundle["extra"] = extra
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            self.postmortems = n
+            self.last_postmortem = path
+            logger.warning("flight post-mortem (%s) written to %s",
+                           reason, path)
+            return path
+        except Exception:
+            logger.exception("flight post-mortem write failed")
+            return None

@@ -8,12 +8,19 @@ phases (schedule / block-accounting / dispatch / detokenize / flush)
 with ``PROF.phase(...)`` context managers, and ``tools/profile_step.py
 --json`` / ``bench.py --clients-sweep`` report ms-per-cycle per phase.
 
-Disabled (the default), ``phase()`` returns a shared no-op context
-manager — two attribute loads and a dict miss per use, no timestamps
-taken — so serving pays nothing for the instrumentation.  Enabled, each
-phase costs two ``perf_counter`` calls.  The profiler is engine-loop
-single-threaded like everything else it brackets; it is NOT meant to be
-shared across engines running in different threads.
+Disabled, ``phase()`` returns a shared no-op context manager — two
+attribute loads and a dict miss per use, no timestamps taken — so
+serving pays nothing for the instrumentation.  Enabled, each phase
+costs two ``perf_counter`` calls.  Since the flight recorder landed
+(runtime/flight.py) the profiler is ALWAYS-ON in practice: building an
+engine with the recorder enabled (the default) flips ``PROF.enabled``
+so every step record carries its phase breakdown; the measured cost is
+inside the <1%-tok/s recorder budget (BENCHMARKS.md "Flight
+recorder"), and ``TPUSERVE_FLIGHT=0`` restores the fully-off state.
+The profiler is engine-loop single-threaded like everything else it
+brackets; it is NOT meant to be shared across engines running in
+different threads (per-cycle deltas in multi-engine processes are
+approximate — see FlightRecorder.note_step).
 """
 
 from __future__ import annotations

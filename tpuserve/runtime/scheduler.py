@@ -119,6 +119,12 @@ class Scheduler:
         # below degrades byte-identically to the pre-SLO behaviour
         # (TPUSERVE_SLO_CLASSES=0, the same-commit A/B lever).
         self.slo = None
+        # Flight recorder (runtime/flight.py), set by the engine when
+        # enabled: admissions and preemptions are recorded HERE — the
+        # one place each decision is made — so every admission path
+        # (batched / chunked / mixed) and both preemption kinds emit
+        # identically.  None = no recording.
+        self.flight = None
         # Set after scheduling a chunked-prefill step: the next cycle runs a
         # decode step first (if anything is running) so in-flight streams get
         # a token between chunks — without this, a 32k prompt at the 2048
@@ -210,13 +216,20 @@ class Scheduler:
         return min(self.cfg.prefill_chunk_size, self.prefill_bucket(remaining))
 
     def _note_admit(self, req: Request) -> None:
-        """Feed the SLO load estimator with a FRESH admission's queue
-        delay (preempted re-entries and chunk continuations excluded —
-        their wait measures preemption policy, not admission load)."""
-        if (self.slo is not None and req.state == RequestState.WAITING
-                and req.num_prefilled == 0 and not req.output_token_ids):
-            self.slo.note_admission(self._rank(req),
-                                    time.monotonic() - req.arrival_time)
+        """Note a FRESH admission's queue delay — to the SLO load
+        estimator and the flight recorder (preempted re-entries and
+        chunk continuations excluded: their wait measures preemption
+        policy, not admission load; their re-prefill shows up as a
+        replay PREFILL event instead)."""
+        if (req.state != RequestState.WAITING or req.num_prefilled > 0
+                or req.output_token_ids):
+            return
+        delay = time.monotonic() - req.arrival_time
+        if self.slo is not None:
+            self.slo.note_admission(self._rank(req), delay)
+        if self.flight is not None:
+            self.flight.req_event(req.request_id, "ADMITTED",
+                                  queue_delay_ms=round(delay * 1000, 3))
 
     def _pop_head_for_chunking(self, head: Request,
                                cached: int = 0) -> Optional[ScheduledBatch]:
@@ -533,6 +546,9 @@ class Scheduler:
         req.state = RequestState.PREEMPTED
         req.num_prefilled = 0
         self.waiting.appendleft(req)
+        if self.flight is not None:
+            self.flight.req_event(req.request_id, "PREEMPTED",
+                                  cause="decode_oom")
         return req
 
     def preempt_for_class(self, victim: Request) -> None:
@@ -548,3 +564,6 @@ class Scheduler:
         victim.num_prefilled = 0
         victim.num_preemptions += 1
         self.reinsert_preempted(victim)
+        if self.flight is not None:
+            self.flight.req_event(victim.request_id, "PREEMPTED",
+                                  cause="slo_class")

@@ -42,8 +42,11 @@ measures).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Optional
+
+logger = logging.getLogger("tpuserve.slo")
 
 SLO_CLASSES = ("interactive", "standard", "batch")
 INTERACTIVE, STANDARD, BATCH = range(3)
@@ -132,6 +135,12 @@ class SloController:
         # histograms (drained by server/runner.py on the same thread)
         self.delay_obs: list[tuple[str, float]] = []
         self.shed_total = 0            # mirrored into EngineStats
+        # flight recorder (runtime/flight.py), set by the engine when
+        # enabled: every ladder transition is logged against the
+        # client-observable per-class SLIs the recorder holds, so a
+        # brownout decision is auditable against what clients actually
+        # experienced at that moment (not just the internal EWMAs)
+        self.flight = None
 
     # ---- estimator inputs ------------------------------------------------
 
@@ -203,13 +212,27 @@ class SloController:
             if p >= thr:
                 desired = i + 1
         if desired > self.level:
+            self._log_transition(self.level, desired, p)
             self.level = desired
             self._level_changed = now
         elif (self.level > 0
               and p < enter[self.level - 1] - self.cfg.exit_margin
               and now - self._level_changed >= self.cfg.hold_s):
+            self._log_transition(self.level, self.level - 1, p)
             self.level -= 1
             self._level_changed = now
+
+    def _log_transition(self, old: int, new: int, pressure: float) -> None:
+        """Ladder transitions logged against the flight recorder's
+        client-observable SLI percentiles (TTFT/ITL/e2e per class):
+        the decision record an operator reads after an incident."""
+        sli = self.flight.sli_summary() if self.flight is not None else {}
+        logger.info(
+            "brownout level %d -> %d (pressure %.3f, waiting %d, "
+            "pad_eff %.2f, delay_ewma %s, client SLI %s)",
+            old, new, pressure, self._waiting, self._pad_eff,
+            ["%.3f" % v if v is not None else "-"
+             for v in self._delay_ewma], sli or "{}")
 
     # ---- policy queries --------------------------------------------------
 

@@ -404,6 +404,22 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
             return
+        # Gateway span + W3C context propagation: the gateway emits its
+        # own span (parented to the caller's traceparent when present)
+        # and injects its context into the upstream request, so
+        # gateway -> server -> engine lifecycle is ONE trace tree in the
+        # reference-parity OTel pipeline.  Degrades to a no-op exactly
+        # like RequestTracer: without the SDK the span is a noop and the
+        # caller's traceparent passes through verbatim (_relay_inner).
+        from tpuserve.server.tracing import extract_context, get_tracer
+        with get_tracer().request_span(
+                "gateway " + self.path,
+                context=extract_context(self.headers),
+                **{"http.method": method}):
+            self._relay_inner(method)
+
+    def _relay_inner(self, method: str):
+        ctx = self.ctx
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else None
         # Per-tenant rate limiting for the whole pool (server/tenants.py):
@@ -460,13 +476,20 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             try:
                 fwd = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json")}
-                for h in ("Authorization", "X-SLO-Class"):
+                for h in ("Authorization", "X-SLO-Class", "traceparent",
+                          "tracestate"):
                     # tenant identity + SLO class must reach the engine
-                    # server (per-tenant default class, exact metering)
+                    # server (per-tenant default class, exact metering);
+                    # trace context passes through so an SDK-less gateway
+                    # still links the caller's trace to the server span
                     if self.headers.get(h):
                         fwd[h] = self.headers[h]
                 if inject_cls:
                     fwd["X-SLO-Class"] = inject_cls
+                # with the SDK active, the gateway SPAN becomes the
+                # upstream parent (overwrites the pass-through value)
+                from tpuserve.server.tracing import inject_headers
+                inject_headers(fwd)
                 req = urllib.request.Request(
                     backend.url + self.path, data=body, method=method,
                     headers=fwd)

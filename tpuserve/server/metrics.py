@@ -238,6 +238,40 @@ class ServerMetrics:
             "overload estimator steers the brownout ladder by",
             ["model_name", "slo_class"], buckets=_DURATION_BUCKETS,
             registry=self.registry)
+        # Flight-recorder SLIs (runtime/flight.py): the CLIENT-observable
+        # latency contract per SLO class, measured at output delivery in
+        # the runner loop (queueing, salvage replays and brownout
+        # degradation all included — unlike the engine-internal
+        # vllm_time_* families, these carry the slo_class label the
+        # brownout ladder and the future autoscaler steer by).
+        self.ttft_class = Histogram(
+            "tpuserve_ttft_seconds",
+            "Client-observable time to first token per SLO class "
+            "(slo_class=interactive|standard|batch) — the per-class "
+            "twin of vllm_time_to_first_token_seconds the brownout "
+            "controller logs level transitions against",
+            ["model_name", "slo_class"], buckets=_TTFT_BUCKETS,
+            registry=self.registry)
+        self.itl_class = Histogram(
+            "tpuserve_itl_seconds",
+            "Client-observable inter-token latency per SLO class "
+            "(slo_class= label; re-prefill replay gaps excluded like "
+            "vllm_time_per_output_token_seconds)",
+            ["model_name", "slo_class"], buckets=_ITL_BUCKETS,
+            registry=self.registry)
+        self.e2e_class = Histogram(
+            "tpuserve_e2e_seconds",
+            "Client-observable end-to-end request latency per SLO "
+            "class (slo_class= label; submit to finish)",
+            ["model_name", "slo_class"], buckets=_DURATION_BUCKETS,
+            registry=self.registry)
+        self.flight_postmortems = counter(
+            "tpuserve_flight_postmortems",
+            "Post-mortem bundles written by the engine flight recorder "
+            "(watchdog trip, fault-storm fail-all, poison isolation) — "
+            "each count is a JSON file of the last N engine cycles + "
+            "affected request timelines under TPUSERVE_FLIGHT_DIR "
+            "(/debug/engine reports the newest path)")
         # Multi-tenant metering (server/tenants.py): tenant = API key /
         # LoRA adapter.  Label cardinality is bounded by the configured
         # tenant set (+ "default").

@@ -598,6 +598,27 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"status": "ready"})
             else:
                 self._error(503, "not ready", "server_error")
+        elif self.path == "/debug/engine":
+            # flight-recorder engine snapshot: recent step records (kind,
+            # rows, actual/padded tokens, phase ms), recent request ids,
+            # client SLI percentiles, post-mortem pointers
+            self._json(200, self._debug_engine_payload())
+        elif self.path.startswith("/debug/requests/"):
+            from urllib.parse import unquote
+            rid = unquote(self.path[len("/debug/requests/"):])
+            timeline = []
+            for fl in self._flight_recorders():
+                timeline.extend(fl.request_timeline(rid))
+            if timeline:
+                timeline.sort(key=lambda e: e["t"])
+                self._json(200, {"request_id": rid, "events": timeline})
+            elif not self._flight_recorders():
+                self._error(404, "flight recorder disabled "
+                                 "(TPUSERVE_FLIGHT=0)")
+            else:
+                self._error(404, f"no recorded events for {rid!r} (the "
+                                 "ring holds the most recent "
+                                 "TPUSERVE_FLIGHT_EVENTS events)")
         elif self.path.startswith("/debug/profile"):
             # jax.profiler capture (SURVEY.md §5: the reference has no
             # profiler; this is the TPU-native story).  Blocks this handler
@@ -613,6 +634,35 @@ class _Handler(BaseHTTPRequestHandler):
                             "server_error")
         else:
             self._error(404, f"no route {self.path}")
+
+    def _flight_recorders(self) -> list:
+        """Enabled flight recorders across the (possibly disagg) engine —
+        one source of truth for inner-engine discovery (the runner's)."""
+        return self.ctx.runner._flights()
+
+    def _debug_engine_payload(self) -> dict:
+        recorders = self._flight_recorders()
+        if not recorders:
+            return {"enabled": False}
+        if len(recorders) == 1:
+            return recorders[0].engine_snapshot()
+        return {"enabled": True,
+                "engines": [f.engine_snapshot() for f in recorders]}
+
+    def _emit_engine_spans(self, rids) -> None:
+        """Export each request's flight timeline as OTLP child spans of
+        the current request span — the gateway->server->engine tree the
+        reference's OTel pipeline was built for but never fed.  No-op
+        unless the SDK is configured (request_span semantics)."""
+        from tpuserve.server.tracing import emit_timeline_spans, get_tracer
+        tracer = get_tracer()
+        if not tracer.active:
+            return
+        for fl in self._flight_recorders():
+            for rid in rids:
+                timeline = fl.request_timeline(rid)
+                if timeline:
+                    emit_timeline_spans(tracer, timeline, fl.wall_of)
 
     def _healthz_payload(self) -> dict:
         """Liveness plus the cache-affinity advertisement: the prefix
@@ -812,12 +862,15 @@ class _Handler(BaseHTTPRequestHandler):
                 logger.exception("prompt scoring failed")
                 self._error(500, str(e), "server_error")
             return
-        from tpuserve.server.tracing import get_tracer
+        from tpuserve.server.tracing import extract_context, get_tracer
         try:
+            # parent = the incoming W3C traceparent (the gateway's span,
+            # or the caller's own trace) so the whole request is one tree
             with get_tracer().request_span(
-                    self.path, **{"gen_ai.request.model": self.ctx.model_name,
-                                  "gen_ai.request.max_tokens": params.max_tokens,
-                                  "tpuserve.stream": stream}):
+                    self.path, context=extract_context(self.headers),
+                    **{"gen_ai.request.model": self.ctx.model_name,
+                       "gen_ai.request.max_tokens": params.max_tokens,
+                       "tpuserve.stream": stream}):
                 if stream:
                     # _stream_response owns its error handling: once SSE
                     # headers are out, a second status line would corrupt
@@ -1319,6 +1372,7 @@ class _Handler(BaseHTTPRequestHandler):
             "completion_tokens": completion_tokens,
             "total_tokens": prompt_tokens + completion_tokens,
         }
+        self._emit_engine_spans([rid for rid, _ in submits])
         self._settle_tenant(usage["total_tokens"])
         obj = "chat.completion" if chat else "text_completion"
         self._json(200, {"id": oid, "object": obj, "created": int(time.time()),
@@ -1631,6 +1685,9 @@ class _Handler(BaseHTTPRequestHandler):
             abort_all()
             self._settle_tenant(prompt_toks + completion_toks)
         finally:
+            # still inside the request span: engine lifecycle child spans
+            # attach under it (survives client-gone paths too)
+            self._emit_engine_spans([rid for rid, _ in submits])
             for rid, _ in submits:
                 ctx.engine.requests.pop(rid, None)
 

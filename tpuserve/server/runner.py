@@ -284,30 +284,58 @@ class AsyncEngineRunner:
                     self.metrics.prompt_tokens.inc(req.num_prompt_tokens)
             msg.rid_event.set()
 
+    def _slo_class_of(self, rid: str) -> str:
+        req = getattr(self.engine, "requests", {}).get(rid)
+        return getattr(getattr(req, "params", None), "slo_class", "standard")
+
     def _route_outputs(self, outputs: list[RequestOutput]) -> None:
         now = time.monotonic()
+        # every inner engine's recorder gets the SLIs: a disagg pod's
+        # decode engine must not log empty client SLIs on brownout
+        flights = self._flights()
         for out in outputs:
             q = self._out_queues.get(out.request_id)
-            if self.metrics:
-                self.metrics.generation_tokens.inc(len(out.new_token_ids))
+            if self.metrics or flights:
+                cls = self._slo_class_of(out.request_id)
                 last = self._last_token_time.get(out.request_id)
+                if self.metrics:
+                    self.metrics.generation_tokens.inc(
+                        len(out.new_token_ids))
+                label = dict(model_name=getattr(self.metrics, "model_name",
+                                                ""), slo_class=cls)
                 if last is not None:
                     if out.num_output_tokens == 1:
-                        self.metrics.ttft.observe(now - self._req_started.get(
-                            out.request_id, now))
+                        ttft = now - self._req_started.get(
+                            out.request_id, now)
+                        if self.metrics:
+                            self.metrics.ttft.observe(ttft)
+                            self.metrics.ttft_class.labels(
+                                **label).observe(ttft)
+                        for fl in flights:
+                            fl.note_sli(cls, "ttft", ttft)
                     elif not out.from_prefill:
                         # A from_prefill emission with output tokens > 1 is a
                         # re-prefill after preemption: its gap is queue +
                         # recompute time and would blow out the ITL histogram.
-                        self.metrics.itl.observe(now - last)
+                        if self.metrics:
+                            self.metrics.itl.observe(now - last)
+                            self.metrics.itl_class.labels(
+                                **label).observe(now - last)
+                        for fl in flights:
+                            fl.note_sli(cls, "itl", now - last)
                 self._last_token_time[out.request_id] = now
             if q is not None:
                 q.put(out)
             if out.finished:
-                if self.metrics:
+                if self.metrics or flights:
                     started = self._req_started.pop(out.request_id, now)
                     reason = out.finish_reason.value if out.finish_reason else "stop"
-                    self.metrics.observe_finish(reason, now - started)
+                    if self.metrics:
+                        self.metrics.observe_finish(reason, now - started)
+                        self.metrics.e2e_class.labels(
+                            **label).observe(now - started)
+                    for fl in flights:
+                        fl.note_sli(cls, "e2e", now - started)
                 self._last_token_time.pop(out.request_id, None)
                 # NOTE: the request record stays in engine.requests — the
                 # caller that submitted claims (pops) it for usage/logprobs.
@@ -390,6 +418,9 @@ class AsyncEngineRunner:
             q.put(None)
         if poisoned:
             self._bump_stat("requests_poisoned")
+            # the isolated request's full lifecycle (faults included) is
+            # exactly what a poison investigation needs
+            self._dump_postmortem("poison", (rid,))
         logger.warning("request %s failed: %s", rid, message)
 
     def _drain_engine_errors(self) -> None:
@@ -420,6 +451,10 @@ class AsyncEngineRunner:
         if (salvage is None
                 or len(self._fault_times) > self.MAX_FAULTS_PER_WINDOW):
             self._bump_stat("engine_restarts")
+            if len(self._fault_times) > self.MAX_FAULTS_PER_WINDOW:
+                # fault storm: capture the flight state BEFORE fail-all
+                # wipes the client map — the bundle is the incident record
+                self._dump_postmortem("fault_storm")
             self._salvage = None
             self._set_admission_filter(None)
             self._fail_all(f"engine failure: {exc}")
@@ -531,6 +566,26 @@ class AsyncEngineRunner:
         return [f for f in (getattr(e, "faults", None)
                             for e in self._inner_engines()) if f is not None]
 
+    def _flights(self) -> list:
+        """Enabled flight recorders of the inner engines (runtime/flight)."""
+        return [f for f in (getattr(e, "flight", None)
+                            for e in self._inner_engines())
+                if f is not None and f.enabled]
+
+    def _dump_postmortem(self, reason: str, rids=()) -> None:
+        """Write flight post-mortem bundles (last N cycles + affected
+        request timelines) and count them.  Called from the loop thread
+        on fault-storm fail-all / poison isolation, and from the
+        WATCHDOG thread on a trip — the recorder's snapshot-read
+        contract makes the cross-thread dump safe even while the loop
+        thread is wedged inside the stuck dispatch."""
+        for fl in self._flights():
+            # snapshot-read dump, safe from the watchdog thread: the
+            # recorder mutates only its own counters (runtime/flight.py
+            # threading contract)
+            if fl.postmortem(reason, rids) is not None:
+                self._bump_stat("flight_postmortems")
+
     def _watchdog_threshold(self) -> float:
         if self._steps_done < self.WATCHDOG_WARMUP_STEPS:
             # early steps legitimately include multi-second XLA compiles
@@ -567,6 +622,10 @@ class AsyncEngineRunner:
                     "engine step stuck for %.2fs (watchdog %.2fs): "
                     "releasing injected hangs, failing the dispatch",
                     running_s, threshold)
+                # capture the stuck step's flight state NOW, from this
+                # thread — the loop thread is inside the wedged dispatch
+                # and may never come back to write it
+                self._dump_postmortem("watchdog_trip")
                 for inj in self._fault_injectors():
                     inj.release_hangs()
             elif (running_s > 2 * threshold
@@ -687,7 +746,9 @@ class AsyncEngineRunner:
                                  ("watchdog_trips",
                                   self.metrics.watchdog_trips),
                                  ("engine_restarts",
-                                  self.metrics.engine_restarts)):
+                                  self.metrics.engine_restarts),
+                                 ("flight_postmortems",
+                                  self.metrics.flight_postmortems)):
                 _advance_counter(
                     metric, sum(getattr(s, attr, 0) for s in stats_objs))
             # last-step padding-waste gauges (the bucketing win's live

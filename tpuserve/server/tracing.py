@@ -63,12 +63,19 @@ class RequestTracer:
         return self._tracer is not None
 
     @contextlib.contextmanager
-    def request_span(self, name: str, **attrs):
+    def request_span(self, name: str, context=None, **attrs):
+        """``context``: an extracted W3C parent context (see
+        :func:`extract_context`) — the gateway's span, or the caller's
+        own trace — so gateway -> server -> engine is ONE tree in the
+        reference-parity OTel pipeline.  None = new root span."""
         if self._tracer is None:
             yield _NoopSpan()
             return
         try:
-            cm = self._tracer.start_as_current_span(name)
+            # context passed only when present: tracers predating the
+            # kwarg (tests' fakes included) keep working
+            kw = {"context": context} if context is not None else {}
+            cm = self._tracer.start_as_current_span(name, **kw)
             span = cm.__enter__()
         except Exception:
             yield _NoopSpan()
@@ -96,6 +103,68 @@ def get_tracer() -> RequestTracer:
     if _tracer is None:
         _tracer = RequestTracer()
     return _tracer
+
+
+# ---- W3C trace-context propagation (gateway -> server -> engine) ---------
+
+def extract_context(headers):
+    """Parent context from incoming ``traceparent``/``tracestate``
+    headers (W3C), or None.  Degrades to None exactly like the tracer:
+    no opentelemetry API installed, no header, or a malformed value all
+    mean "start a new root"."""
+    try:
+        tp = headers.get("traceparent")
+        if not tp:
+            return None
+        from opentelemetry.propagate import extract
+        carrier = {"traceparent": tp}
+        ts = headers.get("tracestate")
+        if ts:
+            carrier["tracestate"] = ts
+        return extract(carrier)
+    except Exception:
+        return None
+
+
+def inject_headers(headers: dict) -> dict:
+    """Inject the CURRENT span's context as ``traceparent`` into
+    ``headers`` (mutated and returned).  No-op without the SDK or
+    outside a recording span — callers should pre-populate any incoming
+    traceparent first so pass-through still works SDK-less."""
+    try:
+        from opentelemetry.propagate import inject
+        inject(headers)
+    except Exception:
+        pass
+    return headers
+
+
+def emit_timeline_spans(tracer: RequestTracer, timeline, wall_of) -> None:
+    """Export a flight-recorder request timeline as OTLP child spans of
+    the CURRENT span (call inside ``request_span``).  Each lifecycle
+    event becomes one ``engine.<event>`` span from its timestamp to the
+    next event's (FINISHED closes on itself); ``wall_of`` maps the
+    recorder's monotonic stamps onto the wall clock
+    (FlightRecorder.wall_of).  Never raises; no-op when inactive."""
+    if not tracer.active or not timeline:
+        return
+    try:
+        tr = tracer._tracer
+        for i, ev in enumerate(timeline):
+            start_ns = int(wall_of(ev["t"]) * 1e9)
+            end_t = timeline[i + 1]["t"] if i + 1 < len(timeline) \
+                else ev["t"]
+            span = tr.start_span("engine." + ev["event"].lower(),
+                                 start_time=start_ns)
+            try:
+                for k, v in (ev.get("detail") or {}).items():
+                    if isinstance(v, (bool, int, float, str)):
+                        span.set_attribute(f"tpuserve.{k}", v)
+            finally:
+                span.end(end_time=max(start_ns,
+                                      int(wall_of(end_t) * 1e9)))
+    except Exception:
+        logger.debug("timeline span export failed", exc_info=True)
 
 
 def capture_profile(seconds: float, out_dir: str | None = None) -> dict:
