@@ -39,6 +39,7 @@ import argparse
 import json
 import os
 import signal
+import sys
 import time
 
 TARGET_TOK_S_PER_CHIP = 2000.0  # BASELINE.md north-star target
@@ -1328,6 +1329,15 @@ def main(argv=None):
                          "(TPUSERVE_FLIGHT=0 equivalent) and report the "
                          "tok/s delta; 'ok' asserts the always-on "
                          "recorder costs <1%")
+    ap.add_argument("--emit-trace", default=None, metavar="PATH",
+                    dest="emit_trace",
+                    help="write the generated workload (prompt ids, "
+                         "arrival offsets, sampling knobs, fault spec) as "
+                         "a portable replay file (tpuserve/replay/), so "
+                         "this bench row is reproducible via tools/"
+                         "replay.py run — applies to the main workload "
+                         "path (burst/poisson), not the specialised "
+                         "--multiturn/--two-class drivers")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny-model CPU smoke run (does not update baselines)")
     args = ap.parse_args(argv)
@@ -1483,6 +1493,29 @@ def main(argv=None):
         inter = np.random.default_rng(7).exponential(
             1.0 / args.arrival_rate, size=batch)
         arrival_offsets = np.cumsum(inter).tolist()
+
+    if args.emit_trace:
+        # every bench row can be a manufacturable replay scenario: the
+        # exact generated workload (ids included — no synthesis needed)
+        # saved BEFORE warmup, so even a run the driver later kills
+        # leaves a usable trace
+        from tpuserve.replay.workload import Workload, WorkloadRequest
+        trace = Workload(
+            requests=[WorkloadRequest(
+                request_id=f"bench-{i}",
+                arrival_s=(arrival_offsets[i] if arrival_offsets
+                           else 0.0),
+                prompt_tokens=len(p), prompt_token_ids=list(p),
+                max_tokens=gen_len, temperature=args.temperature,
+                top_p=args.top_p, seed=0, ignore_eos=True)
+                for i, p in enumerate(prompts)],
+            seed=0, faults=args.faults,
+            meta={"source": "bench", "model": model,
+                  "arrival": args.arrival,
+                  "arrival_rate": args.arrival_rate if poisson else None})
+        trace.save(args.emit_trace)
+        print(f"[bench] wrote replay trace ({len(prompts)} requests) "
+              f"to {args.emit_trace}", file=sys.stderr)
 
     # derive from the REQUEST the run will actually send — the engine's
     # own greedy/truncation predicates — so the warmed sampler executable

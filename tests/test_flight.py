@@ -194,6 +194,36 @@ def test_sli_histograms_and_debug_snapshot(server):
     assert snap["sli"].get("standard", {}).get("ttft", {}).get("n", 0) > 0
 
 
+def test_on_demand_dump_endpoint_is_replay_ready(server):
+    """ISSUE 11 satellite: GET /debug/engine/dump exports a replay-ready
+    schema-versioned bundle on demand (healthy engine, no watchdog or
+    poison event needed), counts in tpuserve_replay_dumps_total, and
+    extracts straight into a workload."""
+    srv, url, _ = server
+    # self-contained: serve one request so the rings are non-empty even
+    # when this test runs in isolation (-k / sharding / reordering)
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps({"prompt": "dumpme", "max_tokens": 2,
+                         "temperature": 0, "ignore_eos": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        r.read()
+    status, bundle = _get(url + "/debug/engine/dump")
+    assert status == 200
+    assert bundle["schema"] >= 2
+    assert "rings" in bundle and "engine" in bundle
+    assert bundle["requests"], "the served request's timeline is in it"
+    import re
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    m = re.search(r"tpuserve_replay_dumps_total\{[^}]*\} (\d+\.\d+)", text)
+    assert m and float(m.group(1)) >= 1
+    from tpuserve.replay import workload_from_bundle
+    wl = workload_from_bundle(bundle)
+    assert wl.requests and wl.schema_version >= 1
+
+
 def test_unknown_request_404(server):
     srv, url, _ = server
     with pytest.raises(urllib.error.HTTPError) as ei:

@@ -172,6 +172,58 @@ def test_p1_unknown_fault_site():
     assert findings == []
 
 
+def test_p1_clock_seam_rule_fires_in_replay_reachable_files():
+    """ISSUE 11 satellite: direct time.monotonic (calls AND bare
+    references like a default_factory) in a clock_paths file is an
+    error — the injectable clock seam (runtime/clock.py) is the only
+    blessed engine-side time source."""
+    findings = lint_snippet("""
+        import time
+
+        class Engine:
+            def _expire(self):
+                now = time.monotonic()
+                return now
+    """, passes=["host-sync"], path="tpuserve/runtime/engine.py")
+    assert "monotonic-outside-clock-seam" in rules(findings)
+    # bare reference (the request.py default_factory shape) fires too
+    findings = lint_snippet("""
+        import dataclasses
+        import time
+
+        @dataclasses.dataclass
+        class Request:
+            arrival_time: float = dataclasses.field(
+                default_factory=time.monotonic)
+    """, passes=["host-sync"], path="tpuserve/runtime/request.py")
+    assert "monotonic-outside-clock-seam" in rules(findings)
+
+
+def test_p1_clock_seam_scope_and_sync_ok():
+    """The rule stays scoped to clock_paths (gateway/tenants keep their
+    real clocks) and accepts reasoned sync-ok tags on genuinely
+    wall-bound sites; the seam itself is clean."""
+    src = """
+        import time
+
+        class Gateway:
+            def probe(self):
+                return time.monotonic()
+    """
+    assert lint_snippet(src, passes=["host-sync"],
+                        path="tpuserve/server/gateway.py") == []
+    findings = lint_snippet("""
+        import time
+
+        class AsyncEngineRunner:
+            def _watchdog_loop(self):
+                # tpulint: sync-ok(watchdog measures REAL hang time)
+                t = time.monotonic()
+                return t - self._clock.monotonic()
+    """, passes=["host-sync"], path="tpuserve/server/runner.py")
+    assert findings == []
+
+
 # ---------------------------------------------------------------------
 # P2 thread-ownership — incl. the PR-3 watchdog regression, re-introduced
 # ---------------------------------------------------------------------

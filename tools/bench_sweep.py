@@ -79,6 +79,12 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # — recorder-on vs TPUSERVE_FLIGHT=0 on the same workload; the
     # acceptance contract is <1% tok/s (CPU row in BENCHMARKS.md).
     ("recorder-ab", ["--recorder-ab"], {}),
+    # Trace replay (ISSUE 11): a Poisson bench row that also exports its
+    # workload as a replay file — the sweep's rows become reproducible
+    # scenarios (tools/replay.py run bench_replay_trace.json), and the
+    # export path itself is exercised on silicon.
+    ("replay-smoke", ["--arrival", "poisson", "--arrival-rate", "16",
+                      "--emit-trace", "bench_replay_trace.json"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

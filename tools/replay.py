@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Trace-driven replay CLI (ROADMAP item 5; subsystem: tpuserve/replay/).
+
+Turns flight-recorder dumps into deterministic, SLI-comparable scenario
+replays — every post-mortem bundle is a manufacturable regression
+scenario, CPU-runnable with no chips.
+
+    # export a replay-ready bundle from a live server (on demand, not
+    # only on watchdog/poison events)
+    python tools/replay.py dump --url http://localhost:8000 -o incident.json
+
+    # convert a bundle (post-mortem or dump) into a portable workload
+    python tools/replay.py extract incident.json -o workload.json
+
+    # replay it in virtual time against the real engine on CPU and diff
+    # the replay SLIs against the incident's recorded SLIs
+    python tools/replay.py run workload.json --report report.json
+
+    # one-shot: bundle in, diff out
+    python tools/replay.py run incident.json --from-bundle
+
+Determinism contract: same workload file + same seed => identical token
+streams and identical SLI summary (report carries sha256 digests of
+both; pinned in tier-1 by tests/test_replay.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# replay is CPU-runnable by contract: never steal (or wait for) a TPU
+# unless the operator explicitly asked for one
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _cmd_dump(args) -> int:
+    import urllib.request
+    url = args.url.rstrip("/") + "/debug/engine/dump"
+    with urllib.request.urlopen(url, timeout=args.timeout) as r:
+        data = json.loads(r.read())
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    n = (sum(len(b.get("requests", {})) for b in data["engines"])
+         if "engines" in data else len(data.get("requests", {})))
+    print(f"wrote replay bundle ({n} request timelines) to {args.out}")
+    return 0
+
+
+def _cmd_extract(args) -> int:
+    from tpuserve.replay import load_bundle, workload_from_bundle
+    wl = workload_from_bundle(load_bundle(args.bundle), seed=args.seed)
+    wl.save(args.out)
+    print(f"wrote workload to {args.out}: "
+          f"{json.dumps(wl.summary(), sort_keys=True)}")
+    if wl.meta.get("truncated"):
+        print("WARNING: source bundle was truncated/torn — see meta in "
+              "the workload file", file=sys.stderr)
+    return 0
+
+
+def _cmd_run(args) -> int:
+    from tpuserve.replay import (ReplayOptions, Workload, diff_report,
+                                 load_bundle, render_diff, replay,
+                                 workload_from_bundle)
+    if args.from_bundle:
+        wl = workload_from_bundle(load_bundle(args.workload),
+                                  seed=args.seed or 0)
+    else:
+        wl = Workload.load(args.workload)
+        if args.seed is not None:
+            wl.seed = args.seed
+    opts = ReplayOptions(
+        model=args.model,
+        step_time_s=(args.step_ms / 1000.0) if args.step_ms else None,
+        max_num_seqs=args.max_seqs, num_blocks=args.num_blocks,
+        multi_step=args.multi_step, slo_classes=not args.no_slo)
+    report = replay(wl, opts)
+    source_sli = None
+    if args.diff:
+        source_sli = load_bundle(args.diff).get("sli", {})
+    diff = diff_report(report, wl, source_sli=source_sli)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump({"report": report, "diff": diff}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        print(f"wrote replay report to {args.report}")
+    if args.json:
+        print(json.dumps({"report": report, "diff": diff},
+                         sort_keys=True))
+    else:
+        print(render_diff(diff))
+        print(f"\ntoken_digest={report['token_digest'][:16]}… "
+              f"sli_digest={report['sli_digest'][:16]}…")
+    return 2 if report.get("aborted") else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/replay.py",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("dump", help="export a replay-ready bundle from a "
+                                    "live server (/debug/engine/dump)")
+    d.add_argument("--url", required=True, help="server base URL")
+    d.add_argument("-o", "--out", default="flight_dump.json")
+    d.add_argument("--timeout", type=float, default=30.0)
+    d.set_defaults(fn=_cmd_dump)
+
+    e = sub.add_parser("extract", help="bundle -> portable workload file")
+    e.add_argument("bundle", help="flight bundle (post-mortem or dump)")
+    e.add_argument("-o", "--out", default="workload.json")
+    e.add_argument("--seed", type=int, default=0,
+                   help="workload seed (prompt synthesis + fault RNG)")
+    e.set_defaults(fn=_cmd_extract)
+
+    r = sub.add_parser("run", help="deterministic virtual-time replay "
+                                   "against the real engine (CPU)")
+    r.add_argument("workload", help="workload file (or a bundle with "
+                                    "--from-bundle)")
+    r.add_argument("--from-bundle", action="store_true",
+                   help="treat the input as a flight bundle and extract "
+                        "in-process first")
+    r.add_argument("--model", default="tiny-qwen3",
+                   help="replay model (default: tiny CPU model)")
+    r.add_argument("--seed", type=int, default=None,
+                   help="override the workload seed")
+    r.add_argument("--step-ms", type=float, default=None,
+                   help="virtual ms per engine cycle (default: the "
+                        "source incident's mean step ms)")
+    r.add_argument("--max-seqs", type=int, default=None,
+                   help="override decode seats (default: source engine "
+                        "facts)")
+    r.add_argument("--num-blocks", type=int, default=None,
+                   help="override KV block count")
+    r.add_argument("--multi-step", type=int, default=None,
+                   help="fused-window size (default: the source "
+                        "engine's, from the bundle facts)")
+    r.add_argument("--no-slo", action="store_true",
+                   help="replay with SLO classes disabled (the "
+                        "TPUSERVE_SLO_CLASSES=0 arm)")
+    r.add_argument("--diff", default=None, metavar="BUNDLE",
+                   help="diff replay SLIs against this bundle instead of "
+                        "the SLIs stashed at extraction")
+    r.add_argument("--report", default=None, metavar="PATH",
+                   help="write the structured report+diff JSON here")
+    r.add_argument("--json", action="store_true",
+                   help="print machine-readable JSON instead of the "
+                        "human diff")
+    r.set_defaults(fn=_cmd_run)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

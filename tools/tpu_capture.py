@@ -94,6 +94,10 @@ PRIORITY = [
     # keeps per-request lifecycle tracing on in production (CPU A/B in
     # BENCHMARKS.md "Flight recorder").
     "recorder-ab",
+    # Trace replay (ISSUE 11): exercise the bench trace export on
+    # silicon — the emitted workload file makes the row itself a
+    # replayable scenario (tools/replay.py run bench_replay_trace.json).
+    "replay-smoke",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

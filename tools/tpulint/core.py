@@ -76,6 +76,17 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/engine.py::Engine._bm_*",
             "tpuserve/runtime/engine.py::Engine._record_logprobs",
         ],
+        # replay-reachable files: the ONLY blessed time source here is
+        # the injectable clock seam (runtime/clock.py) — a direct
+        # time.monotonic would mix wall time into virtual-time replays
+        "clock_paths": [
+            "tpuserve/runtime/engine.py",
+            "tpuserve/runtime/scheduler.py",
+            "tpuserve/runtime/slo.py",
+            "tpuserve/runtime/flight.py",
+            "tpuserve/runtime/request.py",
+            "tpuserve/server/runner.py",
+        ],
     },
     "thread_ownership": {
         # thread entry points that ARE the engine loop (mutations fine)

@@ -1,6 +1,6 @@
 """P1: host-sync lint.
 
-Two sync-discipline rules plus the fault-site registry check:
+Three sync-discipline rules plus the fault-site registry check:
 
 - ``host-sync-in-jit``: a host synchronization (``jax.device_get``,
   ``np.asarray``/``np.array`` on a traced value, ``.item()``,
@@ -14,6 +14,15 @@ Two sync-discipline rules plus the fault-site registry check:
   engine methods that own the one-sync-per-S-tokens property behind the
   fused-window throughput).  The handful of designed sync points carry
   ``# tpulint: sync-ok(reason)``.
+- ``monotonic-outside-clock-seam``: a direct ``time.monotonic``
+  reference in a replay-reachable file (config
+  ``host_sync.clock_paths``).  Those files must read time through the
+  injectable clock seam (``runtime/clock.py`` — the engine's ``clock``
+  attribute), or trace replay (``tpuserve/replay/``) silently mixes
+  wall time into virtual-time policy state (queue-delay EWMAs,
+  brownout hysteresis, deadlines).  Genuinely wall-bound sites
+  (watchdog hang detection, client-side queue waits) carry a reasoned
+  ``# tpulint: sync-ok(...)``.
 - ``unknown-fault-site``: a literal site name passed to
   ``faults.check(...)`` that is not in ``tpuserve.runtime.faults.SITES``
   (the same registry ``bench.py --faults`` validates against).
@@ -273,6 +282,27 @@ def _check_dispatch_path(rel, fn, cls_name, findings):
                 pass_name=NAME))
 
 
+def _check_clock_seam(rel, tree, findings):
+    """Flag every direct ``time.monotonic`` reference (calls AND bare
+    references like a dataclass ``default_factory=time.monotonic``) —
+    the file is replay-reachable, so its time must come from the
+    injectable clock seam (runtime/clock.py)."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute)
+                and node.attr == "monotonic"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "time"):
+            findings.append(Finding(
+                file=rel, line=node.lineno,
+                rule="monotonic-outside-clock-seam",
+                message="direct time.monotonic in a replay-reachable "
+                        "path — read the engine's injectable clock seam "
+                        "instead (runtime/clock.py: self.clock"
+                        ".monotonic()), or tag a genuinely wall-bound "
+                        "site with # tpulint: sync-ok(reason)",
+                pass_name=NAME))
+
+
 def _check_fault_sites(rel, tree, findings):
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -294,10 +324,14 @@ def _check_fault_sites(rel, tree, findings):
 
 
 def run(files: dict, config: Config, repo_root: str) -> list:
+    import fnmatch
     findings: list = []
     sec = config.section("host_sync")
     dispatch_patterns = sec.get("dispatch_paths", [])
+    clock_paths = sec.get("clock_paths", [])
     for rel, (_src, tree) in files.items():
+        if any(fnmatch.fnmatch(rel, pat) for pat in clock_paths):
+            _check_clock_seam(rel, tree, findings)
         traced, lambdas = _collect_traced(tree)
         for fn, statics in traced.items():
             tainted = _tainted_names(fn, statics)

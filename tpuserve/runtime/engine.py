@@ -158,6 +158,14 @@ class EngineConfig:
     # (default on; =0 removes the recorder byte-for-byte — the
     # bench.py --recorder-ab overhead A/B lever).
     flight: Optional[bool] = None
+    # Injectable monotonic-time source (runtime/clock.py): None = the
+    # shared real clock.  The trace-replay harness (tpuserve/replay/)
+    # installs a VirtualClock here so recorded incidents re-run in
+    # seconds without distorting queue-delay EWMAs, brownout hysteresis,
+    # admission deadlines or flight-recorder timelines — every
+    # engine-side timestamp flows through this seam (tpulint P1's
+    # monotonic-outside-clock-seam rule keeps it that way).
+    clock: Optional[object] = None
     # Grammar-FSM guided decoding (runtime/grammar/): compile guided
     # specs to token-level FSMs whose per-state masks ride the fused
     # decode window (true logit masking, distribution-correct), so
@@ -314,6 +322,11 @@ class Engine:
     def __init__(self, config: EngineConfig, *, params=None,
                  model_cfg: ModelConfig | None = None, mesh=None):
         self.config = config
+        # ONE time source for everything replay-reachable (scheduler,
+        # SLO controller, flight recorder, request stamps): the
+        # injectable clock seam.  Replay swaps in a VirtualClock.
+        from tpuserve.runtime.clock import MONOTONIC
+        self.clock = config.clock or MONOTONIC
         if config.quantization not in (None, "int8"):
             # reject before the (potentially multi-GB) checkpoint load
             raise ValueError(f"unknown quantization {config.quantization!r};"
@@ -527,6 +540,7 @@ class Engine:
         self.scheduler = Scheduler(sched_cfg, self.block_manager,
                                    max_model_len=self.cache_cfg.max_model_len,
                                    ragged_align=self._ragged_blk)
+        self.scheduler.clock = self.clock
         # SLO class scheduling + brownout ladder (runtime/slo.py): the
         # controller is consulted at intake (shed / max_tokens clamp),
         # by the scheduler (class-ordered queue, budget reserve,
@@ -538,7 +552,8 @@ class Engine:
         if slo_on is None:
             slo_on = env_flag("TPUSERVE_SLO_CLASSES")
         self._slo = (SloController(config.slo or SloConfig(),
-                                   sched_cfg.resolve_max_waiting())
+                                   sched_cfg.resolve_max_waiting(),
+                                   clock=self.clock)
                      if slo_on else None)
         self.scheduler.slo = self._slo
         # Flight recorder (runtime/flight.py): always-on lifecycle ring
@@ -547,8 +562,22 @@ class Engine:
         # emission sites gate on the cached bool so TPUSERVE_FLIGHT=0
         # costs one attribute load per site (the --recorder-ab lever).
         from tpuserve.runtime.flight import FlightRecorder
-        self.flight = FlightRecorder(enabled=config.flight)
+        self.flight = FlightRecorder(enabled=config.flight,
+                                     clock=self.clock)
         self._flight_on = self.flight.enabled
+        # engine-shape facts ride every bundle so the replay harness
+        # (tpuserve/replay/) can build a comparably-sized engine — an
+        # incident replayed against twice the seats/blocks diffs
+        # meaninglessly
+        self.flight.note_engine_facts(
+            model=config.model,
+            max_num_seqs=sched_cfg.max_num_seqs,
+            num_blocks=self.cache_cfg.num_blocks,
+            block_size=self.cache_cfg.block_size,
+            max_model_len=self.cache_cfg.max_model_len,
+            mixed_batching=sched_cfg.mixed_batching,
+            multi_step=config.resolve_multi_step(),
+            slo_classes=bool(self._slo is not None))
         self.scheduler.flight = self.flight if self._flight_on else None
         if self._slo is not None:
             self._slo.flight = self.flight if self._flight_on else None
@@ -904,7 +933,8 @@ class Engine:
                 self._guided[request_id] = acceptor
         req = Request(request_id=request_id, prompt_token_ids=prompt_token_ids,
                       params=params, prompt=prompt, adapter_idx=adapter_idx,
-                      deadline=deadline)
+                      deadline=deadline,
+                      arrival_time=self.clock.monotonic())
         self._detok[request_id] = IncrementalDetokenizer(self.tokenizer)
         self.requests[request_id] = req
         try:
@@ -928,9 +958,12 @@ class Engine:
             self._guided_fsm.pop(request_id, None)
             self._guided_plan.pop(request_id, None)
             raise
+        # max_tokens recorded so replay extraction can rebuild the
+        # generation budget of requests the incident never finished
         self.flight.req_event(request_id, "QUEUED",
                               slo_class=params.slo_class,
-                              prompt_tokens=len(prompt_token_ids))
+                              prompt_tokens=len(prompt_token_ids),
+                              max_tokens=params.max_tokens)
         if self._adaptive_window and (self.scheduler.running
                                       or self._pending_window is not None):
             # an arrival into a BUSY engine predicts more: shrink the next
@@ -940,7 +973,7 @@ class Engine:
             # only after scheduler.add succeeds): a retry flood against a
             # full queue must not pin running streams at min_multi_step
             # exactly when max throughput would drain the queue fastest.
-            self._last_busy_arrival = time.monotonic()
+            self._last_busy_arrival = self.clock.monotonic()
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
 
@@ -979,7 +1012,8 @@ class Engine:
                 >= self.config.scheduler.max_num_seqs):
             raise MemoryError("decode pool at capacity")
         req = Request(request_id=request_id,
-                      prompt_token_ids=prompt_token_ids, params=params)
+                      prompt_token_ids=prompt_token_ids, params=params,
+                      arrival_time=self.clock.monotonic())
         alloc = self.block_manager.allocate(request_id, prompt_token_ids)
         try:
             # Everything between the allocate and the self.requests
@@ -997,7 +1031,7 @@ class Engine:
                                           alloc.blocks)
             req.output_token_ids.append(first_token)
             req.state = RequestState.RUNNING
-            req.first_token_time = time.monotonic()
+            req.first_token_time = self.clock.monotonic()
             detok = IncrementalDetokenizer(self.tokenizer)
             # seed; text streamed prefill-side
             first_text = detok.add(first_token)
@@ -1050,7 +1084,7 @@ class Engine:
                                       or self._pending_window is not None):
             # cross-pod migration into a busy decode pod is an arrival
             # (bypasses add_request's busy-arrival stamp)
-            self._last_busy_arrival = time.monotonic()
+            self._last_busy_arrival = self.clock.monotonic()
         self.scheduler.running.append(req)
         self.stats.prompt_tokens += len(prompt_token_ids)
         return request_id
@@ -1126,7 +1160,7 @@ class Engine:
         sched = self.scheduler
         if not sched.waiting:
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
         # only requests with NO progress expire here: a preempted
         # mid-stream request (delivered tokens) or a mid-chunk prompt
         # (prefill spent) is paid-for work — aborting it queue-side
@@ -1260,7 +1294,7 @@ class Engine:
         runtime complement to tpulint's static kv-leak pass (faulted
         steps skip the check: their orphans are reconciled by the
         runner's salvage path, not mid-exception)."""
-        t_cycle = time.monotonic()
+        t_cycle = self.clock.monotonic()
         outputs = self._step_inner()
         if self._flight_on:
             dispatched = bool(self._dispatch_rids)
@@ -1268,7 +1302,7 @@ class Engine:
                 self._step_kind, len(self._dispatch_rids),
                 self.stats.step_actual_tokens if dispatched else 0,
                 self.stats.step_padded_tokens if dispatched else 0,
-                time.monotonic() - t_cycle)
+                self.clock.monotonic() - t_cycle)
         if self._slo is not None:
             # estimator tick once per successful cycle (queue depth +
             # the EWMAs fed during scheduling) drives the brownout
@@ -1316,7 +1350,7 @@ class Engine:
         if batch is None:
             # nothing schedulable but a decode result may still be in flight
             return pre + self._flush_pending() + self._flush_window()
-        t0 = time.monotonic()
+        t0 = self.clock.monotonic()
         if batch.kind == "prefill":
             outputs = self._run_prefill(batch)
         elif batch.kind == "prefill_chunk":
@@ -1346,7 +1380,7 @@ class Engine:
                 outputs = self._run_decode_multi(batch)  # None = ineligible
             if outputs is None:
                 outputs = self._run_decode(batch)
-        self.stats.last_step_time = time.monotonic() - t0
+        self.stats.last_step_time = self.clock.monotonic() - t0
         self._release_window_blocks()
         return pre + outputs
 
@@ -1492,7 +1526,7 @@ class Engine:
             self.flight.req_event(req.request_id, "RESTORING",
                                   blocks=len(blocks))
             self._restores[req.request_id] = (span, blocks,
-                                              time.monotonic())
+                                              self.clock.monotonic())
             self.stats.kv_restores += 1
             self.stats.kv_restored_blocks += len(blocks)
 
@@ -1504,7 +1538,7 @@ class Engine:
         order does the rest."""
         if not self._restores:
             return
-        now = time.monotonic()
+        now = self.clock.monotonic()
         for rid, (span, blocks, t0) in self._restores.items():
             self.block_manager.commit_restore(span, blocks)
             req = self.requests.get(rid)
@@ -1551,7 +1585,7 @@ class Engine:
         admission wait is bounded by one window, so this is the p50-TTFT
         lever under load."""
         if self._adaptive_window and (
-                time.monotonic() - self._last_busy_arrival
+                self.clock.monotonic() - self._last_busy_arrival
                 < self.config.adaptive_window_hold_s):
             return self._min_multi_step
         return self._multi_step
@@ -1815,7 +1849,7 @@ class Engine:
         self.stats.num_prefill_steps += 1
         self._note_step_tokens(int(prompt_lens[:len(reqs)].sum()), B * L)
         new_tokens = self._sample(logits, reqs, B)
-        now = time.monotonic()
+        now = self.clock.monotonic()
         for req in reqs:
             if req.first_token_time is None:      # not a re-prefill after preemption
                 req.first_token_time = now
@@ -1895,7 +1929,7 @@ class Engine:
             return []
         self.scheduler.mark_running([req])
         new_tokens = self._sample(logits, [req], 1)
-        now = time.monotonic()
+        now = self.clock.monotonic()
         if req.first_token_time is None:
             req.first_token_time = now
             self.stats.ttft_sum += now - req.arrival_time
@@ -2078,7 +2112,7 @@ class Engine:
         if not emit_reqs:
             return outputs
         new_tokens = self._sample(logits, emit_reqs, B)
-        now = time.monotonic()
+        now = self.clock.monotonic()
         for req in comp_reqs:
             if req.first_token_time is None:
                 req.first_token_time = now
@@ -2501,7 +2535,7 @@ class Engine:
                 delta += req.stop_held
                 req.stop_held = ""
             req.finish_reason = reason
-            req.finish_time = time.monotonic()
+            req.finish_time = self.clock.monotonic()
             self.scheduler.finish(req)
             self.stats.requests_finished += 1
             self.stats.window_overrun_tokens += steps - consumed
@@ -3346,7 +3380,7 @@ class Engine:
             req.stop_held = ""
         if finished:
             req.finish_reason = reason
-            req.finish_time = time.monotonic()
+            req.finish_time = self.clock.monotonic()
             self.scheduler.finish(req)
             self.stats.requests_finished += 1
             self.flight.req_event(req.request_id, "FINISHED",

@@ -33,9 +33,11 @@ watchdog thread may call while the loop thread is wedged inside a stuck
 dispatch (that is the point); it reads snapshots and touches only
 recorder-owned counters.
 
-Timestamps are ``time.monotonic()`` ONLY — no wall-clock deltas (pinned
-by tests/test_flight.py) and no device syncs anywhere (tpulint P1 stays
-green: the recorder stores host-known ints/strs, never a jax array).
+Timestamps come from the injectable monotonic clock seam ONLY
+(runtime/clock.py — virtual under trace replay, the real clock in
+production; no wall-clock deltas, pinned by tests/test_flight.py) and no
+device syncs happen anywhere (tpulint P1 stays green: the recorder
+stores host-known ints/strs, never a jax array).
 ``TPUSERVE_FLIGHT=0`` (or ``EngineConfig.flight=False``) removes it —
 the ``bench.py --recorder-ab`` overhead A/B lever.
 """
@@ -48,10 +50,18 @@ import os
 import time
 from typing import Optional, Sequence
 
+from tpuserve.runtime.clock import MONOTONIC
 from tpuserve.runtime.hostprof import PROF
 from tpuserve.utils import env_flag
 
 logger = logging.getLogger("tpuserve.flight")
+
+#: Post-mortem / on-demand bundle schema.  v1 (implicit — bundles carried
+#: no version field) lacked ring-integrity markers, engine facts and
+#: max_tokens on QUEUED events; replay extraction (tpuserve/replay/
+#: extract.py) upgrades v1 bundles loudly and rejects anything newer
+#: than this build understands.
+FLIGHT_SCHEMA_VERSION = 2
 
 #: canonical lifecycle event names, in rough lifecycle order (the
 #: /debug/requests timeline and the OTLP child spans use these verbatim)
@@ -92,7 +102,7 @@ class _Ring:
 class FlightRecorder:
     def __init__(self, enabled: Optional[bool] = None,
                  events: int = 0, steps: int = 0,
-                 dirpath: Optional[str] = None):
+                 dirpath: Optional[str] = None, clock=None):
         if enabled is None:
             enabled = env_flag("TPUSERVE_FLIGHT")
         self.enabled = bool(enabled)
@@ -103,10 +113,17 @@ class FlightRecorder:
         self._events = _Ring(ev_n)
         self._steps = _Ring(st_n)
         self._dir = dirpath or os.environ.get("TPUSERVE_FLIGHT_DIR") or None
+        # injectable time source (runtime/clock.py): under replay the
+        # recorder stamps VIRTUAL time, so a replayed timeline is
+        # directly comparable to the recorded incident's
+        self._clock = clock or MONOTONIC
         # monotonic->wall anchor for OTLP span export and bundle headers
         # ONLY; every recorded timestamp and every delta stays monotonic
-        self._mono0 = time.monotonic()
+        self._mono0 = self._clock.monotonic()
         self._wall0 = time.time()        # wall-anchor-ok: export mapping, never a delta
+        # engine configuration facts (note_engine_facts), carried in
+        # bundles so replay can size a comparable engine
+        self._facts: dict = {}
         # per-cycle hostprof deltas are diffs against this snapshot of the
         # module profiler's cumulative seconds
         self._prof_last: dict = {}
@@ -120,7 +137,7 @@ class FlightRecorder:
     def req_event(self, rid: str, event: str, **detail) -> None:
         if not self.enabled:
             return
-        self._events.append((time.monotonic(), rid, event,
+        self._events.append((self._clock.monotonic(), rid, event,
                              detail or None))
 
     def req_event_many(self, rids: tuple, event: str, **detail) -> None:
@@ -130,7 +147,7 @@ class FlightRecorder:
         per-row form measurably cost tok/s (the --recorder-ab guard)."""
         if not self.enabled or not rids:
             return
-        self._events.append((time.monotonic(), tuple(rids), event,
+        self._events.append((self._clock.monotonic(), tuple(rids), event,
                              detail or None))
 
     def fault_hook(self, site: str, mode: str,
@@ -140,7 +157,7 @@ class FlightRecorder:
         sequence become self-explanatory)."""
         if not self.enabled:
             return
-        t = time.monotonic()
+        t = self._clock.monotonic()
         for rid in rids or ("(engine)",):
             self._events.append((t, rid, "FAULT",
                                  {"site": site, "mode": mode}))
@@ -162,8 +179,19 @@ class FlightRecorder:
                 if d > 0:
                     phases[k] = round(d * 1000, 4)
             self._prof_last = cur
-        self._steps.append((time.monotonic(), kind, rows, actual, padded,
+        self._steps.append((self._clock.monotonic(), kind, rows, actual, padded,
                             round(dur_s * 1000, 4), phases or None))
+
+    def note_engine_facts(self, **facts) -> None:
+        """Engine configuration facts stamped into every bundle (model,
+        max_num_seqs, num_blocks, block_size, multi_step, slo_classes):
+        what the replay harness needs to size a *comparable* engine —
+        an overload incident replayed against a pool twice the size
+        would diff meaninglessly.  Called once at engine construction;
+        cheap dict update, recorded even when disabled (facts are not
+        trace data)."""
+        self._facts.update({k: v for k, v in facts.items()
+                            if v is not None})
 
     def note_sli(self, slo_class: str, kind: str, value: float) -> None:
         """Client-observable latency sample (runner loop thread): TTFT /
@@ -255,7 +283,48 @@ class FlightRecorder:
         span export / bundle headers only)."""
         return self._wall0 + (t_mono - self._mono0)
 
-    # ---- post-mortems --------------------------------------------------
+    # ---- bundles (post-mortem + on-demand dump) ------------------------
+
+    def dump_bundle(self, reason: str, rids: Sequence[str] = (),
+                    extra: Optional[dict] = None) -> dict:
+        """Build a replay-ready bundle dict: last N cycles, the named (or
+        every ring-reachable) request timeline, SLI reservoirs, engine
+        facts, schema version, and ring-integrity markers.  Snapshot
+        reads only — safe from any thread, including the watchdog thread
+        while the loop is wedged (post-mortems) and HTTP handler threads
+        (/debug/engine/dump).
+
+        Integrity markers: ``rings`` records each ring's write cursor
+        and capacity at dump start, how many entries have already been
+        overwritten (``dropped``), and the cursor again after assembly —
+        ``torn`` flags a dump raced by a live writer.  Replay extraction
+        uses these to REPORT a truncated or torn timeline instead of
+        silently synthesizing a shorter workload."""
+        ev_cursor, st_cursor = self._events.idx, self._steps.idx
+        ids = list(rids) or self.recent_request_ids(limit=10 ** 6)
+        bundle = {
+            "schema": FLIGHT_SCHEMA_VERSION,
+            "reason": reason,
+            "written_unix": self.wall_of(self._clock.monotonic()),
+            "monotonic_anchor": {"mono": self._mono0,
+                                 "wall": self._wall0},
+            "engine": dict(self._facts),
+            "steps": self.steps_snapshot(256),
+            "requests": {rid: self.request_timeline(rid)
+                         for rid in ids},
+            "sli": self.sli_summary(),
+        }
+        bundle["rings"] = {
+            "events": {"cursor": ev_cursor, "capacity": self._events._n,
+                       "dropped": max(0, ev_cursor - self._events._n),
+                       "torn": self._events.idx != ev_cursor},
+            "steps": {"cursor": st_cursor, "capacity": self._steps._n,
+                      "dropped": max(0, st_cursor - self._steps._n),
+                      "torn": self._steps.idx != st_cursor},
+        }
+        if extra:
+            bundle["extra"] = extra
+        return bundle
 
     def postmortem(self, reason: str, rids: Sequence[str] = (),
                    extra: Optional[dict] = None) -> Optional[str]:
@@ -282,19 +351,10 @@ class FlightRecorder:
             path = os.path.join(
                 d, f"flight-{reason}-{os.getpid()}-{n}"
                    f"-{uuid.uuid4().hex[:8]}.json")
-            ids = list(rids) or self.recent_request_ids()
-            bundle = {
-                "reason": reason,
-                "written_unix": self.wall_of(time.monotonic()),
-                "monotonic_anchor": {"mono": self._mono0,
-                                     "wall": self._wall0},
-                "steps": self.steps_snapshot(256),
-                "requests": {rid: self.request_timeline(rid)
-                             for rid in ids},
-                "sli": self.sli_summary(),
-            }
-            if extra:
-                bundle["extra"] = extra
+            # watchdog-path dumps pass the affected rids; a post-mortem
+            # with no named requests captures everything in the ring so
+            # the incident replays whole (tpuserve/replay/extract.py)
+            bundle = self.dump_bundle(reason, rids, extra)
             tmp = path + ".tmp"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(bundle, f, indent=1, sort_keys=True)

@@ -151,6 +151,7 @@ class Request:
     prompt_token_ids: list[int]
     params: SamplingParams
     prompt: Optional[str] = None
+    # tpulint: sync-ok(standalone-Request default only; the engine passes arrival_time from its clock seam)
     arrival_time: float = dataclasses.field(default_factory=time.monotonic)
 
     state: RequestState = RequestState.WAITING

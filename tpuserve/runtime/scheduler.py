@@ -12,11 +12,11 @@ SURVEY.md §7 "hard parts").
 from __future__ import annotations
 
 import dataclasses
-import time
 from collections import deque
 from typing import Optional
 
 from tpuserve.runtime.block_manager import BlockManager
+from tpuserve.runtime.clock import MONOTONIC
 from tpuserve.runtime.request import Request, RequestState
 from tpuserve.runtime.slo import BATCH, class_rank
 from tpuserve.utils import env_flag, next_power_of_2
@@ -125,6 +125,11 @@ class Scheduler:
         # (batched / chunked / mixed) and both preemption kinds emit
         # identically.  None = no recording.
         self.flight = None
+        # Injectable time source (runtime/clock.py): the engine overwrites
+        # this with ITS clock so queue-delay measurement replays in
+        # virtual time; a standalone scheduler (unit tests) gets the real
+        # clock.
+        self.clock = MONOTONIC
         # Set after scheduling a chunked-prefill step: the next cycle runs a
         # decode step first (if anything is running) so in-flight streams get
         # a token between chunks — without this, a 32k prompt at the 2048
@@ -224,7 +229,7 @@ class Scheduler:
         if (req.state != RequestState.WAITING or req.num_prefilled > 0
                 or req.output_token_ids):
             return
-        delay = time.monotonic() - req.arrival_time
+        delay = self.clock.monotonic() - req.arrival_time
         if self.slo is not None:
             self.slo.note_admission(self._rank(req), delay)
         if self.flight is not None:

@@ -43,8 +43,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Optional
+
+from tpuserve.runtime.clock import MONOTONIC
 
 logger = logging.getLogger("tpuserve.slo")
 
@@ -119,11 +120,16 @@ class SloController:
     mutation happens on the engine loop thread; the runner reads
     ``level`` / drains observations from the same thread)."""
 
-    def __init__(self, cfg: SloConfig, max_waiting: int):
+    def __init__(self, cfg: SloConfig, max_waiting: int, clock=None):
         self.cfg = cfg
         self.max_waiting = max(1, max_waiting)
+        # injectable time source (runtime/clock.py): the brownout
+        # ladder's hold-timer hysteresis must run in the engine's time —
+        # virtual under replay — or a storm replayed in seconds would
+        # never hold a level long enough to exit it
+        self.clock = clock or MONOTONIC
         self.level = 0
-        self._level_changed = time.monotonic()
+        self._level_changed = self.clock.monotonic()
         # per-class queue-delay EWMAs (seconds); None until first sample
         self._delay_ewma: list[Optional[float]] = [None] * len(SLO_CLASSES)
         # padding efficiency EWMA (actual/padded tokens per dispatch):
@@ -194,7 +200,7 @@ class SloController:
         down ONE level per hold_s and only under the entry threshold
         minus the margin."""
         self._waiting = waiting
-        now = time.monotonic() if now is None else now
+        now = self.clock.monotonic() if now is None else now
         if waiting == 0:
             # an empty queue's admission delay IS zero: decay the
             # per-class EWMAs toward it, or a burst of slow (compile-

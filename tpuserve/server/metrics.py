@@ -272,6 +272,12 @@ class ServerMetrics:
             "each count is a JSON file of the last N engine cycles + "
             "affected request timelines under TPUSERVE_FLIGHT_DIR "
             "(/debug/engine reports the newest path)")
+        self.replay_dumps = counter(
+            "tpuserve_replay_dumps",
+            "Replay-ready flight bundles exported on demand via "
+            "GET /debug/engine/dump (tools/replay.py dump) — unlike "
+            "post-mortems these capture a HEALTHY engine's recent "
+            "timelines for trace-driven replay (tpuserve/replay/)")
         # Multi-tenant metering (server/tenants.py): tenant = API key /
         # LoRA adapter.  Label cardinality is bounded by the configured
         # tenant set (+ "default").

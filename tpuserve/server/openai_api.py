@@ -603,6 +603,22 @@ class _Handler(BaseHTTPRequestHandler):
             # rows, actual/padded tokens, phase ms), recent request ids,
             # client SLI percentiles, post-mortem pointers
             self._json(200, self._debug_engine_payload())
+        elif self.path == "/debug/engine/dump":
+            # on-demand replay-ready bundle (tools/replay.py dump): the
+            # same schema-versioned format post-mortems use — every ring-
+            # reachable request timeline + step records + SLIs + engine
+            # facts + ring-integrity markers — so an operator can capture
+            # an incident WITHOUT waiting for a watchdog/poison event.
+            # Snapshot reads only; the engine keeps serving.
+            recorders = self._flight_recorders()
+            if not recorders:
+                self._error(404, "flight recorder disabled "
+                                 "(TPUSERVE_FLIGHT=0): nothing to dump")
+            else:
+                bundles = [fl.dump_bundle("on_demand") for fl in recorders]
+                ctx.metrics.replay_dumps.inc()
+                self._json(200, bundles[0] if len(bundles) == 1
+                           else {"engines": bundles})
         elif self.path.startswith("/debug/requests/"):
             from urllib.parse import unquote
             rid = unquote(self.path[len("/debug/requests/"):])

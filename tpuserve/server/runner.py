@@ -16,6 +16,7 @@ import time
 from collections import deque
 from typing import Optional, Sequence, Union
 
+from tpuserve.runtime.clock import MONOTONIC
 from tpuserve.runtime.engine import Engine
 from tpuserve.runtime.request import RequestOutput, RequestState, SamplingParams
 
@@ -101,6 +102,12 @@ class AsyncEngineRunner:
     def __init__(self, engine, metrics=None):
         self.engine = engine
         self.metrics = metrics
+        # The engine's injectable clock seam (runtime/clock.py): request
+        # SLI stamps (_req_started / _route_outputs) run in ENGINE time so
+        # a replay-driven engine records virtual-time SLIs; real-wall
+        # concerns (watchdog hang detection, client queue waits, fault-
+        # storm windows) stay on the real clock below.
+        self._clock = getattr(engine, "clock", MONOTONIC)
         # Optional hook fed with the wall-clock seconds of each engine.step()
         # — the TPU duty-cycle source for tpu_metrics.TpuMetricsExporter.
         self.on_step_time = None
@@ -211,8 +218,10 @@ class AsyncEngineRunner:
         rid, q = self.submit(prompt=prompt, prompt_token_ids=prompt_token_ids,
                              params=params)
         outs = []
+        # tpulint: sync-ok(client-side wall-clock wait on the output queue, not engine time)
         deadline = time.monotonic() + timeout
         while True:
+            # tpulint: sync-ok(client-side wall-clock wait on the output queue, not engine time)
             item = q.get(timeout=max(deadline - time.monotonic(), 0.001))
             if item is None:
                 getattr(self.engine, "requests", {}).pop(rid, None)
@@ -253,7 +262,7 @@ class AsyncEngineRunner:
                     continue
                 msg.assigned_id = rid
                 self._out_queues[rid] = msg.out_queue
-                self._req_started[rid] = time.monotonic()
+                self._req_started[rid] = self._clock.monotonic()
                 self._last_token_time[rid] = self._req_started[rid]
                 if self.metrics:
                     self.metrics.request_total.inc()
@@ -275,7 +284,7 @@ class AsyncEngineRunner:
                 continue
             msg.assigned_id = rid
             self._out_queues[rid] = msg.out_queue
-            self._req_started[rid] = time.monotonic()
+            self._req_started[rid] = self._clock.monotonic()
             self._last_token_time[rid] = self._req_started[rid]
             if self.metrics:
                 self.metrics.request_total.inc()
@@ -289,7 +298,7 @@ class AsyncEngineRunner:
         return getattr(getattr(req, "params", None), "slo_class", "standard")
 
     def _route_outputs(self, outputs: list[RequestOutput]) -> None:
-        now = time.monotonic()
+        now = self._clock.monotonic()
         # every inner engine's recorder gets the SLIs: a disagg pod's
         # decode engine must not log empty client SLIs on brownout
         flights = self._flights()
@@ -442,6 +451,7 @@ class AsyncEngineRunner:
         are isolated and failed individually.  Engines without the salvage
         hook, and fault storms past MAX_FAULTS_PER_WINDOW, fall back to
         the old fail-all (+ tpuserve_engine_restarts)."""
+        # tpulint: sync-ok(fault-storm rate window is a real-wall chaos measure)
         now = time.monotonic()
         self._fault_times = [t for t in self._fault_times
                              if now - t < self.FAULT_WINDOW_S]
@@ -608,7 +618,7 @@ class AsyncEngineRunner:
                 continue
             seq, t0 = cur
             threshold = self._watchdog_threshold()
-            running_s = time.monotonic() - t0
+            running_s = time.monotonic() - t0  # tpulint: sync-ok(watchdog measures REAL hang time; a virtual clock would never trip)
             if running_s < threshold:
                 continue
             if self._step_started != cur:
@@ -807,11 +817,12 @@ class AsyncEngineRunner:
                 continue
             self._step_seq += 1
             seq = self._step_seq
-            step_start = time.monotonic()
+            step_start = time.monotonic()  # tpulint: sync-ok(step wall time feeds the watchdog stamp and TPU duty cycle)
             self._step_started = (seq, step_start)
             try:
                 outputs = self.engine.step()
                 if self.on_step_time is not None:
+                    # tpulint: sync-ok(step wall time feeds the watchdog stamp and TPU duty cycle)
                     self.on_step_time(time.monotonic() - step_start)
             except Exception as e:
                 self._step_started = None
