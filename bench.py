@@ -1199,6 +1199,136 @@ def _best_tpu_result(model):
     return best
 
 
+def _seed_spill_dir(spill_dir):
+    """Phase 1 of the cold-start measurement: one throwaway replica
+    serves a shared prefix, churn evicts it HBM -> host -> PVC spill,
+    and the spill files stay behind — exactly what a scaled-to-zero
+    pool's PVC looks like between bursts."""
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SchedulerConfig)
+    from tpuserve.runtime.request import SamplingParams
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=24,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, max_prefill_tokens=256,
+                                  min_prefill_bucket=8,
+                                  min_decode_bucket=2),
+        enable_prefix_caching=True, kv_tiers=True, kv_host_bytes=3000,
+        kv_spill_dir=spill_dir))
+    shared = list(range(2, 26))          # 6 full blocks at block_size 4
+    p = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    eng.generate([shared + [30]], p)
+    eng.generate([[100 + i] * 40 for i in range(3)], p)   # churn/evict
+    eng._kv_tiers.flush()
+    return shared, int(eng.stats.kv_spilled_blocks)
+
+
+def _autoscale_ab(args):
+    """--autoscale-replay: drive the SLI-driven autoscaler end to end
+    on the simulated replica pool (tpuserve/autoscale/pool.py), in
+    virtual time, tiny CPU model — this measures POLICY dynamics
+    (scale-out timing vs the brownout ladder, per-class SLI deltas,
+    cold-start behaviour), not silicon throughput.
+
+    storm mode: the same recorded brownout storm replayed twice —
+    static topology vs autoscaled — and diffed per class (the tuning
+    loop: change a policy knob, rerun, diff).  cold-start mode: a pool
+    scaled to ZERO with a pre-seeded KV spill dir takes a burst; the
+    from-zero replica must serve its first token with a warm-prefix
+    restore, and the report carries cold-pod-to-first-token."""
+    from tpuserve.autoscale import (PolicyConfig, PoolReplayOptions,
+                                    make_storm_workload, pool_replay)
+
+    def sli_row(rep, cls="interactive"):
+        s = rep["sli"].get(cls, {}).get("ttft", {})
+        return {"ttft_p50_s": s.get("p50"), "ttft_p95_s": s.get("p95"),
+                "n": s.get("n")}
+
+    if args.autoscale_mode == "cold-start":
+        import shutil
+        import tempfile
+        spill = tempfile.mkdtemp(prefix="tpuserve-coldstart-")
+        try:
+            shared, spilled = _seed_spill_dir(spill)
+            from tpuserve.replay.workload import Workload, WorkloadRequest
+            wl = Workload(requests=[WorkloadRequest(
+                request_id=f"cold-{i}", arrival_s=0.2 * i,
+                prompt_tokens=len(shared) + 1,
+                prompt_token_ids=shared + [30 + i], max_tokens=4,
+                slo_class="interactive", seed=i)
+                for i in range(4)], seed=3)
+            rep = pool_replay(
+                wl,
+                PoolReplayOptions(initial_replicas=0, cold_start_s=1.0,
+                                  control_interval_s=0.1,
+                                  kv_spill_dir=spill,
+                                  kv_host_bytes=3000),
+                PolicyConfig(min_replicas=0, max_replicas=1))
+        finally:
+            # repeated sweep rows must not accumulate spill dirs in tmp
+            shutil.rmtree(spill, ignore_errors=True)
+        return {
+            "mode": "cold-start",
+            "spilled_blocks_seeded": spilled,
+            "cold_starts_s": rep["cold_starts_observed_s"],
+            "warm_prefix_blocks_restored":
+                rep["counters"]["kv_restored_blocks"],
+            "completed": rep["counters"]["completed"],
+            "decisions": len(rep["decisions"]),
+            "interactive": sli_row(rep),
+            "wall_s": rep["wall_s"],
+        }
+
+    # tuned so ONE 2-seat replica is ~2x oversubscribed mid-storm (the
+    # static arm climbs to L3 and sheds) while three drain it
+    wl = make_storm_workload(n=80, ramp_s=5.0, span_s=16.0,
+                             max_tokens=16)
+    opts = PoolReplayOptions(step_time_s=0.05, control_interval_s=0.25,
+                             cold_start_s=1.0, initial_replicas=1,
+                             max_num_seqs=2, max_waiting=12)
+    policy = PolicyConfig(min_replicas=1, max_replicas=3,
+                          scale_out_cooldown_s=2.0,
+                          scale_in_cooldown_s=20.0, idle_in_s=10.0)
+    static = pool_replay(wl, opts)
+    auto = pool_replay(wl, opts, policy)
+    s_p95 = (static["sli"].get("interactive", {}).get("ttft", {})
+             .get("p95") or 0.0)
+    a_p95 = (auto["sli"].get("interactive", {}).get("ttft", {})
+             .get("p95") or 0.0)
+    out_t = auto["first_scale_out_t"]
+    # first degradation event of EITHER kind: ladder L3 entry or an
+    # intake shed (queue-full class eviction can shed below L3)
+    shed_ts = [t for t in (auto["first_l3_t"], auto["first_shed_t"])
+               if t is not None]
+    shed_t = min(shed_ts) if shed_ts else None
+    return {
+        "mode": "storm",
+        "workload": {"requests": len(wl.requests),
+                     "span_s": wl.duration_s()},
+        "static": {"interactive": sli_row(static),
+                   "shed": static["counters"]["shed"],
+                   "completed": static["counters"]["completed"],
+                   "wall_s": static["wall_s"]},
+        "autoscaled": {"interactive": sli_row(auto),
+                       "shed": auto["counters"]["shed"],
+                       "completed": auto["counters"]["completed"],
+                       "replicas_peak": auto["replicas_peak"],
+                       "decisions": auto["decisions"],
+                       "cold_starts_s": auto["cold_starts_observed_s"],
+                       "wall_s": auto["wall_s"]},
+        # virtual-time policy A/B: >1 = autoscaling improved the
+        # interactive tail during the storm
+        "interactive_ttft_p95_improvement_x":
+            round(s_p95 / a_p95, 3) if a_p95 else 0.0,
+        "first_scale_out_t": out_t,
+        "first_l3_or_shed_t": shed_t,
+        "scale_out_before_shed": (out_t is not None
+                                  and (shed_t is None or out_t < shed_t)),
+        "decision_digest": auto["decision_digest"],
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="qwen3-0.6b")
@@ -1235,7 +1365,7 @@ def main(argv=None):
                          "fewer, larger page DMAs per decode step — the "
                          "lever that tests whether the paged kernel is "
                          "DMA-latency bound (headline sits ~9x off the "
-                         "byte roofline while int8 bought only +4%)")
+                         "byte roofline while int8 bought only +4%%)")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative decoding with K draft tokens on a "
                          "repetitive-prompt workload")
@@ -1320,6 +1450,20 @@ def main(argv=None):
                          "phases the native/batched host path moved off "
                          "per-request Python; TPUSERVE_HOST_BATCHED=0 "
                          "measures the legacy path for the A/B)")
+    ap.add_argument("--autoscale-replay", action="store_true",
+                    dest="autoscale_replay",
+                    help="SLI-driven autoscaler A/B on the simulated "
+                         "replica pool (tpuserve/autoscale): replay a "
+                         "synthetic brownout storm static vs "
+                         "autoscaled in virtual time and diff the "
+                         "per-class SLIs (policy dynamics, not silicon "
+                         "throughput — always the tiny model)")
+    ap.add_argument("--autoscale-mode", default="storm",
+                    choices=["storm", "cold-start"],
+                    help="storm: static-vs-autoscaled SLI diff; "
+                         "cold-start: scale-from-zero with a "
+                         "pre-seeded KV spill dir, measuring "
+                         "cold-pod-to-first-token with a warm prefix")
     ap.add_argument("--recorder-ab", action="store_true",
                     dest="recorder_ab",
                     help="flight-recorder overhead guard (runtime/"
@@ -1328,7 +1472,7 @@ def main(argv=None):
                          "an engine built with the recorder removed "
                          "(TPUSERVE_FLIGHT=0 equivalent) and report the "
                          "tok/s delta; 'ok' asserts the always-on "
-                         "recorder costs <1%")
+                         "recorder costs <1%%")
     ap.add_argument("--emit-trace", default=None, metavar="PATH",
                     dest="emit_trace",
                     help="write the generated workload (prompt ids, "
@@ -1660,6 +1804,9 @@ def main(argv=None):
             out["two_class"] = _two_class_ab(
                 args, model, on_tpu, attn_impl=attn_impl,
                 pipeline=pipeline, vocab=vocab)
+    if args.autoscale_replay:
+        with tpu_guard("autoscale pool replay"):
+            out["autoscale"] = _autoscale_ab(args)
     if args.compare_mixed:
         with tpu_guard("mixed comparison"):
             out["mixed_ab"] = _compare_mixed(

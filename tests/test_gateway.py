@@ -405,3 +405,164 @@ def test_gateway_all_backends_unreachable():
         assert e.value.code == 502
     finally:
         gw.shutdown()
+
+
+# ---------------------------------------------------------------------
+# dynamic backend set (ISSUE 12): --backends-file reload without restart
+# ---------------------------------------------------------------------
+
+def _stub_backend(name, delay_s=0.0):
+    """A trivial 'engine' pod: /healthz liveness + a completions route
+    that stamps which backend served (optionally slowly — the in-flight
+    drain case)."""
+    import time as _t
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Stub(BaseHTTPRequestHandler):
+        served = []
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'{"status":"ok"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            if delay_s:
+                _t.sleep(delay_s)
+            body = json.dumps({"served_by": name}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            Stub.served.append(name)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    import threading as _th
+    _th.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, Stub, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_dynamic_backend_reload_admit_and_drain(tmp_path):
+    """SATELLITE PIN: a scale-out replica starts receiving traffic
+    after its FIRST healthy probe, and a removed (drained) one stops
+    being selected immediately while its in-flight request completes —
+    zero dropped streams."""
+    import threading
+    import time as _time
+    a_httpd, a_stub, a_url = _stub_backend("A", delay_s=2.0)
+    b_httpd, b_stub, b_url = _stub_backend("B")
+    backends_file = tmp_path / "backends.json"
+    backends_file.write_text(json.dumps([a_url]))
+    gw = Gateway([], GatewayConfig(
+        host="127.0.0.1", port=0, health_interval_s=3600,
+        backends_file=str(backends_file)))
+    gport = gw.start()
+    url = f"http://127.0.0.1:{gport}/v1/completions"
+    try:
+        # initial load from the file: A present but unadmitted until
+        # its first healthy probe
+        assert [b.url for b in gw.backends] == [a_url]
+        assert not gw.backends[0].healthy
+        gw.probe_backends_once()
+        assert gw.backends[0].healthy
+
+        # a slow request lands on A (the only backend) and stays in
+        # flight across the scale events below
+        slow = {}
+
+        def _slow_post():
+            slow["result"] = _post(url, {"prompt": "x"}, timeout=30)
+
+        t = threading.Thread(target=_slow_post)
+        t.start()
+        deadline = _time.monotonic() + 5
+        while not any(b.outstanding for b in gw.backends):
+            assert _time.monotonic() < deadline, "slow post never routed"
+            _time.sleep(0.01)
+
+        # scale-out: B appears in the file; after reload it exists but
+        # receives NOTHING until its first healthy probe passes
+        backends_file.write_text(json.dumps([a_url, b_url]))
+        assert gw.reload_backends()
+        b_backend = [b for b in gw.backends if b.url == b_url][0]
+        assert not b_backend.healthy
+        for _ in range(4):
+            picked = gw.pick_backend(b'{"prompt":"y"}')
+            assert picked.url == a_url
+            gw.release(picked, ok=True)
+        gw.probe_backends_once()              # first healthy probe
+        assert b_backend.healthy
+
+        # scale-in while A's slow request is STILL in flight: A leaves
+        # the selectable set at once, new traffic reaches the
+        # just-admitted B, and A's stream completes untouched
+        backends_file.write_text(json.dumps([b_url]))
+        assert gw.reload_backends()
+        assert [b.url for b in gw.backends] == [b_url]
+        status, out = _post(url, {"prompt": "x"})
+        assert out["served_by"] == "B"
+        t.join(timeout=30)
+        assert slow["result"][0] == 200
+        assert slow["result"][1]["served_by"] == "A"   # zero dropped
+    finally:
+        gw.shutdown()
+        a_httpd.shutdown()
+        b_httpd.shutdown()
+
+
+def test_gateway_empty_dynamic_pool_503_and_demand_counter(tmp_path):
+    """Scale-to-zero: an empty dynamic pool answers a retryable 503
+    with Retry-After and counts the demand for the autoscaler
+    (/gateway/status unserved_total)."""
+    backends_file = tmp_path / "backends.json"
+    backends_file.write_text("[]")
+    gw = Gateway([], GatewayConfig(host="127.0.0.1", port=0,
+                                   health_interval_s=3600,
+                                   backends_file=str(backends_file)))
+    gport = gw.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"http://127.0.0.1:{gport}/v1/completions",
+                  {"prompt": "x"})
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{gport}/gateway/status",
+                timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["unserved_total"] == 1
+    finally:
+        gw.shutdown()
+
+
+def test_backend_source_rejects_garbage(tmp_path):
+    """A proxy error page (or any non-list JSON) from the backend
+    source must NOT wipe the live pool — only a genuine list (possibly
+    empty) reconciles the set."""
+    bf = tmp_path / "backends.json"
+    bf.write_text(json.dumps(["http://127.0.0.1:9"]))
+    gw = Gateway([], GatewayConfig(host="127.0.0.1", port=0,
+                                   health_interval_s=3600,
+                                   backends_file=str(bf)))
+    assert [b.url for b in gw.backends] == ["http://127.0.0.1:9"]
+    for garbage in ("<html>502 Bad Gateway</html>\n",
+                    json.dumps({"error": "nope"}),
+                    "not a url\nalso not\n"):
+        bf.write_text(garbage)
+        assert gw.reload_backends() is False
+        assert [b.url for b in gw.backends] == ["http://127.0.0.1:9"]
+    # newline-separated URLs are accepted; non-URL lines are dropped
+    bf.write_text("# fleet\nhttp://127.0.0.1:19\n")
+    assert gw.reload_backends() is True
+    assert [b.url for b in gw.backends] == ["http://127.0.0.1:19"]
+    # an explicit empty list IS a scale-to-zero instruction
+    bf.write_text("[]")
+    assert gw.reload_backends() is True
+    assert gw.backends == []

@@ -318,6 +318,64 @@ def test_serving_manifests_colocated():
     assert "phi-chat-template" in cms and "opt-chat-template" in cms
 
 
+def test_serving_manifests_autoscaled():
+    """ISSUE 12: autoscale=true adds the scaler Deployment + least-
+    privilege RBAC to the plain-engine topology, all passing the strict
+    vendored schemas."""
+    cfg = _cfg(autoscale=True, autoscale_min_replicas=0,
+               autoscale_max_replicas=5)
+    objs = manifests.serving_manifests(cfg)
+    text = manifests.render(*objs)       # schema-validates every object
+    parsed = list(yaml.safe_load_all(text))
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in parsed]
+    for want in (("ServiceAccount", "tpuserve-autoscaler"),
+                 ("Role", "tpuserve-autoscaler"),
+                 ("RoleBinding", "tpuserve-autoscaler"),
+                 ("Deployment", "tpuserve-autoscaler"),
+                 ("Service", "tpuserve-autoscaler")):
+        assert want in kinds
+    # the gateway polls the scaler's live replica list, so scale events
+    # (including scale-to-zero) reach routing without a restart
+    gw = [o for o in parsed if o["kind"] == "Deployment"
+          and o["metadata"]["name"] == "tpuserve-gateway"][0]
+    gw_cmd = gw["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--backends-url" in gw_cmd
+    assert gw_cmd[gw_cmd.index("--backends-url") + 1].endswith("/backends")
+    scaler = [o for o in parsed if o["kind"] == "Deployment"
+              and o["metadata"]["name"] == "tpuserve-autoscaler"][0]
+    assert scaler["spec"]["replicas"] == 1    # one stateful policy brain
+    pod = scaler["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "tpuserve-autoscaler"
+    cmd = pod["containers"][0]["command"]
+    assert "--max-replicas" in cmd and cmd[cmd.index(
+        "--max-replicas") + 1] == "5"
+    assert "--min-replicas" in cmd and cmd[cmd.index(
+        "--min-replicas") + 1] == "0"
+    # the default topology ships without a scaler
+    base = [(o["kind"], o["metadata"]["name"]) for o in
+            yaml.safe_load_all(manifests.render(
+                *manifests.serving_manifests(_cfg())))]
+    assert ("Deployment", "tpuserve-autoscaler") not in base
+
+
+def test_autoscale_config_validation():
+    import pytest
+    with pytest.raises(ValueError, match="autoscale_min_replicas"):
+        _cfg(autoscale=True, autoscale_min_replicas=3,
+             autoscale_max_replicas=2)
+    with pytest.raises(ValueError, match="disaggregated"):
+        _cfg(autoscale=True, disaggregated=True)
+    with pytest.raises(ValueError, match="multihost"):
+        _cfg(autoscale=True, tensor_parallel=8)
+    # the policy is blind without the SLO scalars / recorder SLIs
+    with pytest.raises(ValueError, match="slo_classes"):
+        _cfg(autoscale=True, slo_classes=False)
+    with pytest.raises(ValueError, match="flight"):
+        _cfg(autoscale=True, flight=False)
+    # same knobs are inert without autoscale
+    assert _cfg(autoscale_min_replicas=9).autoscale is False
+
+
 def test_engine_deployment_tpu_resources_and_probes():
     cfg = _cfg(tensor_parallel=4)
     dep = manifests.engine_deployment(cfg)

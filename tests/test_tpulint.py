@@ -199,6 +199,26 @@ def test_p1_clock_seam_rule_fires_in_replay_reachable_files():
     assert "monotonic-outside-clock-seam" in rules(findings)
 
 
+def test_p1_clock_seam_covers_autoscale():
+    """ISSUE 12 satellite: the autoscaler's decision path runs under
+    VirtualClock in the pool replay harness, so tpuserve/autoscale/ is
+    clock_paths-covered — a policy reading the wall clock directly is
+    an error; the injected clock is clean."""
+    findings = lint_snippet("""
+        import time
+
+        class AutoscalePolicy:
+            def decide(self, sig):
+                return time.monotonic()
+    """, passes=["host-sync"], path="tpuserve/autoscale/policy.py")
+    assert "monotonic-outside-clock-seam" in rules(findings)
+    assert lint_snippet("""
+        class AutoscalePolicy:
+            def decide(self, sig):
+                return self.clock.monotonic()
+    """, passes=["host-sync"], path="tpuserve/autoscale/pool.py") == []
+
+
 def test_p1_clock_seam_scope_and_sync_ok():
     """The rule stays scoped to clock_paths (gateway/tenants keep their
     real clocks) and accepts reasoned sync-ok tags on genuinely

@@ -85,6 +85,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # export path itself is exercised on silicon.
     ("replay-smoke", ["--arrival", "poisson", "--arrival-rate", "16",
                       "--emit-trace", "bench_replay_trace.json"], {}),
+    # SLI-driven autoscaler (ISSUE 12): the brownout-storm policy A/B
+    # (static vs autoscaled simulated pool, virtual time — measures
+    # scale-out-before-shed timing and the per-class SLI delta) and the
+    # scale-from-zero cold start with a warm-prefix KV spill restore.
+    ("autoscale-storm", ["--autoscale-replay"], {}),
+    ("cold-start", ["--autoscale-replay",
+                    "--autoscale-mode", "cold-start"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

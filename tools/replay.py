@@ -75,6 +75,40 @@ def _cmd_run(args) -> int:
         wl = Workload.load(args.workload)
         if args.seed is not None:
             wl.seed = args.seed
+    if args.autoscale:
+        # pool-level replay (tpuserve/autoscale/pool.py): the recorded
+        # incident against a simulated replica pool with the SLI-driven
+        # policy in the loop — the autoscaler tuning rig.  Change a
+        # policy knob, rerun, diff the per-class SLIs and the decision
+        # sequence (decision_digest pins determinism).
+        from tpuserve.autoscale import (PolicyConfig, PoolReplayOptions,
+                                        pool_replay)
+        report = pool_replay(
+            wl,
+            PoolReplayOptions(
+                model=args.model,
+                step_time_s=(args.step_ms / 1000.0) if args.step_ms
+                else 0.02,
+                max_num_seqs=args.max_seqs or 4,
+                initial_replicas=args.initial_replicas,
+                cold_start_s=args.cold_start_s),
+            PolicyConfig(max_replicas=args.autoscale,
+                         scale_out_cooldown_s=args.scale_out_cooldown_s)
+            if not args.static else None)
+        out = {"report": report}
+        if args.report:
+            with open(args.report, "w", encoding="utf-8") as f:
+                json.dump(out, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"wrote pool replay report to {args.report}")
+        print(json.dumps(out, sort_keys=True) if args.json else
+              json.dumps({k: report[k] for k in
+                          ("mode", "replicas_peak", "decisions",
+                           "first_scale_out_t", "first_l3_t", "sli",
+                           "counters", "decision_digest",
+                           "cold_starts_observed_s")}, indent=1,
+                         sort_keys=True))
+        return 2 if report.get("aborted") else 0
     opts = ReplayOptions(
         model=args.model,
         step_time_s=(args.step_ms / 1000.0) if args.step_ms else None,
@@ -146,6 +180,19 @@ def main(argv=None) -> int:
     r.add_argument("--no-slo", action="store_true",
                    help="replay with SLO classes disabled (the "
                         "TPUSERVE_SLO_CLASSES=0 arm)")
+    r.add_argument("--autoscale", type=int, default=0, metavar="MAX",
+                   help="replay against the SIMULATED REPLICA POOL "
+                        "(tpuserve/autoscale) with the SLI-driven "
+                        "policy scaling up to MAX replicas — the "
+                        "policy tuning rig")
+    r.add_argument("--static", action="store_true",
+                   help="with --autoscale: pin the pool static at "
+                        "--initial-replicas (the A/B baseline arm)")
+    r.add_argument("--initial-replicas", type=int, default=1)
+    r.add_argument("--cold-start-s", type=float, default=1.0,
+                   help="modelled boot->ready time for replicas "
+                        "started mid-replay (virtual seconds)")
+    r.add_argument("--scale-out-cooldown-s", type=float, default=3.0)
     r.add_argument("--diff", default=None, metavar="BUNDLE",
                    help="diff replay SLIs against this bundle instead of "
                         "the SLIs stashed at extraction")

@@ -98,6 +98,12 @@ PRIORITY = [
     # silicon — the emitted workload file makes the row itself a
     # replayable scenario (tools/replay.py run bench_replay_trace.json).
     "replay-smoke",
+    # SLI-driven autoscaler (ISSUE 12): policy dynamics run in virtual
+    # time (chip-independent), but the rows belong in the capture so
+    # the control plane is exercised in the same container/jax build
+    # the serving rows certify — storm = scale-out-before-shed + SLI
+    # A/B, cold-start = scale-from-zero with a warm-prefix restore.
+    "autoscale-storm", "cold-start",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

@@ -86,6 +86,7 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/flight.py",
             "tpuserve/runtime/request.py",
             "tpuserve/server/runner.py",
+            "tpuserve/autoscale/*.py",
         ],
     },
     "thread_ownership": {

@@ -1,0 +1,31 @@
+"""SLI-driven autoscaler (ISSUE 12, ROADMAP item 1).
+
+The engine narrates its own load (flight-recorder SLI families,
+``tpuserve_brownout_level``, per-class queue-delay EWMAs); this package
+closes the loop: ``policy.py`` turns those signals into hysteretic
+scale decisions, ``reconciler.py`` applies them to a replica pool
+(kubectl in production, publishing a backends file the gateway polls),
+and ``pool.py`` replays recorded brownout storms against a *simulated*
+pool of real engines under one shared ``VirtualClock`` — so the whole
+control plane is tunable and tier-1-testable on CPU, no Kubernetes.
+CLI: ``python -m tpuserve.autoscale`` (the scaler Deployment's
+entrypoint, provision/manifests.py).
+"""
+
+from tpuserve.autoscale.policy import (ACTIONS, AutoscalePolicy, Decision,
+                                       PolicyConfig, PoolSignals,
+                                       ReplicaSignals, decisions_digest)
+from tpuserve.autoscale.pool import (PoolReplayOptions, make_storm_workload,
+                                     pool_replay)
+from tpuserve.autoscale.reconciler import (KubePool, Reconciler,
+                                           write_backends_file)
+from tpuserve.autoscale.signals import (scrape_replica, signals_from_debug,
+                                        signals_from_metrics)
+
+__all__ = [
+    "ACTIONS", "AutoscalePolicy", "Decision", "PolicyConfig",
+    "PoolSignals", "ReplicaSignals", "decisions_digest",
+    "PoolReplayOptions", "make_storm_workload", "pool_replay",
+    "KubePool", "Reconciler", "write_backends_file",
+    "scrape_replica", "signals_from_debug", "signals_from_metrics",
+]

@@ -99,6 +99,18 @@ class DeployConfig:
     # the engine pods (runtime/faults.py), e.g.
     # "decode_dispatch:raise:0.02".  None = no injection (production).
     faults: Optional[str] = None
+    # SLI-driven autoscaler (tpuserve/autoscale, ISSUE 12): a scaler
+    # Deployment that scrapes every engine pod's /debug/engine scalars
+    # (brownout level, per-class queue-delay EWMAs, TTFT p95) and
+    # drives `kubectl scale` on the engine Deployment — out on SLI
+    # pressure BEFORE the brownout ladder sheds, in only when the pool
+    # sat idle + drained, from zero on gateway-reported demand.  Plain
+    # single-Deployment engine topologies only (the scaler targets ONE
+    # Deployment; disagg/multihost pools aren't scalable units here).
+    autoscale: bool = False
+    autoscale_min_replicas: int = 0        # 0 = scale-to-zero allowed
+    autoscale_max_replicas: int = 4
+    autoscale_interval_s: int = 5          # control-loop cadence
     # Graceful-drain budget on SIGTERM (server --drain-timeout); the
     # emitted pod spec's terminationGracePeriodSeconds is derived from
     # this (+35 s headroom) so K8s never SIGKILLs mid-drain
@@ -224,6 +236,35 @@ class DeployConfig:
             raise ValueError("max_waiting must be >= -1")
         if self.drain_timeout_s < 0:
             raise ValueError("drain_timeout_s must be >= 0")
+        if self.autoscale:
+            if not (0 <= self.autoscale_min_replicas
+                    <= self.autoscale_max_replicas) \
+                    or self.autoscale_max_replicas < 1:
+                raise ValueError(
+                    "need 0 <= autoscale_min_replicas <= "
+                    "autoscale_max_replicas (and max >= 1), got "
+                    f"{self.autoscale_min_replicas}.."
+                    f"{self.autoscale_max_replicas}")
+            if self.autoscale_interval_s < 1:
+                raise ValueError("autoscale_interval_s must be >= 1")
+            if self.disaggregated or self.disagg_cross_pod:
+                raise ValueError(
+                    "autoscale targets the plain engine Deployment; "
+                    "disaggregated pools are not a scalable unit here "
+                    "(see ROADMAP: the disagg-pool autoscale question "
+                    "rides on the TPU A/B)")
+            if self.tensor_parallel > self.chips_per_node:
+                raise ValueError(
+                    "autoscale does not cover multihost StatefulSet "
+                    "replicas (one replica = N pods there)")
+            if not self.slo_classes or not self.flight:
+                # the policy's scale-out triggers ARE the SLO
+                # controller's scalars and the recorder's SLIs; a pool
+                # without them looks permanently idle to the scaler
+                raise ValueError(
+                    "autoscale consumes the SLO controller's brownout/"
+                    "queue-delay scalars and the flight recorder's "
+                    "SLIs — it requires slo_classes and flight enabled")
         # NOTE: the GCP-project requirement is enforced at provision time
         # (infra._provision_gke), not here — subcommands like `test` read
         # cluster identity from the inventory file and need no project.

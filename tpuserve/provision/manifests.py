@@ -402,14 +402,22 @@ def multihost_engine_statefulset(cfg: DeployConfig, replica_idx: int) -> dict:
     }
 
 
-def gateway_deployment(cfg: DeployConfig, backends: list[str]) -> dict:
+def gateway_deployment(cfg: DeployConfig, backends: list[str],
+                       backends_url: Optional[str] = None) -> dict:
     """Gateway Deployment — replaces the llm-d inference gateway the
-    reference discovers at llm-d-test.yaml:14-26."""
+    reference discovers at llm-d-test.yaml:14-26.  ``backends_url``
+    (autoscaled topologies): a poll-able source of the live backend
+    set — the static ``--backend`` list is just the bootstrap, replaced
+    by the first successful poll, so the gateway tracks scale events
+    (including down to an EMPTY pool, where it starts counting the
+    unserved demand the scaler's from-zero trigger reads)."""
     labels = {"app": "tpuserve", "component": "gateway"}
     args = ["python", "-m", "tpuserve.server.gateway",
             "--port", str(cfg.gateway_port)]
     for b in backends:
         args += ["--backend", b]
+    if backends_url:
+        args += ["--backends-url", backends_url]
     return {
         "apiVersion": "apps/v1", "kind": "Deployment",
         "metadata": {"name": "tpuserve-gateway", "namespace": cfg.namespace,
@@ -488,6 +496,110 @@ def gateway_service(cfg: DeployConfig) -> dict:
     }
 
 
+def autoscaler_rbac(cfg: DeployConfig) -> list[dict]:
+    """ServiceAccount + Role + RoleBinding for the scaler Deployment:
+    it lists engine pods (signal scrape targets) and scales the engine
+    Deployment — nothing else (least privilege; the reference has no
+    control plane to authorize at all)."""
+    labels = {"app": "tpuserve", "component": "autoscaler"}
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": "tpuserve-autoscaler",
+                      "namespace": cfg.namespace, "labels": labels}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+         "metadata": {"name": "tpuserve-autoscaler",
+                      "namespace": cfg.namespace, "labels": labels},
+         "rules": [
+             {"apiGroups": [""], "resources": ["pods"],
+              "verbs": ["get", "list", "watch"]},
+             {"apiGroups": ["apps"], "resources": ["deployments",
+                                                   "deployments/scale"],
+              "verbs": ["get", "patch", "update"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "RoleBinding",
+         "metadata": {"name": "tpuserve-autoscaler",
+                      "namespace": cfg.namespace, "labels": labels},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "Role", "name": "tpuserve-autoscaler"},
+         "subjects": [{"kind": "ServiceAccount",
+                       "name": "tpuserve-autoscaler",
+                       "namespace": cfg.namespace}]},
+    ]
+
+
+AUTOSCALER_PORT = 9090
+
+
+def autoscaler_service(cfg: DeployConfig) -> dict:
+    """ClusterIP for the scaler: the gateway polls its /backends
+    endpoint (live ready-replica list) and Prometheus can scrape
+    /metrics through a stable name."""
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "tpuserve-autoscaler",
+                     "namespace": cfg.namespace,
+                     "labels": {"app": "tpuserve"}},
+        "spec": {
+            "selector": {"app": "tpuserve", "component": "autoscaler"},
+            "ports": [{"name": "http", "port": AUTOSCALER_PORT,
+                       "targetPort": AUTOSCALER_PORT}],
+        },
+    }
+
+
+def autoscaler_deployment(cfg: DeployConfig) -> dict:
+    """The scaler Deployment (tpuserve/autoscale): scrapes engine pods'
+    /debug/engine scalars, drives `kubectl scale` on the engine
+    Deployment, and serves its own /metrics with the
+    tpuserve_autoscaler_* families + the cold-start histogram."""
+    labels = {"app": "tpuserve", "component": "autoscaler"}
+    metrics_port = AUTOSCALER_PORT
+    args = ["python", "-m", "tpuserve.autoscale",
+            "--namespace", cfg.namespace,
+            "--deployment", "tpuserve-engine",
+            "--selector", "app=tpuserve,component=engine",
+            "--engine-port", str(cfg.engine_port),
+            "--gateway-url",
+            f"http://tpuserve-gateway.{cfg.namespace}.svc.cluster.local",
+            "--interval", str(cfg.autoscale_interval_s),
+            "--min-replicas", str(cfg.autoscale_min_replicas),
+            "--max-replicas", str(cfg.autoscale_max_replicas),
+            "--port", str(metrics_port)]
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "tpuserve-autoscaler",
+                     "namespace": cfg.namespace, "labels": labels},
+        "spec": {
+            # exactly ONE scaler: the policy is stateful (cooldowns,
+            # idle timers) and two would fight over the replica count
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels, "annotations": {
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/port": str(metrics_port),
+                    "prometheus.io/path": "/metrics"}},
+                "spec": {
+                    "serviceAccountName": "tpuserve-autoscaler",
+                    "containers": [{
+                        "name": "autoscaler",
+                        "image": cfg.image,
+                        "command": args,
+                        "ports": [{"containerPort": metrics_port,
+                                   "name": "http"}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/healthz",
+                                        "port": "http"},
+                            "initialDelaySeconds": 2,
+                            "periodSeconds": 5},
+                    }],
+                },
+            },
+        },
+    }
+
+
 def serving_manifests(cfg: DeployConfig) -> list[dict]:
     """Everything the serving layer applies, in order."""
     objs: list[dict] = [namespace(cfg.namespace), model_pvc(cfg)]
@@ -541,6 +653,23 @@ def serving_manifests(cfg: DeployConfig) -> list[dict]:
         objs.append(engine_deployment(cfg))
         objs.append(engine_service(cfg))
         backends = [f"http://tpuserve-engine.{cfg.namespace}.svc.cluster.local:{cfg.engine_port}"]
+        backends_url = None
+        if cfg.autoscale:
+            # the scaler rides only the plain single-Deployment
+            # topology (DeployConfig.validate enforces it); the gateway
+            # polls the scaler's live replica list so scale events —
+            # including scale-to-zero, whose unserved counter closes
+            # the from-zero loop — reach routing without a restart
+            objs.extend(autoscaler_rbac(cfg))
+            objs.append(autoscaler_deployment(cfg))
+            objs.append(autoscaler_service(cfg))
+            backends_url = (f"http://tpuserve-autoscaler.{cfg.namespace}"
+                            f".svc.cluster.local:{AUTOSCALER_PORT}"
+                            "/backends")
+        objs.append(gateway_deployment(cfg, backends,
+                                       backends_url=backends_url))
+        objs.append(gateway_service(cfg))
+        return objs
     objs.append(gateway_deployment(cfg, backends))
     objs.append(gateway_service(cfg))
     return objs

@@ -1310,6 +1310,18 @@ class Engine:
             # tpuserve_brownout_level gauge
             self._slo.tick(self.scheduler.num_waiting)
             self.stats.brownout_level = self._slo.level
+        if self._flight_on:
+            # control-plane scalars for /debug/engine, dump bundles and
+            # the autoscaler's scrape: the level + per-class delay
+            # EWMAs as plain numbers (ISSUE 12 — consumers must not
+            # reconstruct these from histogram buckets).  waiting/
+            # running are scheduler facts published even with SLO
+            # classes off, so a pool observer is never blind to load.
+            self.flight.note_control(
+                **(self._slo.snapshot() if self._slo is not None
+                   else {"brownout_level": 0}),
+                waiting=self.scheduler.num_waiting,
+                running=len(self.scheduler.running))
         if self._strict_blocks:
             self._check_block_integrity()
         return outputs

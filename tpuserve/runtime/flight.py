@@ -124,6 +124,11 @@ class FlightRecorder:
         # engine configuration facts (note_engine_facts), carried in
         # bundles so replay can size a comparable engine
         self._facts: dict = {}
+        # per-cycle control-plane scalars (note_control): brownout
+        # level, per-class queue-delay EWMAs, queue depths — replaced
+        # wholesale each cycle, snapshot-read by /debug/engine and the
+        # autoscaler's scrape
+        self._control: dict = {}
         # per-cycle hostprof deltas are diffs against this snapshot of the
         # module profiler's cumulative seconds
         self._prof_last: dict = {}
@@ -192,6 +197,18 @@ class FlightRecorder:
         trace data)."""
         self._facts.update({k: v for k, v in facts.items()
                             if v is not None})
+
+    def note_control(self, **scalars) -> None:
+        """Current control-plane scalars (engine-loop thread, once per
+        cycle): the brownout level and per-class queue-delay EWMAs the
+        SLO controller steers by, plus queue depths — published as
+        PLAIN numbers so the autoscaler (and operators reading
+        /debug/engine or a dump bundle) never reconstruct them from
+        histogram buckets.  The dict is replaced atomically; readers on
+        serving threads at worst see the previous cycle's values."""
+        if not self.enabled:
+            return
+        self._control = scalars
 
     def note_sli(self, slo_class: str, kind: str, value: float) -> None:
         """Client-observable latency sample (runner loop thread): TTFT /
@@ -274,6 +291,7 @@ class FlightRecorder:
             "requests": self.recent_request_ids(),
             "steps": self.steps_snapshot(steps),
             "sli": self.sli_summary(),
+            "control": dict(self._control),
             "postmortems": self.postmortems,
             "last_postmortem": self.last_postmortem,
         }
@@ -313,6 +331,7 @@ class FlightRecorder:
             "requests": {rid: self.request_timeline(rid)
                          for rid in ids},
             "sli": self.sli_summary(),
+            "control": dict(self._control),
         }
         bundle["rings"] = {
             "events": {"cursor": ev_cursor, "capacity": self._events._n,

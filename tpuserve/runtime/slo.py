@@ -240,6 +240,19 @@ class SloController:
             ["%.3f" % v if v is not None else "-"
              for v in self._delay_ewma], sli or "{}")
 
+    def snapshot(self) -> dict:
+        """Plain-scalar controller state for /debug/engine, flight
+        bundles, and the autoscaler's scrape (ISSUE 12): the brownout
+        level and per-class queue-delay EWMAs as numbers, so consumers
+        never have to reconstruct them from histogram buckets."""
+        return {
+            "brownout_level": self.level,
+            "queue_delay_ewma": {
+                SLO_CLASSES[i]: (round(v, 6) if v is not None else None)
+                for i, v in enumerate(self._delay_ewma)},
+            "pressure": round(self.pressure(), 6),
+        }
+
     # ---- policy queries --------------------------------------------------
 
     def _queue_pressure_live(self) -> bool:

@@ -128,14 +128,33 @@ class GatewayConfig:
     # the whole replica pool (server/tenants.py): inline JSON or a file
     # path; None = TPUSERVE_TENANTS env (unset: no gateway tenancy).
     tenant_config: Optional[str] = None
+    # Dynamic backend set (ISSUE 12): a poll-able source of backend
+    # URLs — a local file (JSON list or newline-separated; the
+    # autoscaler's reconciler publishes one) or an HTTP URL.  Re-read
+    # every health round: added backends join UNHEALTHY and start
+    # receiving traffic after their first passing probe; removed ones
+    # stop being selected immediately while in-flight relays finish on
+    # the retained Backend object (zero dropped streams).  With a
+    # source configured the gateway may start with ZERO backends
+    # (scale-from-zero) — requests then get a retryable 503 and are
+    # counted in unserved_total, the autoscaler's demand signal.
+    backends_file: Optional[str] = None
+    backends_url: Optional[str] = None
 
 
 class Gateway:
     def __init__(self, backend_urls: list[str], config: GatewayConfig | None = None):
-        if not backend_urls:
-            raise ValueError("gateway needs at least one backend")
         self.config = config or GatewayConfig()
+        dynamic = bool(self.config.backends_file
+                       or self.config.backends_url)
+        if not backend_urls and not dynamic:
+            raise ValueError("gateway needs at least one backend (or a "
+                             "--backends-file/--backends-url source)")
         self.backends = [Backend(url=u.rstrip("/")) for u in backend_urls]
+        # requests that arrived while NO backend existed (pool scaled
+        # to zero): the autoscaler reads this off /gateway/status as
+        # its scale-from-zero demand signal
+        self.unserved_total = 0
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._health_thread: Optional[threading.Thread] = None
@@ -146,6 +165,10 @@ class Gateway:
         self.tenants = TenantRegistry.load(self.config.tenant_config) \
             if (self.config.tenant_config
                 or os.environ.get("TPUSERVE_TENANTS")) else None
+        if dynamic:
+            # synchronous initial load so start() routes immediately
+            # when the source already lists backends
+            self.reload_backends()
 
     def _eject_backoff_s(self, eject_count: int) -> float:
         """Jittered exponential delay before the Nth-ejection backend is
@@ -155,6 +178,85 @@ class Gateway:
                    cfg.readmit_backoff_max_s)
         return base * (1 + random.uniform(-cfg.readmit_jitter_frac,
                                           cfg.readmit_jitter_frac))
+
+    # ---- dynamic backend set -------------------------------------------
+
+    def _read_backend_source(self) -> Optional[list[str]]:
+        """Fetch the configured backend list (file beats URL); None =
+        no source configured or the source is currently unreadable (the
+        current set stays — a scaler mid-rewrite must not wipe the
+        pool)."""
+        cfg = self.config
+        raw: Optional[str] = None
+        if cfg.backends_file:
+            try:
+                with open(cfg.backends_file, "r", encoding="utf-8") as f:
+                    raw = f.read()
+            except OSError:
+                return None
+        elif cfg.backends_url:
+            try:
+                with urllib.request.urlopen(
+                        cfg.backends_url,
+                        timeout=cfg.health_timeout_s) as resp:
+                    raw = resp.read().decode("utf-8", "replace")
+            except Exception:
+                return None
+        if raw is None:
+            return None
+        try:
+            data = json.loads(raw)
+            if isinstance(data, list):
+                return [str(u) for u in data
+                        if isinstance(u, str)
+                        and u.startswith(("http://", "https://"))]
+            return None     # JSON but not a list: not a backend file
+        except ValueError:
+            pass
+        urls = [ln.strip() for ln in raw.splitlines()
+                if ln.strip().startswith(("http://", "https://"))]
+        if urls or not raw.strip():
+            return urls     # empty source = a genuinely empty pool
+        # non-empty, non-JSON, zero URLs: an HTML error page or other
+        # garbage — treat as unreadable, keep the current set (wiping
+        # the live pool on a proxy hiccup would 502 every request)
+        return None
+
+    def reload_backends(self) -> bool:
+        """One poll of the backend source; True when the set changed."""
+        urls = self._read_backend_source()
+        if urls is None:
+            return False
+        return self.set_backends(urls)
+
+    def set_backends(self, urls: list[str]) -> bool:
+        """Reconcile the live backend set against ``urls`` without a
+        restart.  Retained backends keep ALL state (health, digest,
+        backoff, outstanding); added ones join unhealthy and are
+        admitted by their first passing health probe; removed ones are
+        dropped from selection immediately — in-flight relays hold
+        their own Backend reference and release it normally, so a
+        drained replica finishes its streams with zero drops."""
+        wanted = []
+        seen = set()
+        for u in urls:
+            u = u.rstrip("/")
+            if u and u not in seen:
+                seen.add(u)
+                wanted.append(u)
+        with self._lock:
+            current = {b.url: b for b in self.backends}
+            if list(current) == wanted:
+                return False
+            added = [u for u in wanted if u not in current]
+            removed = [u for u in current if u not in seen]
+            self.backends = [
+                current.get(u) or Backend(url=u, healthy=False)
+                for u in wanted]
+        if added or removed:
+            logger.info("backend set reloaded: +%s -%s (%d total)",
+                        added or "[]", removed or "[]", len(wanted))
+        return True
 
     # ---- backend selection ---------------------------------------------
 
@@ -187,14 +289,18 @@ class Gateway:
 
     def pick_backend(self, body: bytes | None = None,
                      exclude: set[str] | None = None,
-                     payload=_UNSET) -> Backend:
+                     payload=_UNSET) -> Optional[Backend]:
         """Pick a backend: rendezvous prefix affinity (with a load-slack
         escape to least-loaded), else least-loaded.  ``exclude``: URLs
         already tried this request (connect-failure failover) — skipped
         unless nothing else remains.  ``payload``: the body's
         already-parsed JSON (the relay parses once; failover retries and
-        the tenant check must not re-parse a large body)."""
+        the tenant check must not re-parse a large body).  ``None`` only
+        when the dynamic backend set is currently EMPTY (pool scaled to
+        zero) — the relay answers a retryable 503 and counts the miss."""
         with self._lock:
+            if not self.backends:
+                return None
             ex = exclude or set()
             # preference order: healthy+untried > any untried (a backend
             # merely flagged by the health loop beats re-dialing one that
@@ -330,6 +436,13 @@ class Gateway:
 
     def _health_loop(self):
         while not self._stop.wait(self.config.health_interval_s):
+            if self.config.backends_file or self.config.backends_url:
+                # reload BEFORE probing: a just-added backend gets its
+                # admission probe this very round
+                try:
+                    self.reload_backends()
+                except Exception:
+                    logger.exception("backend source reload failed")
             self.probe_backends_once()
 
     # ---- lifecycle -------------------------------------------------------
@@ -363,7 +476,8 @@ class Gateway:
     def status(self) -> dict:
         with self._lock:
             out = {"backends": [dataclasses.asdict(b) for b in self.backends],
-                   "affinity": "rendezvous"}
+                   "affinity": "rendezvous",
+                   "unserved_total": self.unserved_total}
         if self.tenants is not None:
             out["tenants"] = self.tenants.snapshot()
         return out
@@ -473,6 +587,20 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         while True:
             backend = ctx.pick_backend(body if method == "POST" else None,
                                        exclude=tried, payload=payload)
+            if backend is None:
+                # dynamic pool currently empty (scaled to zero): count
+                # the demand — the autoscaler polls it off
+                # /gateway/status — and send the client back with a
+                # retryable 503 sized to one boot
+                with ctx._lock:
+                    ctx.unserved_total += 1
+                settle(0)
+                self._send_json_safely(503, json.dumps({"error": {
+                    "message": "no backends in the pool (scaled to "
+                               "zero); retry shortly",
+                    "type": "server_error"}}).encode(),
+                    headers={"Retry-After": "5"})
+                return
             try:
                 fwd = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json")}
@@ -609,8 +737,15 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 def main(argv=None):
     import argparse
     ap = argparse.ArgumentParser("tpuserve.gateway")
-    ap.add_argument("--backend", action="append", required=True,
+    ap.add_argument("--backend", action="append", default=None,
                     help="backend URL (repeatable)")
+    ap.add_argument("--backends-file", default=None, metavar="PATH",
+                    help="poll-able backend list (JSON list or one URL "
+                         "per line), re-read every health round — the "
+                         "autoscaler's reconciler publishes one; "
+                         "backends join/leave without a restart")
+    ap.add_argument("--backends-url", default=None, metavar="URL",
+                    help="HTTP twin of --backends-file")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--tenant-config", default=None, metavar="JSON|PATH",
@@ -618,9 +753,14 @@ def main(argv=None):
                          "the whole pool (server/tenants.py); default: "
                          "TPUSERVE_TENANTS env")
     args = ap.parse_args(argv)
+    if not args.backend and not (args.backends_file or args.backends_url):
+        ap.error("need --backend, --backends-file, or --backends-url")
     logging.basicConfig(level=logging.INFO)
-    gw = Gateway(args.backend, GatewayConfig(host=args.host, port=args.port,
-                                             tenant_config=args.tenant_config))
+    gw = Gateway(args.backend or [],
+                 GatewayConfig(host=args.host, port=args.port,
+                               tenant_config=args.tenant_config,
+                               backends_file=args.backends_file,
+                               backends_url=args.backends_url))
     port = gw.start()
     print(f"gateway listening on :{port}", flush=True)
     try:

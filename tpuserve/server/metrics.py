@@ -302,3 +302,45 @@ class ServerMetrics:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+_COLD_START_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 40.0, 80.0,
+                       160.0, 320.0)
+
+
+class AutoscalerMetrics:
+    """The autoscaler control plane's own registry (tpuserve/autoscale):
+    served from the scaler Deployment's ``/metrics``, fed by the
+    reconciler (and by the simulated pool harness, which exercises the
+    same feed paths tier-1)."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.replicas = Gauge(
+            "tpuserve_autoscaler_replicas",
+            "Replica count the autoscaler is currently holding the "
+            "pool at (pool= the scaled Deployment).  Diverges from the "
+            "Deployment's observed replicas only while a scale action "
+            "is in flight",
+            ["pool"], registry=self.registry)
+        self.decisions = Counter(
+            "tpuserve_autoscaler_decisions",
+            "Non-hold policy decisions applied (action= scale_out | "
+            "scale_in).  scale_out fires on brownout-level / "
+            "queue-delay-EWMA / TTFT-p95 breaches BEFORE the ladder "
+            "sheds; scale_in only after the pool sat idle + drained "
+            "for the configured window",
+            ["action"], registry=self.registry)
+        self.cold_start = Histogram(
+            "tpuserve_cold_start_seconds",
+            "Cold-pod-to-first-token: wall seconds from server process "
+            "boot to the replica's first served token (scraped once "
+            "per replica off /debug/engine cold_start_s) — the number "
+            "the persistent XLA compile cache, orbax PVC weights, and "
+            "KV spill tier's warm prefixes exist to keep small, and "
+            "the one that makes scale-from-zero a real operating "
+            "point", buckets=_COLD_START_BUCKETS,
+            registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

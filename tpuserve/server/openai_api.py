@@ -661,9 +661,16 @@ class _Handler(BaseHTTPRequestHandler):
         if not recorders:
             return {"enabled": False}
         if len(recorders) == 1:
-            return recorders[0].engine_snapshot()
-        return {"enabled": True,
-                "engines": [f.engine_snapshot() for f in recorders]}
+            out = recorders[0].engine_snapshot()
+        else:
+            out = {"enabled": True,
+                   "engines": [f.engine_snapshot() for f in recorders]}
+        # cold-pod-to-first-token (wall seconds since process boot):
+        # the autoscaler's probe exports this once per replica into
+        # tpuserve_cold_start_seconds
+        out["cold_start_s"] = getattr(self.ctx.runner, "cold_start_s",
+                                      None)
+        return out
 
     def _emit_engine_spans(self, rids) -> None:
         """Export each request's flight timeline as OTLP child spans of
@@ -693,6 +700,14 @@ class _Handler(BaseHTTPRequestHandler):
             engines = [e for e in (getattr(ctx.engine, "prefill", None),
                                    getattr(ctx.engine, "decode", None))
                        if e is not None] or [ctx.engine]
+            # cheap control-plane scalars for pollers that don't want
+            # the full /debug/engine snapshot (gateway probes, the
+            # autoscaler's degraded path)
+            out["brownout_level"] = max(
+                (getattr(getattr(e, "stats", None), "brownout_level", 0)
+                 for e in engines), default=0)
+            out["cold_start_s"] = getattr(ctx.runner, "cold_start_s",
+                                          None)
             tiers = {"hbm": 0, "host": 0, "spill": 0}
             reach = 0
             for e in engines:

@@ -22,6 +22,13 @@ from tpuserve.runtime.request import RequestOutput, RequestState, SamplingParams
 
 logger = logging.getLogger("tpuserve.server")
 
+# Cold-start anchor (ISSUE 12): stamped at module import, which `python
+# -m tpuserve.server` reaches before weights load or XLA compiles — so
+# first-token minus this is the cold-pod-to-first-token number the
+# autoscaler exports as tpuserve_cold_start_seconds.  Wall-bound by
+# nature (a pod boots in real seconds, never in replay time).
+_BOOT_MONOTONIC = time.monotonic()  # tpulint: sync-ok(cold start is real wall seconds, anchored at process boot)
+
 
 def _advance_counter(ctr, cumulative) -> None:
     """Advance a prometheus Counter to an engine-side cumulative value
@@ -135,6 +142,10 @@ class AsyncEngineRunner:
         self._hard_trip_seq: Optional[int] = None
         self._fail_lock = threading.Lock()
         self._watchdog_thread: Optional[threading.Thread] = None
+        # boot -> first served token, wall seconds (None until the first
+        # token leaves); /healthz + /debug/engine report it and the
+        # autoscaler's probe feeds it into tpuserve_cold_start_seconds
+        self.cold_start_s: Optional[float] = None
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -303,6 +314,15 @@ class AsyncEngineRunner:
         # decode engine must not log empty client SLIs on brownout
         flights = self._flights()
         for out in outputs:
+            if self.cold_start_s is None and out.new_token_ids:
+                # cold-pod-to-first-token: the first token ANY request
+                # receives from this process (wall seconds since module
+                # import — weights, compiles and warm-prefix restores
+                # all inside the measurement)
+                self.cold_start_s = round(
+                    time.monotonic() - _BOOT_MONOTONIC, 6)  # tpulint: sync-ok(cold start is real wall seconds)
+                logger.info("cold start: first token %.3fs after boot",
+                            self.cold_start_s)
             q = self._out_queues.get(out.request_id)
             if self.metrics or flights:
                 cls = self._slo_class_of(out.request_id)
