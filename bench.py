@@ -548,6 +548,67 @@ def _runner_workload(engine, prompts, params, timeout=600.0):
     return wall, failed
 
 
+def _canary_runner_workload(engine, prompts, params, interval_s=0.25,
+                            timeout=600.0):
+    """ON arm of --canary-ab: the identical soak behind AsyncEngineRunner,
+    but with the in-process SLO burn-rate evaluator armed and a
+    prober-equivalent thread injecting tagged tiny canary requests
+    through the same intake at ``interval_s`` — the full per-request
+    cost of the canary feature (exclusion checks, evaluator feed, probe
+    traffic) measured against the plain soak.  Returns (wall_s,
+    failed, canaries_served)."""
+    import threading as _threading
+
+    from tpuserve.obs import BurnRateEvaluator, DEFAULT_OBJECTIVES
+    from tpuserve.server.runner import AsyncEngineRunner
+    from tpuserve.runtime.request import SamplingParams as _SP
+    runner = AsyncEngineRunner(engine)
+    runner.slo_eval = BurnRateEvaluator(DEFAULT_OBJECTIVES,
+                                        clock=runner._clock)
+    runner.start()
+    stop = _threading.Event()
+    served = [0]
+
+    def prober():
+        classes = ("interactive", "standard", "batch")
+        i = 0
+        while not stop.wait(interval_s):
+            cp = _SP(max_tokens=2, temperature=0.0, ignore_eos=True,
+                     slo_class=classes[i % 3], canary=True)
+            i += 1
+            try:
+                rid, q = runner.submit(prompt_token_ids=[1, 2, 3, 4],
+                                       params=cp)
+                while True:
+                    item = q.get(timeout=timeout)
+                    if item is None or isinstance(item, Exception):
+                        break
+                getattr(engine, "requests", {}).pop(rid, None)
+                served[0] += 1
+            except Exception:
+                pass
+
+    thread = _threading.Thread(target=prober, daemon=True)
+    thread.start()
+    t0 = time.perf_counter()
+    subs = [runner.submit(prompt_token_ids=p, params=params)
+            for p in prompts]
+    failed = 0
+    for rid, q in subs:
+        while True:
+            item = q.get(timeout=timeout)
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                failed += 1
+        getattr(engine, "requests", {}).pop(rid, None)
+    wall = time.perf_counter() - t0
+    stop.set()
+    thread.join(timeout=10)
+    runner.shutdown()
+    return wall, failed, served[0]
+
+
 def _pct(sorted_ms, q):
     if not sorted_ms:
         return 0.0
@@ -1473,6 +1534,17 @@ def main(argv=None):
                          "(TPUSERVE_FLIGHT=0 equivalent) and report the "
                          "tok/s delta; 'ok' asserts the always-on "
                          "recorder costs <1%%")
+    ap.add_argument("--canary-ab", action="store_true", dest="canary_ab",
+                    help="canary overhead guard (ISSUE 13): interleaved "
+                         "soak pairs with the synthetic prober + "
+                         "in-process burn-rate evaluator armed vs the "
+                         "plain runner soak; contract <1%% tok/s "
+                         "(BENCHMARKS.md 'Fleet SLO engine')")
+    ap.add_argument("--backtest", action="store_true",
+                    help="after the run, backtest the generated "
+                         "workload through the burn-rate alert engine "
+                         "(tpuserve/obs/backtest.py) twice and assert "
+                         "the firing sequence is deterministic")
     ap.add_argument("--emit-trace", default=None, metavar="PATH",
                     dest="emit_trace",
                     help="write the generated workload (prompt ids, "
@@ -1638,11 +1710,12 @@ def main(argv=None):
             1.0 / args.arrival_rate, size=batch)
         arrival_offsets = np.cumsum(inter).tolist()
 
-    if args.emit_trace:
+    bench_trace = None
+    if args.emit_trace or args.backtest:
         # every bench row can be a manufacturable replay scenario: the
         # exact generated workload (ids included — no synthesis needed)
         # saved BEFORE warmup, so even a run the driver later kills
-        # leaves a usable trace
+        # leaves a usable trace (--backtest reuses it in memory)
         from tpuserve.replay.workload import Workload, WorkloadRequest
         trace = Workload(
             requests=[WorkloadRequest(
@@ -1657,9 +1730,11 @@ def main(argv=None):
             meta={"source": "bench", "model": model,
                   "arrival": args.arrival,
                   "arrival_rate": args.arrival_rate if poisson else None})
-        trace.save(args.emit_trace)
-        print(f"[bench] wrote replay trace ({len(prompts)} requests) "
-              f"to {args.emit_trace}", file=sys.stderr)
+        bench_trace = trace
+        if args.emit_trace:
+            trace.save(args.emit_trace)
+            print(f"[bench] wrote replay trace ({len(prompts)} requests) "
+                  f"to {args.emit_trace}", file=sys.stderr)
 
     # derive from the REQUEST the run will actually send — the engine's
     # own greedy/truncation predicates — so the warmed sampler executable
@@ -1906,6 +1981,71 @@ def main(argv=None):
             import sys as _sys
             print(f"recorder-ab GUARD FAILED: always-on flight recorder "
                   f"costs {overhead:.1%} tok/s (budget <1%)",
+                  file=_sys.stderr, flush=True)
+
+    if args.canary_ab:
+        # Canary overhead guard (ISSUE 13 acceptance): interleaved pairs
+        # on the SAME warm engine — ON arm = soak with the synthetic
+        # prober injecting tagged canaries + the burn-rate evaluator
+        # armed, OFF arm = the plain runner soak.  Same drift-cancelling
+        # methodology as --recorder-ab; contract <1% tok/s.
+        with tpu_guard("canary A/B"):
+            pairs = max(n_rep, 3)
+            gen_total = params.max_tokens * len(prompts)
+            on_walls, off_walls, canaries = [], [], 0
+            for _ in range(pairs):
+                wall_on, _f, served = _canary_runner_workload(
+                    engine, prompts, params)
+                on_walls.append(wall_on)
+                canaries += served
+                off_walls.append(_runner_workload(engine, prompts,
+                                                  params)[0])
+        on_med = sorted(on_walls)[len(on_walls) // 2]
+        off_med = sorted(off_walls)[len(off_walls) // 2]
+        on_tok_s = gen_total / on_med if on_med else 0.0
+        off_tok_s = gen_total / off_med if off_med else 0.0
+        overhead = (1.0 - on_tok_s / off_tok_s) if off_tok_s else 0.0
+        out["canary_ab"] = {
+            "pairs": pairs,
+            "on_tok_s": round(on_tok_s, 1),
+            "off_tok_s": round(off_tok_s, 1),
+            "canaries_served": canaries,
+            # negative = prober-on measured FASTER (noise floor)
+            "overhead_frac": round(overhead, 4),
+            "ok": overhead < 0.01,
+        }
+        if overhead >= 0.01:
+            import sys as _sys
+            print(f"canary-ab GUARD FAILED: prober+evaluator cost "
+                  f"{overhead:.1%} tok/s (budget <1%)",
+                  file=_sys.stderr, flush=True)
+
+    if args.backtest and bench_trace is not None:
+        # Alert-backtest smoke (ISSUE 13): run the burn-rate engine over
+        # this row's own workload twice; the firing sequence must be
+        # byte-identical (the tier-1 determinism pin, exercised from the
+        # bench so the sweep covers it on every capture).
+        from tpuserve.obs import backtest
+        from tpuserve.obs.burnrate import BurnWindow
+        from tpuserve.replay.harness import ReplayOptions
+        windows = (BurnWindow("fast", 60.0, 10.0, 14.4, 5.0),
+                   BurnWindow("slow", 300.0, 60.0, 6.0, 30.0))
+        runs = [backtest(bench_trace, windows=windows,
+                         replay_opts=ReplayOptions(
+                             include_token_streams=False),
+                         min_events=5) for _ in range(2)]
+        deterministic = (runs[0]["firing_digest"]
+                         == runs[1]["firing_digest"])
+        out["backtest"] = {
+            "transitions": len(runs[0]["transitions"]),
+            "alerts_fired": runs[0]["alerts_fired"],
+            "firing_digest": runs[0]["firing_digest"][:16],
+            "deterministic": deterministic,
+        }
+        if not deterministic:
+            import sys as _sys
+            print("backtest GUARD FAILED: alert firing sequence not "
+                  "deterministic across identical replays",
                   file=_sys.stderr, flush=True)
 
     if args.faults:

@@ -75,6 +75,24 @@ def test_scale_out_on_queue_delay_and_ttft_breach():
         _sig(0.0, n=1, running=1, ttft_p95=3.5)).action == "hold"
 
 
+def test_scale_out_on_canary_breach():
+    """ISSUE 13: the gateway canary's black-box breach (consecutive
+    probe failures) is a scale-out trigger — a replica that stopped
+    answering emits no white-box queue-delay EWMA at all."""
+    clock = VirtualClock()
+    pol = _policy(clock)
+    sig = _sig(0.0, n=1, running=1)
+    sig.canary_breached = 2
+    d = pol.decide(sig)
+    assert d.action == "scale_out" and "canary breach" in d.reason
+    # opt-out restores the old decision sequence
+    clock2 = VirtualClock()
+    pol2 = _policy(clock2, canary_out=False)
+    sig2 = _sig(0.0, n=1, running=1)
+    sig2.canary_breached = 2
+    assert pol2.decide(sig2).action == "hold"
+
+
 def test_no_flap_across_cooldown():
     """SATELLITE PIN: a sustained breach inside the cooldown produces
     exactly ONE scale-out, and the post-storm idle inside the scale-in

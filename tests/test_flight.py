@@ -271,6 +271,9 @@ _CLOCK_PIN_FILES = [
     "tpuserve/runtime", "tpuserve/server/runner.py",
     "tpuserve/server/metrics.py", "tpuserve/server/kv_digest.py",
     "tpuserve/server/tenants.py", "tpuserve/server/tpu_metrics.py",
+    # the SLO engine's latency math (ISSUE 13): burn-rate windows and
+    # canary probe latencies are deltas, never wall timestamps
+    "tpuserve/obs",
 ]
 
 

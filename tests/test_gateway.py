@@ -177,6 +177,46 @@ def test_gateway_status_endpoint(stack):
     assert len(st["backends"]) == 2
 
 
+def test_gateway_status_probe_observability(stack):
+    """ISSUE 13 satellite: /gateway/status carries per-backend
+    last-probe latency and the consecutive probe-failure count — not
+    just the binary eject state."""
+    gw = stack["gw"]
+    gw.probe_backends_once()
+    for b in gw.status()["backends"]:
+        assert b["last_probe_latency_s"] is not None
+        assert b["last_probe_latency_s"] >= 0.0
+        assert b["probe_failures"] == 0
+    # a dead target accumulates consecutive probe failures
+    lone = Gateway(["http://127.0.0.1:9"],  # port 9: discard, refuses
+                   GatewayConfig(host="127.0.0.1", port=0,
+                                 health_timeout_s=0.2))
+    lone.probe_backends_once()
+    b = lone.status()["backends"][0]
+    assert b["probe_failures"] == 1 and not b["healthy"]
+    assert b["last_probe_latency_s"] is not None
+
+
+def test_gateway_slo_endpoint(stack):
+    """Fleet SLO aggregate (/gateway/slo): per-backend burn-rate state
+    + worst-case SLI percentiles scraped off /debug/engine, plus the
+    probe health the canary and autoscaler read."""
+    # one real completion so at least one backend has SLI samples
+    _post(stack["url"] + "/v1/completions",
+          {"prompt": "slo fleet view", "max_tokens": 4})
+    with urllib.request.urlopen(stack["url"] + "/gateway/slo",
+                                timeout=30) as r:
+        data = json.loads(r.read())
+    assert set(data["backends"]) == set(stack["urls"])
+    for entry in data["backends"].values():
+        assert entry["healthy"] is True
+        # the backend servers run the in-process evaluator by default,
+        # so the fleet view sees their slo block (not an error)
+        assert "slo" in entry and "error" not in entry
+    assert isinstance(data["firing"], list)
+    assert isinstance(data["sli_worst"], dict)
+
+
 def test_gateway_bad_request_passthrough(stack):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(stack["url"] + "/v1/completions", {"prompt": ""})

@@ -1,0 +1,452 @@
+"""Fleet SLO engine (tpuserve/obs, ISSUE 13): objectives registry,
+burn-rate evaluation, synthetic canaries, generated alert artifacts,
+and alert backtesting over replay.
+
+One module-scoped server serves every HTTP test (tier-1 runs near its
+wall budget — no per-test engine builds); the backtest tests build the
+replay harness's own tiny engines, same cost class as test_replay.py.
+"""
+
+import json
+import pathlib
+import re
+import time
+import urllib.request
+
+import pytest
+import yaml
+
+from tpuserve.obs.burnrate import (BurnRateEvaluator, BurnWindow,
+                                   alert_rules, promql_burn_expr)
+from tpuserve.obs.objectives import (DEFAULT_OBJECTIVES, SLOObjective,
+                                     load_objectives, objectives_digest,
+                                     validate_objectives)
+from tpuserve.runtime.clock import VirtualClock
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+#: one tight window pair for unit tests: fires at 2x budget burn over
+#: 60s/10s, resolves fast
+TEST_WINDOWS = (BurnWindow("fast", 60.0, 10.0, 2.0, 5.0),)
+
+
+# ---------------------------------------------------------------------
+# bucket audit (satellite): the SLI histogram edges are the burn-rate
+# engine's quantization grid — pinned, not tunable in passing
+# ---------------------------------------------------------------------
+
+def test_sli_bucket_edges_pinned():
+    from tpuserve.server.metrics import SLI_BUCKETS
+    assert SLI_BUCKETS["ttft"] == (0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+                                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+    assert SLI_BUCKETS["itl"] == (0.001, 0.0025, 0.005, 0.01, 0.025,
+                                  0.05, 0.1, 0.25, 0.5, 1.0)
+    # e2e historically started at 100ms (blind on fast classes); the
+    # retuned edges resolve sub-100ms and every objective threshold
+    # must sit on one of them
+    assert SLI_BUCKETS["e2e"] == (0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                                  2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+    for edges in SLI_BUCKETS.values():
+        assert any(e < 0.1 for e in edges), "no sub-100ms resolution"
+        assert list(edges) == sorted(edges)
+
+
+# ---------------------------------------------------------------------
+# objectives registry
+# ---------------------------------------------------------------------
+
+def _registry_families():
+    import inspect
+    from tpuserve.server import metrics as metrics_mod
+    from tools.tpulint.metrics_consistency import registry_from_source
+    fams = set()
+    for m in registry_from_source(inspect.getsource(metrics_mod)):
+        fams.add(m.family)
+        fams.add(m.exported)
+    return fams
+
+
+def test_default_objectives_validate_against_registry():
+    validate_objectives(DEFAULT_OBJECTIVES,
+                        families=_registry_families())
+
+
+def test_objective_threshold_must_sit_on_bucket_edge():
+    bad = SLOObjective("x-ttft", "interactive", "ttft", 0.99, 3600.0,
+                       threshold_s=0.3)      # between 0.25 and 0.5
+    with pytest.raises(ValueError, match="bucket edge"):
+        validate_objectives([bad])
+
+
+def test_objective_ghost_family_rejected():
+    ok = SLOObjective("x-ttft", "interactive", "ttft", 0.99, 3600.0,
+                      threshold_s=0.5)
+    validate_objectives([ok], families=_registry_families())
+    with pytest.raises(ValueError, match="not in the server/metrics"):
+        validate_objectives([ok], families={"tpuserve_other"})
+
+
+def test_load_objectives_json_and_junk():
+    objs = load_objectives(json.dumps([
+        {"name": "a", "slo_class": "interactive", "sli": "ttft",
+         "objective": 0.95, "window_s": 600, "threshold_s": 0.25}]))
+    assert objs[0].error_budget == pytest.approx(0.05)
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_objectives(json.dumps([
+            {"name": "a", "slo_class": "interactive", "sli": "ttft",
+             "objective": 0.95, "window_s": 600, "threshold_s": 0.25,
+             "frobnicate": 1}]))
+    with pytest.raises(ValueError):
+        load_objectives("[]")
+    assert load_objectives(None) == DEFAULT_OBJECTIVES
+
+
+# ---------------------------------------------------------------------
+# burn-rate evaluator (in-process twin)
+# ---------------------------------------------------------------------
+
+def _drive(ev, clock, seconds, value, cls="interactive", kind="ttft",
+           per_s=2):
+    for _ in range(int(seconds * per_s)):
+        clock.advance(1.0 / per_s)
+        ev.observe(cls, kind, value)
+        ev.evaluate()
+
+
+def test_burnrate_fires_on_both_windows_and_resolves():
+    clock = VirtualClock()
+    ev = BurnRateEvaluator(DEFAULT_OBJECTIVES, windows=TEST_WINDOWS,
+                           clock=clock, min_events=4)
+    # healthy traffic: nothing fires
+    _drive(ev, clock, 10, 0.01)
+    assert ev.firing() == []
+    # everything breaching the 0.5s target: fires once
+    _drive(ev, clock, 10, 5.0)
+    assert "interactive-ttft/fast" in ev.firing()
+    fired = [t for t in ev.transitions if t["state"] == "firing"]
+    assert fired and fired[0]["severity"] == "page"
+    # recovery: the short window clears it (long still polluted)
+    _drive(ev, clock, 15, 0.01)
+    assert "interactive-ttft/fast" not in ev.firing()
+    states = [t["state"] for t in ev.transitions
+              if t["objective"] == "interactive-ttft"]
+    assert states == ["firing", "resolved"]
+    # the published snapshot tracks evaluate()
+    assert ev.last_state["firing"] == ev.firing()
+
+
+def test_burnrate_availability_objective():
+    clock = VirtualClock()
+    ev = BurnRateEvaluator(DEFAULT_OBJECTIVES, windows=TEST_WINDOWS,
+                           clock=clock, min_events=4)
+    for _ in range(20):
+        clock.advance(0.5)
+        ev.observe_outcome("standard", False)     # every request shed
+        ev.evaluate()
+    assert "availability/fast" in ev.firing()
+
+
+def test_burnrate_min_events_floor():
+    clock = VirtualClock()
+    ev = BurnRateEvaluator(DEFAULT_OBJECTIVES, windows=TEST_WINDOWS,
+                           clock=clock, min_events=50)
+    _drive(ev, clock, 5, 5.0)       # 10 bad events < 50 floor
+    assert ev.firing() == []
+
+
+# ---------------------------------------------------------------------
+# PromQL compilation + generated artifacts
+# ---------------------------------------------------------------------
+
+def test_promql_exprs_reference_registry_families():
+    from tools.tpulint.metrics_consistency import alert_families
+    fams = _registry_families()
+    for o in DEFAULT_OBJECTIVES:
+        expr = promql_burn_expr(o, 3600.0)
+        for tok in alert_families(expr):
+            assert tok in fams, f"{o.name}: ghost family {tok}"
+        assert "[1h]" in expr
+        if o.threshold_s is not None:
+            # the le= literal is the pinned bucket edge, formatted the
+            # way prometheus_client exports it
+            assert f'le="{float(o.threshold_s)!r}"' in expr
+
+
+def test_alert_rules_cover_every_objective_both_windows():
+    rules = alert_rules(DEFAULT_OBJECTIVES)
+    names = {r["alert"] for r in rules}
+    for o in DEFAULT_OBJECTIVES:
+        for w in ("fast", "slow"):
+            assert f"tpuserve-slo-{o.name}-{w}" in names
+
+
+def test_gen_alerts_goldens_pinned():
+    """A registry or objectives change must regenerate BOTH goldens:
+    python -m tools.gen_alerts --rules-out tests/golden/
+    prometheus_rules.yaml --alertmanager-out tests/golden/
+    alertmanager.yaml"""
+    from tools.gen_alerts import render_alertmanager, render_rules
+    assert render_rules() == (REPO / "tests/golden/prometheus_rules"
+                              ".yaml").read_text(encoding="utf-8")
+    assert render_alertmanager() == (
+        REPO / "tests/golden/alertmanager.yaml").read_text(
+        encoding="utf-8")
+
+
+def test_dashboard_and_alert_goldens_share_registry_digest():
+    """The dashboard <-> alerts drift satellite: all three generated
+    artifacts embed the SAME parsed-registry digest — regenerating one
+    without the others fails here, not in production."""
+    from tools.gen_alerts import registry_digest
+    want = registry_digest()
+    dash = json.loads((REPO / "tests/golden/grafana_dashboard.json")
+                      .read_text(encoding="utf-8"))
+    m = re.search(r"registry-digest: ([0-9a-f]{64})",
+                  dash["description"])
+    assert m and m.group(1) == want, (
+        "grafana dashboard golden was generated against a different "
+        "metrics registry — regenerate dashboard AND alert goldens "
+        "together")
+    for name in ("prometheus_rules.yaml", "alertmanager.yaml"):
+        text = (REPO / "tests/golden" / name).read_text(
+            encoding="utf-8")
+        m = re.search(r"# registry-digest: ([0-9a-f]{64})", text)
+        assert m and m.group(1) == want, (
+            f"{name} was generated against a different metrics "
+            "registry — regenerate all goldens together")
+
+
+def test_every_generated_alert_names_an_existing_runbook_anchor():
+    """Doc satellite: every alert's runbook annotation must point at an
+    anchor that exists in README's runbook table."""
+    rules = yaml.safe_load((REPO / "tests/golden/prometheus_rules.yaml")
+                           .read_text(encoding="utf-8"))
+    readme = (REPO / "README.md").read_text(encoding="utf-8")
+    checked = 0
+    for group in rules["spec"]["groups"]:
+        for rule in group["rules"]:
+            runbook = rule["annotations"]["runbook"]
+            assert runbook.startswith("README.md#"), runbook
+            anchor = runbook.split("#", 1)[1]
+            assert f'id="{anchor}"' in readme, (
+                f"alert {rule['alert']} names runbook anchor "
+                f"{anchor!r} which README.md does not define")
+            checked += 1
+    assert checked >= 10
+
+
+def test_alertmanager_routes_by_severity():
+    cfg = yaml.safe_load((REPO / "tests/golden/alertmanager.yaml")
+                         .read_text(encoding="utf-8"))
+    receivers = {r["name"] for r in cfg["receivers"]}
+    assert {"tpuserve-oncall", "tpuserve-tickets"} <= receivers
+    assert cfg["route"]["routes"][0]["matchers"] == ['severity="page"']
+    assert cfg["inhibit_rules"][0]["equal"] == ["objective"]
+
+
+def test_prometheus_rule_manifest_validates():
+    from tpuserve.provision import manifests
+    from tpuserve.provision.config import DeployConfig
+    from tpuserve.provision.observability import alerting_manifests
+    objs = alerting_manifests(DeployConfig())
+    text = manifests.render(*objs)     # vendored strict schema validation
+    assert "PrometheusRule" in text and "alertmanager.yaml" in text
+
+
+# ---------------------------------------------------------------------
+# backtest: the tier-1 determinism pin
+# ---------------------------------------------------------------------
+
+def _mini_workload():
+    from tpuserve.replay.workload import Workload, WorkloadRequest
+    classes = ("interactive", "standard", "batch")
+    return Workload(requests=[
+        WorkloadRequest(request_id=f"bt-{i}", arrival_s=i * 0.05,
+                        prompt_tokens=8, max_tokens=4,
+                        slo_class=classes[i % 3])
+        for i in range(24)], seed=11)
+
+
+def _run_backtest():
+    from tpuserve.obs import backtest
+    from tpuserve.replay.harness import ReplayOptions
+    return backtest(
+        _mini_workload(),
+        windows=(BurnWindow("fast", 10.0, 2.0, 1.0, 1.0),),
+        replay_opts=ReplayOptions(step_time_s=0.5,
+                                  include_token_streams=False),
+        min_events=2)
+
+
+def test_backtest_determinism_pin():
+    """ISSUE 13 acceptance: same replay bundle + same objectives =>
+    byte-identical alert firing sequence."""
+    r1, r2 = _run_backtest(), _run_backtest()
+    assert json.dumps(r1["transitions"], sort_keys=True) == \
+        json.dumps(r2["transitions"], sort_keys=True)
+    assert r1["firing_digest"] == r2["firing_digest"]
+    assert r1["objectives_digest"] == \
+        objectives_digest(DEFAULT_OBJECTIVES)
+    # the 0.5s-per-cycle replay makes every class breach: alerts fire,
+    # with timestamps in virtual seconds
+    assert r1["alerts_fired"], "backtest produced no alerts to pin"
+    assert all(t["t"] <= r1["replay"]["virtual_s"] + 1e-6
+               for t in r1["transitions"])
+    assert not r1["replay"]["aborted"]
+
+
+# ---------------------------------------------------------------------
+# HTTP: canary exclusion + prober + /debug/engine slo block
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server():
+    from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                                  SchedulerConfig)
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    eng = Engine(EngineConfig(
+        model="tiny-qwen3",
+        cache=CacheConfig(block_size=4, num_blocks=64,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2), seed=0))
+    srv = OpenAIServer(eng, ServerConfig(host="127.0.0.1", port=0))
+    port = srv.start()
+    yield srv, f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def _post(url, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _scrape(base):
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def _sample(text, family, **labels):
+    """Value of one exposition sample (0.0 when the series does not
+    exist yet)."""
+    for line in text.splitlines():
+        if not line.startswith(family):
+            continue
+        rest = line[len(family):]
+        if rest[:1] not in ("{", " "):
+            continue                  # longer family name prefix-match
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def test_canary_provably_absent_from_metering_and_sli(server):
+    """ISSUE 13 acceptance: canary requests flow through the real path
+    (tpuserve_canary_requests_total moves) while tenant metering and
+    every production SLI histogram stay untouched; a normal request
+    moves both."""
+    srv, base = server
+    before = _scrape(base)
+    canary_before = _sample(before, "tpuserve_canary_requests_total")
+    sli_before = _sample(before, "tpuserve_ttft_seconds_count",
+                         slo_class="interactive")
+    e2e_before = _sample(before, "tpuserve_e2e_seconds_count",
+                         slo_class="interactive")
+    tenant_before = _sample(before, "tpuserve_tenant_tokens_total",
+                            tenant="default")
+    status, body = _post(base + "/v1/completions",
+                         {"prompt": "canary ping", "max_tokens": 2},
+                         headers={"X-TPUServe-Canary": "1",
+                                  "X-SLO-Class": "interactive"})
+    assert status == 200 and body["choices"]
+    after = _scrape(base)
+    assert _sample(after, "tpuserve_canary_requests_total") == \
+        canary_before + 1
+    assert _sample(after, "tpuserve_ttft_seconds_count",
+                   slo_class="interactive") == sli_before
+    assert _sample(after, "tpuserve_e2e_seconds_count",
+                   slo_class="interactive") == e2e_before
+    assert _sample(after, "tpuserve_tenant_tokens_total",
+                   tenant="default") == tenant_before
+    # control arm: an identical NON-canary request moves the SLI
+    # histograms and the default tenant's metering
+    status, _ = _post(base + "/v1/completions",
+                      {"prompt": "canary ping", "max_tokens": 2},
+                      headers={"X-SLO-Class": "interactive"})
+    assert status == 200
+    control = _scrape(base)
+    assert _sample(control, "tpuserve_e2e_seconds_count",
+                   slo_class="interactive") == e2e_before + 1
+    assert _sample(control, "tpuserve_tenant_tokens_total",
+                   tenant="default") > tenant_before
+
+
+def test_canary_prober_black_box_round(server):
+    from tpuserve.obs.canary import CanaryConfig, CanaryProber
+    _srv, base = server
+    prober = CanaryProber(base, CanaryConfig(interval_s=60.0,
+                                             timeout_s=60.0))
+    snap = prober.probe_once()
+    assert snap["breached"] is False
+    assert set(snap["consecutive_failures"]) == {"interactive",
+                                                 "standard", "batch"}
+    assert all(v["ok"] for v in snap["last"].values()), snap
+    text = prober.metrics.render().decode()
+    for cls in ("interactive", "standard", "batch"):
+        assert _sample(text, "tpuserve_canary_probes_total",
+                       slo_class=cls) == 1.0
+        assert _sample(text, "tpuserve_canary_failures_total",
+                       slo_class=cls) == 0.0
+    assert _sample(text, "tpuserve_canary_breached") == 0.0
+    # a dead target breaches after the configured consecutive failures
+    dead = CanaryProber("http://127.0.0.1:9",
+                        CanaryConfig(interval_s=60.0, timeout_s=0.2,
+                                     classes=("interactive",),
+                                     breach_failures=2))
+    dead.probe_once()
+    assert dead.breached_classes() == []
+    dead.probe_once()
+    assert dead.breached_classes() == ["interactive"]
+    assert _sample(dead.metrics.render().decode(),
+                   "tpuserve_canary_breached") == 1.0
+
+
+def test_canary_tag_is_token_gated(monkeypatch):
+    """The canary tag bypasses tenant metering/rate limits, so with
+    TPUSERVE_CANARY_TOKEN set a client's bare '1' is NOT a canary —
+    only the token is."""
+    from tpuserve.obs.canary import is_canary_header
+    monkeypatch.delenv("TPUSERVE_CANARY_TOKEN", raising=False)
+    assert is_canary_header("1") and not is_canary_header(None)
+    monkeypatch.setenv("TPUSERVE_CANARY_TOKEN", "s3cret")
+    assert not is_canary_header("1")
+    assert is_canary_header("s3cret")
+
+
+def test_debug_engine_carries_slo_state(server):
+    srv, base = server
+    # the loop evaluates at most once per engine-clock second; give the
+    # idle loop a beat to publish the snapshot
+    deadline = time.monotonic() + 5.0
+    slo = None
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(base + "/debug/engine",
+                                    timeout=30) as r:
+            payload = json.loads(r.read())
+        slo = payload.get("slo")
+        if slo and slo.get("objectives"):
+            break
+        time.sleep(0.2)
+    assert slo and set(slo["objectives"]) == \
+        {o.name for o in DEFAULT_OBJECTIVES}
+    assert "burn" in slo and "firing" in slo
+    # healthy tiny traffic must not be firing anything
+    assert slo["firing"] == []
+    # the burn gauges export too
+    text = _scrape(base)
+    assert "tpuserve_slo_burn_rate" in text
+    assert _sample(text, "tpuserve_slo_alerts_firing") == 0.0

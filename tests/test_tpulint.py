@@ -634,6 +634,57 @@ def test_p5_getattr_fed_metric_is_a_use():
     assert "metric-never-updated" not in rules(findings)
 
 
+def test_p5_alert_drift_both_directions(tmp_path):
+    """ISSUE 13 (P5 extended): an alert expr naming a ghost family is
+    flagged, and an objectives-registry family no alert references is
+    flagged in the other direction."""
+    reg = """
+        from prometheus_client import Counter
+
+        class ServerMetrics:
+            def __init__(self):
+                self.shed = Counter("tpuserve_requests_shed", "d",
+                                    registry=None)
+    """
+    feeder = """
+        def run(self):
+            self.metrics.shed.inc()
+    """
+    golden = tmp_path / "tests" / "golden"
+    golden.mkdir(parents=True)
+    (golden / "prometheus_rules.yaml").write_text(
+        "spec:\n  groups:\n  - rules:\n"
+        "    - expr: rate(tpuserve_ghost_series_total[5m]) > 1\n")
+    findings = run_lint_sources(
+        {"tpuserve/server/metrics.py": textwrap.dedent(reg),
+         "tpuserve/server/feeder.py": textwrap.dedent(feeder)},
+        Config(dict(DEFAULT_CONFIG)), repo_root=str(tmp_path),
+        passes=["metrics"])
+    got = rules(findings)
+    # direction 1: the fake alerts file watches a ghost series
+    assert "alert-unknown-metric" in got
+    # direction 2: the real objectives registry's families (ttft
+    # histograms, availability counters) appear in no alert expr
+    assert "objective-unalerted" in got
+    # no alerts file at all = nothing to check (fixture repos)
+    clean = run_lint_sources(
+        {"tpuserve/server/metrics.py": textwrap.dedent(reg),
+         "tpuserve/server/feeder.py": textwrap.dedent(feeder)},
+        Config(dict(DEFAULT_CONFIG)),
+        repo_root=str(tmp_path / "elsewhere"), passes=["metrics"])
+    assert "alert-unknown-metric" not in rules(clean)
+    assert "objective-unalerted" not in rules(clean)
+
+
+def test_p5_alert_families_normalises_series_suffixes():
+    from tools.tpulint.metrics_consistency import alert_families
+    fams = alert_families(
+        "sum(rate(tpuserve_ttft_seconds_bucket{le=\"0.5\"}[1h])) / "
+        "sum(rate(tpuserve_ttft_seconds_count[1h])) and "
+        "vllm_request_total")
+    assert fams == {"tpuserve_ttft_seconds", "vllm_request_total"}
+
+
 def test_default_config_tracks_pyproject():
     """core.DEFAULT_CONFIG (fixture/no-pyproject fallback) must not
     drift WEAKER than the shipped [tool.tpulint] block: a dispatch path

@@ -92,6 +92,12 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("autoscale-storm", ["--autoscale-replay"], {}),
     ("cold-start", ["--autoscale-replay",
                     "--autoscale-mode", "cold-start"], {}),
+    # Fleet SLO engine (ISSUE 13): canary prober + in-process burn-rate
+    # evaluator overhead guard (<1% tok/s, interleaved pairs) and the
+    # alert-backtest determinism smoke over the row's own workload.
+    ("canary-smoke", ["--canary-ab"], {}),
+    ("backtest-smoke", ["--arrival", "poisson", "--arrival-rate", "16",
+                        "--backtest"], {}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

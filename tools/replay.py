@@ -19,9 +19,15 @@ scenario, CPU-runnable with no chips.
     # one-shot: bundle in, diff out
     python tools/replay.py run incident.json --from-bundle
 
+    # alert backtest (tpuserve/obs): which burn-rate alerts would the
+    # declared objectives have fired over this incident, and when
+    python tools/replay.py backtest workload.json --objectives slos.json
+
 Determinism contract: same workload file + same seed => identical token
 streams and identical SLI summary (report carries sha256 digests of
-both; pinned in tier-1 by tests/test_replay.py).
+both; pinned in tier-1 by tests/test_replay.py).  The backtest extends
+it: same bundle + same objectives => byte-identical alert firing
+sequence (tests/test_obs.py).
 """
 
 from __future__ import annotations
@@ -135,6 +141,46 @@ def _cmd_run(args) -> int:
     return 2 if report.get("aborted") else 0
 
 
+def _cmd_backtest(args) -> int:
+    from tpuserve.obs import backtest, load_objectives
+    from tpuserve.obs.backtest import render_backtest
+    from tpuserve.obs.burnrate import BurnWindow
+    from tpuserve.replay import (ReplayOptions, Workload, load_bundle,
+                                 workload_from_bundle)
+    if args.from_bundle:
+        wl = workload_from_bundle(load_bundle(args.workload),
+                                  seed=args.seed or 0)
+    else:
+        wl = Workload.load(args.workload)
+        if args.seed is not None:
+            wl.seed = args.seed
+    windows = ()
+    if args.windows:
+        windows = tuple(
+            BurnWindow(name, float(long_s), float(short_s),
+                       float(factor))
+            for name, long_s, short_s, factor in
+            (w.split(":") for w in args.windows.split(",")))
+    result = backtest(
+        wl, objectives=load_objectives(args.objectives),
+        windows=windows,
+        replay_opts=ReplayOptions(
+            model=args.model,
+            step_time_s=(args.step_ms / 1000.0) if args.step_ms
+            else None,
+            max_num_seqs=args.max_seqs,
+            include_token_streams=False),
+        min_events=args.min_events)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote backtest report to {args.report}")
+    print(json.dumps(result, sort_keys=True) if args.json
+          else render_backtest(result))
+    return 2 if result["replay"].get("aborted") else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/replay.py",
@@ -202,6 +248,35 @@ def main(argv=None) -> int:
                    help="print machine-readable JSON instead of the "
                         "human diff")
     r.set_defaults(fn=_cmd_run)
+
+    b = sub.add_parser("backtest",
+                       help="evaluate the burn-rate alert engine over a "
+                            "replayed incident: which alerts would have "
+                            "fired, and when (tpuserve/obs/backtest.py)")
+    b.add_argument("workload", help="workload file (or a bundle with "
+                                    "--from-bundle)")
+    b.add_argument("--from-bundle", action="store_true",
+                   help="treat the input as a flight bundle and extract "
+                        "in-process first")
+    b.add_argument("--objectives", default=None, metavar="JSON|PATH",
+                   help="SLO objectives (tpuserve/obs/objectives.py); "
+                        "default: TPUSERVE_SLO_OBJECTIVES env, else the "
+                        "registry defaults")
+    b.add_argument("--windows", default=None,
+                   metavar="NAME:LONG:SHORT:FACTOR[,..]",
+                   help="override the burn windows (seconds), e.g. "
+                        "fast:60:10:14.4 — the alert-tuning knob; "
+                        "default: the production window pairs")
+    b.add_argument("--min-events", type=int, default=10,
+                   help="short-window event floor before a pair may "
+                        "fire (production default 10)")
+    b.add_argument("--model", default="tiny-qwen3")
+    b.add_argument("--seed", type=int, default=None)
+    b.add_argument("--step-ms", type=float, default=None)
+    b.add_argument("--max-seqs", type=int, default=None)
+    b.add_argument("--report", default=None, metavar="PATH")
+    b.add_argument("--json", action="store_true")
+    b.set_defaults(fn=_cmd_backtest)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -104,6 +104,11 @@ PRIORITY = [
     # the serving rows certify — storm = scale-out-before-shed + SLI
     # A/B, cold-start = scale-from-zero with a warm-prefix restore.
     "autoscale-storm", "cold-start",
+    # Fleet SLO engine (ISSUE 13): the canary/burn-rate overhead guard
+    # (<1% tok/s with the prober + in-process evaluator armed) and the
+    # alert-backtest determinism smoke, certified in the same container
+    # the serving rows run in.
+    "canary-smoke", "backtest-smoke",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

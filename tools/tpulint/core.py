@@ -87,6 +87,12 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/request.py",
             "tpuserve/server/runner.py",
             "tpuserve/autoscale/*.py",
+            # SLO burn-rate engine: backtests under VirtualClock
+            # (canary.py deliberately absent — HTTP probes are
+            # wall-bound)
+            "tpuserve/obs/objectives.py",
+            "tpuserve/obs/burnrate.py",
+            "tpuserve/obs/backtest.py",
         ],
     },
     "thread_ownership": {

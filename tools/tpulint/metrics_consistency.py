@@ -12,6 +12,14 @@ checks:
   README.md.
 - ``metric-doc-drift``: a ``vllm_*`` / ``tpuserve_*`` family named in a
   README table row that is not in the registry.
+- ``alert-unknown-metric``: a metric family referenced by an expr in
+  the generated alert rules (``tests/golden/prometheus_rules.yaml``,
+  config key ``metrics.alerts``) that is not in the registry — an
+  alert that can never fire because it watches a ghost series.
+- ``objective-unalerted``: the reverse direction — a family the SLO
+  objectives registry (``tpuserve/obs/objectives.py``) declares that no
+  alert expr references; the objective exists but nothing pages on it
+  (regenerate with ``python -m tools.gen_alerts``).
 
 ``registry_from_source`` is the shared fixture consumed by both this
 pass and ``tests/test_tpulint.py``'s doc-sync test, so the two can never
@@ -37,6 +45,10 @@ _CTOR_KINDS = {
 }
 
 _DOC_NAME_RE = re.compile(r"`((?:vllm|tpuserve)_[a-z0-9_]+)`")
+# family tokens inside alert exprs/annotations (no backticks there)
+_EXPR_NAME_RE = re.compile(r"\b((?:vllm|tpuserve)_[a-z0-9_]+)")
+# histogram sub-series suffixes normalise back to their family
+_SERIES_SUFFIXES = ("_bucket", "_count", "_sum")
 
 
 @dataclasses.dataclass
@@ -176,4 +188,63 @@ def run(files: dict, config: Config, repo_root: str) -> list:
                             "which is not in the server/metrics.py "
                             "registry (renamed or removed?)",
                     pass_name=NAME))
+    findings.extend(_check_alerts(sec, registry, repo_root))
+    return findings
+
+
+def alert_families(alerts_text: str) -> set:
+    """Every family token in the generated alert YAML, histogram
+    sub-series (_bucket/_count/_sum) normalised to their family."""
+    out = set()
+    for tok in _EXPR_NAME_RE.findall(alerts_text):
+        for suffix in _SERIES_SUFFIXES:
+            if tok.endswith(suffix):
+                tok = tok[:-len(suffix)]
+                break
+        out.add(tok)
+    return out
+
+
+def _check_alerts(sec: dict, registry: list, repo_root: str) -> list:
+    """ISSUE 13 (P5 extended): the generated alert rules and the metric
+    registry may not drift in EITHER direction — every family an alert
+    expr watches must be registered, and every family the SLO
+    objectives registry reads must appear in some alert expr."""
+    findings: list = []
+    alerts_rel = sec.get("alerts", "tests/golden/prometheus_rules.yaml")
+    alerts_path = os.path.join(repo_root, alerts_rel)
+    if not os.path.exists(alerts_path):
+        return findings
+    with open(alerts_path, "r", encoding="utf-8") as f:
+        alerts_text = f.read()
+    referenced = alert_families(alerts_text)
+    exported = {m.exported for m in registry} | {m.family
+                                                 for m in registry}
+    for fam in sorted(referenced):
+        if fam not in exported:
+            findings.append(Finding(
+                file=alerts_rel, line=1, rule="alert-unknown-metric",
+                message=f"alert rules reference metric family '{fam}' "
+                        "which is not in the server/metrics.py "
+                        "registry — the alert can never fire "
+                        "(regenerate with python -m tools.gen_alerts)",
+                pass_name=NAME))
+    try:
+        from tpuserve.obs.objectives import DEFAULT_OBJECTIVES
+        needed = set()
+        for o in DEFAULT_OBJECTIVES:
+            needed.update(o.families())
+    except Exception:
+        needed = set()
+    for fam in sorted(needed):
+        base = fam[:-6] if fam.endswith("_total") else fam
+        if fam not in referenced and base not in referenced \
+                and fam + "_total" not in referenced:
+            findings.append(Finding(
+                file=alerts_rel, line=1, rule="objective-unalerted",
+                message=f"SLO objectives read metric family '{fam}' "
+                        "but no generated alert expr references it — "
+                        "the objective exists, nothing pages on it "
+                        "(regenerate with python -m tools.gen_alerts)",
+                pass_name=NAME))
     return findings

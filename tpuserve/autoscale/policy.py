@@ -86,6 +86,12 @@ class PoolSignals:
     # count in production, the pool queue length under replay — the
     # scale-from-zero trigger
     pending_demand: int = 0
+    # SLO classes the gateway's black-box canary prober currently
+    # reports breached (consecutive probe failures past the threshold,
+    # tpuserve/obs/canary.py via /gateway/status) — a scale-out
+    # trigger the white-box signals can't replace: a replica that
+    # stopped answering entirely emits no queue-delay EWMA at all
+    canary_breached: int = 0
 
     @property
     def ready(self) -> list:
@@ -146,6 +152,9 @@ class PolicyConfig:
     # TTFT includes prefill cost, so the right target is deployment-
     # specific where the other two triggers are not).
     ttft_p95_out_s: float = 0.0
+    # ... or when the gateway's synthetic canary reports any SLO class
+    # breached (black-box probe failures; False disables the trigger).
+    canary_out: bool = True
     # Replicas added per scale-out decision.
     scale_out_step: int = 1
     # No second scale-out within this window of the last one: the
@@ -227,6 +236,9 @@ class AutoscalePolicy:
             if ttft is not None and ttft >= cfg.ttft_p95_out_s:
                 return (f"interactive TTFT p95 {ttft:.3f}s >= "
                         f"{cfg.ttft_p95_out_s:g}s")
+        if cfg.canary_out and sig.canary_breached:
+            return (f"canary breach: {sig.canary_breached} SLO "
+                    "class(es) failing black-box probes")
         return None
 
     # ---- the decision --------------------------------------------------

@@ -92,15 +92,22 @@ class KubePool:
 
     def _pending_demand(self) -> int:
         """Unserved-request delta at the gateway since the last poll —
-        requests that arrived while no backend could take them."""
+        requests that arrived while no backend could take them.  The
+        same fetch also captures the gateway canary's breach state
+        (tpuserve/obs/canary.py) into ``_canary_breached`` for the
+        policy's black-box scale-out trigger."""
+        self._canary_breached = 0
         if not self.gateway_url:
             return 0
         try:
             with urllib.request.urlopen(
                     self.gateway_url.rstrip("/") + "/gateway/status",
                     timeout=2.0) as resp:
-                total = int(json.loads(resp.read())
-                            .get("unserved_total") or 0)
+                payload = json.loads(resp.read())
+            total = int(payload.get("unserved_total") or 0)
+            self._canary_breached = len(
+                (payload.get("canary") or {}).get("breached_classes")
+                or ())
         except Exception as e:
             logger.debug("gateway status scrape failed: %s", e)
             return 0
@@ -159,8 +166,11 @@ class KubePool:
         self._unready_since = {k: v for k, v in
                                self._unready_since.items() if k in seen}
         self._ready_urls = ready_urls
+        pending = self._pending_demand()
         return PoolSignals(t=now, replicas=replicas, booting=booting,
-                           pending_demand=self._pending_demand())
+                           pending_demand=pending,
+                           canary_breached=getattr(
+                               self, "_canary_breached", 0))
 
     def ready_urls(self) -> list:
         return list(self._ready_urls)

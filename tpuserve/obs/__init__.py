@@ -1,0 +1,31 @@
+"""Fleet SLO engine (ISSUE 13): declared objectives, burn-rate
+evaluation, synthetic canaries, and alert backtesting over replay.
+
+The serving stack has exported SLIs since the flight recorder landed;
+this package is the layer that *evaluates* them — DeepServe (arxiv
+2501.14417) treats per-QoS-tier SLO attainment as the primary
+operational signal, and "Adaptive Orchestration" (arxiv 2503.20074)
+routes on continuously probed health.  One declarative objectives
+registry feeds four consumers:
+
+- ``objectives.py`` — per-SLO-class targets, validated against the
+  metrics registry and the pinned histogram bucket edges;
+- ``burnrate.py`` — SRE-style multi-window multi-burn-rate evaluation,
+  both in-process (off the runner's SLI stream, under the injectable
+  clock seam) and compiled to PromQL for the generated alert rules
+  (``tools/gen_alerts.py``);
+- ``canary.py`` — a black-box prober driving tagged tiny requests per
+  SLO class through the real serving path (excluded from tenant
+  metering and production SLI histograms);
+- ``backtest.py`` — the burn-rate engine replayed over any flight
+  bundle under ``VirtualClock``: which alerts would have fired, and
+  when (``tools/replay.py backtest``; determinism pinned tier-1).
+"""
+
+from tpuserve.obs.backtest import backtest  # noqa: F401
+from tpuserve.obs.burnrate import (BurnRateEvaluator, BurnWindow,  # noqa: F401
+                                   DEFAULT_WINDOWS, promql_burn_expr)
+from tpuserve.obs.canary import CanaryConfig, CanaryProber  # noqa: F401
+from tpuserve.obs.objectives import (DEFAULT_OBJECTIVES,  # noqa: F401
+                                     SLOObjective, load_objectives,
+                                     objectives_digest, validate_objectives)

@@ -111,6 +111,12 @@ class DeployConfig:
     autoscale_min_replicas: int = 0        # 0 = scale-to-zero allowed
     autoscale_max_replicas: int = 4
     autoscale_interval_s: int = 5          # control-loop cadence
+    # Synthetic canary (tpuserve/obs/canary.py, ISSUE 13): the gateway
+    # probes itself with one tagged tiny request per SLO class every
+    # this-many seconds — black-box tpuserve_canary_* SLIs on the
+    # gateway /metrics, breach state on /gateway/status (an autoscale
+    # scale-out trigger).  0 disables the prober.
+    canary_interval_s: float = 15.0
     # Graceful-drain budget on SIGTERM (server --drain-timeout); the
     # emitted pod spec's terminationGracePeriodSeconds is derived from
     # this (+35 s headroom) so K8s never SIGKILLs mid-drain
@@ -236,6 +242,9 @@ class DeployConfig:
             raise ValueError("max_waiting must be >= -1")
         if self.drain_timeout_s < 0:
             raise ValueError("drain_timeout_s must be >= 0")
+        if self.canary_interval_s < 0:
+            raise ValueError("canary_interval_s must be >= 0 "
+                             "(0 disables the gateway canary)")
         if self.autoscale:
             if not (0 <= self.autoscale_min_replicas
                     <= self.autoscale_max_replicas) \

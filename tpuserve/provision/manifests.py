@@ -418,6 +418,11 @@ def gateway_deployment(cfg: DeployConfig, backends: list[str],
         args += ["--backend", b]
     if backends_url:
         args += ["--backends-url", backends_url]
+    if cfg.canary_interval_s > 0:
+        # embedded black-box prober (tpuserve/obs/canary.py): tagged
+        # probes through the gateway's own relay path; the scrape
+        # annotations below pick up its tpuserve_canary_* families
+        args += ["--canary-interval", str(cfg.canary_interval_s)]
     return {
         "apiVersion": "apps/v1", "kind": "Deployment",
         "metadata": {"name": "tpuserve-gateway", "namespace": cfg.namespace,

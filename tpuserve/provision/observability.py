@@ -31,6 +31,7 @@ def setup(cfg: DeployConfig, kube: KubeCtl) -> None:
     _otel_prometheus(cfg, kube)
     _collector(cfg, kube)
     _grafana_dashboard(cfg, kube)
+    _alerting(cfg, kube)
     _wait_ready(cfg, kube)
 
 
@@ -442,6 +443,42 @@ def _grafana_dashboard(cfg: DeployConfig, kube: KubeCtl) -> None:
         # dashboard is repo-generated, skip rather than fail the deploy
         logger.warning("tools.gen_dashboard unavailable; skipping the "
                        "Grafana dashboard ConfigMap")
+        return
+    kube.apply_manifest(manifests.render(
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": cfg.monitoring_namespace}}, *objs))
+
+
+def alerting_manifests(cfg: DeployConfig) -> list[dict]:
+    """SLO burn-rate alert rules + Alertmanager routing, GENERATED from
+    the objectives + metrics registries (tools/gen_alerts.py; goldens
+    pinned, tpulint P5 checks every alert expr against the registry).
+    The PrometheusRule carries the kube-prometheus-stack's release
+    label so the stack's default rule selector adopts it; the
+    Alertmanager config ships as a ConfigMap for the operator to point
+    their Alertmanager at (receiver webhooks are placeholders by
+    design)."""
+    from tools.gen_alerts import alertmanager_config, prometheus_rule
+    import yaml as _yaml
+    am = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "tpuserve-alertmanager-config",
+                     "namespace": cfg.monitoring_namespace,
+                     "labels": {"app": "tpuserve"}},
+        "data": {"alertmanager.yaml": _yaml.safe_dump(
+            alertmanager_config(), sort_keys=True)},
+    }
+    return [prometheus_rule(namespace=cfg.monitoring_namespace), am]
+
+
+def _alerting(cfg: DeployConfig, kube: KubeCtl) -> None:
+    try:
+        objs = alerting_manifests(cfg)
+    except ImportError:
+        # installed-package deploys without the tools/ tree — like the
+        # dashboard, the alert artifacts are repo-generated
+        logger.warning("tools.gen_alerts unavailable; skipping the "
+                       "SLO alert rules + Alertmanager config")
         return
     kube.apply_manifest(manifests.render(
         {"apiVersion": "v1", "kind": "Namespace",

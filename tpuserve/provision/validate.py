@@ -508,6 +508,36 @@ SCHEMAS: dict[tuple[str, str], dict] = {
             },
             "required": ["parentRefs", "rules"],
             "additionalProperties": False}),
+    ("monitoring.coreos.com/v1", "PrometheusRule"): _top(
+        "monitoring.coreos.com/v1", {
+            "type": "object",
+            "properties": {
+                "groups": {"type": "array", "minItems": 1, "items": {
+                    "type": "object",
+                    "properties": {
+                        "name": {"type": "string"},
+                        "interval": {"type": "string",
+                                     "pattern": r"^[0-9]+(s|m|h)$"},
+                        "rules": {"type": "array", "minItems": 1,
+                                  "items": {
+                            "type": "object",
+                            "properties": {
+                                "alert": {"type": "string"},
+                                "record": {"type": "string"},
+                                "expr": {"type": "string"},
+                                "for": {"type": "string",
+                                        "pattern": r"^[0-9]+(s|m|h)$"},
+                                "labels": _str_map,
+                                "annotations": _str_map,
+                            },
+                            "required": ["expr"],
+                            "additionalProperties": False}},
+                    },
+                    "required": ["name", "rules"],
+                    "additionalProperties": False}},
+            },
+            "required": ["groups"],
+            "additionalProperties": False}),
     ("monitoring.coreos.com/v1", "ServiceMonitor"): _top(
         "monitoring.coreos.com/v1", {
             "type": "object",

@@ -74,6 +74,12 @@ class ReplayOptions:
     # a replay is itself a recorded incident, so the loop closes:
     # bundle -> workload -> replay -> bundle (tests round-trip on this)
     dump_bundle_path: Optional[str] = None
+    # optional SLO-evaluation observer (tpuserve/obs/backtest.py): the
+    # harness calls bind_clock(clock) once after the engine build, then
+    # on_sli(cls, kind, value) per sample, on_outcome(cls, outcome) per
+    # terminal state, and on_tick() after every engine cycle — enough
+    # to run the burn-rate engine over the replay in virtual time
+    observer: Optional[object] = None
 
 
 def _resolve_step_time(workload: Workload,
@@ -136,6 +142,9 @@ def replay(workload: Workload,
     step_time_s = _resolve_step_time(workload, opts)
     wall0 = time.perf_counter()
     engine, clock = build_replay_engine(workload, opts)
+    observer = opts.observer
+    if observer is not None:
+        observer.bind_clock(clock)
     vocab = engine.model_cfg.vocab_size
     max_len = engine.max_seq_len
     from tpuserve.runtime.request import SamplingParams
@@ -156,6 +165,13 @@ def replay(workload: Workload,
     def observe(cls: str, kind: str, value: float) -> None:
         sli.setdefault((cls, kind), []).append(value)
         engine.flight.note_sli(cls, kind, value)
+        if observer is not None:
+            observer.on_sli(cls, kind, value)
+
+    def note_outcome(rid: str, outcome: str) -> None:
+        outcomes[rid] = outcome
+        if observer is not None:
+            observer.on_outcome(cls_of.get(rid, "standard"), outcome)
 
     def submit(r) -> None:
         ids = workload.prompt_ids(r, vocab)
@@ -177,19 +193,19 @@ def replay(workload: Workload,
             engine.add_request(prompt_token_ids=ids, params=params,
                                request_id=r.request_id)
         except ShedError:
-            outcomes[r.request_id] = "shed"
+            note_outcome(r.request_id, "shed")
         except MemoryError:
-            outcomes[r.request_id] = "rejected"
+            note_outcome(r.request_id, "rejected")
         except Exception as e:          # noqa: BLE001 — report, don't die
             logger.warning("replay submit of %s failed: %s",
                            r.request_id, e)
-            outcomes[r.request_id] = "error"
+            note_outcome(r.request_id, "error")
 
     def drain_engine_errors() -> None:
         for rid, exc in engine.drain_request_errors():
-            outcomes[rid] = ("shed" if isinstance(exc, ShedError)
-                             else "deadline_aborted"
-                             if isinstance(exc, TimeoutError) else "error")
+            note_outcome(rid, "shed" if isinstance(exc, ShedError)
+                         else "deadline_aborted"
+                         if isinstance(exc, TimeoutError) else "error")
 
     def route(outs) -> None:
         now = clock.monotonic()
@@ -211,7 +227,7 @@ def replay(workload: Workload,
             if o.finished:
                 cause = (o.finish_reason.value if o.finish_reason
                          else "stop")
-                outcomes[rid] = cause
+                note_outcome(rid, cause)
                 observe(cls, "e2e", now - arrival.get(rid, 0.0))
                 engine.requests.pop(rid, None)
                 last_emit.pop(rid, None)
@@ -241,6 +257,10 @@ def replay(workload: Workload,
                 break
             salvage()
         drain_engine_errors()
+        if observer is not None:
+            # alert evaluation lands at cycle ends, like everything else
+            # stamped under virtual time
+            observer.on_tick()
         if engine.stats.brownout_level > max_brownout:
             max_brownout = engine.stats.brownout_level
         if steps > max_steps:

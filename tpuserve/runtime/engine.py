@@ -815,7 +815,12 @@ class Engine:
             self._slo._waiting = self.scheduler.num_waiting
             retry_after = self._slo.shed_retry_after(rank)
             if retry_after is not None:
-                self.stats.requests_shed += 1
+                if not params.canary:
+                    # synthetic canary probes (tpuserve/obs) must not
+                    # feed the availability SLO's bad-event counter —
+                    # a shed canary is the PROBER's signal (its own
+                    # failures family), not a production shed
+                    self.stats.requests_shed += 1
                 self._slo.shed_total += 1
                 self.flight.req_event(request_id, "SHED",
                                       slo_class=params.slo_class,
@@ -1142,7 +1147,10 @@ class Engine:
                 self.flight.req_event(victim.request_id, "SHED",
                                       cause="queue_full_eviction")
                 self.abort_request(victim.request_id)
-                self.stats.requests_shed += 1
+                if not victim.params.canary:
+                    # canary probes don't count as production sheds
+                    # (tpuserve/obs — same rule as the intake gate)
+                    self.stats.requests_shed += 1
                 self._slo.shed_total += 1
                 ra = self._slo.cfg.shed_retry_after_s
                 self._error_outbox.append((victim.request_id, ShedError(

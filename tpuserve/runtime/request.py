@@ -68,6 +68,12 @@ class SamplingParams:
     # headroom for non-batch classes, and under pressure batch rows are
     # preempted (token-identical re-prefill replay) or shed first.
     slo_class: str = "standard"
+    # Synthetic canary probe (tpuserve/obs/canary.py, tagged via the
+    # X-TPUServe-Canary header): served through the normal path but
+    # EXCLUDED from tenant metering and the production SLI histograms /
+    # burn-rate stream (server/runner.py) — the prober observes the
+    # system, it must not feed the signals it cross-checks
+    canary: bool = False
     # vLLM truncate_prompt_tokens: keep only the LAST N prompt tokens
     # at intake (clients cap their own context budget server-side)
     truncate_prompt_tokens: Optional[int] = None

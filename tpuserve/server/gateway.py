@@ -89,6 +89,13 @@ class Backend:
     eject_count: int = 0
     backoff_until: float = 0.0
     healthy_since: float = 0.0
+    # Probe observability (ISSUE 13 satellite): wall seconds the last
+    # /healthz round-trip took and how many CONSECUTIVE probes have
+    # failed — /gateway/status previously showed only the binary eject
+    # state, which hid both a slowly-degrading backend (rising probe
+    # latency) and how close an unhealthy one is to readmission.
+    last_probe_latency_s: Optional[float] = None
+    probe_failures: int = 0
 
 
 @dataclasses.dataclass
@@ -140,6 +147,15 @@ class GatewayConfig:
     # counted in unserved_total, the autoscaler's demand signal.
     backends_file: Optional[str] = None
     backends_url: Optional[str] = None
+    # Embedded synthetic canary (tpuserve/obs/canary.py, ISSUE 13): > 0
+    # starts a prober that drives one tagged tiny request per SLO class
+    # through THIS gateway every interval — so probes exercise routing,
+    # admission and ejection exactly like client traffic, while the
+    # canary tag keeps them out of tenant metering and the production
+    # SLI histograms.  Black-box tpuserve_canary_* families are served
+    # on the gateway's /metrics; breach state rides /gateway/status for
+    # the autoscaler.  0 = no prober (default).
+    canary_interval_s: float = 0.0
 
 
 class Gateway:
@@ -165,6 +181,9 @@ class Gateway:
         self.tenants = TenantRegistry.load(self.config.tenant_config) \
             if (self.config.tenant_config
                 or os.environ.get("TPUSERVE_TENANTS")) else None
+        # embedded canary prober: constructed against this gateway's own
+        # bound port in start() (port 0 isn't known yet)
+        self.canary = None
         if dynamic:
             # synchronous initial load so start() routes immediately
             # when the source already lists backends
@@ -392,6 +411,7 @@ class Gateway:
                 if not b.healthy and time.monotonic() < b.backoff_until:
                     continue          # ejected + backing off: don't probe
             digest, digest_bits, digest_chars = None, 0, 0
+            probe_t0 = time.monotonic()
             try:
                 with urllib.request.urlopen(
                         b.url + "/healthz",
@@ -409,7 +429,10 @@ class Gateway:
                             pass     # plain-liveness backend: no digest
             except Exception:
                 ok = False
+            probe_latency = time.monotonic() - probe_t0
             with self._lock:
+                b.last_probe_latency_s = round(probe_latency, 6)
+                b.probe_failures = 0 if ok else b.probe_failures + 1
                 if ok:
                     now = time.monotonic()
                     if not b.healthy:
@@ -463,12 +486,27 @@ class Gateway:
                                                name="tpuserve-gateway-health")
         self._health_thread.start()
         port = self._httpd.server_address[1]
+        if self.config.canary_interval_s > 0:
+            from tpuserve.obs.canary import CanaryConfig, CanaryProber
+            # probe whatever address the listener actually binds — a
+            # gateway bound to a specific interface does not answer on
+            # loopback, and a prober dialing the wrong address would
+            # report a permanent false breach (and scale the fleet out)
+            probe_host = ("127.0.0.1"
+                          if self.config.host in ("", "0.0.0.0", "::")
+                          else self.config.host)
+            self.canary = CanaryProber(
+                f"http://{probe_host}:{port}",
+                CanaryConfig(interval_s=self.config.canary_interval_s))
+            self.canary.start()
         logger.info("gateway on :%d -> %s", port,
                     [b.url for b in self.backends])
         return port
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.canary is not None:
+            self.canary.stop()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -480,6 +518,69 @@ class Gateway:
                    "unserved_total": self.unserved_total}
         if self.tenants is not None:
             out["tenants"] = self.tenants.snapshot()
+        if self.canary is not None:
+            # breach state for the autoscaler's status poll (the same
+            # fetch that reads unserved_total) — scale out when the
+            # black-box view says a class stopped answering
+            out["canary"] = self.canary.snapshot()
+        return out
+
+    def slo_status(self) -> dict:
+        """Fleet SLO view (GET /gateway/slo): every healthy backend's
+        in-process burn-rate state + per-class SLI percentiles
+        (scraped off /debug/engine on demand), the per-backend probe
+        health, and the gateway's own black-box canary — the aggregate
+        ROADMAP item 4's multi-gateway tier reads, owned by no single
+        serving process."""
+        with self._lock:
+            backends = list(self.backends)
+
+        def scrape(b):
+            entry: dict = {
+                "healthy": b.healthy,
+                "probe_failures": b.probe_failures,
+                "last_probe_latency_s": b.last_probe_latency_s,
+            }
+            if b.healthy:
+                try:
+                    with urllib.request.urlopen(
+                            b.url + "/debug/engine",
+                            timeout=self.config.health_timeout_s) as r:
+                        snap = json.loads(r.read())
+                    entry["sli"] = snap.get("sli") or {}
+                    entry["slo"] = snap.get("slo") or {}
+                except Exception as e:
+                    entry["error"] = str(e) or type(e).__name__
+            return b.url, entry
+
+        # concurrent scrapes: one slow replica must cost ONE timeout,
+        # not N serialized ones, on an ops endpoint a dashboard polls
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(8, max(len(backends),
+                                                       1))) as pool:
+            results = list(pool.map(scrape, backends))
+        per_backend: dict = {}
+        firing: set = set()
+        sli_worst: dict = {}
+        for url, entry in results:
+            per_backend[url] = entry
+            firing.update((entry.get("slo") or {}).get("firing") or ())
+            for cls, kinds in (entry.get("sli") or {}).items():
+                for kind, pct in kinds.items():
+                    cur = sli_worst.setdefault(cls, {}).get(kind)
+                    if (cur is None or (pct.get("p95") or 0)
+                            > (cur.get("p95") or 0)):
+                        sli_worst[cls][kind] = pct
+        out = {
+            "backends": per_backend,
+            # union of in-process firing alerts across the fleet plus
+            # the worst per-class/kind SLI percentiles — "is any
+            # replica eating its budget" without a Prometheus query
+            "firing": sorted(firing),
+            "sli_worst": sli_worst,
+        }
+        if self.canary is not None:
+            out["canary"] = self.canary.snapshot()
         return out
 
 
@@ -510,8 +611,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def _relay(self, method: str):
         ctx = self.ctx
-        if self.path == "/gateway/status":
-            data = json.dumps(ctx.status()).encode()
+        if self.path in ("/gateway/status", "/gateway/slo"):
+            payload = (ctx.status() if self.path == "/gateway/status"
+                       else ctx.slo_status())
+            data = json.dumps(payload).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
@@ -545,11 +648,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         # retries reuse the same parse
         payload = (ctx._affinity_payload(body)
                    if method == "POST" and body else None)
+        # synthetic canary probes (tpuserve/obs/canary.py) are excluded
+        # from gateway tenancy exactly like server-side metering: the
+        # prober must not drain a tenant's bucket or bill its usage.
+        # Token-gated (TPUSERVE_CANARY_TOKEN) so a tenant can't tag its
+        # own traffic to dodge the rate limit.
+        from tpuserve.obs.canary import is_canary_header
+        canary = is_canary_header(self.headers.get("X-TPUServe-Canary"))
         # tenancy covers the COMPLETION routes only — the same set the
         # engine server meters, so moving the config between the two
         # documented layers never changes which traffic is limited
         # (embeddings don't fit the token-bucket cost model anyway)
-        if (ctx.tenants is not None and payload is not None
+        if (not canary and ctx.tenants is not None and payload is not None
                 and self.path in ("/v1/completions",
                                   "/v1/chat/completions")):
             from tpuserve.server.tenants import estimate_cost
@@ -605,11 +715,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 fwd = {"Content-Type": self.headers.get(
                     "Content-Type", "application/json")}
                 for h in ("Authorization", "X-SLO-Class", "traceparent",
-                          "tracestate"):
+                          "tracestate", "X-TPUServe-Canary"):
                     # tenant identity + SLO class must reach the engine
                     # server (per-tenant default class, exact metering);
                     # trace context passes through so an SDK-less gateway
-                    # still links the caller's trace to the server span
+                    # still links the caller's trace to the server span;
+                    # the canary tag rides along so the server excludes
+                    # probes from metering + SLI histograms too
                     if self.headers.get(h):
                         fwd[h] = self.headers[h]
                 if inject_cls:
@@ -728,6 +840,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(data)
             return
+        if self.path == "/metrics" and self.ctx.canary is not None:
+            # the embedded prober's black-box tpuserve_canary_* SLIs —
+            # the gateway's only metrics surface; without a prober the
+            # path relays to a backend like any other GET
+            data = self.ctx.canary.metrics.render()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         self._relay("GET")
 
     def do_POST(self):
@@ -752,6 +876,14 @@ def main(argv=None):
                     help="per-tenant token metering + rate limits for "
                          "the whole pool (server/tenants.py); default: "
                          "TPUSERVE_TENANTS env")
+    ap.add_argument("--canary-interval", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="run the embedded synthetic canary: one tagged "
+                         "tiny request per SLO class through this "
+                         "gateway every SECONDS (tpuserve/obs/"
+                         "canary.py); black-box tpuserve_canary_* "
+                         "SLIs on /metrics, breach state on "
+                         "/gateway/status.  0 = off")
     args = ap.parse_args(argv)
     if not args.backend and not (args.backends_file or args.backends_url):
         ap.error("need --backend, --backends-file, or --backends-url")
@@ -760,7 +892,8 @@ def main(argv=None):
                  GatewayConfig(host=args.host, port=args.port,
                                tenant_config=args.tenant_config,
                                backends_file=args.backends_file,
-                               backends_url=args.backends_url))
+                               backends_url=args.backends_url,
+                               canary_interval_s=args.canary_interval))
     port = gw.start()
     print(f"gateway listening on :{port}", flush=True)
     try:

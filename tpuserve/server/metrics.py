@@ -17,6 +17,19 @@ _TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.5, 1.0, 2.5,
                  5.0, 10.0)
 _ITL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 _DURATION_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# Per-SLO-class SLI bucket edges (the burn-rate engine's quantization
+# grid): PromQL can only evaluate a latency objective AT a bucket edge,
+# so every edge here is a legal objective threshold and
+# tpuserve/obs/objectives.py rejects thresholds between edges.  e2e
+# historically reused _DURATION_BUCKETS, whose first edge is 100ms —
+# blind exactly where a fast interactive class lives, which silently
+# flattened burn-rate math for any sub-100ms target (ISSUE 13 bucket
+# audit).  Edges are PINNED by tests/test_obs.py: changing them is an
+# objectives-compatibility decision, not a tuning tweak.
+_SLI_E2E_BUCKETS = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 120.0)
+SLI_BUCKETS = {"ttft": _TTFT_BUCKETS, "itl": _ITL_BUCKETS,
+               "e2e": _SLI_E2E_BUCKETS}
 
 
 class ServerMetrics:
@@ -224,6 +237,14 @@ class ServerMetrics:
             "replay; bounded per request by the preemption budget).  "
             "A subset of vllm_num_preemptions, which also counts "
             "decode-OOM evictions")
+        self.requests_failed = counter(
+            "tpuserve_requests_failed",
+            "Terminal engine-decided failures routed to clients other "
+            "than shed/poison (admission-deadline 504s, salvage-path "
+            "errors) — with shed + poisoned, the bad-event families "
+            "the availability SLO's PromQL twin reads, matching what "
+            "the in-process burn-rate evaluator counts "
+            "(tpuserve/obs/objectives.py)")
         self.brownout_level = gauge(
             "tpuserve_brownout_level",
             "Current graceful-degradation rung (0 normal, 1 spec off "
@@ -235,8 +256,10 @@ class ServerMetrics:
             "Admission queue delay per SLO class (slo_class= "
             "interactive|standard|batch): arrival to first prefill "
             "scheduling, fresh admissions only — the per-class SLI the "
-            "overload estimator steers the brownout ladder by",
-            ["model_name", "slo_class"], buckets=_DURATION_BUCKETS,
+            "overload estimator steers the brownout ladder by "
+            "(sub-100ms edges: an interactive queue should sit well "
+            "under the old 100ms first bucket)",
+            ["model_name", "slo_class"], buckets=_SLI_E2E_BUCKETS,
             registry=self.registry)
         # Flight-recorder SLIs (runtime/flight.py): the CLIENT-observable
         # latency contract per SLO class, measured at output delivery in
@@ -262,8 +285,10 @@ class ServerMetrics:
         self.e2e_class = Histogram(
             "tpuserve_e2e_seconds",
             "Client-observable end-to-end request latency per SLO "
-            "class (slo_class= label; submit to finish)",
-            ["model_name", "slo_class"], buckets=_DURATION_BUCKETS,
+            "class (slo_class= label; submit to finish).  Buckets "
+            "include sub-100ms edges (SLI_BUCKETS) so burn-rate math "
+            "resolves fast classes",
+            ["model_name", "slo_class"], buckets=_SLI_E2E_BUCKETS,
             registry=self.registry)
         self.flight_postmortems = counter(
             "tpuserve_flight_postmortems",
@@ -294,11 +319,85 @@ class ServerMetrics:
             "limit (Retry-After = time until the bucket refills "
             "enough)",
             ["model_name", "tenant"], registry=self.registry)
+        # SLO evaluation (tpuserve/obs): the in-process burn-rate engine
+        # runs off the same SLI stream the histograms above export, so a
+        # pod can report its own SLO state without a Prometheus in the
+        # loop (and the PromQL rules gen_alerts.py compiles from the
+        # same objectives registry are the fleet-level twin).
+        self.slo_burn_rate = Gauge(
+            "tpuserve_slo_burn_rate",
+            "Long-window error-budget burn rate per declared SLO "
+            "objective and alert window (objective= from "
+            "tpuserve/obs/objectives.py, window= fast|slow).  1.0 = "
+            "burning exactly the budget; the window's factor (e.g. "
+            "14.4 fast) is the firing threshold",
+            ["model_name", "objective", "window"], registry=self.registry)
+        self.slo_alerts_firing = gauge(
+            "tpuserve_slo_alerts_firing",
+            "SLO burn-rate alerts currently firing in-process (count "
+            "over objective x window pairs) — nonzero means this pod "
+            "is eating error budget fast enough to page, even if the "
+            "Prometheus stack is down")
+        self.slo_transitions = Counter(
+            "tpuserve_slo_alert_transitions",
+            "In-process burn-rate alert state transitions (state= "
+            "firing|resolved, objective=, window=) — the replay "
+            "backtester (tools/replay.py backtest) reproduces exactly "
+            "this sequence from a recorded incident",
+            ["model_name", "objective", "window", "state"],
+            registry=self.registry)
+        self.canary_requests = counter(
+            "tpuserve_canary_requests",
+            "Synthetic canary probes served by this pod (tagged "
+            "X-TPUServe-Canary; excluded from tenant metering and "
+            "every production SLI histogram — this counter is the "
+            "proof they still flow through the real path)")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,
                                     finished_reason=reason).inc()
         self.request_duration.observe(duration_s)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class CanaryMetrics:
+    """The synthetic prober's own registry (tpuserve/obs/canary.py):
+    black-box SLIs measured from OUTSIDE the serving process, per SLO
+    class, through whatever path the prober was pointed at (gateway ->
+    server -> engine in production).  Served from the gateway's
+    ``/metrics`` when its embedded prober is enabled, or from a
+    standalone prober process."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.probes = Counter(
+            "tpuserve_canary_probes",
+            "Synthetic probe requests attempted per SLO class "
+            "(slo_class= label) — black-box coverage; "
+            "absent(tpuserve_canary_probes_total) in the generated "
+            "rules catches a dead prober",
+            ["slo_class"], registry=self.registry)
+        self.failures = Counter(
+            "tpuserve_canary_failures",
+            "Probe requests that failed (non-200, malformed body, or "
+            "timed out) per SLO class — the numerator of the "
+            "black-box availability SLI",
+            ["slo_class"], registry=self.registry)
+        self.probe_latency = Histogram(
+            "tpuserve_canary_probe_latency_seconds",
+            "End-to-end wall latency of successful probes per SLO "
+            "class — the black-box twin of tpuserve_e2e_seconds, "
+            "measured through the full gateway->server->engine path",
+            ["slo_class"], buckets=_SLI_E2E_BUCKETS,
+            registry=self.registry)
+        self.breached = Gauge(
+            "tpuserve_canary_breached",
+            "1 while any SLO class has >= the configured consecutive "
+            "probe failures (0 otherwise) — the scale-out/eject "
+            "signal the autoscaler polls off /gateway/status",
+            registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

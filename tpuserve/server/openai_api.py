@@ -27,6 +27,7 @@ from tpuserve.runtime.slo import SLO_CLASSES, ShedError
 from tpuserve.server.metrics import ServerMetrics
 from tpuserve.server.runner import AsyncEngineRunner
 from tpuserve.server.tenants import TenantRegistry, estimate_cost
+from tpuserve.utils import env_flag
 
 logger = logging.getLogger("tpuserve.server")
 
@@ -74,6 +75,16 @@ class ServerConfig:
     # when this server is directly exposed — behind the gateway, enforce
     # there instead (one charge per request, not two).
     tenant_config: Optional[str] = None
+    # In-process SLO burn-rate evaluation (tpuserve/obs): the runner
+    # feeds the per-class SLI stream into a BurnRateEvaluator over the
+    # declared objectives and exports tpuserve_slo_* families; /debug/
+    # engine carries the firing state.  TPUSERVE_SLO_BURN=0 kills it.
+    slo_burn: bool = True
+    # Objectives override (tpuserve/obs/objectives.py): inline JSON
+    # list or a file path; None = TPUSERVE_SLO_OBJECTIVES env, else the
+    # registry defaults.  Validated at boot — a threshold off the
+    # pinned bucket edges fails the server, not the alert.
+    slo_objectives: Optional[str] = None
 
 
 def _num(body: dict, key: str, default, cast):
@@ -304,6 +315,16 @@ class OpenAIServer:
         # under 'default' and resolves LoRA adapters as tenants.
         self.tenants = (TenantRegistry.load(self.config.tenant_config)
                         or TenantRegistry())
+        # In-process SLO evaluation (tpuserve/obs/burnrate.py): the
+        # runner owns the evaluator (single-threaded feed + evaluate on
+        # the loop thread, engine-clock timestamps so a replay-driven
+        # engine backtests the identical code).  Boot-validated: bad
+        # objectives must fail the pod, not silently never alert.
+        if self.config.slo_burn and env_flag("TPUSERVE_SLO_BURN"):
+            from tpuserve.obs import BurnRateEvaluator, load_objectives
+            self.runner.slo_eval = BurnRateEvaluator(
+                load_objectives(self.config.slo_objectives),
+                clock=self.runner._clock)
         self.tpu_exporter = None
         if self.config.tpu_metrics:
             try:
@@ -670,6 +691,12 @@ class _Handler(BaseHTTPRequestHandler):
         # tpuserve_cold_start_seconds
         out["cold_start_s"] = getattr(self.ctx.runner, "cold_start_s",
                                       None)
+        # in-process SLO burn-rate state (tpuserve/obs): the loop-thread-
+        # published snapshot — firing alerts + per-objective burn rates
+        # as plain scalars, aggregated fleet-wide by /gateway/slo
+        ev = getattr(self.ctx.runner, "slo_eval", None)
+        if ev is not None:
+            out["slo"] = dict(ev.last_state)
         return out
 
     def _emit_engine_spans(self, rids) -> None:
@@ -813,9 +840,22 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # ---- multi-tenant + SLO class (server/tenants.py, runtime/slo.py)
         ctx = self.ctx
-        tenant = ctx.tenants.resolve(self.headers.get("Authorization"),
-                                     body.get("model"),
-                                     tuple(ctx.lora_names or ()))
+        # Synthetic canary probes (tpuserve/obs/canary.py) ride the real
+        # serving path but are excluded from tenant metering (no tenant
+        # resolved, no charge/settle) and from the affinity digest —
+        # the identical tiny prompt from every probe would otherwise
+        # steer the gateway's cache-aware routing.  The SLO class still
+        # applies: a canary must queue like the class it probes.
+        # Because the tag bypasses rate limits, deployments with
+        # tenancy set TPUSERVE_CANARY_TOKEN — a bare "1" from a client
+        # is then just normal (billed, SLI-counted) traffic.
+        from tpuserve.obs.canary import is_canary_header
+        canary = is_canary_header(self.headers.get("X-TPUServe-Canary"))
+        if canary:
+            params = dataclasses.replace(params, canary=True)
+        tenant = None if canary else ctx.tenants.resolve(
+            self.headers.get("Authorization"), body.get("model"),
+            tuple(ctx.lora_names or ()))
         self._tenant = tenant
         if body.get("slo_class") is None:
             # body field > X-SLO-Class header > tenant default > standard
@@ -828,7 +868,7 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 params = dataclasses.replace(params, slo_class=cls)
         cost = estimate_cost(body)
-        retry = ctx.tenants.charge(tenant, cost)
+        retry = None if canary else ctx.tenants.charge(tenant, cost)
         if retry is not None:
             ctx.metrics.tenant_rate_limited.labels(
                 model_name=ctx.model_name, tenant=tenant).inc()
@@ -837,14 +877,15 @@ class _Handler(BaseHTTPRequestHandler):
                         "rate_limit_exceeded",
                         headers={"Retry-After": str(int(retry) + 1)})
             return
-        self._charged = cost
+        self._charged = None if canary else cost
         # digest the affinity key only after every API-layer validation
         # has passed: a 400'd request caches no KV and must not steer the
         # gateway here.  (Engine-side rejects — oversize prompt, 503
         # backpressure — can still note a key; the bit is advisory and
         # ages out of the LRU window.)
-        from tpuserve.server.kv_digest import affinity_key
-        self.ctx.kv_digest.note(affinity_key(body))
+        if not canary:
+            from tpuserve.server.kv_digest import affinity_key
+            self.ctx.kv_digest.note(affinity_key(body))
         kwargs = ({"prompt_token_ids": prompt} if isinstance(prompt, list)
                   else {"prompt": prompt})
         # multi-LoRA routing (vLLM semantics): "model" naming a loaded
@@ -1882,6 +1923,16 @@ def main(argv=None):
                          "(server/tenants.py); inline JSON or a file "
                          "path (default: TPUSERVE_TENANTS).  Behind the "
                          "gateway, configure limits there instead")
+    ap.add_argument("--no-slo-burn", action="store_true",
+                    help="disable the in-process SLO burn-rate "
+                         "evaluator (tpuserve/obs; TPUSERVE_SLO_BURN=0 "
+                         "is the env twin)")
+    ap.add_argument("--slo-objectives", default=None,
+                    metavar="JSON|PATH",
+                    help="SLO objectives override (tpuserve/obs/"
+                         "objectives.py); inline JSON list or a file "
+                         "path (default: TPUSERVE_SLO_OBJECTIVES, else "
+                         "the registry defaults).  Validated at boot")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=25.0,
                     help="graceful-drain budget on SIGTERM, seconds; keep "
@@ -1999,6 +2050,8 @@ def main(argv=None):
         host=args.host, port=args.port, chat_template=chat_template,
         tool_call_parser=args.tool_call_parser, warmup_embed=warmup_embed,
         tenant_config=args.tenant_config,
+        slo_burn=not args.no_slo_burn,
+        slo_objectives=args.slo_objectives,
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)

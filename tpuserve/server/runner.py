@@ -19,6 +19,7 @@ from typing import Optional, Sequence, Union
 from tpuserve.runtime.clock import MONOTONIC
 from tpuserve.runtime.engine import Engine
 from tpuserve.runtime.request import RequestOutput, RequestState, SamplingParams
+from tpuserve.runtime.slo import ShedError
 
 logger = logging.getLogger("tpuserve.server")
 
@@ -146,6 +147,13 @@ class AsyncEngineRunner:
         # token leaves); /healthz + /debug/engine report it and the
         # autoscaler's probe feeds it into tpuserve_cold_start_seconds
         self.cold_start_s: Optional[float] = None
+        # In-process SLO burn-rate evaluation (tpuserve/obs/burnrate.py):
+        # set by the server when enabled.  Fed and evaluated ONLY on the
+        # loop thread (observe at delivery, evaluate throttled in
+        # _update_gauges), timestamps through the engine clock seam so a
+        # replay-driven runner evaluates in virtual time.
+        self.slo_eval = None
+        self._slo_eval_last: Optional[float] = None
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -288,6 +296,14 @@ class AsyncEngineRunner:
                     prompt=msg.prompt, prompt_token_ids=msg.prompt_token_ids,
                     params=msg.params, request_id=msg.request_id, **kw)
             except Exception as e:           # invalid request: report, don't die
+                if (self.slo_eval is not None
+                        and isinstance(e, (MemoryError, ShedError))
+                        and not getattr(msg.params, "canary", False)):
+                    # intake shed/backpressure is unavailability the
+                    # client saw; invalid-request errors are not
+                    self.slo_eval.observe_outcome(
+                        getattr(msg.params, "slo_class", "standard"),
+                        False)
                 msg.assigned_id = msg.request_id or "rejected"
                 msg.rid_event.set()
                 msg.out_queue.put(e)
@@ -308,6 +324,16 @@ class AsyncEngineRunner:
         req = getattr(self.engine, "requests", {}).get(rid)
         return getattr(getattr(req, "params", None), "slo_class", "standard")
 
+    def _sli_ident(self, rid: str) -> tuple:
+        """(slo_class, canary) for a live request — canary probes
+        (tpuserve/obs/canary.py) are excluded from every production SLI
+        histogram and the burn-rate stream; they get their own
+        black-box families from the prober side."""
+        req = getattr(self.engine, "requests", {}).get(rid)
+        p = getattr(req, "params", None)
+        return (getattr(p, "slo_class", "standard"),
+                getattr(p, "canary", False))
+
     def _route_outputs(self, outputs: list[RequestOutput]) -> None:
         now = self._clock.monotonic()
         # every inner engine's recorder gets the SLIs: a disagg pod's
@@ -324,15 +350,15 @@ class AsyncEngineRunner:
                 logger.info("cold start: first token %.3fs after boot",
                             self.cold_start_s)
             q = self._out_queues.get(out.request_id)
-            if self.metrics or flights:
-                cls = self._slo_class_of(out.request_id)
+            if self.metrics or flights or self.slo_eval is not None:
+                cls, canary = self._sli_ident(out.request_id)
                 last = self._last_token_time.get(out.request_id)
                 if self.metrics:
                     self.metrics.generation_tokens.inc(
                         len(out.new_token_ids))
                 label = dict(model_name=getattr(self.metrics, "model_name",
                                                 ""), slo_class=cls)
-                if last is not None:
+                if last is not None and not canary:
                     if out.num_output_tokens == 1:
                         ttft = now - self._req_started.get(
                             out.request_id, now)
@@ -342,6 +368,8 @@ class AsyncEngineRunner:
                                 **label).observe(ttft)
                         for fl in flights:
                             fl.note_sli(cls, "ttft", ttft)
+                        if self.slo_eval is not None:
+                            self.slo_eval.observe(cls, "ttft", ttft)
                     elif not out.from_prefill:
                         # A from_prefill emission with output tokens > 1 is a
                         # re-prefill after preemption: its gap is queue +
@@ -352,19 +380,37 @@ class AsyncEngineRunner:
                                 **label).observe(now - last)
                         for fl in flights:
                             fl.note_sli(cls, "itl", now - last)
+                        if self.slo_eval is not None:
+                            self.slo_eval.observe(cls, "itl", now - last)
                 self._last_token_time[out.request_id] = now
             if q is not None:
                 q.put(out)
             if out.finished:
-                if self.metrics or flights:
+                if self.metrics or flights or self.slo_eval is not None:
                     started = self._req_started.pop(out.request_id, now)
                     reason = out.finish_reason.value if out.finish_reason else "stop"
-                    if self.metrics:
-                        self.metrics.observe_finish(reason, now - started)
-                        self.metrics.e2e_class.labels(
-                            **label).observe(now - started)
-                    for fl in flights:
-                        fl.note_sli(cls, "e2e", now - started)
+                    if canary:
+                        # a served canary still proves the path works —
+                        # counted in its own family, absent everywhere
+                        # a tenant or an SLI reader would see it
+                        if self.metrics:
+                            self.metrics.canary_requests.inc()
+                            self.metrics.request_success.labels(
+                                model_name=self.metrics.model_name,
+                                finished_reason=reason).inc()
+                    else:
+                        if self.metrics:
+                            self.metrics.observe_finish(reason,
+                                                        now - started)
+                            self.metrics.e2e_class.labels(
+                                **label).observe(now - started)
+                        for fl in flights:
+                            fl.note_sli(cls, "e2e", now - started)
+                        if self.slo_eval is not None:
+                            self.slo_eval.observe(cls, "e2e",
+                                                  now - started)
+                            self.slo_eval.observe_outcome(
+                                cls, reason in ("stop", "length"))
                 self._last_token_time.pop(out.request_id, None)
                 # NOTE: the request record stays in engine.requests — the
                 # caller that submitted claims (pops) it for usage/logprobs.
@@ -434,6 +480,21 @@ class AsyncEngineRunner:
         of salvage: a poisoned batch costs one request, not a batch.
         ``exc`` overrides the default RuntimeError so typed rejections
         (ShedError -> 429, TimeoutError -> 504) keep their HTTP status."""
+        if self.slo_eval is not None or self.metrics:
+            # availability SLI: every engine-decided terminal error
+            # (shed, deadline expiry, salvage exhaustion, poison) is a
+            # bad event for the burn-rate engine — read BEFORE the
+            # abort drops the request record
+            cls, canary = self._sli_ident(rid)
+            if self.slo_eval is not None and not canary:
+                self.slo_eval.observe_outcome(cls, False)
+            if (self.metrics and not canary and not poisoned
+                    and not isinstance(exc, ShedError)):
+                # shed and poison have their own counters; this family
+                # covers the rest (deadline 504s, salvage errors) so
+                # the availability PromQL twin sees the same bad
+                # events the in-process evaluator does
+                self.metrics.requests_failed.inc()
         try:
             self.engine.abort_request(rid)
         except Exception:
@@ -692,7 +753,47 @@ class AsyncEngineRunner:
         self._set_admission_filter(None)
         return True
 
+    def _evaluate_slo(self) -> None:
+        """Advance the in-process burn-rate engine (loop thread; at most
+        once per engine-clock second — the window math scans buckets)
+        and export its state: transitions counter, per-objective burn
+        gauge, firing count."""
+        ev = self.slo_eval
+        if ev is None:
+            return
+        from tpuserve.obs.burnrate import EVAL_INTERVAL_S
+        now = self._clock.monotonic()
+        if (self._slo_eval_last is not None
+                and now - self._slo_eval_last < EVAL_INTERVAL_S):
+            return
+        self._slo_eval_last = now
+        transitions = ev.evaluate()
+        for tr in transitions:
+            logger.warning("SLO burn-rate alert %s: %s/%s "
+                           "(burn %.1fx long / %.1fx short)",
+                           tr["state"].upper(), tr["objective"],
+                           tr["window"], tr["burn_long"],
+                           tr["burn_short"])
+        if not self.metrics:
+            return
+        model = self.metrics.model_name
+        for tr in transitions:
+            self.metrics.slo_transitions.labels(
+                model_name=model, objective=tr["objective"],
+                window=tr["window"], state=tr["state"]).inc()
+        # reuse the snapshot evaluate() just published instead of
+        # re-scanning every window's bucket deque a second time
+        state = ev.last_state
+        for key, (burn_long, _short) in state.get("burn", {}).items():
+            name, _, window = key.rpartition("/")
+            self.metrics.slo_burn_rate.labels(
+                model_name=model, objective=name,
+                window=window).set(burn_long)
+        self.metrics.slo_alerts_firing.set(
+            len(state.get("firing", ())))
+
     def _update_gauges(self) -> None:
+        self._evaluate_slo()
         if not self.metrics:
             return
         eng = self.engine
