@@ -1,7 +1,9 @@
-"""tpulint in tier-1: the shipped tree lints clean, and each of the five
+"""tpulint in tier-1: the shipped tree lints clean, and each of the seven
 passes provably catches a seeded violation of its bug class — including a
-re-introduction of the PR-3 watchdog cross-thread mutation and a seeded
-KV-block leak (the acceptance criteria's two named regressions).
+re-introduction of the PR-3 watchdog cross-thread mutation, a seeded
+KV-block leak, and (P6) a renamed ``/debug/engine`` control scalar read
+by the REAL, now-stale ``autoscale/signals.py`` — the historical drift
+class the protocol pass exists for.
 
 Fixtures run through ``run_lint_sources`` — the exact pipeline the CLI
 uses, suppression handling included — so a fixture that stops firing
@@ -796,3 +798,415 @@ def test_fault_site_registry_matches_engine():
     # the registry tpulint checks IS the one the engine parses specs with
     from tpuserve.runtime.faults import SITES
     assert tuple(FAULT_SITES) == tuple(SITES)
+
+
+# ---------------------------------------------------------------------
+# P6 protocol consistency — incl. the historical /debug/engine drift
+# ---------------------------------------------------------------------
+
+# a minimal /debug/engine producer half: the snapshot builder plus the
+# engine's per-cycle note_control publication (whose KEYWORDS are the
+# published control-scalar names)
+P6_PRODUCER = """
+    class FlightRecorder:
+        def engine_snapshot(self):
+            return {"enabled": True, "engines": [], "sli": {},
+                    "control": dict(self._control),
+                    "cold_start_s": None,
+                    "queue_delay_ewma": {}}
+
+    class Engine:
+        def _publish(self):
+            self.flight.note_control(
+                {SCALAR}=self._slo.level,
+                waiting=self.scheduler.num_waiting,
+                running=len(self.scheduler.running))
+"""
+
+P6_FIXTURE_ENDPOINTS = {
+    "producer_files": [], "consumer_files": [], "header_files": [],
+    "extra_paths": [],
+    "endpoints": {"/debug/engine": {
+        "producers": [
+            "tpuserve/runtime/flight.py::FlightRecorder.engine_snapshot",
+            "tpuserve/runtime/engine.py::call:note_control"],
+        "consumers": [
+            "tpuserve/autoscale/signals.py::_merge_engines",
+            "tpuserve/autoscale/signals.py::signals_from_debug"],
+    }},
+}
+
+
+def _p6_lint_with_real_signals(scalar: str):
+    """Lint a fixture producer publishing ``scalar`` against the REAL
+    autoscale/signals.py reader — the shipping consumer goes stale the
+    moment the engine renames a control scalar."""
+    with open(os.path.join(REPO, "tpuserve", "autoscale",
+                           "signals.py")) as f:
+        signals_src = f.read()
+    producer = textwrap.dedent(P6_PRODUCER).replace("{SCALAR}", scalar)
+    return run_lint_sources(
+        {"tpuserve/runtime/flight.py": producer,
+         "tpuserve/runtime/engine.py": producer,
+         "tpuserve/autoscale/signals.py": signals_src},
+        Config({**DEFAULT_CONFIG, "protocol": P6_FIXTURE_ENDPOINTS}),
+        repo_root=REPO, passes=["protocol"])
+
+
+def test_p6_catches_renamed_control_scalar_stale_signals_reader():
+    """The re-introduced historical drift: the engine renames the
+    brownout control scalar, the real signals.py reader still indexes
+    the old name — json-key-unproduced on the stale read, and the new
+    name surfaces as a write-only dead key."""
+    findings = _p6_lint_with_real_signals("brownout_lvl")
+    got = rules(findings)
+    assert "json-key-unproduced" in got
+    unproduced = [f for f in findings if f.rule == "json-key-unproduced"]
+    assert {f.file for f in unproduced} == \
+        {"tpuserve/autoscale/signals.py"}
+    assert any("brownout_level" in f.message for f in unproduced)
+    dead = [f for f in findings if f.rule == "json-key-dead"]
+    assert any("brownout_lvl" in f.message for f in dead)
+    assert all(f.severity == "warning" for f in dead)
+
+
+def test_p6_matching_control_scalar_is_clean():
+    findings = _p6_lint_with_real_signals("brownout_level")
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_p6_endpoint_unserved_and_dead_surface():
+    producer = """
+        class Handler:
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._metrics()
+                elif self.path == "/debug/extra":
+                    self._extra()
+    """
+    consumer = """
+        import urllib.request
+
+        def scrape(base):
+            with urllib.request.urlopen(base + "/debug/engine") as r:
+                return r.read()
+    """
+    spec = {**P6_FIXTURE_ENDPOINTS,
+            "producer_files": ["tpuserve/server/openai_api.py"],
+            "consumer_files": ["tpuserve/autoscale/signals.py"],
+            "endpoints": {}}
+    findings = run_lint_sources(
+        {"tpuserve/server/openai_api.py": textwrap.dedent(producer),
+         "tpuserve/autoscale/signals.py": textwrap.dedent(consumer)},
+        Config({**DEFAULT_CONFIG, "protocol": spec}),
+        repo_root=REPO, passes=["protocol"])
+    got = rules(findings)
+    # /debug/engine dialed but only /metrics + /debug/extra served
+    assert "endpoint-unserved" in got
+    # /debug/extra served, never dialed, not operator surface
+    dead = [f for f in findings if f.rule == "endpoint-dead"]
+    assert any("/debug/extra" in f.message for f in dead)
+    assert all(f.severity == "warning" for f in dead)
+    # /metrics is dialed by the real deploy-layer... not here: also dead
+    # but for the K8s scrape-annotation reason it's exercised on the
+    # real tree (tree-clean test); this fixture only pins the warning
+
+
+def test_p6_proto_ok_suppression_and_prefix_routes():
+    producer = """
+        class Handler:
+            def do_GET(self):
+                if self.path.startswith("/debug/requests/"):
+                    self._req()
+    """
+    consumer = """
+        import urllib.request
+
+        def scrape(base, rid):
+            url = base + "/debug/requests/" + rid      # prefix-served
+            # tpulint: proto-ok(served by the out-of-repo peer)
+            peer = base + "/peer-only/endpoint"
+            return url, peer
+    """
+    spec = {**P6_FIXTURE_ENDPOINTS,
+            "producer_files": ["tpuserve/server/openai_api.py"],
+            "consumer_files": ["tpuserve/autoscale/signals.py"],
+            "endpoints": {}}
+    findings = run_lint_sources(
+        {"tpuserve/server/openai_api.py": textwrap.dedent(producer),
+         "tpuserve/autoscale/signals.py": textwrap.dedent(consumer)},
+        Config({**DEFAULT_CONFIG, "protocol": spec}),
+        repo_root=REPO, passes=["protocol"])
+    # the prefix route serves the first dial; the peer-only dial is
+    # suppressed with a reasoned proto-ok — nothing is left
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_p6_header_consistency_both_directions():
+    reader = """
+        class Handler:
+            def do_POST(self):
+                ghost = self.headers.get("X-Ghost-Header")
+                canary = self.headers.get("X-Probe")
+    """
+    writer = """
+        import urllib.request
+
+        def probe(url):
+            return urllib.request.Request(url, headers={
+                "X-Probe": "1", "X-Write-Only": "1"})
+    """
+    spec = {**P6_FIXTURE_ENDPOINTS, "endpoints": {},
+            "header_files": ["tpuserve/server/openai_api.py",
+                             "tpuserve/obs/canary.py"]}
+    findings = run_lint_sources(
+        {"tpuserve/server/openai_api.py": textwrap.dedent(reader),
+         "tpuserve/obs/canary.py": textwrap.dedent(writer)},
+        Config({**DEFAULT_CONFIG, "protocol": spec}),
+        repo_root=REPO, passes=["protocol"])
+    unset = [f for f in findings if f.rule == "header-unset"]
+    assert [f.severity for f in unset] == ["error"]
+    assert "X-Ghost-Header" in unset[0].message
+    unread = [f for f in findings if f.rule == "header-unread"]
+    assert any("X-Write-Only" in f.message for f in unread)
+    assert all(f.severity == "warning" for f in unread)
+
+
+def test_p6_gateway_forward_loop_counts_as_read_and_set():
+    """The gateway's ``for h in (...): fwd[h] = self.headers[h]``
+    forwarding idiom must register every constant as both a read and a
+    set — otherwise the real tree could never lint clean."""
+    from tools.tpulint.interface import headers_in
+    import ast as _ast
+    src = textwrap.dedent("""
+        def relay(self):
+            fwd = {}
+            for h in ("X-SLO-Class", "traceparent"):
+                if self.headers.get(h):
+                    fwd[h] = self.headers[h]
+    """)
+    reads, writes = headers_in(
+        "f.py", _ast.parse(src),
+        lambda n: n.startswith("X-") or n == "traceparent")
+    assert {s.name for s in reads} == {"X-SLO-Class", "traceparent"}
+    assert {s.name for s in writes} == {"X-SLO-Class", "traceparent"}
+
+
+# ---------------------------------------------------------------------
+# P7 config-surface drift
+# ---------------------------------------------------------------------
+
+#: fixture isolation for P7: no on-disk extra sources, and no real
+#: README (whose tables would be judged against the fixture's empty
+#: flag universe).  Fixtures that WANT the README override readme back.
+P7_NO_EXTRAS = {"extra_paths": [], "readme": "_no_readme_.md"}
+
+
+def test_p7_ghost_env_var_is_unreachable_and_undocumented():
+    findings = lint_snippet("""
+        import os
+
+        KNOB = os.environ.get("TPUSERVE_GHOST_KNOB", "0")
+    """, passes=["config-surface"],
+        extra={"config_surface": {**P7_NO_EXTRAS, "readme": "README.md"}})
+    got = rules(findings)
+    # no DeployConfig field / manifest env reaches it, and README never
+    # mentions it — both directions fire on the same read site
+    assert "env-var-unreachable" in got
+    assert "env-var-undocumented" in got
+
+
+def test_p7_debug_only_registry_exempts_with_reason():
+    findings = lint_snippet("""
+        import os
+
+        KNOB = os.environ.get("TPUSERVE_GHOST_KNOB", "0")
+    """, passes=["config-surface"],
+        extra={"config_surface": {
+            **P7_NO_EXTRAS,
+            "env_debug_only": {
+                **DEFAULT_CONFIG["config_surface"]["env_debug_only"],
+                "TPUSERVE_GHOST_KNOB": "fixture-only knob"}}})
+    assert findings == []
+
+
+def test_p7_config_ok_suppression():
+    findings = lint_snippet("""
+        import os
+
+        # tpulint: config-ok(fixture: reachability demoed elsewhere)
+        KNOB = os.environ.get("TPUSERVE_GHOST_KNOB", "0")
+    """, passes=["config-surface"],
+        extra={"config_surface": P7_NO_EXTRAS})
+    assert findings == []
+
+
+def test_p7_readme_doc_drift_both_kinds(tmp_path):
+    """A README table row naming a removed env var or flag is drift —
+    the P5 enforcement style applied to the config surface."""
+    (tmp_path / "README.md").write_text(
+        "| Key | Default |\n|---|---|\n"
+        "| `TPUSERVE_REMOVED_KNOB` | gone |\n"
+        "| `--removed-flag` | gone |\n")
+    findings = run_lint_sources(
+        {"tpuserve/x.py": "import os\n"},
+        Config(dict(DEFAULT_CONFIG)), repo_root=str(tmp_path),
+        passes=["config-surface"])
+    got = rules(findings)
+    assert "env-var-doc-drift" in got
+    assert "flag-doc-drift" in got
+    # README-anchored findings can't carry a Python suppression comment
+    # — --json must not advertise one
+    assert all(not f.as_dict()["suppressible"] for f in findings
+               if f.file.endswith(".md"))
+
+
+def test_p7_deploy_field_unused():
+    config_py = """
+        import dataclasses
+
+        @dataclasses.dataclass
+        class DeployConfig:
+            namespace: str = "tpu-serve"
+            ghost_field_nobody_reads: int = 0
+    """
+    manifests_py = """
+        def build(cfg):
+            return {"metadata": {"namespace": cfg.namespace}}
+    """
+    findings = run_lint_sources(
+        {"tpuserve/provision/config.py": textwrap.dedent(config_py),
+         "tpuserve/provision/manifests.py": textwrap.dedent(manifests_py)},
+        Config(dict(DEFAULT_CONFIG)), repo_root=REPO,
+        passes=["config-surface"])
+    unused = [f for f in findings if f.rule == "deploy-field-unused"]
+    assert len(unused) == 1
+    assert "ghost_field_nobody_reads" in unused[0].message
+    assert unused[0].file == "tpuserve/provision/config.py"
+
+
+def test_p7_env_shell_registry_staleness():
+    findings = lint_snippet("x = 1\n", passes=["config-surface"],
+                            extra={"config_surface": {
+                                **P7_NO_EXTRAS,
+                                "env_shell": {"TPUSERVE_NOT_IN_SCRIPT":
+                                              "tools/tpu_watch.sh"}}})
+    assert rules(findings) == ["env-shell-stale"]
+
+
+def test_p7_shipping_slo_burn_is_reachable():
+    """The drift P7 found on landing, pinned fixed: TPUSERVE_SLO_BURN
+    is now backed by DeployConfig.slo_burn and the manifests emit it."""
+    import dataclasses as _dc
+    from tpuserve.provision.config import DeployConfig
+    from tpuserve.provision.manifests import _engine_container
+    assert any(f.name == "slo_burn" for f in _dc.fields(DeployConfig))
+    cfg = DeployConfig(provider="local", slo_burn=False)
+    env = {e["name"]: e.get("value")
+           for e in _engine_container(cfg)["env"]}
+    assert env.get("TPUSERVE_SLO_BURN") == "0"
+    cfg_on = DeployConfig(provider="local")
+    env_on = {e["name"] for e in _engine_container(cfg_on)["env"]}
+    assert "TPUSERVE_SLO_BURN" not in env_on
+
+
+# ---------------------------------------------------------------------
+# CLI surface: --explain, --json fields, and the shared AST cache
+# ---------------------------------------------------------------------
+
+def test_cli_explain_rule_and_pass(capsys):
+    # in-process through the real CLI entry (subprocess start-up would
+    # re-pay interpreter+import cost three times for the same coverage)
+    from tools.tpulint.__main__ import main as cli_main
+    for code, want in (("json-key-unproduced", "proto-ok"),
+                       ("config-surface", "config-ok")):
+        assert cli_main(["--explain", code]) == 0
+        assert want in capsys.readouterr().out   # suppression syntax
+    assert cli_main(["--explain", "bogus"]) == 2
+    assert "unknown pass or rule" in capsys.readouterr().err
+
+
+def test_json_findings_carry_pass_and_suppressible():
+    findings = lint_snippet("""
+        import os
+
+        KNOB = os.environ.get("TPUSERVE_GHOST_KNOB", "0")
+        y = 1  # tpulint: config-ok
+    """, passes=["config-surface"],
+        extra={"config_surface": P7_NO_EXTRAS})
+    by_rule = {f.rule: f.as_dict() for f in findings}
+    lint = by_rule["env-var-unreachable"]
+    assert lint["pass"] == "config-surface" and lint["suppressible"]
+    core = by_rule["suppression-missing-reason"]
+    assert core["pass"] == "core" and not core["suppressible"]
+
+
+def test_suppression_honored_in_disk_loaded_files(tmp_path):
+    """P6/P7 anchor findings in files they load from disk (tools/,
+    bench.py) — a reasoned per-line tag there must suppress exactly like
+    in the lint set, or the documented escape hatch is a lie."""
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    src = ("import os\n\n"
+           "# tpulint: config-ok(fixture: documented in the tool's "
+           "--help)\n"
+           'X = os.environ.get("TPUSERVE_DISK_ONLY_KNOB")\n')
+    (tools / "knob.py").write_text(src)
+    (tmp_path / "README.md").write_text("no env vars documented here\n")
+    cfg = Config({**DEFAULT_CONFIG, "config_surface": {
+        **DEFAULT_CONFIG["config_surface"], "env_shell": {}}})
+    findings = run_lint_sources({}, cfg, repo_root=str(tmp_path),
+                                passes=["config-surface"])
+    assert findings == []
+    # negative control: the tag (not an extraction gap) does the work
+    (tools / "knob.py").write_text(src.replace(
+        "# tpulint: config-ok(fixture: documented in the tool's "
+        "--help)\n", ""))
+    from tools.tpulint.core import _AST_CACHE  # content-keyed: no stale
+    assert _AST_CACHE is not None
+    findings = run_lint_sources({}, cfg, repo_root=str(tmp_path),
+                                passes=["config-surface"])
+    assert "env-var-undocumented" in rules(findings)
+
+
+def test_p7_tools_read_does_not_mask_engine_unreachability():
+    """A var read in BOTH bench/tools and tpuserve/ is judged by its
+    engine-side site — a tools read (sorted first) must not swallow the
+    reachability rule."""
+    read = 'import os\nX = os.environ.get("TPUSERVE_GHOST_KNOB")\n'
+    findings = run_lint_sources(
+        {"tools/a.py": read, "tpuserve/b.py": read},
+        Config({**DEFAULT_CONFIG, "config_surface": P7_NO_EXTRAS}),
+        repo_root=REPO, passes=["config-surface"])
+    unreach = [f for f in findings if f.rule == "env-var-unreachable"]
+    assert [f.file for f in unreach] == ["tpuserve/b.py"]
+
+
+def test_p6_keys_read_skips_environ_and_header_receivers():
+    """A consumer function reading os.environ or request headers must
+    not turn those constant keys into payload-contract reads."""
+    from tools.tpulint.interface import keys_read
+    import ast as _ast
+    src = textwrap.dedent("""
+        import os
+
+        def consume(payload, self):
+            a = payload.get("real_key")
+            b = os.environ.get("TPUSERVE_NOT_A_PAYLOAD_KEY")
+            c = self.headers.get("X-Not-A-Payload-Key")
+            d = self.headers["X-Also-Not"]
+            return a, b, c, d
+    """)
+    got = keys_read({"f.py": (src, _ast.parse(src))}, ["f.py::consume"])
+    assert set(got) == {"real_key"}
+
+
+def test_ast_cache_is_shared_across_runs():
+    from tools.tpulint.core import cached_parse
+    src = "x = 1\n"
+    assert cached_parse(src) is cached_parse(src)
+    # and the parse pipeline uses it: same source, same tree object
+    from tools.tpulint.core import parse_sources
+    t1 = parse_sources({"a.py": src})[0]["a.py"][1]
+    t2 = parse_sources({"b.py": src})[0]["b.py"][1]
+    assert t1 is t2

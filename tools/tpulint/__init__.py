@@ -1,7 +1,7 @@
 """tpulint: repo-native static analysis for tpuserve engine invariants.
 
-Five AST-based passes over ``tpuserve/``, each encoding a bug class that a
-generic linter cannot see because it is a *property of this engine's
+Seven AST-based passes over ``tpuserve/``, each encoding a bug class that
+a generic linter cannot see because it is a *property of this engine's
 design*, not of Python:
 
 - ``host-sync`` (P1): host synchronization (``jax.device_get`` /
@@ -21,8 +21,20 @@ design*, not of Python:
 - ``metrics`` (P5): every metric registered in ``server/metrics.py`` is
   incremented somewhere and documented in README.md, and the README
   tables name only real metric families.
+- ``protocol`` (P6): the control-plane wire protocol between server,
+  gateway, autoscaler and provisioner — every endpoint a consumer dials
+  is served, every JSON key a consumer indexes is written by that
+  endpoint's payload builders, every header read is set by a peer (and
+  the reverse directions are dead-surface warnings).
+- ``config-surface`` (P7): the configuration surface — every
+  ``TPUSERVE_*`` read is reachable from a DeployConfig field (or
+  registered debug-only), every DeployConfig field lands in a
+  provision-layer manifest, and the README flag tables agree with the
+  argparse/env surface both directions.
 
-Run: ``python -m tools.tpulint [paths...] [--json]``.
+Run: ``python -m tools.tpulint [paths...] [--json]``;
+``--explain CODE`` prints a pass's (or one rule's) text and its
+suppression-tag syntax.
 Suppress a finding with a reasoned comment on (or one line above) the
 flagged line::
 
@@ -43,4 +55,4 @@ __all__ = ["Config", "Finding", "collect_files", "load_config", "run_lint",
            "run_lint_sources", "PASS_NAMES"]
 
 PASS_NAMES = ("host-sync", "thread-ownership", "kv-leak", "pallas",
-              "metrics")
+              "metrics", "protocol", "config-surface")

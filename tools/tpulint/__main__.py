@@ -1,8 +1,11 @@
 """CLI: ``python -m tools.tpulint [paths...] [--json] [--passes ...]``.
 
 Exit status: 0 = clean, 1 = findings at severity error, 2 = usage error.
-Findings at severity "warning" (per-pass via ``[tool.tpulint.severity]``)
-print but do not fail the run.
+Findings at severity "warning" (per-pass via ``[tool.tpulint.severity]``
+and the protocol pass's dead-surface rules) print but do not fail the
+run.  ``--explain CODE`` (a pass name or a rule id) prints the rule
+text and the suppression-tag syntax; ``--json`` findings carry
+``pass``/``suppressible`` fields for downstream filters.
 """
 
 from __future__ import annotations
@@ -12,7 +15,38 @@ import json
 import sys
 
 from tools.tpulint import PASS_NAMES
-from tools.tpulint.core import find_repo_root, load_config, run_lint
+from tools.tpulint.core import (_pass_modules, find_repo_root, load_config,
+                                run_lint)
+
+
+def explain(code: str) -> int:
+    """Print a pass's (or a single rule's) text plus the suppression
+    syntax.  Returns an exit status (2 = unknown code)."""
+    mods = _pass_modules()
+    if code in mods:
+        mod = mods[code]
+        doc = (mod.__doc__ or "").strip()
+        print(f"pass {code} (suppression tag: {mod.TAG})\n")
+        print(doc.split("\n\n")[0])
+        for rule, text in sorted(getattr(mod, "RULES", {}).items()):
+            print(f"\n  {rule}\n      {text}")
+        print(f"\nsuppress a finding with a reasoned tag on (or one line "
+              f"above) the flagged line:\n"
+              f"    # tpulint: {mod.TAG}(why this is safe)")
+        return 0
+    for name, mod in mods.items():
+        rules = getattr(mod, "RULES", {})
+        if code in rules:
+            print(f"{code} (pass {name}, suppression tag: {mod.TAG})\n")
+            print(f"  {rules[code]}")
+            print(f"\nsuppress with:  # tpulint: {mod.TAG}(why this is "
+                  "safe)")
+            return 0
+    known = sorted(set(mods) | {r for m in mods.values()
+                                for r in getattr(m, "RULES", {})})
+    print(f"unknown pass or rule {code!r}; known codes:\n  "
+          + "\n  ".join(known), file=sys.stderr)
+    return 2
 
 
 def main(argv=None) -> int:
@@ -20,21 +54,29 @@ def main(argv=None) -> int:
         prog="python -m tools.tpulint",
         description="repo-native static analysis for tpuserve engine "
                     "invariants (host-sync, thread-ownership, KV leaks, "
-                    "Pallas contracts, metrics consistency)")
+                    "Pallas contracts, metrics consistency, control-"
+                    "plane protocol, config-surface drift)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to lint (default: tpuserve/)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable JSON findings on stdout")
+                    help="machine-readable JSON findings on stdout "
+                         "(per-finding pass/suppressible fields for "
+                         "downstream filters)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of passes to run "
                          f"(available: {', '.join(PASS_NAMES)})")
     ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("--explain", default=None, metavar="CODE",
+                    help="print a pass's (or one rule id's) rule text "
+                         "and suppression-tag syntax, then exit")
     args = ap.parse_args(argv)
 
     if args.list_passes:
         for p in PASS_NAMES:
             print(p)
         return 0
+    if args.explain:
+        return explain(args.explain)
 
     paths = args.paths or ["tpuserve"]
     repo_root = find_repo_root(paths[0])

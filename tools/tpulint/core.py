@@ -11,6 +11,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import fnmatch
+import hashlib
 import os
 import re
 from typing import Optional
@@ -34,7 +35,17 @@ class Finding:
     severity: str = "error"    # "error" | "warning"
 
     def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        out = dataclasses.asdict(self)
+        # machine-consumer conveniences (tools/ scripts, pre-commit
+        # filters): the owning pass under its CLI name, and whether a
+        # per-line tag can silence this finding at all (core findings —
+        # syntax errors, suppression hygiene — cannot be suppressed,
+        # and suppressions are Python comments, so findings anchored in
+        # README/YAML/shell files have nowhere to carry a tag)
+        out["pass"] = self.pass_name
+        out["suppressible"] = (self.pass_name != "core"
+                               and self.file.endswith(".py"))
+        return out
 
     def render(self) -> str:
         return (f"{self.file}:{self.line}: [{self.pass_name}/{self.rule}] "
@@ -52,10 +63,11 @@ class Suppression:
 
 DEFAULT_CONFIG: dict = {
     "passes": ["host-sync", "thread-ownership", "kv-leak", "pallas",
-               "metrics"],
+               "metrics", "protocol", "config-surface"],
     # suppression tags that may appear in the tree at all
     "suppression_allowlist": ["sync-ok", "thread-ok", "leak-ok",
-                              "pallas-ok", "metric-ok"],
+                              "pallas-ok", "metric-ok", "proto-ok",
+                              "config-ok"],
     "severity": {},            # pass id -> "error" | "warning"
     "host_sync": {
         # the pipelined dispatch path: methods where ANY host sync must be
@@ -127,6 +139,167 @@ DEFAULT_CONFIG: dict = {
     "metrics": {
         "registry": "tpuserve/server/metrics.py",
         "readme": "README.md",
+    },
+    # P6 protocol consistency: the HTTP surface wiring the four
+    # processes together.  Producer files HANDLE paths (compare
+    # self.path); consumer files DIAL them (str-concat / f-string /
+    # probe dicts).  ``endpoints`` pins the JSON contract per endpoint:
+    # every key a consumer indexes must be written by that endpoint's
+    # payload builders (and write-only keys outside ``operator_keys``
+    # are dead-surface warnings).
+    "protocol": {
+        "producer_files": ["tpuserve/server/openai_api.py",
+                           "tpuserve/server/gateway.py",
+                           "tpuserve/autoscale/__main__.py"],
+        "consumer_files": ["tpuserve/server/gateway.py",
+                           "tpuserve/autoscale/signals.py",
+                           "tpuserve/autoscale/reconciler.py",
+                           "tpuserve/obs/canary.py",
+                           "tpuserve/parallel/disagg_net.py",
+                           "tpuserve/provision/manifests.py",
+                           "tools/replay.py"],
+        "header_files": ["tpuserve/server/openai_api.py",
+                         "tpuserve/server/gateway.py",
+                         "tpuserve/server/tracing.py",
+                         "tpuserve/obs/canary.py"],
+        # consumer/producer sources outside the default lint roots,
+        # loaded from the working tree when not already being linted
+        "extra_paths": ["tools/replay.py"],
+        # non-X- headers the cross-process contract rides on
+        "checked_headers": ["traceparent", "tracestate"],
+        # served routes with no in-repo dialer BY DESIGN: the client
+        # API surface (dialed by users/SDKs) and human/ops endpoints
+        # (dashboards, jq, kubectl port-forward)
+        "operator_endpoints": [
+            "/v1/completions", "/v1/chat/completions", "/v1/embeddings",
+            "/v1/models", "/v1/models/", "/tokenize", "/detokenize",
+            "/debug/requests/", "/debug/profile", "/gateway/slo",
+            "/decisions",
+        ],
+        # payload keys written for operators (jq / dashboards /
+        # post-mortem readers), not for any in-repo consumer — exempt
+        # from the write-only dead-surface warning
+        "operator_keys": [
+            # /debug/engine ring bookkeeping + per-request detail
+            "enabled", "events_recorded", "steps_recorded", "requests",
+            "steps", "postmortems", "last_postmortem",
+            # SLI/controller scalars beyond what the autoscaler reads
+            "n", "p50", "pressure",
+            # burn-rate evaluator detail (objectives list, transition
+            # log) — /gateway/slo consumes only "firing"
+            "objectives", "burn", "transitions", "objective", "window",
+            "state", "severity", "t", "burn_long", "burn_short",
+            "long_s", "short_s",
+            # /healthz degraded-poller scalars + per-tier KV residency
+            # (brownout/cold-start ride here for pollers that skip the
+            # full /debug/engine snapshot; hbm/host/spill are the
+            # kv_tier_blocks breakdown)
+            "status", "kv_tier_blocks", "brownout_level",
+            "cold_start_s", "hbm", "host", "spill",
+            # /gateway/status ops view beyond the reconciler's reads
+            "backends", "affinity", "tenants", "breached",
+            "consecutive_failures", "last", "ok", "latency_s", "detail",
+        ],
+        "endpoints": {
+            "/debug/engine": {
+                "producers": [
+                    "tpuserve/runtime/flight.py::FlightRecorder"
+                    ".engine_snapshot",
+                    "tpuserve/runtime/flight.py::FlightRecorder"
+                    ".sli_summary",
+                    "tpuserve/runtime/slo.py::SloController.snapshot",
+                    # the engine publishes the per-cycle control scalars
+                    # as note_control KEYWORDS — renaming one here must
+                    # break the stale signals.py reader below
+                    "tpuserve/runtime/engine.py::call:note_control",
+                    "tpuserve/server/openai_api.py::*"
+                    "._debug_engine_payload",
+                    "tpuserve/obs/burnrate.py::BurnRateEvaluator"
+                    ".evaluate",
+                ],
+                "consumers": [
+                    "tpuserve/autoscale/signals.py::_merge_engines",
+                    "tpuserve/autoscale/signals.py::signals_from_debug",
+                    "tpuserve/server/gateway.py::Gateway.slo_status",
+                ],
+            },
+            "/healthz": {
+                "producers": [
+                    "tpuserve/server/openai_api.py::*._healthz_payload",
+                ],
+                "consumers": [
+                    "tpuserve/server/gateway.py::Gateway"
+                    ".probe_backends_once",
+                ],
+            },
+            "/gateway/status": {
+                "producers": [
+                    "tpuserve/server/gateway.py::Gateway.status",
+                    "tpuserve/obs/canary.py::CanaryProber.snapshot",
+                ],
+                "consumers": [
+                    "tpuserve/autoscale/reconciler.py::KubePool"
+                    "._pending_demand",
+                ],
+            },
+        },
+    },
+    # P7 config-surface drift: TPUSERVE_* env vars, argparse flags,
+    # DeployConfig fields and the README flag tables, checked both
+    # directions (the P5 enforcement style applied to configuration).
+    "config_surface": {
+        "readme": "README.md",
+        "deploy_config": "tpuserve/provision/config.py",
+        "manifests": "tpuserve/provision/manifests.py",
+        "provision_dir": "tpuserve/provision",
+        "env_prefix": "TPUSERVE_",
+        # env/flag read sites outside the default lint roots
+        "extra_paths": ["bench.py", "tools"],
+        # operator-facing entrypoints whose every flag must be in the
+        # README flag tables (both directions; tools keep their own
+        # --help as documentation)
+        "argparse_files": ["tpuserve/server/openai_api.py",
+                           "tpuserve/server/gateway.py",
+                           "tpuserve/autoscale/__main__.py"],
+        # debug-only vars: harness plumbing and tuning levers that are
+        # deliberately NOT part of the deploy config or README surface.
+        # The reason string is the documentation.
+        "env_debug_only": {
+            "TPUSERVE_BENCH_REEXEC": "bench.py TPU re-exec handshake",
+            "TPUSERVE_BENCH_DEGRADED": "bench.py probe->run handoff",
+            "TPUSERVE_BENCH_PROBE_ERROR": "bench.py probe->run handoff",
+            "TPUSERVE_BENCH_START_TS": "bench.py budget bookkeeping",
+            "TPUSERVE_BENCH_BUDGET_S": "harness wall-clock budget guard",
+            "TPUSERVE_TIER1_LOG": "tier-1 harness log path plumbing",
+            "TPUSERVE_HBM_BYTES": "test/bench HBM budget override",
+            "TPUSERVE_VMEM_BUDGET_MB": "kernel tuning (bench_sweep)",
+            "TPUSERVE_RAGGED_BLOCK": "kernel tuning (bench_sweep)",
+            "TPUSERVE_FLASH_BLK_Q": "kernel tuning (bench_sweep)",
+            "TPUSERVE_FLASH_BLK_K": "kernel tuning (bench_sweep)",
+            "TPUSERVE_SEQS_PER_PROGRAM": "kernel tuning (bench_sweep)",
+            "TPUSERVE_PAGES_PER_GROUP": "kernel tuning (bench_sweep)",
+            "TPUSERVE_FSM_MAX_STATES": "grammar-compile guard rail",
+            "TPUSERVE_FSM_MAX_WALK_CHARS": "grammar-compile guard rail",
+            "TPUSERVE_FSM_JSON_DEPTH": "grammar-compile guard rail",
+        },
+        # operator-injected vars: documented in README but deliberately
+        # not derived from a DeployConfig field (secrets, A/B levers the
+        # operator sets per-pod, ring sizes)
+        "env_operator": [
+            "TPUSERVE_CANARY_TOKEN", "TPUSERVE_SLO_OBJECTIVES",
+            "TPUSERVE_HOST_BATCHED", "TPUSERVE_STRICT_BLOCKS",
+            "TPUSERVE_BLOCK_MANAGER", "TPUSERVE_FLIGHT_EVENTS",
+            "TPUSERVE_FLIGHT_STEPS", "TPUSERVE_FSM_CACHE_DIR",
+        ],
+        # vars read by shell entrypoints the AST can't see: var -> the
+        # script that reads it.  The pass verifies the var still appears
+        # in that file, so an entry can't outlive the read site.
+        "env_shell": {
+            "TPUSERVE_WATCH_BUDGET_S": "tools/tpu_watch.sh",
+            "TPUSERVE_CONFIG": "deploy-tpu-cluster.sh",
+        },
+        # DeployConfig fields allowed to have no provision-layer read
+        "deploy_field_allow": [],
     },
 }
 
@@ -214,12 +387,29 @@ def collect_files(paths: list[str], repo_root: str) -> dict:
     return out
 
 
+# Single-parse AST cache, shared across passes, fixtures, and repeat
+# run_lint invocations in one process (the tier-1 suite lints the full
+# tree several times; with seven passes re-parsing would dominate lint
+# wall time).  Keyed by content, so a fixture shadowing a real path can
+# never collide with it, and trees are read-only by pass contract.
+_AST_CACHE: dict = {}
+
+
+def cached_parse(src: str) -> ast.Module:
+    key = hashlib.sha256(src.encode("utf-8")).digest()
+    tree = _AST_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(src)
+        _AST_CACHE[key] = tree
+    return tree
+
+
 def parse_sources(sources: dict) -> tuple[dict, list[Finding]]:
     files: dict = {}
     errors: list[Finding] = []
     for rel, src in sources.items():
         try:
-            files[rel] = (src, ast.parse(src))
+            files[rel] = (src, cached_parse(src))
         except SyntaxError as e:
             errors.append(Finding(
                 file=rel, line=e.lineno or 1, rule="syntax-error",
@@ -240,7 +430,9 @@ def collect_suppressions(sources: dict) -> list[Suppression]:
 
 def apply_suppressions(findings: list[Finding], sups: list[Suppression],
                        tag_for_pass: dict, allowlist: list[str],
-                       active_tags: Optional[set] = None) -> list[Finding]:
+                       active_tags: Optional[set] = None,
+                       staleness_files: Optional[set] = None
+                       ) -> list[Finding]:
     """Drop findings covered by a matching suppression on the same line or
     the line directly above; emit findings for malformed suppressions
     (missing reason, unknown tag, unused).
@@ -248,7 +440,12 @@ def apply_suppressions(findings: list[Finding], sups: list[Suppression],
     ``active_tags``: tags whose owning pass actually ran this invocation.
     Staleness (unused-suppression) is only judged for those — a subset
     run (``--passes kv-leak``) must not condemn the sync-ok comments the
-    skipped host-sync pass would have consumed.  None means all ran."""
+    skipped host-sync pass would have consumed.  None means all ran.
+
+    ``staleness_files``: files whose suppressions may be judged stale.
+    Files pulled in only because a finding anchored there (the P6/P7
+    disk-loaded set) are excluded — judging them would make staleness
+    appear and vanish with unrelated findings.  None means all."""
     by_loc: dict = {}
     for s in sups:
         by_loc.setdefault((s.file, s.tag), []).append(s)
@@ -278,7 +475,8 @@ def apply_suppressions(findings: list[Finding], sups: list[Suppression],
                 message=f"suppression tag '{s.tag}' is not in "
                         "[tool.tpulint] suppression_allowlist",
                 pass_name="core"))
-        elif not s.used and (active_tags is None or s.tag in active_tags):
+        elif not s.used and (active_tags is None or s.tag in active_tags) \
+                and (staleness_files is None or s.file in staleness_files):
             kept.append(Finding(
                 file=s.file, line=s.line, rule="unused-suppression",
                 message=f"suppression '{s.tag}' matches no finding — "
@@ -288,10 +486,11 @@ def apply_suppressions(findings: list[Finding], sups: list[Suppression],
 
 
 def _pass_modules() -> dict:
-    from tools.tpulint import (host_sync, kv_leak, metrics_consistency,
-                               pallas_contracts, thread_ownership)
+    from tools.tpulint import (config_surface, host_sync, kv_leak,
+                               metrics_consistency, pallas_contracts,
+                               protocol_consistency, thread_ownership)
     mods = (host_sync, thread_ownership, kv_leak, pallas_contracts,
-            metrics_consistency)
+            metrics_consistency, protocol_consistency, config_surface)
     return {m.NAME: m for m in mods}
 
 
@@ -308,14 +507,32 @@ def run_lint_sources(sources: dict, config: Config,
         mod = mods[name]
         sev = config.severity_for(name)
         for f in mod.run(files, config, repo_root):
-            f.severity = sev
+            # pass-emitted warnings (dead-surface findings) keep their
+            # severity; the per-pass config level applies to errors
+            if f.severity == "error":
+                f.severity = sev
             findings.append(f)
     tag_for_pass = {name: mods[name].TAG for name in mods}
-    sups = collect_suppressions(sources)
+    # P6/P7 anchor findings in files they load from disk (tools/,
+    # bench.py, interface files outside the lint roots); their per-line
+    # suppressions must work there too, so pull in the source of any
+    # finding-bearing file the lint set doesn't already hold.  Python
+    # only: suppressions are Python comments, and scanning a
+    # finding-bearing README would mis-flag its documentation EXAMPLE
+    # of the tag syntax as an unused suppression.
+    sup_sources = dict(sources)
+    for f in findings:
+        if f.file not in sup_sources and f.file.endswith(".py"):
+            path = os.path.join(repo_root, f.file)
+            if os.path.isfile(path):
+                with open(path, "r", encoding="utf-8") as fh:
+                    sup_sources[f.file] = fh.read()
+    sups = collect_suppressions(sup_sources)
     findings = apply_suppressions(findings, sups, tag_for_pass,
                                   config.allowlist(),
                                   active_tags={mods[p].TAG
-                                               for p in enabled})
+                                               for p in enabled},
+                                  staleness_files=set(sources))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 

@@ -38,6 +38,21 @@ from tools.tpulint.core import (FAULT_SITES, Config, Finding, call_name,
 NAME = "host-sync"
 TAG = "sync-ok"
 
+#: rule texts for ``python -m tools.tpulint --explain CODE``
+RULES = {
+    "host-sync-in-jit": "jax.device_get / np.asarray / .item() / traced "
+                        "truthiness inside a jit/scan body forces a "
+                        "device round-trip per trace",
+    "sync-in-dispatch-path": "ANY sync primitive inside the pipelined "
+                             "dispatch path breaks one-sync-per-window",
+    "monotonic-outside-clock-seam": "direct time.monotonic in a "
+                                    "replay-reachable file bypasses the "
+                                    "injectable clock seam "
+                                    "(runtime/clock.py)",
+    "unknown-fault-site": "a literal fault-site name not in "
+                          "runtime/faults.SITES",
+}
+
 # explicit sync primitives (flagged in both traced and dispatch contexts)
 _SYNC_CALLS = {"jax.device_get", "jax.block_until_ready", "hard_sync"}
 _SYNC_METHODS = {"item", "block_until_ready", "tolist"}

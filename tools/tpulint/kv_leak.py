@@ -36,6 +36,15 @@ from tools.tpulint.core import Config, Finding, dotted
 NAME = "kv-leak"
 TAG = "leak-ok"
 
+#: rule texts for ``python -m tools.tpulint --explain CODE``
+RULES = {
+    "kv-alloc-leak-on-exception": "a raising statement between a "
+                                  "BlockManager allocate and its free/"
+                                  "ownership transfer leaks blocks",
+    "kv-alloc-never-released": "an allocate with no free or ownership "
+                               "transfer on any path",
+}
+
 
 def _is_alloc_call(node: ast.Call, receivers: list) -> bool:
     if not isinstance(node.func, ast.Attribute):

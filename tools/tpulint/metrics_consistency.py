@@ -38,6 +38,17 @@ from tools.tpulint.core import Config, Finding, call_name, const_str, dotted
 NAME = "metrics"
 TAG = "metric-ok"
 
+#: rule texts for ``python -m tools.tpulint --explain CODE``
+RULES = {
+    "metric-never-updated": "a registered metric no code ever feeds — "
+                            "dashboards read zeros forever",
+    "metric-undocumented": "a registered family with no README mention",
+    "metric-doc-drift": "a README table row naming a family not in the "
+                        "registry",
+    "alert-unknown-metric": "an alert expr watching a ghost series",
+    "objective-unalerted": "an SLO-objective family no alert references",
+}
+
 _CTOR_KINDS = {
     "counter": "counter", "Counter": "counter",
     "gauge": "gauge", "Gauge": "gauge",
@@ -71,7 +82,8 @@ def registry_from_source(src: str) -> list[Metric]:
     """Parse the metric registry out of server/metrics.py source: every
     ``self.<attr> = counter("family", ...)`` (and Gauge/Histogram/
     Counter(...) forms) in the module."""
-    tree = ast.parse(src)
+    from tools.tpulint.core import cached_parse
+    tree = cached_parse(src)
     out: list[Metric] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:

@@ -39,6 +39,24 @@ from tools.tpulint.core import Config, Finding, call_name, dotted
 NAME = "pallas"
 TAG = "pallas-ok"
 
+#: rule texts for ``python -m tools.tpulint --explain CODE``
+RULES = {
+    "pallas-index-map-arity": "BlockSpec index-map arity != grid rank "
+                              "+ num_scalar_prefetch",
+    "pallas-kernel-arity": "kernel parameter count != prefetch + "
+                           "in_specs + out_specs + scratch_shapes",
+    "pallas-call-arity": "pallas_call operand count != prefetch + "
+                         "in_specs",
+    "pallas-dot-accum": "dot_general without preferred_element_type "
+                        "accumulates in input precision",
+    "pallas-upcast-before-dot": "astype(f32) before the dot burns VMEM; "
+                                "accumulate via preferred_element_type",
+    "pallas-dequant-dtype": "int8-dequant helper fed a non-int8/f32 "
+                            "dtype combination",
+    "pallas-vmem-budget": "static scratch/block estimate exceeds the "
+                          "per-core VMEM budget",
+}
+
 _ITEMSIZE = {
     "jnp.float32": 4, "jnp.int32": 4, "jnp.uint32": 4, "np.float32": 4,
     "jnp.bfloat16": 2, "jnp.float16": 2, "jnp.int16": 2,

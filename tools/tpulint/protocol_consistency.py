@@ -1,0 +1,188 @@
+"""P6: control-plane protocol consistency.
+
+Four processes (server, gateway, autoscaler, provisioner) agree on the
+wire protocol only by string discipline: the autoscaler scrapes
+``/debug/engine`` scalars the engine publishes, the gateway probes
+``/healthz`` digests, the reconciler polls ``/gateway/status``, probes
+and trace context ride custom headers.  A renamed path, payload key or
+header on one side is a silent zero (or a permanent probe failure) on
+the other — an incident, not a type error.  This pass makes it a lint
+failure, both directions, on the shared AST model in ``interface.py``:
+
+- ``endpoint-unserved``: a consumer dials a path no handler serves.
+- ``endpoint-dead`` (warning): a handler serves a path nothing in-repo
+  dials and that is not declared operator/client surface
+  (``[tool.tpulint.protocol] operator_endpoints``).
+- ``json-key-unproduced``: a consumer indexes a payload key the
+  endpoint's payload builders never write (the historical
+  ``/debug/engine`` control-scalar-rename drift class).
+- ``json-key-dead`` (warning): a payload builder writes a key no
+  consumer reads and that is not declared operator surface
+  (``operator_keys``).
+- ``header-unset``: a header one process reads that no peer ever sets.
+- ``header-unread`` (warning): a header set that no peer reads.
+
+Suppress with ``# tpulint: proto-ok(reason)`` — e.g. an endpoint dialed
+on a peer that lives outside this repo.
+"""
+
+from __future__ import annotations
+
+from tools.tpulint.core import Config, Finding
+from tools.tpulint.interface import (expand_paths, get_source, headers_in,
+                                     keys_read, keys_written, paths_dialed,
+                                     route_serves, routes_served)
+
+NAME = "protocol"
+TAG = "proto-ok"
+
+RULES = {
+    "endpoint-unserved": "a consumer dials an HTTP path no producer "
+                         "serves — the request can only 404",
+    "endpoint-dead": "a served route nothing in-repo dials and not in "
+                     "operator_endpoints (warning: dead surface)",
+    "json-key-unproduced": "a consumer reads a JSON key the endpoint's "
+                           "payload builders never write — it reads "
+                           "None/0 forever",
+    "json-key-dead": "a payload key no consumer reads and not in "
+                     "operator_keys (warning: dead surface)",
+    "header-unset": "a header read that no peer process ever sets",
+    "header-unread": "a header set that no peer reads (warning)",
+}
+
+
+def _sources(files: dict, sec: dict, repo_root: str,
+             errors: list) -> dict:
+    """The lint set plus every configured interface file, fixtures
+    shadowing the tree (interface.get_source order)."""
+    wanted = set(sec.get("producer_files", ()))
+    wanted |= set(sec.get("consumer_files", ()))
+    wanted |= set(sec.get("header_files", ()))
+    wanted |= set(expand_paths(repo_root, sec.get("extra_paths", ())))
+    # files named by endpoint producer/consumer patterns: a subset lint
+    # (``tpulint tpuserve/runtime``) must still see the payload-builder
+    # halves that live outside the linted paths
+    for spec in sec.get("endpoints", {}).values():
+        for pat in list(spec.get("producers", ())) \
+                + list(spec.get("consumers", ())):
+            fpat = pat.split("::", 1)[0]
+            if "*" not in fpat and "?" not in fpat:
+                wanted.add(fpat)
+    out = dict(files)
+    for rel in sorted(wanted):
+        if rel not in out:
+            got = get_source(files, repo_root, rel, errors=errors)
+            if got is not None:
+                out[rel] = got
+    return out
+
+
+def run(files: dict, config: Config, repo_root: str) -> list:
+    findings: list = []
+    sec = config.section("protocol")
+    srcs = _sources(files, sec, repo_root, findings)
+
+    # ---- endpoints, both directions ---------------------------------
+    served: list = []
+    for rel in sec.get("producer_files", ()):
+        if rel in srcs:
+            served.extend(routes_served(rel, srcs[rel][1]))
+    dialed: list = []
+    for rel in sec.get("consumer_files", ()):
+        if rel in srcs:
+            dialed.extend(paths_dialed(rel, srcs[rel][1]))
+    if served:     # no producers at all = fixture without a server half
+        for d in dialed:
+            if not any(route_serves(r, d.name) for r in served):
+                findings.append(Finding(
+                    file=d.file, line=d.line, rule="endpoint-unserved",
+                    message=f"endpoint '{d.name}' is dialed here but no "
+                            "handler serves it (producer files: "
+                            f"{', '.join(sec.get('producer_files', ()))})"
+                            " — renamed route with a stale consumer?",
+                    pass_name=NAME))
+    if dialed or served:
+        operator = set(sec.get("operator_endpoints", ()))
+        seen: set = set()
+        for r in served:
+            if r.name in seen:
+                continue
+            seen.add(r.name)
+            if r.name in operator:
+                continue
+            if any(route_serves(r, d.name) for d in dialed):
+                continue
+            findings.append(Finding(
+                file=r.file, line=r.line, rule="endpoint-dead",
+                message=f"route '{r.name}' is served but nothing in-repo "
+                        "dials it and it is not declared in "
+                        "[tool.tpulint.protocol] operator_endpoints — "
+                        "dead surface or missing allowlist entry",
+                pass_name=NAME, severity="warning"))
+
+    # ---- JSON payload contracts per endpoint ------------------------
+    operator_keys = set(sec.get("operator_keys", ()))
+    for ep, spec in sorted(sec.get("endpoints", {}).items()):
+        written = keys_written(srcs, list(spec.get("producers", ())))
+        read = keys_read(srcs, list(spec.get("consumers", ())))
+        for key in sorted(set(read) - set(written)):
+            site = read[key]
+            findings.append(Finding(
+                file=site.file, line=site.line,
+                rule="json-key-unproduced",
+                message=f"consumer of {ep} reads payload key '{key}' "
+                        "which none of the endpoint's payload builders "
+                        "write — the read sees None/0 forever (renamed "
+                        "producer key with a stale reader?)",
+                pass_name=NAME))
+        if read:   # a producer-only fixture has no contract to judge
+            for key in sorted(set(written) - set(read) - operator_keys):
+                site = written[key]
+                findings.append(Finding(
+                    file=site.file, line=site.line, rule="json-key-dead",
+                    message=f"{ep} payload key '{key}' is written but no "
+                            "configured consumer reads it and it is not "
+                            "in [tool.tpulint.protocol] operator_keys — "
+                            "dead surface or missing allowlist entry",
+                    pass_name=NAME, severity="warning"))
+
+    # ---- headers, both directions -----------------------------------
+    checked = {h.lower() for h in sec.get("checked_headers", ())}
+
+    def interesting(name: str) -> bool:
+        # HTTP header names are case-insensitive (and matching below
+        # compares lowercased), so the filter must be too
+        return name.lower().startswith("x-") or name.lower() in checked
+
+    reads: list = []
+    writes: list = []
+    for rel in sec.get("header_files", ()):
+        if rel in srcs:
+            r, w = headers_in(rel, srcs[rel][1], interesting)
+            reads.extend(r)
+            writes.extend(w)
+    if writes:
+        set_names = {s.name.lower() for s in writes}
+        seen = set()
+        for s in reads:
+            if s.name.lower() in set_names or s.name.lower() in seen:
+                continue
+            seen.add(s.name.lower())
+            findings.append(Finding(
+                file=s.file, line=s.line, rule="header-unset",
+                message=f"header '{s.name}' is read here but no peer "
+                        "ever sets it — the read is always None",
+                pass_name=NAME))
+    if reads:
+        read_names = {s.name.lower() for s in reads}
+        seen = set()
+        for s in writes:
+            if s.name.lower() in read_names or s.name.lower() in seen:
+                continue
+            seen.add(s.name.lower())
+            findings.append(Finding(
+                file=s.file, line=s.line, rule="header-unread",
+                message=f"header '{s.name}' is set here but no peer "
+                        "reads it — dead surface",
+                pass_name=NAME, severity="warning"))
+    return findings

@@ -42,6 +42,17 @@ from tools.tpulint.core import Config, Finding, call_name, dotted, qual_match
 NAME = "thread-ownership"
 TAG = "thread-ok"
 
+#: rule texts for ``python -m tools.tpulint --explain CODE``
+RULES = {
+    "cross-thread-mutation": "engine-loop-owned state mutated from a "
+                             "foreign (watchdog/gateway/health) thread",
+    "cross-thread-setattr": "setattr on loop-owned state from a foreign "
+                            "thread",
+    "native-boundary-call": "a foreign thread reaching through a native "
+                            "handle (._core) on loop-owned state — the "
+                            "C++ core races concurrent access",
+}
+
 _MUTATOR_HINTS = {
     # container / engine mutators that change loop-owned state
     "pop", "clear", "append", "appendleft", "remove", "add", "update",

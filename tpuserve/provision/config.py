@@ -83,6 +83,11 @@ class DeployConfig:
     # fronted fleets configure the gateway instead (one charge per
     # request).  None = no tenancy config (metering under 'default').
     tenants: Optional[dict] = None
+    # In-process SLO burn-rate evaluator (tpuserve/obs): firing state on
+    # /debug/engine, aggregated by /gateway/slo.  False exports
+    # TPUSERVE_SLO_BURN=0 to the engine pods (the env twin of the
+    # server's --no-slo-burn).
+    slo_burn: bool = True
     # Engine flight recorder (runtime/flight.py): always-on lifecycle
     # tracing + post-mortem bundles.  False exports TPUSERVE_FLIGHT=0
     # (the measured-overhead A/B lever, bench.py --recorder-ab).
@@ -122,7 +127,13 @@ class DeployConfig:
     # this (+35 s headroom) so K8s never SIGKILLs mid-drain
     drain_timeout_s: int = 25
     storage_class: str = "standard-rwo"    # reference: local-path (llm-d-deploy.yaml:115)
-    storage_size: str = "50Gi"             # reference: llm-d-deploy.yaml:116
+    # General model-storage PVC size (reference: llm-d-deploy.yaml:116
+    # ships 50Gi).  None = track model_pvc_size: earlier releases sized
+    # the model-storage PVCs from that field, and K8s forbids shrinking
+    # an existing PVC's storage request — an independent default would
+    # break idempotent re-provisioning for anyone who overrode
+    # model_pvc_size while this field was dead.
+    storage_size: Optional[str] = None
     model_pvc_size: str = "100Gi"          # reference workaround PVC (llm-d-deploy.yaml:207)
     image: str = "tpuserve:latest"         # engine container image (tag)
     # Registry prefix the image is pushed to and pulled from (e.g.
@@ -159,7 +170,11 @@ class DeployConfig:
     # --- timeouts (reference envelope, SURVEY.md §6) ----------------------
     install_timeout_s: int = 1800          # llm-d-deploy.yaml:192
     pods_ready_timeout_s: int = 1800       # llm-d-deploy.yaml:232
-    node_ready_timeout_s: int = 300        # SSH-up analog (launch-instance.yaml:69)
+    # Node-Ready poll budget, the reference's SSH-up analog
+    # (launch-instance.yaml:69 waits 300).  600 preserves the ceiling
+    # the poll historically had (30 retries x ~20s/attempt) — fresh GKE
+    # TPU slices routinely take 6-9 min to go Ready.
+    node_ready_timeout_s: int = 600
 
     def validate(self) -> None:
         if self.provider not in ("gke", "local"):
@@ -397,7 +412,7 @@ PRESETS: dict[str, dict] = {
         "tpu_type": "v5litepod-4", "tpu_topology": "2x4",
         "machine_type": "ct5lp-hightpu-4t", "num_nodes": 4,
         "tensor_parallel": 8, "replicas": 2,
-        "storage_size": "200Gi", "model_pvc_size": "300Gi",
+        "model_pvc_size": "300Gi",
     },
     # cross-pod variant of the disaggregated config: separate prefill and
     # decode Deployments on their own v5e-4 slices, independently scalable

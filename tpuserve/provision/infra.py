@@ -91,7 +91,12 @@ def provision(cfg: DeployConfig, runner: CommandRunner, workdir: str = ".",
         _adopt_local(cfg, runner, rec, kubeconfig)
 
     kube = KubeCtl(runner, kubeconfig)
-    if not kube.wait_nodes_ready():
+    # budget from the shared config (the reference's SSH-up analog,
+    # launch-instance.yaml:69): each attempt costs up to 10s of
+    # `kubectl wait --timeout=10s` PLUS the 10s retry delay, so the
+    # retry count divides by 20 to keep wall clock ~= node_ready_timeout_s
+    if not kube.wait_nodes_ready(
+            retries=max(cfg.node_ready_timeout_s // 20, 1)):
         raise RuntimeError("nodes did not become Ready within the timeout")
     _preflight_tpu(cfg, kube)
 

@@ -51,9 +51,13 @@ def _pvc(cfg: DeployConfig, name: str, size: str) -> dict:
 
 def storage_pvcs(cfg: DeployConfig) -> list[dict]:
     """General model-storage PVCs created at the cluster layer
-    (kubernetes-single-node.yaml:385-400)."""
-    return [_pvc(cfg, "model-storage-1", cfg.model_pvc_size),
-            _pvc(cfg, "model-storage-2", cfg.model_pvc_size)]
+    (kubernetes-single-node.yaml:385-400).  Sized by ``storage_size``
+    when set; unset tracks ``model_pvc_size``, which is what every
+    pre-existing cluster was provisioned with — K8s PVC requests can
+    only grow, so the fallback keeps re-provisioning idempotent."""
+    size = cfg.storage_size or cfg.model_pvc_size
+    return [_pvc(cfg, "model-storage-1", size),
+            _pvc(cfg, "model-storage-2", size)]
 
 
 def model_pvc(cfg: DeployConfig) -> dict:
@@ -206,6 +210,10 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
            # .npz tables instead of walking 151k token texts inline.
            {"name": "TPUSERVE_FSM_CACHE_DIR",
             "value": "/models/.fsm-cache"}]
+    if not cfg.slo_burn:
+        # kill switch for the in-process burn-rate evaluator (the env
+        # twin of --no-slo-burn; default on)
+        env.append({"name": "TPUSERVE_SLO_BURN", "value": "0"})
     if not cfg.flight:
         # kill switch for the engine flight recorder (the --recorder-ab
         # measured-overhead lever; default on)
