@@ -1540,6 +1540,16 @@ def main(argv=None):
                          "in-process burn-rate evaluator armed vs the "
                          "plain runner soak; contract <1%% tok/s "
                          "(BENCHMARKS.md 'Fleet SLO engine')")
+    ap.add_argument("--devprof", action="store_true",
+                    help="device-telemetry overhead guard (runtime/"
+                         "devprof.py): interleaved soak pairs on the "
+                         "SAME warm engine with the devprof layer "
+                         "toggled per arm (the exact state "
+                         "TPUSERVE_DEVPROF=0 serves in), reporting the "
+                         "tok/s delta plus the ON arm's device/dispatch "
+                         "ms-per-cycle attribution, compile count and "
+                         "HBM watermark; 'ok' asserts the always-on "
+                         "layer costs <1%%")
     ap.add_argument("--backtest", action="store_true",
                     help="after the run, backtest the generated "
                          "workload through the burn-rate alert engine "
@@ -2017,6 +2027,65 @@ def main(argv=None):
         if overhead >= 0.01:
             import sys as _sys
             print(f"canary-ab GUARD FAILED: prober+evaluator cost "
+                  f"{overhead:.1%} tok/s (budget <1%)",
+                  file=_sys.stderr, flush=True)
+
+    if args.devprof:
+        # Device-telemetry overhead guard: interleaved pairs on the
+        # SAME warm engine — the devprof layer is toggled per arm into
+        # the exact state TPUSERVE_DEVPROF=0 serves in (dp.enabled
+        # False AND the flight handle None, so note_step never reads a
+        # step delta).  Same drift-cancelling methodology as
+        # --recorder-ab; contract <1% tok/s.  The ON arm's attribution
+        # breakdown rides along so the sweep captures device vs host
+        # ms-per-cycle and the HBM watermark with every guard row.
+        with tpu_guard("devprof A/B"):
+            inners = [e for e in (getattr(engine, "prefill", None),
+                                  getattr(engine, "decode", None))
+                      if e is not None] or [engine]
+            dps = [e.devprof for e in inners]
+            assert all(dp.enabled for dp in dps), \
+                "--devprof ON arm has devprof disabled " \
+                "(TPUSERVE_DEVPROF=0 in the bench environment?)"
+
+            def _set_devprof(enabled):
+                for e in inners:
+                    e.devprof.enabled = enabled
+                    e.flight.devprof = e.devprof if enabled else None
+
+            pairs = max(n_rep, 3)
+            on_runs, off_runs = [], []
+            for _ in range(pairs):
+                _set_devprof(True)
+                on_runs.append(_run_workload(
+                    engine, prompts, params,
+                    arrival_offsets=arrival_offsets))
+                _set_devprof(False)
+                off_runs.append(_run_workload(
+                    engine, prompts, params,
+                    arrival_offsets=arrival_offsets))
+            _set_devprof(True)
+        on_tok_s = _rate(sorted(on_runs, key=_rate)[len(on_runs) // 2])
+        off_tok_s = _rate(sorted(off_runs, key=_rate)[len(off_runs) // 2])
+        overhead = (1.0 - on_tok_s / off_tok_s) if off_tok_s else 0.0
+        rep = dps[0].report()
+        out["devprof"] = {
+            "pairs": pairs,
+            "on_tok_s": round(on_tok_s, 1),
+            "off_tok_s": round(off_tok_s, 1),
+            # negative = devprof-on measured FASTER (noise floor)
+            "overhead_frac": round(overhead, 4),
+            "ok": overhead < 0.01,
+            "device_ms_per_cycle": rep["device_ms_per_cycle"],
+            "dispatch_ms_per_cycle": rep["dispatch_ms_per_cycle"],
+            "compiles": rep["ladder"]["compiles"],
+            "compile_ms": rep["ladder"]["compile_ms"],
+            "retained_executables": rep["ladder"]["retained"],
+            "hbm": rep["hbm"],
+        }
+        if overhead >= 0.01:
+            import sys as _sys
+            print(f"devprof GUARD FAILED: device-telemetry layer costs "
                   f"{overhead:.1%} tok/s (budget <1%)",
                   file=_sys.stderr, flush=True)
 

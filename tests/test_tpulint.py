@@ -221,6 +221,28 @@ def test_p1_clock_seam_covers_autoscale():
     """, passes=["host-sync"], path="tpuserve/autoscale/pool.py") == []
 
 
+def test_p1_clock_seam_covers_devprof():
+    """ISSUE 16 satellite: runtime/devprof.py is clock_paths-covered —
+    its attribution brackets must stay on perf_counter (replay-safe
+    interval clock), so a direct time.monotonic is an error while the
+    perf_counter hot path is clean."""
+    findings = lint_snippet("""
+        import time
+
+        class DeviceProfiler:
+            def bracket(self):
+                return time.monotonic()
+    """, passes=["host-sync"], path="tpuserve/runtime/devprof.py")
+    assert "monotonic-outside-clock-seam" in rules(findings)
+    assert lint_snippet("""
+        import time
+
+        class DeviceProfiler:
+            def bracket(self):
+                return time.perf_counter()
+    """, passes=["host-sync"], path="tpuserve/runtime/devprof.py") == []
+
+
 def test_p1_clock_seam_scope_and_sync_ok():
     """The rule stays scoped to clock_paths (gateway/tenants keep their
     real clocks) and accepts reasoned sync-ok tags on genuinely
@@ -1108,6 +1130,44 @@ def test_p7_shipping_slo_burn_is_reachable():
     cfg_on = DeployConfig(provider="local")
     env_on = {e["name"] for e in _engine_container(cfg_on)["env"]}
     assert "TPUSERVE_SLO_BURN" not in env_on
+
+
+def test_p7_shipping_devprof_is_reachable():
+    """ISSUE 16 wiring pin: TPUSERVE_DEVPROF is backed by
+    DeployConfig.devprof (P7's DeployConfig-field legitimization path)
+    and the manifests emit the kill switch only when devprof=False —
+    the always-on default ships no env var."""
+    import dataclasses as _dc
+    from tpuserve.provision.config import DeployConfig
+    from tpuserve.provision.manifests import _engine_container
+    assert any(f.name == "devprof" for f in _dc.fields(DeployConfig))
+    cfg = DeployConfig(provider="local", devprof=False)
+    env = {e["name"]: e.get("value")
+           for e in _engine_container(cfg)["env"]}
+    assert env.get("TPUSERVE_DEVPROF") == "0"
+    cfg_on = DeployConfig(provider="local")
+    env_on = {e["name"] for e in _engine_container(cfg_on)["env"]}
+    assert "TPUSERVE_DEVPROF" not in env_on
+
+
+def test_p5_devprof_families_registered_and_documented(metric_registry):
+    """ISSUE 16 (P5 both directions): the device-telemetry families are
+    in the parsed registry with the right kinds AND in README's metric
+    table under their exported (_total-suffixed) names."""
+    fams = {m.family: m.kind for m in metric_registry}
+    assert fams.get("tpuserve_hbm_bytes") == "gauge"
+    assert fams.get("tpuserve_hbm_headroom_bytes") == "gauge"
+    assert fams.get("tpuserve_device_seconds") == "counter"
+    assert fams.get("tpuserve_executable_compiles") == "counter"
+    assert fams.get("tpuserve_executables_retained") == "gauge"
+    assert fams.get("tpuserve_profile_captures") == "counter"
+    with open(os.path.join(REPO, "README.md")) as f:
+        documented = documented_families(f.read())
+    exported = {m.exported for m in metric_registry
+                if m.family.startswith(("tpuserve_hbm", "tpuserve_device",
+                                        "tpuserve_exec",
+                                        "tpuserve_profile"))}
+    assert exported <= documented, exported - documented
 
 
 # ---------------------------------------------------------------------

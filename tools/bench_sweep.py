@@ -98,6 +98,14 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     ("canary-smoke", ["--canary-ab"], {}),
     ("backtest-smoke", ["--arrival", "poisson", "--arrival-rate", "16",
                         "--backtest"], {}),
+    # Device telemetry (ISSUE 16): the always-on devprof overhead guard
+    # on silicon (<1% tok/s, interleaved same-engine toggle) — the row
+    # also records the first REAL device/dispatch ms-per-cycle split,
+    # compile walls per ladder bucket, and the HBM watermark; the
+    # legacy row pins the removed-layer baseline under
+    # TPUSERVE_DEVPROF=0 on the same commit.
+    ("devprof", ["--devprof"], {}),
+    ("devprof-legacy", [], {"TPUSERVE_DEVPROF": "0"}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -109,6 +109,12 @@ PRIORITY = [
     # alert-backtest determinism smoke, certified in the same container
     # the serving rows run in.
     "canary-smoke", "backtest-smoke",
+    # Device telemetry (ISSUE 16): the devprof <1% guard on silicon
+    # plus the first measured device-vs-host ms-per-cycle split,
+    # per-bucket compile walls and the real v5e HBM watermark — the
+    # self-instrumenting answer to the standing measurement debt; the
+    # legacy row is the same-commit TPUSERVE_DEVPROF=0 baseline.
+    "devprof", "devprof-legacy",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

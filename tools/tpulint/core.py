@@ -96,6 +96,7 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/scheduler.py",
             "tpuserve/runtime/slo.py",
             "tpuserve/runtime/flight.py",
+            "tpuserve/runtime/devprof.py",
             "tpuserve/runtime/request.py",
             "tpuserve/server/runner.py",
             "tpuserve/autoscale/*.py",
@@ -199,6 +200,10 @@ DEFAULT_CONFIG: dict = {
             # /gateway/status ops view beyond the reconciler's reads
             "backends", "affinity", "tenants", "breached",
             "consecutive_failures", "last", "ok", "latency_s", "detail",
+            # device telemetry (runtime/devprof.py): the /debug/engine
+            # "devprof" section + compile-cache stats are operator/jq
+            # surface; the autoscaler reads control scalars, not these
+            "devprof", "compile_caches",
         ],
         "endpoints": {
             "/debug/engine": {

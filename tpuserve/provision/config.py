@@ -96,6 +96,13 @@ class DeployConfig:
     # compile caches, so watchdog/fault-storm bundles survive the pod
     # that wrote them (exported as TPUSERVE_FLIGHT_DIR).
     flight_dir: str = "/models/.flight"
+    # Device telemetry (runtime/devprof.py): per-dispatch device-time
+    # attribution, the executable-ladder registry, HBM watermark gauges,
+    # and on-demand/auto jax.profiler capture.  False exports
+    # TPUSERVE_DEVPROF=0 (the env twin of --no-devprof; serving output
+    # is byte-identical either way — bench.py --devprof is the
+    # measured-overhead A/B lever).
+    devprof: bool = True
     # Hang watchdog threshold (server --step-watchdog-s): a dispatch
     # blocking past this is failed + salvaged like an exception instead
     # of stranding clients behind a wedged device call.  0 disables.

@@ -223,6 +223,11 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         # isolation) land on the model PVC and survive the pod
         env.append({"name": "TPUSERVE_FLIGHT_DIR",
                     "value": cfg.flight_dir})
+    if not cfg.devprof:
+        # kill switch for device telemetry (runtime/devprof.py; the
+        # bench.py --devprof measured-overhead lever; default on —
+        # profiler traces share flight_dir with the bundles)
+        env.append({"name": "TPUSERVE_DEVPROF", "value": "0"})
     if cfg.faults:
         # chaos drill: arm the engine's deterministic fault-injection
         # layer (runtime/faults.py) so recovery claims are verified

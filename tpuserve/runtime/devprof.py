@@ -1,0 +1,335 @@
+"""Device telemetry: per-dispatch attribution, executable ladder, HBM.
+
+Host-side observability is deep (hostprof phases, the flight recorder's
+step records, SLO burn rates) but the device itself was one opaque blob:
+nothing said how a step's wall time split into device compute vs host
+overhead, which bucketed executable served it, what compiles cost, or
+how close HBM sat to the edge — exactly the step-time/HBM breakdowns
+the Gemma TPU-serving study leans on (PAPERS.md, arxiv 2605.25645) and
+the capability/cost signals heterogeneous routing wants (arxiv
+2503.20074).  This module is that layer, with ZERO new device syncs
+(tpulint P1 stays green):
+
+- **device-time attribution**: the engine brackets its EXISTING
+  designated sync points (window flush, pending flush, sample read,
+  spec verify, draft proposal, guided top-k) with ``sync(kind)`` — the
+  host seconds blocked in a ``device_get`` are the device time the
+  pipelined design successfully hid everywhere else, split per sync
+  kind.  Dispatch brackets (``dispatch(kind, key)``) time the ASYNC
+  enqueue, i.e. pure host trace/dispatch cost — except on an
+  executable's FIRST call, where the blocking XLA compile lands in the
+  same bracket and is recorded as that (kind, bucket)'s compile wall.
+- **executable-ladder registry**: every (dispatch kind, bucket key)
+  pair the engine ever dispatched — compile wall ms, hit count, an
+  activation-bytes estimate — so compile storms and ladder bloat are a
+  table on /debug/engine, not an inference from step-time spikes.
+- **HBM watermark accounting**: the engine reconciles its block-manager
+  KV reservation with loaded weight bytes and the backend's
+  ``memory_stats`` into one watermark dict (``set_hbm``), exported as
+  the ``tpuserve_hbm_bytes{kind=weights|kv|other}`` gauges plus a
+  headroom scalar.
+- **profiler-capture bookkeeping**: ``note_capture`` records every
+  ``jax.profiler`` trace taken through /debug/profile or the fast-burn
+  SLO auto-capture hook (server/tracing.py holds the capture lock), so
+  post-mortem bundles reference the traces written beside them.
+
+Cost contract: mirrors hostprof — disabled, every bracket returns a
+shared no-op context manager (an attribute load and a falsy check per
+site, no timestamps); enabled, a bracket costs two ``perf_counter``
+calls and a dict update, inside the same <1% tok/s budget the flight
+recorder holds (``bench.py --devprof`` is the interleaved A/B guard).
+``TPUSERVE_DEVPROF=0`` / ``EngineConfig.devprof=False`` /
+``--no-devprof`` removes the layer with byte-identical serving
+behaviour: nothing here ever touches a jax array or changes a dispatch.
+
+Threading contract (the flight recorder's): every mutating call happens
+on the engine loop thread; serving threads read ``snapshot()`` copies
+only.  One profiler per engine — unlike hostprof's module singleton,
+the ladder and HBM view are engine-shaped state, so multi-engine
+processes (disagg) keep per-engine attribution exact.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Optional
+
+from tpuserve.utils import env_flag
+
+#: bound the ladder table in snapshots/bundles: a pathological bucket
+#: explosion must not turn /debug/engine into a megabyte payload (the
+#: registry itself is unbounded — seeing the overflow COUNT is the point)
+MAX_LADDER_SNAPSHOT = 128
+
+
+class _NoopCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopCtx()
+
+
+class _Dispatch:
+    """Brackets one async exec-hook call: accumulates host dispatch wall
+    per kind and maintains the (kind, key) ladder entry — first call
+    records the bracket wall as the executable's compile cost."""
+
+    __slots__ = ("_dp", "_kind", "_key", "_t0")
+
+    def __init__(self, dp, kind, key):
+        self._dp = dp
+        self._kind = kind
+        self._key = key
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        dp = self._dp
+        dp.dispatch_s[self._kind] += dt
+        dp.dispatch_counts[self._kind] += 1
+        lk = (self._kind, self._key)
+        ent = dp.ladder.get(lk)
+        if ent is None:
+            # first dispatch of this (kind, bucket): the blocking XLA
+            # compile ran inside this bracket — that wall IS the
+            # compile cost (tools/profile_step.py measures the same way)
+            dp.ladder[lk] = [round(dt * 1000, 3), 1,
+                             dp.estimate_bytes(self._key)]
+            dp.compiles += 1
+            dp.compile_s += dt
+        else:
+            ent[1] += 1
+        return False
+
+
+class _Sync:
+    """Brackets one EXISTING designated device_get: seconds the host
+    blocked waiting for the device, attributed to the sync kind."""
+
+    __slots__ = ("_dp", "_kind", "_t0")
+
+    def __init__(self, dp, kind):
+        self._dp = dp
+        self._kind = kind
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dp = self._dp
+        dp.sync_s[self._kind] += time.perf_counter() - self._t0
+        dp.sync_counts[self._kind] += 1
+        return False
+
+
+class DeviceProfiler:
+    """Per-engine device telemetry accumulator (see module docstring).
+
+    ``enabled=None`` resolves the ``TPUSERVE_DEVPROF`` env flag
+    (default on — the layer is meant to be always-on, like the flight
+    recorder it rides beside)."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = env_flag("TPUSERVE_DEVPROF")
+        self.enabled = bool(enabled)
+        # host wall spent inside exec-hook brackets (async enqueue +
+        # first-call compile), per dispatch kind
+        self.dispatch_s: dict[str, float] = defaultdict(float)
+        self.dispatch_counts: dict[str, int] = defaultdict(int)
+        # host wall blocked in the designated device_get sites, per sync
+        # kind — the measurable device time of the pipelined design
+        self.sync_s: dict[str, float] = defaultdict(float)
+        self.sync_counts: dict[str, int] = defaultdict(int)
+        # (kind, bucket key) -> [compile_ms, hits, est_bytes]
+        self.ladder: dict[tuple, list] = {}
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.cycles = 0
+        # per-token activation-bytes hint (set_model_hints); 0 = no
+        # estimate, ladder rows carry est_bytes=0
+        self._act_bytes_per_token = 0
+        # HBM watermark (set_hbm): static reconciliation of weights /
+        # KV reservation / backend memory stats, refreshed at engine
+        # construction (the reservation is static by design — paged KV
+        # is allocated up front)
+        self._hbm: dict = {}
+        # jax.profiler traces taken while this engine served (manual
+        # /debug/profile POSTs and SLO-page auto-captures): newest last,
+        # referenced from flight bundles; captures_total is the
+        # monotonic count behind the tpuserve_profile_captures counter
+        # (the list itself is trimmed)
+        self.captures: list[dict] = []
+        self.captures_total = 0
+        # step_delta() diffs against these totals
+        self._last_sync = 0.0
+        self._last_dispatch = 0.0
+        self._last_compiles = 0
+
+    # ---- hot path (engine loop thread) --------------------------------
+
+    def dispatch(self, kind: str, key: tuple):
+        if not self.enabled:
+            return _NOOP
+        return _Dispatch(self, kind, key)
+
+    def sync(self, kind: str):
+        if not self.enabled:
+            return _NOOP
+        return _Sync(self, kind)
+
+    def bump_cycle(self) -> None:
+        if self.enabled:
+            self.cycles += 1
+
+    # ---- facts (engine construction / capture paths) -------------------
+
+    def set_model_hints(self, *, act_bytes_per_token: int) -> None:
+        """Per-padded-token activation-bytes estimate for ladder rows —
+        a hint, not an XLA memory analysis (which jit does not expose
+        per cached executable); good enough to rank which buckets are
+        worth retiring."""
+        self._act_bytes_per_token = max(0, int(act_bytes_per_token))
+
+    def estimate_bytes(self, key: tuple) -> int:
+        """Estimated live-activation bytes for a bucket key whose first
+        element is the primary dispatch shape (rows x tokens...)."""
+        if not self._act_bytes_per_token or not key:
+            return 0
+        shape = key[0]
+        if not isinstance(shape, tuple):
+            return 0
+        n = 1
+        for d in shape:
+            n *= max(1, int(d))
+        return n * self._act_bytes_per_token
+
+    def set_hbm(self, *, weights: int, kv_reserved: int, limit: int,
+                num_blocks: int, block_bytes: int,
+                in_use: Optional[int] = None) -> None:
+        """Record the HBM watermark: ``weights`` (loaded param bytes,
+        draft included), ``kv_reserved`` (the paged cache's full static
+        reservation = num_blocks * block_bytes), ``limit`` (detected or
+        TPUSERVE_HBM_BYTES-overridden device budget), and the backend's
+        live ``bytes_in_use`` when it reports one.  ``other`` is the
+        workspace/fragmentation remainder the backend sees beyond
+        weights+KV; ``headroom`` is what is left under the limit."""
+        other = 0
+        if in_use is not None:
+            other = max(0, int(in_use) - int(weights) - int(kv_reserved))
+        self._hbm = {
+            "limit_bytes": int(limit),
+            "weights_bytes": int(weights),
+            "kv_reserved_bytes": int(kv_reserved),
+            "other_bytes": int(other),
+            "num_blocks": int(num_blocks),
+            "block_bytes": int(block_bytes),
+            "headroom_bytes": int(limit) - int(weights)
+                              - int(kv_reserved) - int(other),
+        }
+
+    def note_capture(self, trace_dir: str, reason: str,
+                     seconds: float) -> None:
+        """One jax.profiler trace landed on disk (manual or SLO-page
+        auto-capture).  Bounded: bundles reference the 16 newest."""
+        self.captures.append({"trace_dir": trace_dir, "reason": reason,
+                              "seconds": seconds})
+        self.captures_total += 1
+        del self.captures[:-16]
+
+    # ---- snapshots (any thread) ---------------------------------------
+
+    def hbm_snapshot(self) -> dict:
+        return dict(self._hbm)
+
+    def step_delta(self) -> Optional[dict]:
+        """Per-step deltas for the flight recorder's step record (single
+        consumer: FlightRecorder.note_step, engine loop thread): device
+        ms blocked, host dispatch ms, compiles since the previous
+        record.  Mirrors note_step's hostprof diffing."""
+        sync_t = sum(self.sync_s.values())
+        disp_t = sum(self.dispatch_s.values())
+        dev = {}
+        d = sync_t - self._last_sync
+        if d > 0:
+            dev["device_ms"] = round(d * 1000, 4)
+        d = disp_t - self._last_dispatch
+        if d > 0:
+            dev["dispatch_ms"] = round(d * 1000, 4)
+        d = self.compiles - self._last_compiles
+        if d > 0:
+            dev["compiles"] = d
+        self._last_sync = sync_t
+        self._last_dispatch = disp_t
+        self._last_compiles = self.compiles
+        return dev or None
+
+    def ladder_snapshot(self) -> dict:
+        """The executable ladder as a bounded table: one row per
+        (kind, bucket), hottest first, plus the registry totals (which
+        keep counting past the snapshot bound)."""
+        items = sorted(self.ladder.items(),
+                       key=lambda kv: kv[1][1], reverse=True)
+        rows = [{"kind": kind, "bucket": repr(key),
+                 "compile_ms": ent[0], "hits": ent[1],
+                 "est_bytes": ent[2]}
+                for (kind, key), ent in items[:MAX_LADDER_SNAPSHOT]]
+        return {
+            "retained": len(self.ladder),
+            "compiles": self.compiles,
+            "compile_ms": round(self.compile_s * 1000, 2),
+            "truncated": max(0, len(self.ladder) - MAX_LADDER_SNAPSHOT),
+            "executables": rows,
+        }
+
+    def report(self) -> dict:
+        """Machine-readable breakdown (bench.py --devprof rows,
+        /debug/engine, flight bundles): per-kind device/dispatch ms
+        totals and ms-per-cycle, ladder summary, HBM watermark,
+        recorded captures."""
+        cycles = max(self.cycles, 1)
+        device = {k: {"total_ms": round(v * 1000, 2),
+                      "syncs": self.sync_counts[k]}
+                  for k, v in sorted(self.sync_s.items())}
+        dispatch = {k: {"total_ms": round(v * 1000, 2),
+                        "calls": self.dispatch_counts[k]}
+                    for k, v in sorted(self.dispatch_s.items())}
+        dev_total = sum(self.sync_s.values())
+        disp_total = sum(self.dispatch_s.values())
+        return {
+            "enabled": self.enabled,
+            "cycles": self.cycles,
+            "device_ms_per_cycle": round(1000 * dev_total / cycles, 4),
+            "dispatch_ms_per_cycle": round(1000 * disp_total / cycles, 4),
+            "device": device,
+            "dispatch": dispatch,
+            "ladder": self.ladder_snapshot(),
+            "hbm": self.hbm_snapshot(),
+            "captures": list(self.captures),
+        }
+
+    # /debug/engine + bundle alias; report() is the bench-facing name
+    snapshot = report
+
+    def reset(self) -> None:
+        self.dispatch_s.clear()
+        self.dispatch_counts.clear()
+        self.sync_s.clear()
+        self.sync_counts.clear()
+        self.ladder.clear()
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.cycles = 0
+        self._last_sync = self._last_dispatch = 0.0
+        self._last_compiles = 0

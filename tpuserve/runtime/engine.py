@@ -158,6 +158,14 @@ class EngineConfig:
     # (default on; =0 removes the recorder byte-for-byte — the
     # bench.py --recorder-ab overhead A/B lever).
     flight: Optional[bool] = None
+    # Device telemetry (runtime/devprof.py): per-dispatch device-time
+    # attribution at the EXISTING designated sync points (zero new
+    # syncs), the (kind, bucket) executable-ladder registry, HBM
+    # watermark accounting behind the tpuserve_hbm_bytes gauges, and
+    # jax.profiler capture bookkeeping.  None = TPUSERVE_DEVPROF env
+    # (default on; =0 removes the layer byte-identically — the
+    # bench.py --devprof overhead A/B lever).
+    devprof: Optional[bool] = None
     # Injectable monotonic-time source (runtime/clock.py): None = the
     # shared real clock.  The trace-replay harness (tpuserve/replay/)
     # installs a VirtualClock here so recorded incidents re-run in
@@ -586,6 +594,17 @@ class Engine:
             # calls per phase) so every step record carries its
             # schedule/block/dispatch/detokenize/flush breakdown
             PROF.enabled = True
+        # Device telemetry (runtime/devprof.py): device-time attribution
+        # at the existing sync points, the executable-ladder registry,
+        # HBM watermark accounting and profiler-capture bookkeeping.
+        # Always-on by default like the recorder; the recorder handle
+        # lets note_step stamp per-step device-ms deltas and bundles
+        # carry the ladder/HBM/capture sections.  TPUSERVE_DEVPROF=0 /
+        # EngineConfig.devprof=False removes it byte-identically.
+        from tpuserve.runtime.devprof import DeviceProfiler
+        self.devprof = DeviceProfiler(enabled=config.devprof)
+        self.flight.devprof = (self.devprof if self.devprof.enabled
+                               else None)
         self._step_kind = "idle"
         # terminal errors for QUEUED requests decided engine-side
         # (deadline expiry, queue-full class eviction): (rid, exc) pairs
@@ -644,6 +663,11 @@ class Engine:
         # buckets so the windowed executable count stays bounded.
         self._guided_fsm: dict[str, list] = {}
         self._fsm_cache: dict[tuple, object] = {}
+        # grammar compile-cache counters surfaced via compile_cache_stats
+        # (/debug/engine "compile_caches"): misses count full compile
+        # walks AND disk-cache loads; disk_hits is the subset the
+        # fleet-wide PVC cache absorbed
+        self._fsm_stats = {"hits": 0, "misses": 0, "disk_hits": 0}
         self._fsm_device: dict[int, tuple] = {}
         self._fsm_texts: Optional[dict] = None   # token -> text, lazy
         self._fsm_tok_fp: Optional[str] = None   # disk-cache key half, lazy
@@ -724,6 +748,71 @@ class Engine:
             self.cache_cfg.max_model_len,
             self.model_cfg.max_position_embeddings,
             (self.cache_cfg.num_blocks - 1) * self.cache_cfg.block_size)
+        # seed the devprof HBM watermark once weights + cache exist
+        self._note_hbm_budget()
+
+    def _device_hbm_limit(self) -> int:
+        """Per-device HBM budget in bytes, after ``hbm_share``.
+
+        ``TPUSERVE_HBM_BYTES`` overrides detection, then jax
+        ``memory_stats()`` (bytes_limit / bytes_reservable_limit), then a
+        fixed fallback for backends without stats (CPU tests, some PJRT
+        plugins).  Shared by cache auto-sizing (_auto_num_blocks) and the
+        devprof HBM watermark so both report against the SAME budget."""
+        import os
+
+        limit = None
+        env = os.environ.get("TPUSERVE_HBM_BYTES")
+        if env:
+            limit = int(env)
+        if not limit:
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+                limit = (stats.get("bytes_limit")
+                         or stats.get("bytes_reservable_limit"))
+            except Exception:
+                pass
+        if not limit:
+            # backends without memory stats: assume a v5e-sized 16 GiB
+            # HBM on TPU, stay small elsewhere
+            limit = (16 << 30) if jax.default_backend() == "tpu" else (1 << 30)
+        return int(limit * self.config.hbm_share)
+
+    def _note_hbm_budget(self) -> None:
+        """Seed the devprof HBM watermark: weights (target + draft + any
+        pp-stage replication already inside self.params) from actual
+        loaded array bytes, the KV reservation from the cache geometry,
+        and live in-use bytes from device memory_stats when the backend
+        reports them (TPU does; CPU tests fall back to the
+        weights+kv floor, making "other" zero there)."""
+        if not self.devprof.enabled:
+            return
+
+        def _tree_bytes(tree) -> int:
+            if tree is None:
+                return 0
+            return sum(int(getattr(x, "nbytes", 0))
+                       for x in jax.tree_util.tree_leaves(tree))
+
+        weights = _tree_bytes(self.params) + _tree_bytes(self._draft_params)
+        kv = _tree_bytes(self.kv_cache)
+        block_bytes = (kv // self.cache_cfg.num_blocks
+                       if self.cache_cfg.num_blocks else 0)
+        in_use = None
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use")
+        except Exception:
+            pass
+        self.devprof.set_hbm(weights=weights, kv_reserved=kv,
+                             limit=self._device_hbm_limit(),
+                             num_blocks=self.cache_cfg.num_blocks,
+                             block_bytes=block_bytes, in_use=in_use)
+        # ladder footprint estimates: activations scale with tokens ×
+        # hidden; 3 transient buffers of f32 hidden per token is a
+        # deliberately rough upper-ish bound (documented as an estimate)
+        self.devprof.set_model_hints(
+            act_bytes_per_token=int(self.model_cfg.hidden_size) * 4 * 3)
 
     def _auto_num_blocks(self, mesh) -> int:
         """Size the paged KV cache to the device memory the weights left
@@ -740,26 +829,8 @@ class Engine:
         ``TPUSERVE_HBM_BYTES`` overrides the detected per-device memory —
         for engines sharing a chip (the colocated disagg topology passes
         a halved value via hbm_share) and for tests."""
-        import os
-
         from tpuserve.runtime.kv_cache import num_blocks_for_budget
-        limit = None
-        env = os.environ.get("TPUSERVE_HBM_BYTES")
-        if env:
-            limit = int(env)
-        if not limit:
-            try:
-                stats = jax.local_devices()[0].memory_stats() or {}
-                limit = (stats.get("bytes_limit")
-                         or stats.get("bytes_reservable_limit"))
-            except Exception:
-                pass
-        if not limit:
-            # backends without memory stats (CPU tests, some PJRT
-            # plugins): assume a v5e-sized 16 GiB HBM on TPU, stay small
-            # elsewhere
-            limit = (16 << 30) if jax.default_backend() == "tpu" else (1 << 30)
-        limit = int(limit * self.config.hbm_share)
+        limit = self._device_hbm_limit()
         from tpuserve.models.weights import param_nbytes
         shards = 1
         param_bytes = param_nbytes(self.params)
@@ -1352,6 +1423,7 @@ class Engine:
         self._dispatch_rids = ()
         self._step_kind = "idle"
         PROF.bump_cycle()
+        self.devprof.bump_cycle()
         # overload robustness, BEFORE scheduling: deadline-expired queued
         # requests leave without spending prefill, and a stricter-class
         # waiting head may preempt running batch rows for its seat/blocks
@@ -1712,29 +1784,31 @@ class Engine:
 
     def _exec_prefill(self, tokens, prompt_lens, slot_ids, ad=None):
         self.faults.check("prefill_dispatch", self._dispatch_rids)
-        if self._pp > 1:
-            from tpuserve.parallel.pipeline import pp_prefill
-            return pp_prefill(self._pp_head, self._pp_stages, self.model_cfg,
-                              tokens, prompt_lens, slot_ids, self.kv_cache,
-                              mesh=self.mesh)
-        return transformer.prefill(
-            self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
-            self.kv_cache, ad, attn_impl=self.attn_impl,
-            mesh=self._attn_mesh)
+        with self.devprof.dispatch("prefill", (tuple(tokens.shape),)):
+            if self._pp > 1:
+                from tpuserve.parallel.pipeline import pp_prefill
+                return pp_prefill(self._pp_head, self._pp_stages,
+                                  self.model_cfg, tokens, prompt_lens,
+                                  slot_ids, self.kv_cache, mesh=self.mesh)
+            return transformer.prefill(
+                self.params, self.model_cfg, tokens, prompt_lens, slot_ids,
+                self.kv_cache, ad, attn_impl=self.attn_impl,
+                mesh=self._attn_mesh)
 
     def _exec_decode(self, tokens, positions, slot_ids, block_tables,
                      seq_lens, ad=None):
         self.faults.check("decode_dispatch", self._dispatch_rids)
-        if self._pp > 1:
-            from tpuserve.parallel.pipeline import pp_decode_step
-            return pp_decode_step(self._pp_head, self._pp_stages,
-                                  self.model_cfg, tokens, positions,
-                                  slot_ids, block_tables, seq_lens,
-                                  self.kv_cache, mesh=self.mesh)
-        return transformer.decode_step(
-            self.params, self.model_cfg, tokens, positions, slot_ids,
-            block_tables, seq_lens, self.kv_cache, ad,
-            attn_impl=self.attn_impl, mesh=self._attn_mesh)
+        with self.devprof.dispatch("decode", (tuple(tokens.shape),)):
+            if self._pp > 1:
+                from tpuserve.parallel.pipeline import pp_decode_step
+                return pp_decode_step(self._pp_head, self._pp_stages,
+                                      self.model_cfg, tokens, positions,
+                                      slot_ids, block_tables, seq_lens,
+                                      self.kv_cache, mesh=self.mesh)
+            return transformer.decode_step(
+                self.params, self.model_cfg, tokens, positions, slot_ids,
+                block_tables, seq_lens, self.kv_cache, ad,
+                attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_prefill_chunk(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables, ad=None):
@@ -1742,10 +1816,11 @@ class Engine:
         if self._pp > 1:            # unreachable: gated at add_request
             raise RuntimeError("chunked prefill is not supported on the "
                                "pipeline engine")
-        return transformer.prefill_chunk(
-            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
-            slot_ids, block_tables, self.kv_cache, ad,
-            attn_impl=self.attn_impl, mesh=self._attn_mesh)
+        with self.devprof.dispatch("prefill_chunk", (tuple(tokens.shape),)):
+            return transformer.prefill_chunk(
+                self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+                slot_ids, block_tables, self.kv_cache, ad,
+                attn_impl=self.attn_impl, mesh=self._attn_mesh)
 
     def _exec_decode_verify(self, tokens, ctx_lens, chunk_lens, slot_ids,
                             block_tables):
@@ -1756,9 +1831,10 @@ class Engine:
         # Verify windows are a handful of rows — below the Pallas kernel's
         # tiling minima and cheap for the segmented einsum — so this stays
         # on the reference attention regardless of attn_impl.
-        return transformer.decode_verify(
-            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
-            slot_ids, block_tables, self.kv_cache)
+        with self.devprof.dispatch("verify", (tuple(tokens.shape),)):
+            return transformer.decode_verify(
+                self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+                slot_ids, block_tables, self.kv_cache)
 
     def _exec_decode_verify_sampled(self, tokens, ctx_lens, chunk_lens,
                                     slot_ids, block_tables, keys,
@@ -1766,10 +1842,11 @@ class Engine:
         self.faults.check("decode_dispatch", self._dispatch_rids)
         # sampled-batch twin of _exec_decode_verify: rejection-sampling
         # acceptance runs on device against the full verify logits
-        return transformer.decode_verify_sampled(
-            self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
-            slot_ids, block_tables, self.kv_cache, keys, temperature,
-            top_k, top_p, min_p)
+        with self.devprof.dispatch("verify_sampled", (tuple(tokens.shape),)):
+            return transformer.decode_verify_sampled(
+                self.params, self.model_cfg, tokens, ctx_lens, chunk_lens,
+                slot_ids, block_tables, self.kv_cache, keys, temperature,
+                top_k, top_p, min_p)
 
     def _exec_draft_propose(self, tokens, lens, *, k):
         self.faults.check("decode_dispatch", self._dispatch_rids)
@@ -1777,8 +1854,10 @@ class Engine:
         # rest of speculation in __init__); the hook exists so the AST
         # coverage test can hold the "no direct transformer calls" line
         # everywhere (see _exec_decode_verify).
-        return transformer.draft_propose(self._draft_params,
-                                         self._draft_cfg, tokens, lens, k=k)
+        with self.devprof.dispatch("draft", (tuple(tokens.shape), k)):
+            return transformer.draft_propose(self._draft_params,
+                                             self._draft_cfg, tokens, lens,
+                                             k=k)
 
     def _exec_decode_multi(self, tokens, positions, block_tables, seq_lens,
                            active, keys, temperature, *, steps, mode,
@@ -1789,26 +1868,30 @@ class Engine:
                            gstate=None, gmasks=None, gclass=None,
                            gnext=None, ad=None):
         self.faults.check("decode_dispatch", self._dispatch_rids)
-        if self._pp > 1:
-            from tpuserve.parallel.pipeline import pp_decode_multi
-            return pp_decode_multi(
-                self._pp_head, self._pp_stages, self.model_cfg, tokens,
-                positions, block_tables, seq_lens, active, keys,
-                temperature, self.kv_cache, mesh=self.mesh, steps=steps,
-                mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
-                logprobs_n=logprobs_n, counts=counts, presence=presence,
-                frequency=frequency, repetition=repetition, bias=bias,
-                floor_bias=floor_bias, floor_remaining=floor_remaining)
-        return transformer.decode_multi(
-            self.params, self.model_cfg, tokens, positions, block_tables,
-            seq_lens, active, keys, temperature, self.kv_cache, ad,
-            steps=steps, mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
-            logprobs_n=logprobs_n, counts=counts, presence=presence,
-            frequency=frequency, repetition=repetition, bias=bias,
-            floor_bias=floor_bias, floor_remaining=floor_remaining,
-            gstate=gstate, gmasks=gmasks, gclass=gclass, gnext=gnext,
-            attn_impl=self.attn_impl,
-            mesh=self._attn_mesh, out_mesh=self.mesh)
+        with self.devprof.dispatch(
+                "decode_multi", (tuple(tokens.shape), steps, mode,
+                                 logprobs_n, gmasks is not None)):
+            if self._pp > 1:
+                from tpuserve.parallel.pipeline import pp_decode_multi
+                return pp_decode_multi(
+                    self._pp_head, self._pp_stages, self.model_cfg, tokens,
+                    positions, block_tables, seq_lens, active, keys,
+                    temperature, self.kv_cache, mesh=self.mesh, steps=steps,
+                    mode=mode, top_k=top_k, top_p=top_p, min_p=min_p,
+                    logprobs_n=logprobs_n, counts=counts, presence=presence,
+                    frequency=frequency, repetition=repetition, bias=bias,
+                    floor_bias=floor_bias, floor_remaining=floor_remaining)
+            return transformer.decode_multi(
+                self.params, self.model_cfg, tokens, positions, block_tables,
+                seq_lens, active, keys, temperature, self.kv_cache, ad,
+                steps=steps, mode=mode, top_k=top_k, top_p=top_p,
+                min_p=min_p, logprobs_n=logprobs_n, counts=counts,
+                presence=presence, frequency=frequency,
+                repetition=repetition, bias=bias, floor_bias=floor_bias,
+                floor_remaining=floor_remaining, gstate=gstate,
+                gmasks=gmasks, gclass=gclass, gnext=gnext,
+                attn_impl=self.attn_impl,
+                mesh=self._attn_mesh, out_mesh=self.mesh)
 
     def _exec_forward_ragged(self, tokens, positions, slot_ids, row_seq,
                              block_tables, kv_lens, q_starts, q_lens,
@@ -1820,19 +1903,22 @@ class Engine:
         # precedent).  No mesh arg: under tp _ragged_attn is forced to
         # "reference" (the ragged kernel has no shard_map wrapper yet)
         # and GSPMD partitions the reference einsums on its own.
-        return transformer.forward_ragged(
-            self.params, self.model_cfg, tokens, positions, slot_ids,
-            row_seq, block_tables, kv_lens, q_starts, q_lens, meta,
-            blk_seq, last_rows, self.kv_cache, ad,
-            ragged_blk=self._ragged_blk, attn_impl=self._ragged_attn)
+        with self.devprof.dispatch("mixed", (tuple(tokens.shape),)):
+            return transformer.forward_ragged(
+                self.params, self.model_cfg, tokens, positions, slot_ids,
+                row_seq, block_tables, kv_lens, q_starts, q_lens, meta,
+                blk_seq, last_rows, self.kv_cache, ad,
+                ragged_blk=self._ragged_blk, attn_impl=self._ragged_attn)
 
     def _exec_sample(self, logits, keys, temperature, top_k, top_p, *,
                      min_p=None, mode):
         # sampling executables ride the decode site: they are part of the
         # same device round-trip a dispatch failure would take down
         self.faults.check("decode_dispatch", self._dispatch_rids)
-        return sampling_ops.sample_tokens(
-            logits, keys, temperature, top_k, top_p, min_p=min_p, mode=mode)
+        with self.devprof.dispatch("sample", (tuple(logits.shape), mode)):
+            return sampling_ops.sample_tokens(
+                logits, keys, temperature, top_k, top_p, min_p=min_p,
+                mode=mode)
 
     # ---- prefill ------------------------------------------------------
 
@@ -2411,13 +2497,14 @@ class Engine:
         # exactly what the salvage path expects to find.
         self.faults.check("window_flush",
                           tuple(r.request_id for r in p.reqs))
-        with PROF.phase("flush"):
+        with PROF.phase("flush"), self.devprof.sync("window"):
             # tpulint: sync-ok(THE designated sync: one device_get per S-token window is the whole fused-window design)
             toks_h = np.asarray(jax.device_get(p.toks))
         lp_h = None
         if p.lp is not None:
-            # tpulint: sync-ok(rides the same window-flush sync point; logprob arrays resolve with the tokens)
-            lp_h = tuple(np.asarray(x) for x in jax.device_get(p.lp))
+            with self.devprof.sync("window"):
+                # tpulint: sync-ok(rides the same window-flush sync point; logprob arrays resolve with the tokens)
+                lp_h = tuple(np.asarray(x) for x in jax.device_get(p.lp))
         outputs: list[RequestOutput] = []
         # Commit written KV BEFORE emitting (finish frees blocks mid-loop);
         # zombie rows' blocks were already freed at the previous flush.
@@ -2759,17 +2846,19 @@ class Engine:
                 jnp.asarray(top_p), jnp.asarray(min_p))
             # ONE round trip for both arrays — a tunneled backend pays
             # tens of ms per host sync
-            accept_h, pred_h = (
-                np.asarray(x) for x in
-                # tpulint: sync-ok(spec verify is synchronous by design: accept/pred decide host-side emission this step)
-                jax.device_get((accept, pred)))
+            with self.devprof.sync("verify"):
+                accept_h, pred_h = (
+                    np.asarray(x) for x in
+                    # tpulint: sync-ok(spec verify is synchronous by design: accept/pred decide host-side emission this step)
+                    jax.device_get((accept, pred)))
         else:
             pred, self.kv_cache = self._exec_decode_verify(
                 jnp.asarray(tokens), jnp.asarray(ctx_lens),
                 jnp.asarray(chunk_lens), jnp.asarray(slot_ids),
                 jnp.asarray(block_tables))
-            # tpulint: sync-ok(greedy spec verify twin of the sampled sync above)
-            pred_h = np.asarray(jax.device_get(pred))
+            with self.devprof.sync("verify"):
+                # tpulint: sync-ok(greedy spec verify twin of the sampled sync above)
+                pred_h = np.asarray(jax.device_get(pred))
         self.stats.num_decode_steps += 1
         self.stats.spec_steps += 1
         self._note_step_tokens(int(chunk_lens[:len(reqs)].sum()), B * K)
@@ -2807,9 +2896,12 @@ class Engine:
             ids = (r.prompt_token_ids + r.output_token_ids)[-W:]
             tokens[i, :len(ids)] = ids
             lens[i] = len(ids)
-        # tpulint: sync-ok(draft proposals feed the verify batch built host-side this same step; spec path is synchronous)
-        out = np.asarray(self._exec_draft_propose(
-            jnp.asarray(tokens), jnp.asarray(lens), k=k))
+        out_d = self._exec_draft_propose(jnp.asarray(tokens),
+                                         jnp.asarray(lens), k=k)
+        # designated sync: draft proposals feed the verify batch built
+        # host-side this same step (the spec path is synchronous)
+        with self.devprof.sync("draft"):
+            out = np.asarray(out_d)
         return [[int(t) for t in out[i]] for i in range(len(reqs))]
 
     def _spec_govern(self, proposed: int, accepted: int) -> None:
@@ -2843,7 +2935,7 @@ class Engine:
         p, self._pending = self._pending, None
         if p is None:
             return []
-        with PROF.phase("flush"):
+        with PROF.phase("flush"), self.devprof.sync("decode"):
             # tpulint: sync-ok(the single-step pipeline's designated sync: resolves the PREVIOUS step while the next runs)
             toks = np.asarray(jax.device_get(p.toks))
         reqs, vals = [], []
@@ -2879,7 +2971,7 @@ class Engine:
         toks = self._sample_modes(logits, reqs, B, frozenset())
         if any(r.params.logprobs is not None for r in reqs):
             self._record_logprobs(logits, toks, reqs)
-        with PROF.phase("flush"):
+        with PROF.phase("flush"), self.devprof.sync("sample"):
             # tpulint: sync-ok(the synchronous per-step path's one sync; the pipelined paths never call _sample)
             toks_np = np.asarray(jax.device_get(toks))[:n].copy()
         if any(r.request_id in self._guided for r in reqs):
@@ -2927,7 +3019,9 @@ class Engine:
             return None
         key = (params.guided, params.guided_schema)
         if key in self._fsm_cache:
+            self._fsm_stats["hits"] += 1
             return self._fsm_cache[key]
+        self._fsm_stats["misses"] += 1
         from tpuserve.runtime.grammar import (FsmCompileError, fsm_for_spec,
                                               load_fsm, resolve_cache_dir,
                                               save_fsm, token_text_table,
@@ -2948,6 +3042,7 @@ class Engine:
             fsm = load_fsm(disk_dir, params.guided, params.guided_schema,
                            tok_fp)
             if fsm is not None:
+                self._fsm_stats["disk_hits"] += 1
                 self._memoise_fsm(key, fsm)
                 return fsm
         if self._fsm_texts is None:
@@ -2981,6 +3076,29 @@ class Engine:
             if old is not None:
                 self._fsm_device.pop(id(old), None)
         self._fsm_cache[key] = fsm
+
+    def compile_cache_stats(self) -> dict:
+        """Hit/miss/size for the engine's two compile caches — the
+        grammar-FSM memo and the bucketed-executable ladder — surfaced at
+        /debug/engine ("compile_caches") so compile churn is visible
+        without log archaeology.  FSM misses count full determinizing
+        walks AND disk-cache loads (disk_hits is the subset the
+        fleet-wide PVC cache absorbed); ladder misses are first-dispatch
+        compiles as attributed by devprof (tracked=False when
+        TPUSERVE_DEVPROF=0 leaves the ladder unobserved)."""
+        dp = self.devprof
+        return {
+            "fsm": {"hits": self._fsm_stats["hits"],
+                    "misses": self._fsm_stats["misses"],
+                    "disk_hits": self._fsm_stats["disk_hits"],
+                    "size": len(self._fsm_cache)},
+            "ladder": {"hits": max(0, sum(dp.dispatch_counts.values())
+                                   - dp.compiles),
+                       "misses": dp.compiles,
+                       "size": len(dp.ladder),
+                       "compile_ms": round(dp.compile_s * 1000.0, 3),
+                       "tracked": dp.enabled},
+        }
 
     def _fsm_device_tables(self, fsm):
         """Device-resident (masks, tok_class, class_next) for ``fsm``,
@@ -3035,8 +3153,9 @@ class Engine:
         is written by the NEXT dispatch."""
         k = min(self.GUIDED_TOP_K, self.model_cfg.vocab_size)
         _, top_ids = jax.lax.top_k(logits, k)
-        # tpulint: sync-ok(legacy guided substitution is host-side by design; FSM-compilable grammars stay on device)
-        ids_h = np.asarray(jax.device_get(top_ids))
+        with self.devprof.sync("guided"):
+            # tpulint: sync-ok(legacy guided substitution is host-side by design; FSM-compilable grammars stay on device)
+            ids_h = np.asarray(jax.device_get(top_ids))
         for i, r in enumerate(reqs):
             st = self._guided.get(r.request_id)
             if r.params.guided is None or st is None:

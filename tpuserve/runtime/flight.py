@@ -132,6 +132,12 @@ class FlightRecorder:
         # per-cycle hostprof deltas are diffs against this snapshot of the
         # module profiler's cumulative seconds
         self._prof_last: dict = {}
+        # device telemetry handle (runtime/devprof.py): set by the OWNING
+        # engine when devprof is enabled; None keeps every record
+        # byte-identical to a devprof-less build (the TPUSERVE_DEVPROF=0
+        # removal pin).  Per-engine like the recorder itself — step
+        # records carry THIS engine's device deltas, not a process blur
+        self.devprof = None
         # client-observable SLI reservoirs: (class, kind) -> bounded ring
         self._sli: dict = {}
         self.postmortems = 0
@@ -184,8 +190,13 @@ class FlightRecorder:
                 if d > 0:
                     phases[k] = round(d * 1000, 4)
             self._prof_last = cur
+        dev = None
+        if self.devprof is not None:
+            # per-step device-ms / dispatch-ms / compile deltas, same
+            # diffing idiom as the hostprof phases above
+            dev = self.devprof.step_delta()
         self._steps.append((self._clock.monotonic(), kind, rows, actual, padded,
-                            round(dur_s * 1000, 4), phases or None))
+                            round(dur_s * 1000, 4), phases or None, dev))
 
     def note_engine_facts(self, **facts) -> None:
         """Engine configuration facts stamped into every bundle (model,
@@ -256,13 +267,17 @@ class FlightRecorder:
 
     def steps_snapshot(self, limit: int = 128) -> list[dict]:
         out = []
-        for t, kind, rows, actual, padded, ms, phases in \
+        for t, kind, rows, actual, padded, ms, phases, dev in \
                 self._steps.snapshot()[-limit:]:
             rec = {"t": t, "kind": kind, "rows": rows,
                    "actual_tokens": actual, "padded_tokens": padded,
                    "ms": ms}
             if phases:
                 rec["phase_ms"] = phases
+            if dev:
+                # device-time attribution deltas (runtime/devprof.py):
+                # device_ms / dispatch_ms / compiles for this step
+                rec["dev"] = dev
             out.append(rec)
         return out
 
@@ -284,7 +299,7 @@ class FlightRecorder:
         return out
 
     def engine_snapshot(self, steps: int = 128) -> dict:
-        return {
+        out = {
             "enabled": self.enabled,
             "events_recorded": self._events.idx,
             "steps_recorded": self._steps.idx,
@@ -295,6 +310,11 @@ class FlightRecorder:
             "postmortems": self.postmortems,
             "last_postmortem": self.last_postmortem,
         }
+        if self.devprof is not None:
+            # device telemetry: attribution totals, executable ladder,
+            # HBM watermark, recorded profiler captures
+            out["devprof"] = self.devprof.snapshot()
+        return out
 
     def wall_of(self, t_mono: float) -> float:
         """Map a recorded monotonic timestamp onto the wall clock (OTLP
@@ -333,6 +353,11 @@ class FlightRecorder:
             "sli": self.sli_summary(),
             "control": dict(self._control),
         }
+        if self.devprof is not None:
+            # ladder/HBM/capture state at dump time: a post-mortem names
+            # the jax.profiler traces written beside it (trace_dir under
+            # the same TPUSERVE_FLIGHT_DIR)
+            bundle["devprof"] = self.devprof.snapshot()
         bundle["rings"] = {
             "events": {"cursor": ev_cursor, "capacity": self._events._n,
                        "dropped": max(0, ev_cursor - self._events._n),

@@ -352,6 +352,56 @@ class ServerMetrics:
             "X-TPUServe-Canary; excluded from tenant metering and "
             "every production SLI histogram — this counter is the "
             "proof they still flow through the real path)")
+        # Device telemetry (runtime/devprof.py): the engine's own view of
+        # device time, HBM occupancy, and the bucketed-executable ladder —
+        # the step-time/HBM breakdowns the reference's DCGM-only GPU
+        # metrics never had (PARITY.md).  TPUSERVE_DEVPROF=0 leaves these
+        # families at zero.
+        self.hbm_bytes = Gauge(
+            "tpuserve_hbm_bytes",
+            "Per-device HBM watermark by kind= weights (loaded param "
+            "bytes, draft included), kv (the paged cache's full static "
+            "reservation), other (workspace/fragmentation the backend "
+            "reports beyond weights+kv) — reconciled against jax "
+            "memory_stats at engine construction",
+            ["model_name", "kind"], registry=self.registry)
+        self.hbm_headroom = gauge(
+            "tpuserve_hbm_headroom_bytes",
+            "Detected HBM budget minus weights+kv+other — what is left "
+            "before the next ladder bucket, draft model, or KV resize "
+            "OOMs; the generated hbm-headroom-low warning fires on the "
+            "ratio of this to the budget")
+        self.device_seconds = Counter(
+            "tpuserve_device_seconds",
+            "Host seconds blocked in the engine's designated device_get "
+            "sync points, by sync kind= window|decode|sample|verify|"
+            "draft|guided — the measurable device time of the pipelined "
+            "design (an underestimate of raw device compute: overlapped "
+            "work never blocks)",
+            ["model_name", "kind"], registry=self.registry)
+        self.exec_compiles = counter(
+            "tpuserve_executable_compiles",
+            "First-dispatch XLA compiles observed by the executable "
+            "ladder (one per (dispatch kind, bucket) pair) — a rising "
+            "rate in steady state is a compile storm: bucket ladders "
+            "too fine, or an unbounded shape leaking into a dispatch")
+        self.exec_compile_seconds = counter(
+            "tpuserve_executable_compile_seconds",
+            "Wall seconds spent inside first-dispatch compile brackets "
+            "— the serving stall each new executable cost (warmup "
+            "prepays the planned ladder; this counts the rest)")
+        self.execs_retained = gauge(
+            "tpuserve_executables_retained",
+            "Distinct (dispatch kind, bucket) executables the ladder "
+            "has ever dispatched and jit retains — ladder bloat is HBM "
+            "spent on compiled code, bounded by design by the "
+            "power-of-2 bucketing")
+        self.profile_captures = counter(
+            "tpuserve_profile_captures",
+            "jax.profiler traces captured on demand (POST "
+            "/debug/profile) or by the fast-burn SLO auto-capture hook "
+            "— trace dirs land under TPUSERVE_FLIGHT_DIR beside the "
+            "post-mortem bundles that reference them")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -657,20 +657,35 @@ class _Handler(BaseHTTPRequestHandler):
                                  "ring holds the most recent "
                                  "TPUSERVE_FLIGHT_EVENTS events)")
         elif self.path.startswith("/debug/profile"):
-            # jax.profiler capture (SURVEY.md §5: the reference has no
-            # profiler; this is the TPU-native story).  Blocks this handler
-            # thread only; the engine keeps serving while being traced.
-            from urllib.parse import parse_qs, urlparse
-            from tpuserve.server.tracing import capture_profile
-            try:
-                q = parse_qs(urlparse(self.path).query)
-                seconds = float(q.get("seconds", ["2"])[0])
-                self._json(200, capture_profile(seconds))
-            except Exception as e:
-                self._error(500, f"profile capture failed: {e}",
-                            "server_error")
+            self._handle_profile()
         else:
             self._error(404, f"no route {self.path}")
+
+    def _handle_profile(self) -> None:
+        """jax.profiler capture (SURVEY.md §5: the reference has no
+        profiler; this is the TPU-native story).  Blocks this handler
+        thread only; the engine keeps serving while being traced — the
+        trace is OF live serving.  Serialized process-wide (409 when a
+        capture is already running); the trace dir lands under
+        TPUSERVE_FLIGHT_DIR when configured and is recorded on each
+        engine's DeviceProfiler so bundles reference it.  GET kept for
+        compatibility; POST is the documented verb (a capture writes
+        disk state)."""
+        from urllib.parse import parse_qs, urlparse
+        from tpuserve.server.tracing import (CaptureBusy,
+                                             capture_profile_locked)
+        profs = [getattr(e, "devprof", None)
+                 for e in self.ctx.runner._inner_engines()]
+        try:
+            q = parse_qs(urlparse(self.path).query)
+            seconds = float(q.get("seconds", ["2"])[0])
+            self._json(200, capture_profile_locked(
+                seconds, reason="manual", profilers=profs))
+        except CaptureBusy as e:
+            self._error(409, str(e), "server_error")
+        except Exception as e:
+            self._error(500, f"profile capture failed: {e}",
+                        "server_error")
 
     def _flight_recorders(self) -> list:
         """Enabled flight recorders across the (possibly disagg) engine —
@@ -697,6 +712,15 @@ class _Handler(BaseHTTPRequestHandler):
         ev = getattr(self.ctx.runner, "slo_eval", None)
         if ev is not None:
             out["slo"] = dict(ev.last_state)
+        # compile-cache visibility (the small fix riding the devprof PR):
+        # grammar-FSM memo + bucketed-executable ladder hit/miss/size per
+        # engine, so compile churn is an endpoint read, not log archaeology
+        caches = [e.compile_cache_stats()
+                  for e in self.ctx.runner._inner_engines()
+                  if hasattr(e, "compile_cache_stats")]
+        if caches:
+            out["compile_caches"] = (caches[0] if len(caches) == 1
+                                     else caches)
         return out
 
     def _emit_engine_spans(self, rids) -> None:
@@ -817,6 +841,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/v1/embeddings":
             self._handle_embeddings()
+            return
+        if self.path.startswith("/debug/profile"):
+            self._handle_profile()
             return
         chat = self.path == "/v1/chat/completions"
         if self.path not in ("/v1/completions", "/v1/chat/completions"):
@@ -1927,6 +1954,12 @@ def main(argv=None):
                     help="disable the in-process SLO burn-rate "
                          "evaluator (tpuserve/obs; TPUSERVE_SLO_BURN=0 "
                          "is the env twin)")
+    ap.add_argument("--no-devprof", action="store_true",
+                    help="disable device telemetry (runtime/devprof.py): "
+                         "no device-time attribution, executable ladder, "
+                         "HBM watermark, or profiler-capture bookkeeping "
+                         "(TPUSERVE_DEVPROF=0 is the env twin); serving "
+                         "output is byte-identical either way")
     ap.add_argument("--slo-objectives", default=None,
                     metavar="JSON|PATH",
                     help="SLO objectives override (tpuserve/obs/"
@@ -1990,6 +2023,7 @@ def main(argv=None):
         kv_tiers=False if args.no_kv_tiers else None,
         kv_host_bytes=args.kv_host_bytes, kv_spill_dir=args.kv_spill_dir,
         slo_classes=False if args.no_slo_classes else None,
+        devprof=False if args.no_devprof else None,
         faults=args.faults, step_watchdog_s=args.step_watchdog_s)
     mesh = None
     if args.pp > 1 and args.tp > 1:

@@ -154,6 +154,10 @@ class AsyncEngineRunner:
         # replay-driven runner evaluates in virtual time.
         self.slo_eval = None
         self._slo_eval_last: Optional[float] = None
+        # fast-burn auto-capture (runtime/devprof.py + server/tracing.py):
+        # wall-clock cooldown stamp so a flapping page takes ONE
+        # jax.profiler trace per window, not one per transition
+        self._auto_capture_last: Optional[float] = None
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -774,6 +778,7 @@ class AsyncEngineRunner:
                            tr["state"].upper(), tr["objective"],
                            tr["window"], tr["burn_long"],
                            tr["burn_short"])
+        self._maybe_auto_capture(transitions)
         if not self.metrics:
             return
         model = self.metrics.model_name
@@ -791,6 +796,57 @@ class AsyncEngineRunner:
                 window=window).set(burn_long)
         self.metrics.slo_alerts_firing.set(
             len(state.get("firing", ())))
+
+    # fast-burn auto-capture: a SHORT trace (the incident is happening
+    # now; a long one only delays the next) and a long cooldown so a
+    # flapping page cannot fill the flight dir with traces
+    AUTO_CAPTURE_SECONDS = 3.0
+    AUTO_CAPTURE_COOLDOWN_S = 600.0
+
+    def _maybe_auto_capture(self, transitions: list) -> None:
+        """Fast-burn SLO pages self-instrument: when a fast-window
+        burn-rate alert FIRES, take a short jax.profiler trace on a
+        daemon thread (the engine loop must keep serving — the trace is
+        OF the degraded serving).  The trace lands under
+        TPUSERVE_FLIGHT_DIR beside any post-mortem and is recorded on
+        each engine's DeviceProfiler, so bundles written during the
+        incident reference it.  No-ops when devprof is disabled, inside
+        the cooldown, or when a manual capture holds the process lock."""
+        fired = [tr for tr in transitions
+                 if tr.get("state") == "firing"
+                 and tr.get("window") == "fast"]
+        if not fired:
+            return
+        profs = [dp for dp in (getattr(e, "devprof", None)
+                               for e in self._inner_engines())
+                 if dp is not None and dp.enabled]
+        if not profs:
+            return
+        now = time.monotonic()  # tpulint: sync-ok(capture cooldown is real wall seconds; jax.profiler cannot run in replay time)
+        if (self._auto_capture_last is not None
+                and now - self._auto_capture_last
+                < self.AUTO_CAPTURE_COOLDOWN_S):
+            return
+        self._auto_capture_last = now
+        reason = f"slo-{fired[0]['objective']}"
+
+        def _run():
+            from tpuserve.server.tracing import (CaptureBusy,
+                                                 capture_profile_locked)
+            try:
+                out = capture_profile_locked(self.AUTO_CAPTURE_SECONDS,
+                                             reason=reason,
+                                             profilers=profs)
+                logger.warning("fast-burn auto-capture -> %s",
+                               out["trace_dir"])
+            except CaptureBusy:
+                logger.info("fast-burn auto-capture skipped: a capture "
+                            "is already in progress")
+            except Exception:
+                logger.exception("fast-burn auto-capture failed")
+
+        threading.Thread(target=_run, daemon=True,
+                         name="tpuserve-auto-capture").start()
 
     def _update_gauges(self) -> None:
         self._evaluate_slo()
@@ -926,6 +982,39 @@ class AsyncEngineRunner:
             sum(t.host_count for t in stores))
         self.metrics.kv_tier_blocks.labels(tier="spill", **label).set(
             sum(t.spill_count for t in stores))
+        # device telemetry (runtime/devprof.py): HBM watermark gauges,
+        # per-sync-kind device seconds, ladder compile totals, capture
+        # count.  Engines keep cumulative totals; counters advance by
+        # delta (_advance_counter), gauges set wholesale.  Disabled
+        # devprofs are skipped — the families stay at zero.
+        profs = [dp for dp in (getattr(e, "devprof", None)
+                               for e in (inners or [eng]))
+                 if dp is not None and dp.enabled]
+        if profs:
+            hbm = [dp.hbm_snapshot() for dp in profs]
+            for kind, field in (("weights", "weights_bytes"),
+                                ("kv", "kv_reserved_bytes"),
+                                ("other", "other_bytes")):
+                self.metrics.hbm_bytes.labels(kind=kind, **label).set(
+                    sum(h.get(field, 0) for h in hbm))
+            self.metrics.hbm_headroom.set(
+                min((h.get("headroom_bytes", 0) for h in hbm if h),
+                    default=0))
+            sync_totals: dict = {}
+            for dp in profs:
+                for k, v in dp.sync_s.items():
+                    sync_totals[k] = sync_totals.get(k, 0.0) + v
+            for k, v in sync_totals.items():
+                _advance_counter(
+                    self.metrics.device_seconds.labels(kind=k, **label), v)
+            _advance_counter(self.metrics.exec_compiles,
+                             sum(dp.compiles for dp in profs))
+            _advance_counter(self.metrics.exec_compile_seconds,
+                             sum(dp.compile_s for dp in profs))
+            self.metrics.execs_retained.set(
+                sum(len(dp.ladder) for dp in profs))
+            _advance_counter(self.metrics.profile_captures,
+                             sum(dp.captures_total for dp in profs))
 
     def _loop(self) -> None:
         logger.info("engine loop started")

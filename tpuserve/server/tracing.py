@@ -21,6 +21,7 @@ import logging
 import os
 import sys
 import tempfile
+import threading
 import time
 
 logger = logging.getLogger("tpuserve.tracing")
@@ -182,3 +183,54 @@ def capture_profile(seconds: float, out_dir: str | None = None) -> dict:
     finally:
         jax.profiler.stop_trace()
     return {"trace_dir": out_dir, "seconds": seconds}
+
+
+class CaptureBusy(RuntimeError):
+    """A jax.profiler capture is already running in this process.
+
+    jax allows ONE active trace per process; a second start_trace raises
+    deep inside the profiler plugin.  Callers (POST /debug/profile, the
+    SLO fast-burn auto-capture) turn this into HTTP 409 / a skipped
+    auto-capture instead of a 500."""
+
+
+# one trace at a time per process: guards manual /debug/profile requests
+# racing each other AND the SLO auto-capture thread racing either
+_capture_lock = threading.Lock()
+
+
+def profile_out_dir(reason: str) -> str | None:
+    """Trace destination under ``TPUSERVE_FLIGHT_DIR`` (the model PVC in
+    the manifests) so traces land BESIDE the post-mortem bundles that
+    reference them — or None (capture_profile falls back to a tmpdir)
+    when no flight dir is configured.  Same naming scheme as
+    FlightRecorder.postmortem: reason + pid + uuid, collision-proof for
+    disagg pods and concurrent threads."""
+    import uuid
+    d = os.environ.get("TPUSERVE_FLIGHT_DIR")
+    if not d:
+        return None
+    path = os.path.join(d, f"profile-{reason}-{os.getpid()}"
+                           f"-{uuid.uuid4().hex[:8]}")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def capture_profile_locked(seconds: float, *, reason: str = "manual",
+                           profilers=()) -> dict:
+    """Serialized :func:`capture_profile`: raises :class:`CaptureBusy`
+    instead of stacking a second trace, writes under the flight dir when
+    configured, and records the capture on every engine
+    ``DeviceProfiler`` handle passed in ``profilers`` (so bundles and
+    the tpuserve_profile_captures counter see it)."""
+    if not _capture_lock.acquire(blocking=False):
+        raise CaptureBusy("a profiler capture is already in progress")
+    try:
+        out = capture_profile(seconds, out_dir=profile_out_dir(reason))
+    finally:
+        _capture_lock.release()
+    out["reason"] = reason
+    for dp in profilers:
+        if dp is not None and getattr(dp, "enabled", False):
+            dp.note_capture(out["trace_dir"], reason, out["seconds"])
+    return out
