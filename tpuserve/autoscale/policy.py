@@ -86,6 +86,11 @@ class PoolSignals:
     # count in production, the pool queue length under replay — the
     # scale-from-zero trigger
     pending_demand: int = 0
+    # per-model split of pending_demand (gateway unserved_by_model):
+    # scale-from-zero uses it to pick WHICH catalog model the booting
+    # replica should load warm.  Empty for single-model fleets and old
+    # replay traces — decisions (and their digests) are unchanged then.
+    pending_by_model: dict = dataclasses.field(default_factory=dict)
     # SLO classes the gateway's black-box canary prober currently
     # reports breached (consecutive probe failures past the threshold,
     # tpuserve/obs/canary.py via /gateway/status) — a scale-out
@@ -123,6 +128,15 @@ class PoolSignals:
             if v is not None:
                 vals.append(v)
         return max(vals) if vals else None
+
+    def boot_model(self) -> Optional[str]:
+        """The catalog model scale-from-zero should boot warm: the one
+        with the most unserved demand.  Ties break lexically so replay
+        is deterministic; None when no per-model split was observed."""
+        if not self.pending_by_model:
+            return None
+        return max(sorted(self.pending_by_model),
+                   key=lambda m: self.pending_by_model[m])
 
     def idle(self) -> bool:
         """True when NOTHING is happening pool-wide: no pending demand,
@@ -254,10 +268,17 @@ class AutoscalePolicy:
         # for (every queued second here is raw client TTFT).
         if live == 0 and sig.pending_demand > 0:
             target = max(cfg.min_replicas, 1)
+            reason = (f"scale-from-zero: {sig.pending_demand} pending, "
+                      "0 replicas")
+            # per-model demand (modelpool fleets) names the model the
+            # new replica should boot warm; the suffix only appears
+            # when the split exists, so single-model replay digests
+            # are untouched
+            boot = sig.boot_model()
+            if boot is not None:
+                reason += f", boot model {boot}"
             return self._record(Decision(
-                now, "scale_out", live, target,
-                f"scale-from-zero: {sig.pending_demand} pending, "
-                "0 replicas"))
+                now, "scale_out", live, target, reason))
 
         # scale out: SLI pressure, gated by the scale-out cooldown
         if live < cfg.max_replicas and (

@@ -76,6 +76,8 @@ class KubePool:
         self._unready_since: dict = {}
         self._ready_urls: list = []
         self._unserved_last: Optional[int] = None
+        self._unserved_by_model_last: dict = {}
+        self._pending_by_model: dict = {}
         # replicas whose cold_start_s was already exported (the scalar
         # is stable per pod lifetime; the histogram wants it once)
         self._cold_seen: set = set()
@@ -97,6 +99,7 @@ class KubePool:
         (tpuserve/obs/canary.py) into ``_canary_breached`` for the
         policy's black-box scale-out trigger."""
         self._canary_breached = 0
+        self._pending_by_model = {}
         if not self.gateway_url:
             return 0
         try:
@@ -105,6 +108,8 @@ class KubePool:
                     timeout=2.0) as resp:
                 payload = json.loads(resp.read())
             total = int(payload.get("unserved_total") or 0)
+            by_model = {str(k): int(v) for k, v in
+                        (payload.get("unserved_by_model") or {}).items()}
             self._canary_breached = len(
                 (payload.get("canary") or {}).get("breached_classes")
                 or ())
@@ -112,6 +117,14 @@ class KubePool:
             logger.debug("gateway status scrape failed: %s", e)
             return 0
         prev, self._unserved_last = self._unserved_last, total
+        prev_by, self._unserved_by_model_last = (
+            self._unserved_by_model_last, by_model)
+        # same delta treatment as the total: only demand that arrived
+        # since the last poll steers the boot-model pick
+        self._pending_by_model = {
+            m: d for m, d in
+            ((m, v - prev_by.get(m, 0)) for m, v in by_model.items())
+            if d > 0} if prev is not None else {}
         return max(0, total - prev) if prev is not None else 0
 
     def signals(self) -> PoolSignals:
@@ -169,6 +182,7 @@ class KubePool:
         pending = self._pending_demand()
         return PoolSignals(t=now, replicas=replicas, booting=booting,
                            pending_demand=pending,
+                           pending_by_model=dict(self._pending_by_model),
                            canary_breached=getattr(
                                self, "_canary_breached", 0))
 

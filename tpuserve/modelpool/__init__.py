@@ -1,0 +1,13 @@
+"""Model pool: weight tiering + hot-swap so one fleet serves a catalog.
+
+See :mod:`tpuserve.modelpool.pool` for the swap driver and
+:mod:`tpuserve.modelpool.tiers` for the HBM -> host-DRAM -> PVC weight
+store.  ``TPUSERVE_MODELPOOL=0`` removes the whole layer byte-identically
+(no pool object is constructed)."""
+
+from tpuserve.modelpool.pool import (ModelPool, ModelPoolConfig,
+                                     parse_catalog, pool_enabled)
+from tpuserve.modelpool.tiers import WeightTiers
+
+__all__ = ["ModelPool", "ModelPoolConfig", "WeightTiers", "parse_catalog",
+           "pool_enabled"]

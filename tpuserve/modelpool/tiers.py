@@ -1,0 +1,411 @@
+"""Host-DRAM and PVC spill tiers for model WEIGHT pytrees.
+
+The KV-tier design (runtime/kv_tiers.py) applied to weights, per ROADMAP
+item 2 / DeepServe's serverless density story (arxiv 2501.14417): a
+replica that swaps its served model should not re-read the checkpoint (or
+re-random-init) when the outgoing weights were resident seconds ago.
+This store pages whole param pytrees
+
+- tier ``host``: numpy leaf trees in host DRAM under a byte budget
+  (``TPUSERVE_WEIGHT_HOST_BYTES``) — a restore from here is a
+  host->device copy away from serving;
+- tier ``spill``: streamed leaf-per-file directories on the model PVC
+  (``TPUSERVE_WEIGHT_SPILL_DIR``; provision/manifests.py wires both).
+  Spill WRITES run on a background thread and stream tensor-by-tensor
+  (models/weights.stream_params_to_dir), so a demotion never doubles
+  host RSS and the swap path never blocks on PVC latency; entries are
+  resolvable from memory the moment they enter the write queue.  On
+  init the directory is rescanned, so spilled weights survive pod
+  restarts and a cold-started replica can boot its model warm.
+
+A model lives in EXACTLY ONE tier here (callers hold the HBM-resident
+sets themselves): ``put`` demotes out of HBM, host-budget pressure moves
+the LRU host entry to spill, ``take`` removes the entry as its leaves go
+back to device.  ``prefetch`` promotes spill -> host on a background
+thread — the restore-ahead-of-admission overlap that lets a drain and a
+PVC read run concurrently.
+
+Writers: the pool/engine loop (put/take/drop) and the spill-writer
+thread; shared maps are guarded by one lock held only for dict surgery,
+never for file or device I/O.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import re
+import shutil
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from tpuserve.models.weights import (
+    iter_param_leaves, load_params_from_dir, stream_dir_nbytes,
+    stream_params_to_dir)
+
+logger = logging.getLogger("tpuserve.modelpool.tiers")
+
+# Spill-tier model cap: a backstop against unbounded PVC growth when a
+# catalog churns through models it never reuses (the PVC also holds
+# checkpoints, KV spill files and compile caches).  Oldest dirs are
+# dropped past it — at init-rescan time too.
+DEFAULT_MAX_SPILL_MODELS = 16
+
+
+def tree_host_nbytes(params) -> int:
+    """Host bytes a (numpy) param tree occupies."""
+    return sum(int(np.asarray(a).nbytes)
+               for _, a in iter_param_leaves(params))
+
+
+def _safe_name(name: str) -> str:
+    """Filesystem-safe token for a model name (slashes in HF ids)."""
+    return re.sub(r"[^A-Za-z0-9._-]", "_", name)
+
+
+class WeightTiers:
+    """Model-name-keyed weight pytrees in host DRAM with PVC overflow.
+
+    Values are param pytrees with NUMPY leaves (``put`` pulls jax leaves
+    to host one at a time); ``take`` hands the numpy tree back and the
+    caller re-devices it leaf-by-leaf.
+    """
+
+    def __init__(self, host_bytes: int, spill_dir: str | None = None,
+                 max_spill_models: int = DEFAULT_MAX_SPILL_MODELS):
+        self.host_budget_bytes = int(host_bytes)
+        self.spill_dir = spill_dir
+        self.max_spill_models = max_spill_models
+        # name -> (numpy tree, nbytes); LRU order, oldest first
+        self._host: OrderedDict[str, tuple] = OrderedDict()
+        # spill tier, split by write progress:
+        #   _spill_pending: name -> numpy tree, queued for the writer
+        #   _spill:         name -> (dir path, nbytes), durably on disk
+        self._spill_pending: OrderedDict[str, object] = OrderedDict()
+        self._spill: OrderedDict[str, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+        self._writeq: "queue.Queue[str | None]" = queue.Queue()
+        self._writer: threading.Thread | None = None
+        # name -> in-flight spill->host prefetch thread
+        self._prefetches: dict[str, threading.Thread] = {}
+        self.host_bytes_used = 0
+        # cumulative flow counters (the pool mirrors these into
+        # EngineStats so server/runner.py can export them)
+        self.spilled_models = 0     # host -> PVC demotions (at enqueue)
+        self.dropped_models = 0     # fell off the last tier (weights lost)
+        self.prefetched_models = 0  # spill -> host promotions completed
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._rescan_spill_dir()
+
+    # ---- introspection --------------------------------------------------
+
+    def has(self, name: str) -> bool:
+        return self.where(name) is not None
+
+    def where(self, name: str) -> str | None:
+        with self._lock:
+            if name in self._host:
+                return "host"
+            if name in self._spill or name in self._spill_pending:
+                return "spill"
+        return None
+
+    def names(self) -> dict[str, str]:
+        """name -> tier for everything resolvable (host wins)."""
+        with self._lock:
+            out = {n: "spill" for n in list(self._spill)
+                   + list(self._spill_pending)}
+            out.update({n: "host" for n in self._host})
+        return out
+
+    def bytes_by_tier(self) -> dict[str, int]:
+        with self._lock:
+            spill = sum(nb for _, nb in self._spill.values())
+            spill += sum(tree_host_nbytes(t)
+                         for t in self._spill_pending.values())
+            return {"host": self.host_bytes_used, "spill": spill}
+
+    # ---- spill writer ---------------------------------------------------
+
+    def _spill_path(self, name: str) -> str:
+        return os.path.join(self.spill_dir, f"wt_{_safe_name(name)}")
+
+    def _rescan_spill_dir(self) -> None:
+        """Adopt pre-existing spilled models (pod restart / crashed
+        sibling), oldest-first so cap trimming drops the stalest.  A dir
+        without a complete manifest is a half-written corpse — removed."""
+        try:
+            ents = []
+            for entry in os.listdir(self.spill_dir):
+                if not entry.startswith("wt_"):
+                    continue
+                path = os.path.join(self.spill_dir, entry)
+                if not os.path.isdir(path):
+                    continue
+                nbytes = stream_dir_nbytes(path)
+                meta = self._read_meta(path)
+                if nbytes is None or meta is None:
+                    self._drop_spill_tree(path)
+                    continue
+                try:
+                    ents.append((os.path.getmtime(path), meta, path, nbytes))
+                except OSError:
+                    continue
+            ents.sort(key=lambda e: e[0])
+            for _, _, path, _ in ents[:-self.max_spill_models or None]:
+                self._drop_spill_tree(path)
+            for _, meta, path, nbytes in ents[-self.max_spill_models:]:
+                self._spill[meta] = (path, nbytes)
+            if self._spill:
+                logger.info("adopted %d spilled model(s) from %s: %s",
+                            len(self._spill), self.spill_dir,
+                            sorted(self._spill))
+        except OSError:
+            pass
+
+    @staticmethod
+    def _read_meta(path: str) -> str | None:
+        """The original (un-sanitised) model name, stored beside the
+        streamed leaves so rescans key entries correctly."""
+        try:
+            with open(os.path.join(path, "model.json")) as f:
+                return json.load(f)["model"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _ensure_writer(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            daemon=True,
+                                            name="tpuserve-weight-spill")
+            self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            name = self._writeq.get()
+            try:
+                if name is None:
+                    return
+                with self._lock:
+                    tree = self._spill_pending.get(name)
+                if tree is None:
+                    continue            # taken/dropped before the write
+                ok, nbytes = self._write_spill_tree(name, tree)
+                victims: list[str] = []
+                with self._lock:
+                    if self._spill_pending.pop(name, None) is None:
+                        # taken/dropped DURING the write: orphaned dir
+                        if ok:
+                            victims.append(self._spill_path(name))
+                    elif ok:
+                        self._spill[name] = (self._spill_path(name), nbytes)
+                        while len(self._spill) > self.max_spill_models:
+                            _, (p, _) = self._spill.popitem(last=False)
+                            victims.append(p)
+                            self.dropped_models += 1
+                    else:
+                        self.dropped_models += 1
+                for p in victims:
+                    self._drop_spill_tree(p)
+            finally:
+                self._writeq.task_done()
+
+    def _write_spill_tree(self, name: str, tree) -> tuple[bool, int]:
+        path = self._spill_path(name)
+        try:
+            nbytes = stream_params_to_dir(tree, path)
+            with open(os.path.join(path, "model.json"), "w") as f:
+                json.dump({"model": name}, f)
+            return True, nbytes
+        except OSError as e:
+            logger.warning("weight spill write failed for %s (%s); "
+                           "dropping", name, e)
+            self._drop_spill_tree(path)
+            return False, 0
+
+    def _spill_one(self, name: str, tree) -> bool:
+        """Move one model's tree to the spill tier — resolvable from the
+        pending map immediately; the streamed file writes happen on the
+        writer thread so a swap never blocks on PVC latency."""
+        if not self.spill_dir:
+            return False
+        with self._lock:
+            self._spill_pending[name] = tree
+        self.spilled_models += 1
+        self._ensure_writer()
+        self._writeq.put(name)
+        return True
+
+    @staticmethod
+    def _drop_spill_tree(path: str) -> None:
+        shutil.rmtree(path, ignore_errors=True)
+
+    def flush(self) -> None:
+        """Block until queued spill writes have landed (tests/shutdown)."""
+        self._writeq.join()
+        for t in list(self._prefetches.values()):
+            t.join()
+
+    # ---- demote ---------------------------------------------------------
+
+    def put(self, name: str, params) -> str:
+        """Demote one model's params out of HBM.  Leaves are pulled to
+        host ONE AT A TIME (never a second full-tree copy); host-budget
+        overflow cascades the LRU host entry to the spill tier (or drops
+        it when no spill dir is configured).  Returns the tier the entry
+        landed in ("host"/"spill") or "dropped"."""
+        if self.has(name):              # already demoted (shouldn't happen:
+            self.drop(name)             # HBM held the name until now) —
+            # replace: the caller's tree is the fresher weights
+        tree = self._hostify(params)
+        nbytes = tree_host_nbytes(tree)
+        if nbytes > self.host_budget_bytes:
+            # a single model bigger than the whole host budget goes
+            # straight to spill (stay correct under degenerate budgets)
+            if self._spill_one(name, tree):
+                return "spill"
+            self.dropped_models += 1
+            return "dropped"
+        with self._lock:
+            self._host[name] = (tree, nbytes)
+            self.host_bytes_used += nbytes
+            evict = []
+            while (self.host_bytes_used > self.host_budget_bytes
+                   and self._host):
+                old, (old_tree, old_n) = self._host.popitem(last=False)
+                self.host_bytes_used -= old_n
+                evict.append((old, old_tree))
+        for old, old_tree in evict:
+            if not self._spill_one(old, old_tree):
+                self.dropped_models += 1
+        return "host"
+
+    @staticmethod
+    def _hostify(params):
+        """jax tree -> numpy tree, one leaf at a time (each device leaf
+        is copied to host and the next touched only after — the
+        streaming-demotion contract tests pin by peak RSS)."""
+        def leaf(a):
+            return a if isinstance(a, np.ndarray) else np.asarray(a)
+        import jax
+        return jax.tree_util.tree_map(leaf, params)
+
+    # ---- restore --------------------------------------------------------
+
+    def prefetch(self, name: str) -> bool:
+        """Begin promoting ``name`` from spill to host on a background
+        thread (restore-ahead-of-admission: runs while the engine drains
+        toward its swap window).  No-op unless the entry is spill-only.
+        Returns True when a prefetch is running (or already resolved to
+        host)."""
+        with self._lock:
+            if name in self._host:
+                return True
+            if name in self._spill_pending:
+                return True             # still in memory; take() is cheap
+            if name not in self._spill:
+                return False
+            t = self._prefetches.get(name)
+            if t is not None and t.is_alive():
+                return True
+            t = threading.Thread(target=self._prefetch_one, args=(name,),
+                                 daemon=True,
+                                 name=f"tpuserve-weight-prefetch-{_safe_name(name)}")
+            self._prefetches[name] = t
+        t.start()
+        return True
+
+    def _prefetch_one(self, name: str) -> None:
+        with self._lock:
+            ent = self._spill.get(name)
+        if ent is None:
+            return
+        path, _ = ent
+        try:
+            tree = load_params_from_dir(path)
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("weight prefetch failed for %s (%s)", name, e)
+            return
+        nbytes = tree_host_nbytes(tree)
+        with self._lock:
+            if self._spill.pop(name, None) is None:
+                return                  # taken/dropped while reading
+            self._host[name] = (tree, nbytes)
+            self.host_bytes_used += nbytes
+            self.prefetched_models += 1
+            evict = []
+            while (self.host_bytes_used > self.host_budget_bytes
+                   and len(self._host) > 1):
+                old, (old_tree, old_n) = self._host.popitem(last=False)
+                if old == name:         # never evict what we just fetched
+                    self._host[old] = (old_tree, old_n)
+                    self._host.move_to_end(old, last=False)
+                    break
+                self.host_bytes_used -= old_n
+                evict.append((old, old_tree))
+        self._drop_spill_tree(path)
+        for old, old_tree in evict:
+            if not self._spill_one(old, old_tree):
+                self.dropped_models += 1
+
+    def take(self, name: str) -> tuple | None:
+        """Remove and return ``(numpy tree, source tier)`` for ``name``
+        (the weights are about to become HBM-resident again and a model
+        lives in exactly one tier).  Joins an in-flight prefetch first so
+        overlap work is never duplicated.  None when unresolvable or the
+        spill dir is unreadable (the caller falls back to a cold load)."""
+        t = self._prefetches.pop(name, None)
+        if t is not None and t.is_alive():
+            t.join()
+        with self._lock:
+            ent = self._host.pop(name, None)
+            if ent is not None:
+                self.host_bytes_used -= ent[1]
+                return ent[0], "host"
+            pending = self._spill_pending.pop(name, None)
+            if pending is not None:
+                return pending, "host"  # never hit the PVC: host-speed
+            ent = self._spill.pop(name, None)
+        if ent is None:
+            return None
+        path, _ = ent
+        try:
+            tree = load_params_from_dir(path)
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("weight spill read failed for %s (%s); "
+                           "treating as a miss", name, e)
+            self._drop_spill_tree(path)
+            self.dropped_models += 1    # the weights are LOST — cold load
+            return None
+        self._drop_spill_tree(path)
+        return tree, "spill"
+
+    def drop(self, name: str) -> None:
+        t = self._prefetches.pop(name, None)
+        if t is not None and t.is_alive():
+            t.join()
+        with self._lock:
+            ent = self._host.pop(name, None)
+            if ent is not None:
+                self.host_bytes_used -= ent[1]
+                return
+            if self._spill_pending.pop(name, None) is not None:
+                return                  # writer cleans any half-born dir
+            ent = self._spill.pop(name, None)
+        if ent is not None:
+            self._drop_spill_tree(ent[0])
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spill_pending.clear()
+            paths = [p for p, _ in self._spill.values()]
+            self._spill.clear()
+            self._host.clear()
+            self.host_bytes_used = 0
+        for path in paths:
+            self._drop_spill_tree(path)

@@ -361,6 +361,125 @@ def load_or_init(cfg: ModelConfig, ckpt_dir: str | None, seed: int = 0) -> Param
 
 
 # --------------------------------------------------------------------------
+# Streaming leaf-wise persistence (the weight-tier demotion path)
+# --------------------------------------------------------------------------
+#
+# ``save_orbax`` (and a naive np.savez of the whole tree) materialises a
+# second full host copy of the model while writing — during a model-pool
+# demotion that transiently DOUBLES host RSS exactly when the host tier is
+# under byte pressure.  These helpers stream one tensor at a time: each
+# leaf is pulled to host, written, and released before the next is
+# touched, so peak extra RSS is one leaf, not one model
+# (tpuserve/modelpool/tiers.py is the consumer; tests/test_modelpool.py
+# pins the peak-RSS bound).
+
+_STREAM_MANIFEST = "manifest.json"
+
+
+def _leaf_np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name incl. ml_dtypes extension types (bfloat16
+    leaves round-trip the spill dir as raw bytes + this tag)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def iter_param_leaves(params, prefix: str = ""):
+    """Yield ``(dotted_path, leaf)`` pairs of a params pytree in
+    deterministic depth-first order.  Param trees are pure nests of
+    dict/list/tuple over arrays — integer path components are list
+    indices (``layers.0.q_proj.kernel``)."""
+    if isinstance(params, dict):
+        for k in params:
+            yield from iter_param_leaves(params[k], f"{prefix}{k}.")
+    elif isinstance(params, (list, tuple)):
+        for i, v in enumerate(params):
+            yield from iter_param_leaves(v, f"{prefix}{i}.")
+    elif params is not None:
+        yield prefix[:-1], params
+
+
+def stream_params_to_dir(params, out_dir: str) -> int:
+    """Write a params pytree leaf-by-leaf into ``out_dir``.
+
+    One ``.npy`` file per leaf plus a ``manifest.json`` (written LAST —
+    its presence marks the directory complete; readers treat a
+    manifest-less dir as garbage).  Extension dtypes (bfloat16, int8
+    scales ride as-is) are stored as raw bytes with the dtype tagged in
+    the manifest.  Never holds more than one leaf's host copy beyond the
+    caller's own tree.  Returns the total leaf bytes written."""
+    os.makedirs(out_dir, exist_ok=True)
+    leaves = []
+    total = 0
+    for idx, (path, leaf) in enumerate(iter_param_leaves(params)):
+        a = np.asarray(leaf)            # ONE leaf on host at a time
+        tag = "" if a.dtype.isbuiltin == 1 else str(a.dtype)
+        fname = f"{idx:05d}.npy"
+        ent = {"path": path, "file": fname, "shape": list(a.shape)}
+        if tag:
+            ent["dtype"] = tag
+            a = np.ascontiguousarray(a).view(np.uint8)
+        fpath = os.path.join(out_dir, fname)
+        tmp = f"{fpath}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, a)
+        os.replace(tmp, fpath)          # atomic per leaf
+        total += int(a.nbytes)
+        leaves.append(ent)
+        del a                           # release before the next leaf
+    manifest = {"version": 1, "total_bytes": total, "leaves": leaves}
+    mpath = os.path.join(out_dir, _STREAM_MANIFEST)
+    tmp = f"{mpath}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, mpath)
+    return total
+
+
+def stream_dir_nbytes(in_dir: str) -> int | None:
+    """Leaf bytes recorded in a streamed dir's manifest; None when the
+    dir has no (complete) manifest."""
+    try:
+        with open(os.path.join(in_dir, _STREAM_MANIFEST)) as f:
+            return int(json.load(f)["total_bytes"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def load_params_from_dir(in_dir: str) -> Params:
+    """Rebuild the pytree written by :func:`stream_params_to_dir`.
+
+    Leaves come back as numpy arrays (the caller decides when each goes
+    to device — ``jax.tree.map(jnp.asarray, ...)`` for a full promote).
+    Raises ``FileNotFoundError`` on a manifest-less dir (incomplete
+    write)."""
+    with open(os.path.join(in_dir, _STREAM_MANIFEST)) as f:
+        manifest = json.load(f)
+    root: dict = {}
+    for ent in manifest["leaves"]:
+        a = np.load(os.path.join(in_dir, ent["file"]))
+        tag = ent.get("dtype")
+        if tag:
+            a = a.view(_leaf_np_dtype(tag)).reshape(ent["shape"])
+        parts = ent["path"].split(".")
+        cur = root
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = a
+
+    def _listify(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.isdigit() for k in node):
+            return [_listify(node[str(i)]) for i in range(len(node))]
+        return {k: _listify(v) for k, v in node.items()}
+
+    return _listify(root)
+
+
+# --------------------------------------------------------------------------
 # Weight-only int8 quantization
 # --------------------------------------------------------------------------
 #

@@ -57,6 +57,15 @@ class DeployConfig:
     # Multi-LoRA serving: {adapter_name: path-inside-model-pvc}; forwarded
     # as --lora-modules so requests pick adapters by the "model" field
     lora_modules: Optional[dict] = None
+    # Model pool (tpuserve/modelpool, ISSUE 17): catalog of models one
+    # replica may serve by weight tiering + hot-swap.  A YAML mapping
+    # {name: checkpoint-dir-or-null}, a JSON object string, or a comma
+    # list of names; exported as TPUSERVE_MODEL_CATALOG to the engine
+    # pods.  None/empty = no pool — one-model behaviour byte-identical.
+    model_catalog: Optional[str] = None
+    # Host-DRAM weight tier byte budget for demoted param sets
+    # (TPUSERVE_WEIGHT_HOST_BYTES); 0 = engine default (2 GiB)
+    weight_host_bytes: int = 0
     # Tiered KV cache (runtime/kv_tiers.py): demote evicted prefix KV to
     # host DRAM and from there to a spill dir on the model PVC instead of
     # destroying it; restore asynchronously ahead of admission.  The
@@ -260,6 +269,18 @@ class DeployConfig:
         if self.kv_host_bytes < 0:
             raise ValueError("kv_host_bytes must be >= 0 (0 = engine "
                              "default)")
+        if self.weight_host_bytes < 0:
+            raise ValueError("weight_host_bytes must be >= 0 (0 = "
+                             "engine default)")
+        if self.model_catalog:
+            # deploy-time-parse rule (same as faults/tenants): a typo'd
+            # catalog must fail the deploy, not CrashLoop the pods
+            from tpuserve.modelpool import parse_catalog
+            parse_catalog(self.model_catalog)
+            if self.disaggregated or self.disagg_cross_pod:
+                raise ValueError("model_catalog needs a plain engine "
+                                 "topology (the pool swaps ONE engine; "
+                                 "disagg replicas are two)")
         if self.max_waiting < -1:
             raise ValueError("max_waiting must be >= -1")
         if self.drain_timeout_s < 0:

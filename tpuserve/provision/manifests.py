@@ -239,6 +239,22 @@ def _engine_container(cfg: DeployConfig, *, role: Optional[str] = None,
         import json as _json
         env.append({"name": "TPUSERVE_TENANTS",
                     "value": _json.dumps(cfg.tenants, sort_keys=True)})
+    if cfg.model_catalog:
+        # model pool (tpuserve/modelpool): the replica's catalog, as a
+        # canonical JSON object (deploy-time validated like faults/
+        # tenants).  Weight spill rides the model PVC next to the
+        # compile caches so demoted param sets survive pod restarts.
+        import json as _json
+        from tpuserve.modelpool import parse_catalog
+        env.append({"name": "TPUSERVE_MODEL_CATALOG",
+                    "value": _json.dumps(
+                        parse_catalog(cfg.model_catalog),
+                        sort_keys=True)})
+        env.append({"name": "TPUSERVE_WEIGHT_SPILL_DIR",
+                    "value": "/models/.weight-spill"})
+        if cfg.weight_host_bytes:
+            env.append({"name": "TPUSERVE_WEIGHT_HOST_BYTES",
+                        "value": str(cfg.weight_host_bytes)})
     if cfg.provider != "gke":
         env.append({"name": "JAX_PLATFORMS", "value": "cpu"})
     if cfg.chat_template:

@@ -282,6 +282,15 @@ class EngineStats:
     kv_restored_blocks: int = 0
     kv_restores: int = 0
     restore_latencies: list = dataclasses.field(default_factory=list)
+    # model pool (tpuserve/modelpool/): hot-swaps executed through
+    # Engine.swap_model, keyed by the warmth of the incoming weights
+    # ("resident"/"host"/"spill"/"cold"/"failed" — the outcome label on
+    # tpuserve_model_swaps_total).  swap_latencies holds recent
+    # (outcome, seconds) pairs drained into tpuserve_model_swap_seconds
+    # by server/runner.py; bounded like restore_latencies.
+    model_swaps: int = 0
+    model_swaps_by_outcome: dict = dataclasses.field(default_factory=dict)
+    swap_latencies: list = dataclasses.field(default_factory=list)
     ttft_sum: float = 0.0
     ttft_count: int = 0
     # recent per-token latencies (decode step wall time / batch)
@@ -750,6 +759,74 @@ class Engine:
             (self.cache_cfg.num_blocks - 1) * self.cache_cfg.block_size)
         # seed the devprof HBM watermark once weights + cache exist
         self._note_hbm_budget()
+
+    def swap_model(self, config: EngineConfig, *, params=None,
+                   source_tier: str = "cold"):
+        """Replace the served model in place — the model-pool hot-swap
+        seam (tpuserve/modelpool/pool.py drives it).
+
+        Preconditions: the engine is DRAINED (``has_work()`` False — the
+        runner's idle branch guarantees the window boundary) and single-
+        process/meshless (the lockstep and GSPMD paths don't re-broadcast
+        weights).  The engine re-initialises against ``config`` —
+        ``params`` carries tier-restored weights (warm swap; the module-
+        level transformer jit entries and the persistent XLA cache make
+        the rebuilt executable ladder compile-free for a model served
+        before), None falls through to ``load_or_init`` (cold swap).
+
+        Continuity across the swap: the flight recorder (one timeline
+        per replica, SWAP event emitted here), the device profiler (HBM
+        watermark re-reconciled for the new resident model via
+        ``_note_hbm_budget``), cumulative ``EngineStats`` (metrics
+        counters stay monotonic over the pool's lifetime), and the
+        injected clock (replays swap too).  Returns
+        ``(old_model_name, old_params)`` — the caller owns demoting the
+        outgoing weights through the tiers."""
+        if self.has_work():
+            raise RuntimeError("swap_model needs a drained engine "
+                               "(has_work() is True)")
+        if self._pp > 1 or self.mesh is not None or jax.process_count() > 1:
+            raise ValueError("model hot-swap is single-process, meshless "
+                             "only (weights aren't re-broadcast/re-sharded)")
+        t0 = self.clock.monotonic()
+        old_model, old_params = self.config.model, self.params
+        flight, devprof, stats = self.flight, self.devprof, self.stats
+        self.params = None              # the pool owns the outgoing tree
+        self.__init__(dataclasses.replace(config, clock=self.clock),
+                      params=params)
+        # re-attach the replica-lifetime observability objects the
+        # re-init replaced with fresh ones
+        self.flight = flight
+        self._flight_on = flight.enabled
+        self.scheduler.flight = flight if self._flight_on else None
+        if self._slo is not None:
+            self._slo.flight = flight if self._flight_on else None
+        self.devprof = devprof
+        flight.devprof = devprof if devprof.enabled else None
+        self.stats = stats
+        flight.note_engine_facts(
+            model=config.model,
+            max_num_seqs=self.scheduler.cfg.max_num_seqs,
+            num_blocks=self.cache_cfg.num_blocks,
+            block_size=self.cache_cfg.block_size,
+            max_model_len=self.cache_cfg.max_model_len,
+            mixed_batching=self.scheduler.cfg.mixed_batching,
+            multi_step=config.resolve_multi_step(),
+            slo_classes=bool(self._slo is not None))
+        self._note_hbm_budget()         # HBM watermark per resident model
+        dt = self.clock.monotonic() - t0
+        stats.model_swaps += 1
+        stats.model_swaps_by_outcome[source_tier] = (
+            stats.model_swaps_by_outcome.get(source_tier, 0) + 1)
+        stats.swap_latencies.append((source_tier, dt))
+        del stats.swap_latencies[:-256]
+        if self._flight_on:
+            flight.req_event(f"swap:{old_model}->{config.model}", "SWAP",
+                             source_tier=source_tier,
+                             seconds=round(dt, 4))
+        logger.info("model swap %s -> %s (%s, %.2fs)", old_model,
+                    config.model, source_tier, dt)
+        return old_model, old_params
 
     def _device_hbm_limit(self) -> int:
         """Per-device HBM budget in bytes, after ``hbm_share``.

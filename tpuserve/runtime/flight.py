@@ -67,7 +67,7 @@ FLIGHT_SCHEMA_VERSION = 2
 #: /debug/requests timeline and the OTLP child spans use these verbatim)
 EVENTS = ("QUEUED", "ADMITTED", "RESTORING", "PREFILL", "PREFILL_CHUNK",
           "WINDOW", "PREEMPTED", "SALVAGED", "BROWNOUT_CLAMPED", "SHED",
-          "FAULT", "FINISHED")
+          "FAULT", "SWAP", "FINISHED")
 
 SLI_KINDS = ("ttft", "itl", "e2e")
 

@@ -96,6 +96,14 @@ class Backend:
     # latency) and how close an unhealthy one is to readmission.
     last_probe_latency_s: Optional[float] = None
     probe_failures: int = 0
+    # Model-pool catalog advertisement parsed off /healthz
+    # (tpuserve/modelpool): name -> warmth tag (serving/resident/host/
+    # spill/cold) for every model this backend registers, plus the one
+    # it is serving right now.  Empty for pool-less backends — catalog
+    # routing then ignores them for named-model requests they can't
+    # serve and treats everything else normally.
+    models: dict = dataclasses.field(default_factory=dict)
+    model_current: str = ""
 
 
 @dataclasses.dataclass
@@ -169,8 +177,11 @@ class Gateway:
         self.backends = [Backend(url=u.rstrip("/")) for u in backend_urls]
         # requests that arrived while NO backend existed (pool scaled
         # to zero): the autoscaler reads this off /gateway/status as
-        # its scale-from-zero demand signal
+        # its scale-from-zero demand signal.  The per-model split lets
+        # scale-from-zero pick WHICH model to boot warm
+        # (tpuserve/modelpool + autoscale/signals.py).
         self.unserved_total = 0
+        self.unserved_by_model: dict[str, int] = {}
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._health_thread: Optional[threading.Thread] = None
@@ -332,6 +343,31 @@ class Gateway:
             from tpuserve.server.kv_digest import affinity_key, digest_has
             if payload is _UNSET:
                 payload = self._affinity_payload(body) if body else None
+            # Catalog-aware narrowing (tpuserve/modelpool): a request
+            # naming a model some backend REGISTERS routes within the
+            # warmest subset that holds it — serving/resident beats
+            # host beats spill beats cold, because a cold replica pays a
+            # full weight restore (or 503s under swap_policy=reject)
+            # before the first token.  Load-slack guarded like prefix
+            # affinity: an overloaded warm replica's queueing delay can
+            # exceed what skipping the swap saves.  Backends without the
+            # model in their catalog are excluded once ANY backend
+            # advertises it (they would serve the wrong weights).
+            model = (payload.get("model")
+                     if isinstance(payload, dict) else None)
+            if isinstance(model, str) and model:
+                warmth = {"serving": 0, "resident": 1, "host": 2,
+                          "spill": 3, "cold": 4}
+                known = [(warmth.get(b.models.get(model), 9), b)
+                         for b in pool if model in b.models]
+                if known:
+                    best = min(rank for rank, _ in known)
+                    warm = [b for rank, b in known if rank == best]
+                    warm_least = min(warm, key=lambda b: b.outstanding)
+                    idlest = min(pool, key=lambda b: b.outstanding)
+                    if (warm_least.outstanding - idlest.outstanding
+                            <= self.config.affinity_load_slack):
+                        pool = warm
             chars = self.config.affinity_prefix_chars
             key = (affinity_key(payload, chars)
                    if payload is not None else None)
@@ -411,6 +447,7 @@ class Gateway:
                 if not b.healthy and time.monotonic() < b.backoff_until:
                     continue          # ejected + backing off: don't probe
             digest, digest_bits, digest_chars = None, 0, 0
+            models, model_current = None, ""
             probe_t0 = time.monotonic()
             try:
                 with urllib.request.urlopen(
@@ -425,6 +462,15 @@ class Gateway:
                                               or 0)
                             digest_chars = int(info.get("kv_digest_chars")
                                                or 0)
+                            # model-pool catalog digest: [{"name","tier"}]
+                            cat = info.get("models")
+                            if isinstance(cat, list):
+                                models = {
+                                    str(m["name"]): str(m["tier"])
+                                    for m in cat
+                                    if isinstance(m, dict) and "name" in m}
+                                model_current = str(
+                                    info.get("model_current") or "")
                         except Exception:
                             pass     # plain-liveness backend: no digest
             except Exception:
@@ -453,6 +499,9 @@ class Gateway:
                         b.kv_digest = digest
                         b.kv_digest_bits = digest_bits
                         b.kv_digest_chars = digest_chars
+                    if models is not None:
+                        b.models = models
+                        b.model_current = model_current
                 else:
                     b.healthy = False
                 b.last_checked = time.monotonic()
@@ -515,7 +564,8 @@ class Gateway:
         with self._lock:
             out = {"backends": [dataclasses.asdict(b) for b in self.backends],
                    "affinity": "rendezvous",
-                   "unserved_total": self.unserved_total}
+                   "unserved_total": self.unserved_total,
+                   "unserved_by_model": dict(self.unserved_by_model)}
         if self.tenants is not None:
             out["tenants"] = self.tenants.snapshot()
         if self.canary is not None:
@@ -704,6 +754,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # retryable 503 sized to one boot
                 with ctx._lock:
                     ctx.unserved_total += 1
+                    m = (payload.get("model")
+                         if isinstance(payload, dict) else None)
+                    if isinstance(m, str) and m:
+                        ctx.unserved_by_model[m] = (
+                            ctx.unserved_by_model.get(m, 0) + 1)
                 settle(0)
                 self._send_json_safely(503, json.dumps({"error": {
                     "message": "no backends in the pool (scaled to "

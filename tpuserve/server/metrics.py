@@ -402,6 +402,37 @@ class ServerMetrics:
             "/debug/profile) or by the fast-burn SLO auto-capture hook "
             "— trace dirs land under TPUSERVE_FLIGHT_DIR beside the "
             "post-mortem bundles that reference them")
+        # Model pool (tpuserve/modelpool): weight tiering + hot-swap so
+        # one replica serves a catalog.  TPUSERVE_MODELPOOL=0 (or no
+        # catalog) leaves these families at zero.
+        self.model_swaps = Counter(
+            "tpuserve_model_swaps",
+            "Model hot-swaps executed at engine idle boundaries, by "
+            "outcome= the source tier the incoming weights restored "
+            "from: resident (HBM co-resident — no copy, no XLA), host "
+            "(DRAM restore; warm jit/XLA caches skip compilation), "
+            "spill (PVC restore), cold (full checkpoint load / init)",
+            ["model_name", "outcome"], registry=self.registry)
+        self.model_swap_seconds = histogram(
+            "tpuserve_model_swap_seconds",
+            "Drain-boundary-to-serving wall time of each model hot-swap "
+            "(weight restore + engine rebuild; warm swaps reuse the "
+            "in-process jit cache and the persistent XLA compile cache, "
+            "so they sit orders of magnitude left of cold ones)",
+            _COLD_START_BUCKETS)
+        self.weight_tier_bytes = Gauge(
+            "tpuserve_weight_tier_bytes",
+            "Model/LoRA weight bytes resident per tier= hbm (the "
+            "serving params plus co-resident sets), host (DRAM tier "
+            "under TPUSERVE_WEIGHT_HOST_BYTES), spill (PVC tier) — the "
+            "weight twin of tpuserve_kv_tier_blocks",
+            ["model_name", "tier"], registry=self.registry)
+        self.models_resident = gauge(
+            "tpuserve_models_resident",
+            "Catalog models with weights live in HBM right now (the "
+            "serving model + co-resident sets, <= max_resident) — the "
+            "co-serving occupancy the gateway's catalog routing and "
+            "the per-model scale-from-zero signal key on")
 
     def observe_finish(self, reason: str, duration_s: float) -> None:
         self.request_success.labels(model_name=self.model_name,

@@ -14,6 +14,7 @@ import dataclasses
 import json
 import logging
 import math
+import os
 import threading
 import time
 import uuid
@@ -85,6 +86,25 @@ class ServerConfig:
     # registry defaults.  Validated at boot — a threshold off the
     # pinned bucket edges fails the server, not the alert.
     slo_objectives: Optional[str] = None
+    # Model pool (tpuserve/modelpool): catalog spec — JSON object string
+    # ({"name": "/ckpt/dir", ...}) or comma-separated names; None =
+    # TPUSERVE_MODEL_CATALOG env.  A non-empty catalog (with
+    # TPUSERVE_MODELPOOL != 0) builds a ModelPool: per-request "model"
+    # routes through it, and a registered-but-cold name hot-swaps at the
+    # next idle boundary or answers 503 + Retry-After per swap_policy.
+    model_catalog: Optional[str] = None
+    swap_policy: str = "swap"              # "swap" | "reject"
+    # co-serving knob: how many models' weights may sit in HBM at once
+    max_resident_models: int = 1
+    # host-DRAM weight tier budget; 0 = TPUSERVE_WEIGHT_HOST_BYTES / 2 GiB
+    weight_host_bytes: int = 0
+    # PVC weight spill dir; None = TPUSERVE_WEIGHT_SPILL_DIR (unset: no
+    # spill tier — host-budget overflow means a cold load next time)
+    weight_spill_dir: Optional[str] = None
+    # Retry-After seconds on swap_policy="reject" 503s — longer than the
+    # drain 503's: the client should give the gateway's catalog routing
+    # a beat to steer the retry at a replica already holding the weights
+    swap_retry_after_s: int = 5
 
 
 def _num(body: dict, key: str, default, cast):
@@ -325,6 +345,33 @@ class OpenAIServer:
             self.runner.slo_eval = BurnRateEvaluator(
                 load_objectives(self.config.slo_objectives),
                 clock=self.runner._clock)
+        # Model pool (tpuserve/modelpool): one replica, N registered
+        # models, hot-swap at idle boundaries.  TPUSERVE_MODELPOOL=0 or
+        # an empty catalog means NO pool object exists — every consumer
+        # checks `pool is not None`, so the one-model path is
+        # byte-identical (same pattern as the SLO controller).
+        self.pool = None
+        from tpuserve.modelpool import (ModelPool, ModelPoolConfig,
+                                        parse_catalog, pool_enabled)
+        catalog = parse_catalog(
+            self.config.model_catalog
+            or os.environ.get("TPUSERVE_MODEL_CATALOG"))
+        if catalog and pool_enabled():
+            if not hasattr(engine, "config"):
+                raise ValueError(
+                    "--model-catalog needs a plain single engine; "
+                    "disaggregated/handoff topologies cannot hot-swap")
+            self.pool = ModelPool(engine.config, ModelPoolConfig(
+                catalog=catalog,
+                max_resident=self.config.max_resident_models,
+                swap_policy=self.config.swap_policy,
+                host_bytes=self.config.weight_host_bytes,
+                spill_dir=self.config.weight_spill_dir,
+                retry_after_s=self.config.swap_retry_after_s))
+            self.runner.pool = self.pool
+            logger.info("model pool: catalog=%s max_resident=%d policy=%s",
+                        self.pool.models(), self.config.max_resident_models,
+                        self.config.swap_policy)
         self.tpu_exporter = None
         if self.config.tpu_metrics:
             try:
@@ -588,6 +635,15 @@ class _Handler(BaseHTTPRequestHandler):
             data += [{"id": name, "object": "model", "created": now,
                       "owned_by": "tpuserve", "parent": ctx.model_name}
                      for name in ctx.lora_names]
+            # model-pool catalog entries are selectable too; tier= is
+            # the warmth tag (serving/resident/host/spill/cold) clients
+            # and the gateway can read without a /healthz round-trip
+            if ctx.pool is not None:
+                data += [{"id": name, "object": "model", "created": now,
+                          "owned_by": "tpuserve",
+                          "tier": ctx.pool.tier_of(name)}
+                         for name in ctx.pool.models()
+                         if name != ctx.model_name]
             self._json(200, {"object": "list", "data": data})
         elif self.path.startswith("/v1/models/"):
             # OpenAI retrieve-model: GET /v1/models/{id} (ids may contain
@@ -602,6 +658,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"id": wanted, "object": "model",
                                  "created": now, "owned_by": "tpuserve",
                                  "parent": ctx.model_name})
+            elif ctx.pool is not None and ctx.pool.is_registered(wanted):
+                self._json(200, {"id": wanted, "object": "model",
+                                 "created": now, "owned_by": "tpuserve",
+                                 "tier": ctx.pool.tier_of(wanted)})
             else:
                 self._error(404, f"model {wanted!r} not found",
                             "invalid_request_error")
@@ -695,7 +755,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _debug_engine_payload(self) -> dict:
         recorders = self._flight_recorders()
         if not recorders:
-            return {"enabled": False}
+            out = {"enabled": False}
+            if self.ctx.pool is not None:
+                out["modelpool"] = self.ctx.pool.status()
+            return out
         if len(recorders) == 1:
             out = recorders[0].engine_snapshot()
         else:
@@ -721,6 +784,10 @@ class _Handler(BaseHTTPRequestHandler):
         if caches:
             out["compile_caches"] = (caches[0] if len(caches) == 1
                                      else caches)
+        # model-pool residency + swap bookkeeping (catalog, tier bytes,
+        # pending swap, demand ledger) — the operator's swap console
+        if self.ctx.pool is not None:
+            out["modelpool"] = self.ctx.pool.status()
         return out
 
     def _emit_engine_spans(self, rids) -> None:
@@ -784,6 +851,13 @@ class _Handler(BaseHTTPRequestHandler):
             # digest (kv_digest.py)
             from tpuserve.server.kv_digest import AFFINITY_PREFIX_CHARS
             out["kv_digest_chars"] = AFFINITY_PREFIX_CHARS
+            # model-pool catalog digest: every registered model with its
+            # warmth tag (serving/resident/host/spill/cold) — the
+            # gateway's catalog routing prefers replicas already holding
+            # the requested weights
+            if ctx.pool is not None:
+                out["models"] = ctx.pool.catalog_status()
+                out["model_current"] = ctx.pool.current
         except Exception:       # liveness must never fail on telemetry
             pass
         return out
@@ -922,6 +996,29 @@ class _Handler(BaseHTTPRequestHandler):
         if (isinstance(adapter, str) and adapter != self.ctx.model_name
                 and adapter in (self.ctx.lora_names or ())):
             kwargs["adapter"] = adapter
+        elif ctx.pool is not None and isinstance(adapter, str):
+            # model-pool catalog routing: a registered-but-not-current
+            # name parks for a hot-swap ("swap" policy) or answers a
+            # retryable 503 ("reject" — the gateway's catalog tags steer
+            # the retry at a replica already holding the weights).
+            # Unregistered names keep the alias-compat fall-through
+            # above: they serve whatever is current, exactly as without
+            # a pool.  Note demand either way — it is the per-model
+            # scale-from-zero signal AND kicks spill->host prefetch.
+            verdict = ctx.pool.route(adapter)
+            if verdict in ("swap", "reject"):
+                ctx.pool.note_demand(adapter)
+            if verdict == "swap":
+                kwargs["model"] = adapter
+            elif verdict == "reject":
+                ctx.pool.rejects += 1
+                self._error(503, f"model {adapter!r} is registered but "
+                                 "not resident on this replica; retry "
+                                 "(routing prefers a warm replica)",
+                            "server_error",
+                            headers={"Retry-After": str(
+                                ctx.pool.cfg.retry_after_s)})
+                return
         if body.get("prompt_logprobs") is not None:
             # vLLM extension: per-choice prompt logprobs on the response
             if stream:
@@ -946,6 +1043,14 @@ class _Handler(BaseHTTPRequestHandler):
             # OpenAI prompt scoring: max_tokens=0 + echo + logprobs returns
             # the prompt's own logprobs with no generation (completions
             # only — chat has no echo, so 0 tokens buys nothing there)
+            if "model" in kwargs:
+                # scoring runs synchronously against the live engine —
+                # it cannot park for a hot-swap like generation does
+                self._error(400, "prompt scoring (max_tokens=0) is "
+                                 "served by the currently-resident "
+                                 "model; retry once it is serving "
+                                 f"{kwargs['model']!r}")
+                return
             if (chat or stream or not body.get("echo")
                     or params.logprobs is None or n != 1
                     or body.get("prompt_logprobs") is not None):
@@ -1323,7 +1428,11 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = self.ctx
         # multi-LoRA: echo the ADAPTER id the request selected (vLLM
         # does); mixed-adapter traffic is otherwise unattributable
-        served = kwargs.get("adapter") or ctx.model_name
+        # with a pool, the alias fall-through is served by whatever is
+        # CURRENT (possibly swapped since boot), not the boot-time name
+        served = (kwargs.get("model") or kwargs.get("adapter")
+                  or (ctx.pool.current if ctx.pool is not None
+                      else ctx.model_name))
         t0 = time.monotonic()
         # best_of > n: sample best_of candidates and keep the top n by
         # cumulative logprob (OpenAI completions semantics; vLLM ranking).
@@ -1480,7 +1589,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_response(self, body, params, chat, kwargs, n=1, toolctx=None):
         ctx = self.ctx
-        served = kwargs.get("adapter") or ctx.model_name
+        # with a pool, the alias fall-through is served by whatever is
+        # CURRENT (possibly swapped since boot), not the boot-time name
+        served = (kwargs.get("model") or kwargs.get("adapter")
+                  or (ctx.pool.current if ctx.pool is not None
+                      else ctx.model_name))
         # vLLM-compatible extension: carry each chunk's token ids so
         # clients (and the load harness) can count tokens exactly — chunk
         # count != token count under fused multi-step decode.
@@ -1966,6 +2079,32 @@ def main(argv=None):
                          "objectives.py); inline JSON list or a file "
                          "path (default: TPUSERVE_SLO_OBJECTIVES, else "
                          "the registry defaults).  Validated at boot")
+    ap.add_argument("--model-catalog", default=None, metavar="JSON|LIST",
+                    help="model-pool catalog (tpuserve/modelpool): a JSON "
+                         "object of name -> checkpoint dir, or a comma-"
+                         "separated name list; requests naming a "
+                         "registered model hot-swap the engine at the "
+                         "next idle boundary (default: "
+                         "TPUSERVE_MODEL_CATALOG; TPUSERVE_MODELPOOL=0 "
+                         "disables the pool entirely)")
+    ap.add_argument("--swap-policy", default="swap",
+                    choices=["swap", "reject"],
+                    help="registered-but-cold model requests: 'swap' "
+                         "parks them for a hot-swap, 'reject' answers "
+                         "503 + Retry-After so the gateway retries a "
+                         "replica already holding the weights")
+    ap.add_argument("--max-resident-models", type=int, default=1,
+                    help="co-serving: how many models' weights may stay "
+                         "live in HBM at once (swapping between resident "
+                         "models skips both the weight copy and XLA)")
+    ap.add_argument("--weight-host-bytes", type=int, default=0,
+                    help="host-DRAM weight tier byte budget for demoted "
+                         "models (0 = TPUSERVE_WEIGHT_HOST_BYTES or "
+                         "2 GiB)")
+    ap.add_argument("--weight-spill-dir", default=None, metavar="DIR",
+                    help="PVC spill directory for the third weight tier "
+                         "(default: TPUSERVE_WEIGHT_SPILL_DIR; unset = "
+                         "host overflow means a cold load next time)")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--drain-timeout", type=float, default=25.0,
                     help="graceful-drain budget on SIGTERM, seconds; keep "
@@ -2086,6 +2225,11 @@ def main(argv=None):
         tenant_config=args.tenant_config,
         slo_burn=not args.no_slo_burn,
         slo_objectives=args.slo_objectives,
+        model_catalog=args.model_catalog,
+        swap_policy=args.swap_policy,
+        max_resident_models=args.max_resident_models,
+        weight_host_bytes=args.weight_host_bytes,
+        weight_spill_dir=args.weight_spill_dir,
         allow_kv_migration=args.role == "decode"))
     port = server.start(warmup=not args.no_warmup)
     print(f"tpuserve listening on {args.host}:{port}", flush=True)

@@ -53,6 +53,9 @@ class _Submit:
     # engine aborts the request queue-side (no prefill spent) and the
     # client gets a TimeoutError through the output queue
     deadline: Optional[float] = None
+    # model-pool routing (tpuserve/modelpool): a registered-but-not-
+    # current model name parks the submit until the pool swaps to it
+    model: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -158,6 +161,12 @@ class AsyncEngineRunner:
         # wall-clock cooldown stamp so a flapping page takes ONE
         # jax.profiler trace per window, not one per transition
         self._auto_capture_last: Optional[float] = None
+        # Model pool (tpuserve/modelpool): set by the server when a
+        # catalog is configured and TPUSERVE_MODELPOOL isn't 0.  Submits
+        # naming a registered-but-not-current model park here until the
+        # pool hot-swaps at an idle boundary (_maybe_swap_pool).
+        self.pool = None
+        self._parked: list[_Submit] = []
 
     # ---- lifecycle -----------------------------------------------------
 
@@ -180,8 +189,10 @@ class AsyncEngineRunner:
             busy = False
         # _intake matters too: a request accepted by the handler just
         # before draining flipped may still sit queued for the engine
-        # loop — stopping now would silently drop it
-        return not busy and not self._out_queues and self._intake.empty()
+        # loop — stopping now would silently drop it; same for submits
+        # parked behind a pending model swap
+        return (not busy and not self._out_queues and self._intake.empty()
+                and not self._parked)
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -197,16 +208,19 @@ class AsyncEngineRunner:
                request_id: Optional[str] = None,
                adapter: Optional[str] = None,
                deadline: Optional[float] = None,
+               model: Optional[str] = None,
                ) -> tuple[str, "queue.Queue[RequestOutput | Exception | None]"]:
         """Enqueue a request; returns (request_id, output queue).  The queue
         yields RequestOutput items, then None when finished; an Exception
-        item signals a rejected request."""
+        item signals a rejected request.  ``model`` routes through the
+        model pool: a registered-but-not-current name parks the request
+        until the engine hot-swaps to it."""
         sub = _Submit(prompt=prompt,
                       prompt_token_ids=list(prompt_token_ids) if prompt_token_ids else None,
                       params=params or SamplingParams(),
                       out_queue=queue.Queue(), rid_event=threading.Event(),
                       request_id=request_id, adapter=adapter,
-                      deadline=deadline)
+                      deadline=deadline, model=model)
         self._intake.put(sub)
         self._wake.set()
         sub.rid_event.wait(timeout=60)
@@ -291,6 +305,26 @@ class AsyncEngineRunner:
                     self.metrics.request_total.inc()
                     self.metrics.prompt_tokens.inc(len(m["prompt_token_ids"]))
                 msg.rid_event.set()
+                continue
+            if (msg.model and self.pool is not None
+                    and msg.model != self.pool.current):
+                # Model-pool routing: a registered foreign model parks
+                # until the pool swaps at the next idle boundary
+                # (_maybe_swap_pool re-injects it); demand is noted so
+                # spill->host prefetch warms the target WHILE the engine
+                # drains, and so the autoscaler's per-model signal sees
+                # it.  The API edge 404s unknown names first; this is
+                # the belt-and-braces typed rejection.
+                if self.pool.is_registered(msg.model):
+                    self.pool.note_demand(msg.model)
+                    self.pool.request_swap(msg.model)
+                    self._parked.append(msg)
+                    continue
+                msg.assigned_id = msg.request_id or "rejected"
+                msg.rid_event.set()
+                msg.out_queue.put(ValueError(
+                    f"model {msg.model!r} is not in this replica's catalog"))
+                msg.out_queue.put(None)
                 continue
             try:
                 kw = {"adapter": msg.adapter} if msg.adapter else {}
@@ -848,6 +882,55 @@ class AsyncEngineRunner:
         threading.Thread(target=_run, daemon=True,
                          name="tpuserve-auto-capture").start()
 
+    def _maybe_swap_pool(self) -> None:
+        """Model-pool hot-swap at the idle boundary (loop thread only).
+        The engine having no work IS the drain-to-window-boundary
+        precondition; the pool then demotes the outgoing weights through
+        the tiers, restores the incoming set from the warmest tier, and
+        parked submits for the new model re-enter intake."""
+        pool = self.pool
+        if pool is None:
+            return
+        # expire parked submits whose admission deadline passed while
+        # waiting for the swap — same typed 504 as queue-side expiry
+        if self._parked:
+            still = []
+            # tpulint: sync-ok(admission deadlines are client wall-clock contracts)
+            now = time.monotonic()
+            for msg in self._parked:
+                if msg.deadline is not None and now > msg.deadline:
+                    msg.assigned_id = msg.request_id or "rejected"
+                    msg.rid_event.set()
+                    msg.out_queue.put(TimeoutError(
+                        "admission deadline expired while parked for a "
+                        f"model swap to {msg.model!r}"))
+                    msg.out_queue.put(None)
+                else:
+                    still.append(msg)
+            self._parked = still
+        if pool.pending is None:
+            if not self._parked:
+                return
+            # multiple target models can park at once; the single-slot
+            # pending may have been consumed by an earlier swap — re-aim
+            # at the oldest still-parked model
+            pool.request_swap(self._parked[0].model)
+        if self.engine.has_work():
+            return
+        outcome = pool.maybe_swap(self.engine)
+        if outcome is None:
+            return
+        logger.info("model swap -> %s (source tier: %s)",
+                    pool.current, outcome)
+        still = []
+        for msg in self._parked:
+            if msg.model == pool.current:
+                self._intake.put(msg)
+            else:
+                still.append(msg)
+        self._parked = still
+        self._wake.set()
+
     def _update_gauges(self) -> None:
         self._evaluate_slo()
         if not self.metrics:
@@ -1015,12 +1098,56 @@ class AsyncEngineRunner:
                 sum(len(dp.ladder) for dp in profs))
             _advance_counter(self.metrics.profile_captures,
                              sum(dp.captures_total for dp in profs))
+        # Model pool (tpuserve/modelpool): swap totals/latency come off
+        # the engine stats (carried across swap_model rebuilds, so the
+        # counters stay monotonic); tier residency off the pool's weight
+        # store.  No pool -> the families stay at zero.
+        pool = self.pool
+        if pool is not None:
+            swaps_by: dict = {}
+            for s in stats_objs:
+                for outcome, n in getattr(s, "model_swaps_by_outcome",
+                                          {}).items():
+                    swaps_by[outcome] = swaps_by.get(outcome, 0) + n
+            for outcome, n in swaps_by.items():
+                _advance_counter(
+                    self.metrics.model_swaps.labels(outcome=outcome,
+                                                    **label), n)
+            for s in stats_objs:
+                lats = getattr(s, "swap_latencies", None)
+                if lats:
+                    for _tier, dt in lats:
+                        self.metrics.model_swap_seconds.observe(dt)
+                    lats.clear()
+            # hbm = the serving params + co-resident sets; the serving
+            # share is cached per current model (tree walks every 50ms
+            # idle tick would be wasteful on big param trees)
+            cached = getattr(self, "_pool_hbm_cache", None)
+            if cached is None or cached[0] != pool.current:
+                from tpuserve.models.weights import param_nbytes
+                serving = sum(
+                    param_nbytes(e.params)
+                    for e in (inners or [eng])
+                    if getattr(e, "params", None) is not None)
+                cached = (pool.current, serving)
+                self._pool_hbm_cache = cached
+            tiers = pool.tiers.bytes_by_tier()
+            self.metrics.weight_tier_bytes.labels(tier="hbm", **label).set(
+                cached[1] + pool.resident_nbytes())
+            self.metrics.weight_tier_bytes.labels(tier="host", **label).set(
+                tiers.get("host", 0))
+            self.metrics.weight_tier_bytes.labels(tier="spill", **label).set(
+                tiers.get("spill", 0))
+            self.metrics.models_resident.set(sum(
+                1 for entry in pool.catalog_status()
+                if entry["tier"] in ("serving", "resident")))
 
     def _loop(self) -> None:
         logger.info("engine loop started")
         while not self._stop.is_set():
             self._drain_intake()
             if not self.engine.has_work():
+                self._maybe_swap_pool()
                 self._update_gauges()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
